@@ -1,0 +1,122 @@
+"""Runtime configuration.
+
+The TPU-native analogue of FFConfig (reference: include/flexflow/config.h:92-157,
+src/runtime/model.cc:3371 parse_args): every knob of the training run,
+the search, and the cost model, parseable from argv with the reference's
+flag spellings so existing launch scripts translate directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineSpec
+
+
+@dataclass
+class IterationConfig:
+    """Per-iteration knobs threaded into forward/backward
+    (reference: config.h:159-164 FFIterationConfig.seq_length)."""
+
+    seq_length: int = -1
+
+
+@dataclass
+class FFConfig:
+    # training
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    # machine
+    num_devices: int = 0  # 0 = all visible jax devices
+    machine_spec: Optional[MachineSpec] = None
+    machine_model_file: Optional[str] = None
+    # parallelization search (reference: config.h:116-157)
+    search_budget: int = 128
+    search_alpha: float = 1.05
+    only_data_parallel: bool = False
+    enable_parameter_parallel: bool = True
+    enable_attribute_parallel: bool = True
+    enable_inplace_optimizations: bool = True
+    search_num_devices: int = 0  # override devices for search (search a big
+    # strategy on a small machine, reference: graph.cc:1535-1540)
+    base_optimize_threshold: int = 10
+    substitution_json: Optional[str] = None
+    export_strategy_file: Optional[str] = None
+    import_strategy_file: Optional[str] = None
+    export_strategy_computation_graph_file: Optional[str] = None
+    # numerics
+    compute_dtype: str = "bfloat16"  # matmul dtype on TPU
+    param_dtype: str = "float32"
+    # execution
+    profiling: bool = False
+    perform_fusion: bool = True
+    seed: int = 0
+    iteration: IterationConfig = field(default_factory=IterationConfig)
+
+    def __post_init__(self):
+        if self.num_devices == 0:
+            try:
+                import jax
+
+                self.num_devices = len(jax.devices())
+            except Exception:
+                self.num_devices = 1
+        if self.machine_spec is None:
+            if self.machine_model_file:
+                self.machine_spec = MachineSpec.from_file(self.machine_model_file)
+            else:
+                self.machine_spec = MachineSpec.tpu_v5e(self.num_devices)
+
+    @property
+    def search_devices(self) -> int:
+        return self.search_num_devices or self.num_devices
+
+    # ---- argv parsing ----------------------------------------------------
+    @staticmethod
+    def parse_args(argv: Optional[Sequence[str]] = None) -> "FFConfig":
+        """Accepts the reference's flag spellings
+        (reference: model.cc:3371-3654, README.md:79-102)."""
+        p = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
+        p.add_argument("-e", "--epochs", type=int, default=1)
+        p.add_argument("-b", "--batch-size", type=int, default=64)
+        p.add_argument("--lr", "--learning-rate", dest="lr", type=float, default=0.01)
+        p.add_argument("--wd", "--weight-decay", dest="wd", type=float, default=1e-4)
+        p.add_argument("-ll:tpu", "--num-devices", dest="num_devices", type=int, default=0)
+        p.add_argument("--budget", "--search-budget", dest="budget", type=int, default=128)
+        p.add_argument("--alpha", "--search-alpha", dest="alpha", type=float, default=1.05)
+        p.add_argument("--only-data-parallel", action="store_true")
+        p.add_argument("--enable-parameter-parallel", action="store_true", default=True)
+        p.add_argument("--enable-attribute-parallel", action="store_true", default=True)
+        p.add_argument("--search-num-nodes", type=int, default=0)
+        p.add_argument("--search-num-workers", type=int, default=0)
+        p.add_argument("--base-optimize-threshold", type=int, default=10)
+        p.add_argument("--substitution-json", type=str, default=None)
+        p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
+        p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
+        p.add_argument("--machine-model-file", type=str, default=None)
+        p.add_argument("--profiling", action="store_true")
+        p.add_argument("--seed", type=int, default=0)
+        args, _ = p.parse_known_args(argv)
+        search_devs = args.search_num_workers * max(1, args.search_num_nodes or 1)
+        return FFConfig(
+            epochs=args.epochs,
+            batch_size=args.batch_size,
+            learning_rate=args.lr,
+            weight_decay=args.wd,
+            num_devices=args.num_devices,
+            search_budget=args.budget,
+            search_alpha=args.alpha,
+            only_data_parallel=args.only_data_parallel,
+            search_num_devices=search_devs,
+            base_optimize_threshold=args.base_optimize_threshold,
+            substitution_json=args.substitution_json,
+            export_strategy_file=args.export_strategy,
+            import_strategy_file=args.import_strategy,
+            machine_model_file=args.machine_model_file,
+            profiling=args.profiling,
+            seed=args.seed,
+        )

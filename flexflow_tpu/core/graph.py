@@ -1,0 +1,322 @@
+"""The Parallel Computation Graph (PCG).
+
+A DAG of operator nodes connected by tensor edges — the IR that the
+auto-parallelization search rewrites and costs.  Re-implements the
+capabilities of the reference's PCG (reference: src/runtime/graph.cc:299-362,
+include/flexflow/graph.h:240, dominators.h) in pure Python with no
+runtime coupling: nodes hold immutable operator descriptors, and
+parallelization strategies live *outside* the graph as
+``{node_guid: MachineView}`` maps, so one graph can be costed under
+many strategies without copying.
+
+Provides the graph algorithms the search needs: topological order,
+dominators/post-dominators, bottleneck (articulation) node finding
+(reference: graph.cc:580), sequence/horizontal splits
+(reference: graph.cc:96-295), structural hashing for memoization
+(reference: graph.cc:1356), and graphviz export.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Edge:
+    """Tensor edge: output ``src_idx`` of ``src`` feeds input ``dst_idx`` of ``dst``."""
+
+    src: int  # node guid
+    dst: int  # node guid
+    src_idx: int = 0
+    dst_idx: int = 0
+
+
+class Node:
+    """A PCG node: guid + operator descriptor.
+
+    ``op`` is any object exposing ``op_type``, ``name``,
+    ``output_shapes`` and a stable ``signature()`` used for structural
+    hashing (operators from flexflow_tpu.ops satisfy this).
+    """
+
+    __slots__ = ("guid", "op")
+
+    def __init__(self, guid: int, op):
+        self.guid = guid
+        self.op = op
+
+    def __repr__(self) -> str:
+        return f"Node({self.guid}, {getattr(self.op, 'name', self.op)})"
+
+
+class Graph:
+    """Directed multigraph of operator nodes (the PCG)."""
+
+    def __init__(self):
+        self.nodes: Dict[int, Node] = {}
+        self.in_edges: Dict[int, List[Edge]] = {}
+        self.out_edges: Dict[int, List[Edge]] = {}
+        self._next_guid = 1
+
+    # ---- construction ----------------------------------------------------
+    def new_node(self, op) -> Node:
+        node = Node(self._next_guid, op)
+        self._next_guid += 1
+        self.add_node(node)
+        return node
+
+    def add_node(self, node: Node) -> None:
+        if node.guid in self.nodes:
+            return
+        self.nodes[node.guid] = node
+        self.in_edges.setdefault(node.guid, [])
+        self.out_edges.setdefault(node.guid, [])
+        self._next_guid = max(self._next_guid, node.guid + 1)
+
+    def add_edge(self, src: Node, dst: Node, src_idx: int = 0, dst_idx: int = 0) -> None:
+        self.add_node(src)
+        self.add_node(dst)
+        e = Edge(src.guid, dst.guid, src_idx, dst_idx)
+        self.out_edges[src.guid].append(e)
+        self.in_edges[dst.guid].append(e)
+
+    def remove_node(self, guid: int) -> None:
+        for e in list(self.in_edges.get(guid, [])):
+            self.out_edges[e.src].remove(e)
+        for e in list(self.out_edges.get(guid, [])):
+            self.in_edges[e.dst].remove(e)
+        self.in_edges.pop(guid, None)
+        self.out_edges.pop(guid, None)
+        self.nodes.pop(guid, None)
+
+    def copy(self) -> "Graph":
+        g = Graph()
+        g._next_guid = self._next_guid
+        for guid, n in self.nodes.items():
+            g.nodes[guid] = n  # nodes are immutable (op descriptors shared)
+            g.in_edges[guid] = list(self.in_edges[guid])
+            g.out_edges[guid] = list(self.out_edges[guid])
+        return g
+
+    # ---- queries ---------------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def num_edges(self) -> int:
+        return sum(len(v) for v in self.out_edges.values())
+
+    def sources(self) -> List[Node]:
+        return [self.nodes[g] for g in self.nodes if not self.in_edges[g]]
+
+    def sinks(self) -> List[Node]:
+        return [self.nodes[g] for g in self.nodes if not self.out_edges[g]]
+
+    def predecessors(self, guid: int) -> List[int]:
+        seen, out = set(), []
+        for e in self.in_edges[guid]:
+            if e.src not in seen:
+                seen.add(e.src)
+                out.append(e.src)
+        return out
+
+    def successors(self, guid: int) -> List[int]:
+        seen, out = set(), []
+        for e in self.out_edges[guid]:
+            if e.dst not in seen:
+                seen.add(e.dst)
+                out.append(e.dst)
+        return out
+
+    def topo_order(self) -> List[Node]:
+        """Deterministic Kahn topological order (ties by guid)."""
+        indeg = {g: len(set((e.src, e.src_idx, e.dst_idx) for e in self.in_edges[g]))
+                 for g in self.nodes}
+        # count parallel edges properly: use raw counts
+        indeg = {g: len(self.in_edges[g]) for g in self.nodes}
+        ready = sorted(g for g, d in indeg.items() if d == 0)
+        order: List[Node] = []
+        import heapq
+
+        heapq.heapify(ready)
+        while ready:
+            g = heapq.heappop(ready)
+            order.append(self.nodes[g])
+            for e in self.out_edges[g]:
+                indeg[e.dst] -= 1
+                if indeg[e.dst] == 0:
+                    heapq.heappush(ready, e.dst)
+        if len(order) != len(self.nodes):
+            raise ValueError("graph has a cycle")
+        return order
+
+    # ---- structural hash (memoization key) -------------------------------
+    def hash(self) -> int:
+        """Structure-and-op hash, stable across guid renumbering.
+
+        Iteratively refines per-node hashes from op signatures and
+        predecessor hashes — same role as the reference's graph hash
+        used to memoize DP states (reference: src/runtime/graph.cc:1356).
+        """
+        h: Dict[int, int] = {}
+        for node in self.topo_order():
+            sig = repr(node.op.signature()) if hasattr(node.op, "signature") else repr(node.op)
+            ins = sorted(
+                (h[e.src], e.src_idx, e.dst_idx) for e in self.in_edges[node.guid]
+            )
+            payload = (sig + "|" + repr(ins)).encode()
+            h[node.guid] = int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
+        sinks = sorted(h[n.guid] for n in self.sinks())
+        payload = repr(sinks).encode()
+        return int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
+
+    # ---- dominators & bottlenecks ----------------------------------------
+    def dominators(self) -> Dict[int, Set[int]]:
+        """dom(v) = set of nodes on every path from any source to v
+        (multi-source DAG variant, reference: include/flexflow/dominators.h)."""
+        dom: Dict[int, Set[int]] = {}
+        for node in self.topo_order():
+            preds = self.predecessors(node.guid)
+            if not preds:
+                dom[node.guid] = {node.guid}
+            else:
+                inter = set(dom[preds[0]])
+                for p in preds[1:]:
+                    inter &= dom[p]
+                inter.add(node.guid)
+                dom[node.guid] = inter
+        return dom
+
+    def post_dominators(self) -> Dict[int, Set[int]]:
+        return self.reversed().dominators()
+
+    def reversed(self) -> "Graph":
+        g = Graph()
+        g._next_guid = self._next_guid
+        for guid, n in self.nodes.items():
+            g.nodes[guid] = n
+            g.in_edges[guid] = [Edge(e.dst, e.src, e.src_idx, e.dst_idx) for e in self.out_edges[guid]]
+            g.out_edges[guid] = [Edge(e.dst, e.src, e.src_idx, e.dst_idx) for e in self.in_edges[guid]]
+        return g
+
+    def bottlenecks(self) -> List[Node]:
+        """Nodes through which *every* source→sink path passes, in topo
+        order, excluding sources/sinks — the sequence-split candidates
+        (reference: src/runtime/graph.cc:580 find_bottleneck_node)."""
+        if not self.nodes:
+            return []
+        sink_guids = [n.guid for n in self.sinks()]
+        src_guids = {n.guid for n in self.sources()}
+        dom = self.dominators()
+        pdom = self.post_dominators()
+        common_dom = None
+        for s in sink_guids:
+            common_dom = set(dom[s]) if common_dom is None else common_dom & dom[s]
+        common_pdom = None
+        for s in src_guids:
+            common_pdom = set(pdom[s]) if common_pdom is None else common_pdom & pdom[s]
+        cands = (common_dom or set()) & (common_pdom or set())
+        cands -= src_guids
+        cands -= set(sink_guids)
+        order = {n.guid: i for i, n in enumerate(self.topo_order())}
+        return [self.nodes[g] for g in sorted(cands, key=lambda g: order[g])]
+
+    # ---- splits (used by DP search) --------------------------------------
+    def split_at_node(self, node: Node) -> Tuple["Graph", "Graph"]:
+        """Sequence split: (prefix including ``node``, suffix with ``node``
+        as its source) — reference: src/runtime/graph.cc:96-159."""
+        order = self.topo_order()
+        idx = {n.guid: i for i, n in enumerate(order)}
+        pivot = idx[node.guid]
+        first, second = Graph(), Graph()
+        first._next_guid = second._next_guid = self._next_guid
+        pre_guids = {n.guid for n in order[: pivot + 1]}
+        for guid, n in self.nodes.items():
+            if guid in pre_guids:
+                first.add_node(n)
+            if guid not in pre_guids or guid == node.guid:
+                second.add_node(n)
+        for guid in self.nodes:
+            for e in self.out_edges[guid]:
+                s_pre, d_pre = e.src in pre_guids, e.dst in pre_guids
+                if s_pre and d_pre:
+                    first.out_edges[e.src].append(e)
+                    first.in_edges[e.dst].append(e)
+                elif not s_pre and not d_pre:
+                    second.out_edges[e.src].append(e)
+                    second.in_edges[e.dst].append(e)
+                elif e.src == node.guid and not d_pre:
+                    second.out_edges[e.src].append(e)
+                    second.in_edges[e.dst].append(e)
+                else:
+                    # crossing edge not through the bottleneck: caller must
+                    # only split at true bottlenecks
+                    raise ValueError(f"split_at_node: edge {e} crosses the split")
+        return first, second
+
+    def split_horizontal(self) -> Optional[Tuple["Graph", "Graph"]]:
+        """Partition into two independent (vertex-disjoint, no crossing
+        edges) subgraphs if the PCG is disconnected between them —
+        reference: src/runtime/graph.cc:161-295 nonsequence split."""
+        comps = self.weakly_connected_components()
+        if len(comps) < 2:
+            return None
+        half = len(comps) // 2
+        a_guids = set().union(*comps[:half])
+        return self._subgraph(a_guids), self._subgraph(
+            set(self.nodes) - a_guids
+        )
+
+    def weakly_connected_components(self) -> List[Set[int]]:
+        parent = {g: g for g in self.nodes}
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        for guid in self.nodes:
+            for e in self.out_edges[guid]:
+                ra, rb = find(e.src), find(e.dst)
+                if ra != rb:
+                    parent[ra] = rb
+        comps: Dict[int, Set[int]] = {}
+        for g in self.nodes:
+            comps.setdefault(find(g), set()).add(g)
+        return [comps[k] for k in sorted(comps)]
+
+    def _subgraph(self, guids: Set[int]) -> "Graph":
+        g = Graph()
+        g._next_guid = self._next_guid
+        for guid in guids:
+            g.add_node(self.nodes[guid])
+        for guid in guids:
+            for e in self.out_edges[guid]:
+                if e.dst in guids:
+                    g.out_edges[e.src].append(e)
+                    g.in_edges[e.dst].append(e)
+        return g
+
+    # ---- export ----------------------------------------------------------
+    def to_dot(self, strategy: Optional[Dict[int, object]] = None) -> str:
+        """Graphviz export (reference: substitution.cc:1790
+        export_strategy_computation_graph_file)."""
+        lines = ["digraph PCG {", "  rankdir=TB;"]
+        for guid, n in sorted(self.nodes.items()):
+            label = getattr(n.op, "name", str(n.op))
+            if strategy and guid in strategy:
+                label += f"\\n{strategy[guid]}"
+            lines.append(f'  n{guid} [label="{label}" shape=box];')
+        for guid in sorted(self.nodes):
+            for e in self.out_edges[guid]:
+                lines.append(f"  n{e.src} -> n{e.dst};")
+        lines.append("}")
+        return "\n".join(lines)
+
+    def write_dot(self, path: str, strategy=None) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_dot(strategy))

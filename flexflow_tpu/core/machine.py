@@ -1,0 +1,149 @@
+"""Machine description and per-op parallelization descriptors.
+
+Replaces the reference's MachineView/MachineResource/ParallelConfig
+triple (reference: include/flexflow/machine_view.h:14-87) with TPU-mesh
+concepts:
+
+* ``MachineSpec`` — the hardware: chip count, per-chip peak FLOPs and
+  HBM bandwidth, ICI link bandwidth/latency and torus shape, DCN
+  bandwidth/latency for multi-slice.  Parameterizes the cost model the
+  way MachineModel does in the reference
+  (reference: src/runtime/machine_model.cc:57-68, machine_config_example:1-40).
+* ``MachineView`` — a per-op parallelization: partition degree for each
+  output dim plus a replica degree.  Where the reference's MachineView
+  is a strided box of physical device ids decoded by the Legion mapper
+  (reference: src/mapper/mapper.cc:371-475), here device placement is
+  delegated to XLA: degrees are canonically factored onto named mesh
+  axes (see flexflow_tpu.parallel.mesh.assign_axes) and GSPMD places
+  the shards.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class MachineSpec:
+    """Hardware description used for cost modeling and mesh construction.
+
+    Bandwidths are bytes/second, latencies seconds, flops are
+    peak per-chip FLOP/s at the matmul dtype (bf16 on TPU).
+    """
+
+    num_devices: int = 1
+    devices_per_host: int = 8
+    peak_flops: float = 1.97e14  # TPU v5e bf16 MXU peak
+    hbm_bandwidth: float = 8.1e11  # bytes/s
+    hbm_capacity: float = 16e9  # bytes
+    vmem_capacity: float = 128e6  # bytes (~VMEM per core)
+    ici_bandwidth: float = 4.5e10  # bytes/s per link per direction
+    ici_latency: float = 1e-6  # seconds per hop
+    ici_torus: Tuple[int, ...] = ()  # physical torus shape, () = derive
+    dcn_bandwidth: float = 3.125e9  # bytes/s per host (25 Gbps)
+    dcn_latency: float = 10e-6
+    name: str = "tpu_v5e"
+
+    # ---- constructors ----------------------------------------------------
+    @staticmethod
+    def tpu_v5e(num_devices: int = 8) -> "MachineSpec":
+        side = int(math.isqrt(num_devices))
+        torus = (side, num_devices // side) if side * (num_devices // side) == num_devices else (num_devices,)
+        return MachineSpec(num_devices=num_devices, ici_torus=torus)
+
+    @staticmethod
+    def tpu_v5p(num_devices: int = 8) -> "MachineSpec":
+        return MachineSpec(
+            num_devices=num_devices,
+            peak_flops=4.59e14,
+            hbm_bandwidth=2.765e12,
+            hbm_capacity=95e9,
+            ici_bandwidth=9e10,
+            name="tpu_v5p",
+        )
+
+    @staticmethod
+    def host_cpu(num_devices: int = 8) -> "MachineSpec":
+        """Virtual-device CPU machine for tests (same role as the
+        reference's --search-num-workers override, graph.cc:1535-1540)."""
+        return MachineSpec(
+            num_devices=num_devices,
+            peak_flops=1e11,
+            hbm_bandwidth=5e10,
+            ici_bandwidth=1e10,
+            name="host_cpu",
+        )
+
+    @staticmethod
+    def from_file(path: str) -> "MachineSpec":
+        """Load from a JSON machine-config file — the TPU analogue of
+        the reference's EnhancedMachineModel config
+        (reference: machine_config_example:1-40, --machine-model-file)."""
+        with open(path) as f:
+            cfg = json.load(f)
+        if "ici_torus" in cfg:
+            cfg["ici_torus"] = tuple(cfg["ici_torus"])
+        return MachineSpec(**cfg)
+
+    def to_file(self, path: str) -> None:
+        d = {k: getattr(self, k) for k in self.__dataclass_fields__}
+        d["ici_torus"] = list(d["ici_torus"])
+        with open(path, "w") as f:
+            json.dump(d, f, indent=2)
+
+    # ---- queries ---------------------------------------------------------
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_devices // self.devices_per_host)
+
+    def matmul_time(self, flops: float) -> float:
+        return flops / self.peak_flops
+
+    def hbm_time(self, num_bytes: float) -> float:
+        return num_bytes / self.hbm_bandwidth
+
+
+@dataclass(frozen=True)
+class MachineView:
+    """Parallelization of one operator: degree per output dim + replicas.
+
+    ``dim_degrees[i]`` partitions output dim i into that many shards;
+    ``replica_degree`` replicates the op's output (data-parallel
+    weights / partial-sum inputs use this slot).  Total parts =
+    product, must divide the machine's device count — the same divisor
+    rule the reference uses when registering candidate views
+    (reference: src/runtime/graph.cc:1778-1810).
+    """
+
+    dim_degrees: Tuple[int, ...]
+    replica_degree: int = 1
+
+    @property
+    def num_parts(self) -> int:
+        p = self.replica_degree
+        for d in self.dim_degrees:
+            p *= d
+        return p
+
+    @property
+    def is_trivial(self) -> bool:
+        return self.num_parts == 1
+
+    def __str__(self) -> str:
+        s = "x".join(str(d) for d in self.dim_degrees)
+        if self.replica_degree > 1:
+            s += f"*R{self.replica_degree}"
+        return f"MV[{s}]"
+
+    @staticmethod
+    def trivial(ndim: int) -> "MachineView":
+        return MachineView(dim_degrees=(1,) * ndim)
+
+    @staticmethod
+    def data_parallel(ndim: int, degree: int, batch_dim: int = 0) -> "MachineView":
+        dims = [1] * ndim
+        dims[batch_dim] = degree
+        return MachineView(dim_degrees=tuple(dims))

@@ -1,0 +1,286 @@
+"""PCG → XLA lowering.
+
+The TPU counterpart of the entire execution half of the reference
+(FFModel::compile region mapping model.cc:2703-2836 + per-op Legion
+index launches + Legion tracing): the whole training iteration becomes
+ONE jitted SPMD program over the global mesh.  Per-op "machine views"
+are realized as GSPMD sharding constraints on tensor edges; XLA inserts
+the collectives the reference delegated to Legion/Realm (activations)
+and NCCL (gradients), fuses elementwise chains (the reference's FusedOp
+pass, model.cc:2343, is obsolete by construction), and overlaps
+compute/communication in its scheduler.
+
+There are no backward methods anywhere: ``jax.value_and_grad`` of the
+lowered forward replaces every hand-written backward task of the
+reference (src/ops/ backward kernels), and gradient synchronization falls
+out of params' shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.graph import Graph, Node
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import DataType
+from flexflow_tpu.losses import LossType, compute_loss
+from flexflow_tpu.metrics import MetricsType, compute_metrics
+from flexflow_tpu.ops.base import LoweringContext, OpSharding, ShardAnnot
+from flexflow_tpu.ops.inout import InputOp
+from flexflow_tpu.optimizers import Optimizer
+from flexflow_tpu.parallel.mesh import (
+    annot_partition_spec,
+    build_mesh,
+    mesh_axis_sizes,
+    view_slot_axes,
+)
+
+
+def data_parallel_strategy(graph: Graph, degree: int) -> Dict[int, MachineView]:
+    """Batch-dim partitioning for every op — the reference's
+    --only-data-parallel path (graph.cc:1572-1597)."""
+    # candidate degrees: divisors of the device count, descending, so the
+    # chosen degree always factors into the mesh's prime-factor axis pool
+    divisors = sorted(
+        (d for d in range(1, degree + 1) if degree % d == 0), reverse=True
+    )
+    strategy: Dict[int, MachineView] = {}
+    for node in graph.topo_order():
+        out = node.op.output_shapes[0]
+        batch = out.sizes[0] if out.ndim else 1
+        d = 1
+        if out.ndim and 0 in node.op.splittable_output_dims():
+            d = next(dd for dd in divisors if batch % dd == 0)
+        strategy[node.guid] = (
+            MachineView.data_parallel(out.ndim, d) if d > 1 else MachineView.trivial(out.ndim)
+        )
+    return strategy
+
+
+class CompiledModel:
+    """A PCG + strategy compiled to jitted train/eval steps over a mesh."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        strategy: Dict[int, MachineView],
+        config: FFConfig,
+        loss_type: LossType,
+        metric_types: Sequence[MetricsType],
+        optimizer: Optional[Optimizer],
+        mesh=None,
+        label_dtype: str = "int32",
+    ):
+        self.graph = graph
+        self.strategy = strategy
+        self.config = config
+        self.loss_type = LossType.from_any(loss_type)
+        self.metric_types = [MetricsType.from_any(m) for m in metric_types]
+        self.optimizer = optimizer
+        self.mesh = mesh if mesh is not None else build_mesh(
+            jax.devices()[: config.num_devices]
+        )
+        self.label_dtype = label_dtype
+        self.compute_dtype = DataType.from_any(config.compute_dtype).to_numpy()
+
+        self._topo = graph.topo_order()
+        self._input_nodes: List[Node] = [
+            n for n in self._topo if isinstance(n.op, InputOp)
+        ]
+        # order inputs by frontend tensor guid for stable binding
+        self._input_nodes.sort(key=lambda n: n.op.attrs.get("tensor_guid", n.guid))
+        sinks = graph.sinks()
+        assert sinks, "empty graph"
+        self._sink = sinks[-1]
+
+        axis_pool = mesh_axis_sizes(int(np.prod(list(self.mesh.shape.values()))))
+        self._shardings: Dict[int, OpSharding] = {}
+        self._slot_axes: Dict[int, Dict[int, Tuple[str, ...]]] = {}
+        for node in self._topo:
+            mv = strategy.get(node.guid) or MachineView.trivial(
+                node.op.output_shapes[0].ndim
+            )
+            self._shardings[node.guid] = node.op.propagate(mv)
+            self._slot_axes[node.guid] = view_slot_axes(mv, axis_pool)
+
+        self._multi_device = int(np.prod(list(self.mesh.shape.values()))) > 1
+        self._train_step_fn = None
+        self._eval_step_fn = None
+
+    # ------------------------------------------------------------------
+    def _constrain(self, x, annot: ShardAnnot, slot_axes) -> jax.Array:
+        if not self._multi_device or annot.partial:
+            return x
+        spec = annot_partition_spec(annot, slot_axes)
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(self.mesh, spec)
+        )
+
+    def input_sharding(self, i: int):
+        """NamedSharding for the i-th frontend input (dataloader uses it)."""
+        node = self._input_nodes[i]
+        annot = self._shardings[node.guid].outputs[0]
+        spec = annot_partition_spec(annot, self._slot_axes[node.guid])
+        return jax.sharding.NamedSharding(self.mesh, spec)
+
+    def batch_sharding(self):
+        """Batch-dim sharding of the label tensor = sink's batch annot."""
+        annot = self._shardings[self._sink.guid].outputs[0]
+        axes = self._slot_axes[self._sink.guid].get(0, ())
+        from jax.sharding import PartitionSpec
+
+        spec = PartitionSpec(tuple(axes) if len(axes) > 1 else (axes[0] if axes else None))
+        return jax.sharding.NamedSharding(self.mesh, spec)
+
+    # ------------------------------------------------------------------
+    def apply(
+        self,
+        params: Dict[str, Dict[str, jax.Array]],
+        state: Dict[str, jax.Array],
+        inputs: Sequence[jax.Array],
+        rng: Optional[jax.Array],
+        train: bool,
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Forward through the PCG (global view). Returns (logits, new_state)."""
+        ctx = LoweringContext(
+            compute_dtype=self.compute_dtype,
+            train=train,
+            rng=rng,
+            seq_length=self.config.iteration.seq_length,
+            state_in=state,
+        )
+        values: Dict[Tuple[int, int], jax.Array] = {}
+        input_pos = {n.guid: i for i, n in enumerate(self._input_nodes)}
+        for node in self._topo:
+            osh = self._shardings[node.guid]
+            axes = self._slot_axes[node.guid]
+            if node.guid in input_pos:
+                x = inputs[input_pos[node.guid]]
+                values[(node.guid, 0)] = self._constrain(x, osh.outputs[0], axes)
+                continue
+            in_edges = sorted(self.graph.in_edges[node.guid], key=lambda e: e.dst_idx)
+            ins = []
+            for e in in_edges:
+                x = values[(e.src, e.src_idx)]
+                if e.dst_idx < len(osh.inputs):
+                    x = self._constrain(x, osh.inputs[e.dst_idx], axes)
+                ins.append(x)
+            outs = node.op.forward(ctx, ins, params.get(node.op.name, {}))
+            for i, y in enumerate(outs):
+                if i < len(osh.outputs):
+                    y = self._constrain(y, osh.outputs[i], axes)
+                values[(node.guid, i)] = y
+        logits = values[(self._sink.guid, 0)]
+        new_state = dict(state)
+        new_state.update(ctx.state_out)
+        return logits, new_state
+
+    # ------------------------------------------------------------------
+    def init_params(self, seed: int = 0):
+        """Initialize sharded params + model state (reference: per-weight
+        initializer tasks, initializer.cc; here one jitted program whose
+        out_shardings place every weight shard directly)."""
+        specs = []  # (op_name, weight_name, shape, dtype, init, sharding)
+        for node in self._topo:
+            osh = self._shardings[node.guid]
+            axes = self._slot_axes[node.guid]
+            for wi, ws in enumerate(node.op._weight_specs):
+                annot = osh.weights[wi] if wi < len(osh.weights) else None
+                spec = (
+                    annot_partition_spec(annot, axes)
+                    if annot is not None
+                    else jax.sharding.PartitionSpec()
+                )
+                specs.append(
+                    (
+                        node.op.name,
+                        ws.name,
+                        ws.shape,
+                        ws.dtype.to_numpy(),
+                        ws.initializer,
+                        jax.sharding.NamedSharding(self.mesh, spec),
+                    )
+                )
+
+        def _init(key):
+            out = {}
+            for i, (op_name, w_name, shape, dtype, init, _) in enumerate(specs):
+                k = jax.random.fold_in(key, i)
+                out.setdefault(op_name, {})[w_name] = init.init(k, shape, dtype)
+            return out
+
+        shardings = {}
+        for op_name, w_name, _, _, _, sh in specs:
+            shardings.setdefault(op_name, {})[w_name] = sh
+        key = jax.random.key(seed)
+        params = jax.jit(_init, out_shardings=(shardings or None))(key)
+
+        state: Dict[str, jax.Array] = {}
+        for node in self._topo:
+            ss = getattr(node.op, "state_specs", None)
+            if ss is None:
+                continue
+            for name, shape, dtype, fill in ss():
+                state[f"{node.op.name}/{name}"] = jnp.full(shape, fill, dtype)
+        self.param_shardings = shardings
+        return params, state
+
+    # ------------------------------------------------------------------
+    def _loss_from(self, logits, labels, new_state):
+        loss = compute_loss(self.loss_type, logits, labels)
+        for k, v in new_state.items():
+            if k.endswith("/aux_loss"):
+                loss = loss + v
+        return loss
+
+    def _build_train_step(self):
+        optimizer = self.optimizer
+
+        def step(params, opt_state, state, rng, inputs, labels):
+            def loss_fn(p):
+                logits, new_state = self.apply(p, state, inputs, rng, train=True)
+                loss = self._loss_from(logits, labels, new_state)
+                return loss, (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            new_params, new_opt_state = optimizer.apply(params, grads, opt_state)
+            m = compute_metrics(self.metric_types, self.loss_type, logits, labels)
+            return new_params, new_opt_state, new_state, loss, m
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_eval_step(self):
+        def step(params, state, inputs, labels):
+            logits, new_state = self.apply(params, state, inputs, None, train=False)
+            loss = self._loss_from(logits, labels, new_state)
+            m = compute_metrics(self.metric_types, self.loss_type, logits, labels)
+            return loss, m
+
+        return jax.jit(step)
+
+    def train_step(self, params, opt_state, state, rng, inputs, labels):
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        return self._train_step_fn(params, opt_state, state, rng, inputs, labels)
+
+    def eval_step(self, params, state, inputs, labels):
+        if self._eval_step_fn is None:
+            self._eval_step_fn = self._build_eval_step()
+        return self._eval_step_fn(params, state, inputs, labels)
+
+    def forward_fn(self):
+        """(params, state, inputs) -> logits — for export/inspection."""
+
+        def fwd(params, state, inputs):
+            logits, _ = self.apply(params, state, inputs, None, train=False)
+            return logits
+
+        return fwd

@@ -1,0 +1,448 @@
+"""FFModel — the public model-building and training API.
+
+Mirrors the surface of the reference's FFModel
+(reference: include/flexflow/model.h:316-700 layer methods;
+python/flexflow/core/flexflow_cffi.py:784-1900): ``create_tensor`` +
+layer methods build a lazy graph; ``compile`` turns it into a PCG,
+picks a parallelization strategy, and lowers to one jitted SPMD
+program; ``fit``/``eval`` run the training loop.
+
+Differences by design (TPU-native):
+* no init/forward/backward/update verbs per op — one fused train step;
+* the parallelization strategy is sharding degrees over a global mesh,
+  searched by flexflow_tpu.search (Unity algorithm) or data-parallel;
+* NHWC conv layout.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.graph import Graph, Node
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import DataType, ParallelTensorShape, Tensor
+from flexflow_tpu.initializers import Initializer
+from flexflow_tpu.losses import LossType
+from flexflow_tpu.metrics import MetricsType, PerfMetrics
+from flexflow_tpu import ops as O
+from flexflow_tpu.optimizers import Optimizer, SGDOptimizer
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.graph = Graph()
+        self._producer: Dict[int, Tuple[Node, int]] = {}  # tensor.guid -> (node, out_idx)
+        self._input_tensors: List[Tensor] = []
+        self._name_counts: Dict[str, int] = {}
+        self.compiled = None
+        self.params = None
+        self.opt_state = None
+        self.state = None
+        self.optimizer: Optional[Optimizer] = None
+        self._rng_counter = 0
+
+    # ------------------------------------------------------------------
+    def _fresh_name(self, base: str, name: Optional[str]) -> str:
+        if name:
+            return name
+        i = self._name_counts.get(base, 0)
+        self._name_counts[base] = i + 1
+        return f"{base}_{i}"
+
+    def _shape_of(self, t: Tensor) -> ParallelTensorShape:
+        return ParallelTensorShape.make(t.sizes, t.dtype)
+
+    def _add_op(self, op: O.Operator, inputs: Sequence[Tensor]) -> List[Tensor]:
+        node = self.graph.new_node(op)
+        for i, t in enumerate(inputs):
+            src_node, src_idx = self._producer[t.guid]
+            self.graph.add_edge(src_node, node, src_idx, i)
+        outs = []
+        for i, shape in enumerate(op.output_shapes):
+            t = Tensor(shape.sizes, shape.dtype, owner_layer=node, owner_idx=i,
+                       name=f"{op.name}:{i}")
+            self._producer[t.guid] = (node, i)
+            outs.append(t)
+        return outs
+
+    # ------------------------------------------------------------------
+    def create_tensor(self, dims: Sequence[int], dtype="float32", name=None) -> Tensor:
+        """Frontend input tensor (reference: FFModel::create_tensor)."""
+        name = self._fresh_name("input", name)
+        t = Tensor(dims, dtype, name=name)
+        op = O.InputOp(name, ParallelTensorShape.make(t.sizes, t.dtype), tensor_guid=t.guid)
+        node = self.graph.new_node(op)
+        self._producer[t.guid] = (node, 0)
+        self._input_tensors.append(t)
+        return t
+
+    # ---- layers (reference: model.h layer-method block) ----------------
+    def dense(self, input: Tensor, out_dim: int, activation=None, use_bias=True,
+              kernel_initializer=None, bias_initializer=None, name=None) -> Tensor:
+        op = O.LinearOp(self._fresh_name("dense", name), [self._shape_of(input)],
+                        out_dim=out_dim, activation=activation, use_bias=use_bias,
+                        kernel_initializer=kernel_initializer,
+                        bias_initializer=bias_initializer)
+        return self._add_op(op, [input])[0]
+
+    def conv2d(self, input: Tensor, out_channels: int, kernel_h: int, kernel_w: int,
+               stride_h: int = 1, stride_w: int = 1, padding_h: int = 0,
+               padding_w: int = 0, activation=None, groups: int = 1, use_bias=True,
+               kernel_initializer=None, bias_initializer=None, name=None) -> Tensor:
+        op = O.Conv2DOp(self._fresh_name("conv2d", name), [self._shape_of(input)],
+                        out_channels=out_channels, kernel_h=kernel_h, kernel_w=kernel_w,
+                        stride_h=stride_h, stride_w=stride_w, padding_h=padding_h,
+                        padding_w=padding_w, groups=groups, activation=activation,
+                        use_bias=use_bias, kernel_initializer=kernel_initializer,
+                        bias_initializer=bias_initializer)
+        return self._add_op(op, [input])[0]
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int, stride_h: int = 1,
+               stride_w: int = 1, padding_h: int = 0, padding_w: int = 0,
+               pool_type: str = "max", activation=None, name=None) -> Tensor:
+        op = O.Pool2DOp(self._fresh_name("pool2d", name), [self._shape_of(input)],
+                        kernel_h=kernel_h, kernel_w=kernel_w, stride_h=stride_h,
+                        stride_w=stride_w, padding_h=padding_h, padding_w=padding_w,
+                        pool_type=pool_type, activation=activation)
+        return self._add_op(op, [input])[0]
+
+    def batch_norm(self, input: Tensor, relu: bool = True, momentum: float = 0.9,
+                   name=None) -> Tensor:
+        op = O.BatchNormOp(self._fresh_name("batchnorm", name), [self._shape_of(input)],
+                           relu=relu, momentum=momentum)
+        return self._add_op(op, [input])[0]
+
+    def layer_norm(self, input: Tensor, axes=(-1,), elementwise_affine=True,
+                   eps=1e-5, name=None) -> Tensor:
+        op = O.LayerNormOp(self._fresh_name("layernorm", name), [self._shape_of(input)],
+                           axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps)
+        return self._add_op(op, [input])[0]
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int,
+                  aggr: str = "none", kernel_initializer=None, name=None) -> Tensor:
+        op = O.EmbeddingOp(self._fresh_name("embedding", name), [self._shape_of(input)],
+                           num_entries=num_entries, out_dim=out_dim, aggr=aggr,
+                           kernel_initializer=kernel_initializer)
+        return self._add_op(op, [input])[0]
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int, kdim: int = 0,
+                            vdim: int = 0, dropout: float = 0.0, bias: bool = False,
+                            causal: bool = False, kernel_initializer=None,
+                            name=None) -> Tensor:
+        op = O.MultiHeadAttentionOp(
+            self._fresh_name("attention", name),
+            [self._shape_of(query), self._shape_of(key), self._shape_of(value)],
+            embed_dim=embed_dim, num_heads=num_heads, kdim=kdim, vdim=vdim,
+            dropout=dropout, use_bias=bias, causal=causal,
+            kernel_initializer=kernel_initializer)
+        return self._add_op(op, [query, key, value])[0]
+
+    def batch_matmul(self, A: Tensor, B: Tensor, a_seq_length_dim: int = -1,
+                     b_seq_length_dim: int = -1, name=None) -> Tensor:
+        op = O.BatchMatmulOp(self._fresh_name("bmm", name),
+                             [self._shape_of(A), self._shape_of(B)],
+                             a_seq_length_dim=a_seq_length_dim,
+                             b_seq_length_dim=b_seq_length_dim)
+        return self._add_op(op, [A, B])[0]
+
+    def dropout(self, input: Tensor, rate: float = 0.5, seed: int = 0, name=None) -> Tensor:
+        op = O.DropoutOp(self._fresh_name("dropout", name), [self._shape_of(input)],
+                         rate=rate, seed=seed)
+        return self._add_op(op, [input])[0]
+
+    def softmax(self, input: Tensor, axis: int = -1, name=None) -> Tensor:
+        op = O.SoftmaxOp(self._fresh_name("softmax", name), [self._shape_of(input)], axis=axis)
+        return self._add_op(op, [input])[0]
+
+    def concat(self, tensors: Sequence[Tensor], axis: int, name=None) -> Tensor:
+        op = O.ConcatOp(self._fresh_name("concat", name),
+                        [self._shape_of(t) for t in tensors], axis=axis)
+        return self._add_op(op, list(tensors))[0]
+
+    def split(self, input: Tensor, sizes: Union[int, Sequence[int]], axis: int,
+              name=None) -> List[Tensor]:
+        if isinstance(sizes, int):
+            total = input.sizes[axis]
+            assert total % sizes == 0
+            sizes = [total // sizes] * sizes
+        op = O.SplitOp(self._fresh_name("split", name), [self._shape_of(input)],
+                       sizes=tuple(sizes), axis=axis)
+        return self._add_op(op, [input])
+
+    def flat(self, input: Tensor, name=None) -> Tensor:
+        op = O.FlatOp(self._fresh_name("flat", name), [self._shape_of(input)])
+        return self._add_op(op, [input])[0]
+
+    def reshape(self, input: Tensor, shape: Sequence[int], name=None) -> Tensor:
+        op = O.ReshapeOp(self._fresh_name("reshape", name), [self._shape_of(input)],
+                         shape=tuple(shape))
+        return self._add_op(op, [input])[0]
+
+    def transpose(self, input: Tensor, perm: Sequence[int], name=None) -> Tensor:
+        op = O.TransposeOp(self._fresh_name("transpose", name), [self._shape_of(input)],
+                           perm=tuple(perm))
+        return self._add_op(op, [input])[0]
+
+    def reverse(self, input: Tensor, axis: int, name=None) -> Tensor:
+        op = O.ReverseOp(self._fresh_name("reverse", name), [self._shape_of(input)], axis=axis)
+        return self._add_op(op, [input])[0]
+
+    def cast(self, input: Tensor, dtype, name=None) -> Tensor:
+        op = O.CastOp(self._fresh_name("cast", name), [self._shape_of(input)], dtype=dtype)
+        return self._add_op(op, [input])[0]
+
+    def mean(self, input: Tensor, dims: Sequence[int], keepdims: bool = False,
+             name=None) -> Tensor:
+        op = O.MeanOp(self._fresh_name("mean", name), [self._shape_of(input)],
+                      dims=tuple(dims), keepdims=keepdims)
+        return self._add_op(op, [input])[0]
+
+    def top_k(self, input: Tensor, k: int, sorted: bool = True, name=None) -> Tuple[Tensor, Tensor]:
+        op = O.TopKOp(self._fresh_name("topk", name), [self._shape_of(input)], k=k, sorted=sorted)
+        outs = self._add_op(op, [input])
+        return outs[0], outs[1]
+
+    def gather(self, input: Tensor, indices: Tensor, axis: int = 0, name=None) -> Tensor:
+        op = O.GatherOp(self._fresh_name("gather", name),
+                        [self._shape_of(input), self._shape_of(indices)], axis=axis)
+        return self._add_op(op, [input, indices])[0]
+
+    def group_by(self, data: Tensor, assign: Tensor, n_experts: int, alpha: float = 1.0,
+                 name=None) -> List[Tensor]:
+        op = O.GroupByOp(self._fresh_name("group_by", name),
+                         [self._shape_of(data), self._shape_of(assign)],
+                         n_experts=n_experts, alpha=alpha)
+        return self._add_op(op, [data, assign])
+
+    def aggregate(self, gates: Tensor, expert_idx: Tensor, pos: Tensor, valid: Tensor,
+                  expert_out: Tensor, lambda_bal: float = 0.0, name=None) -> Tensor:
+        op = O.AggregateOp(
+            self._fresh_name("aggregate", name),
+            [self._shape_of(t) for t in (gates, expert_idx, pos, valid, expert_out)],
+            lambda_bal=lambda_bal)
+        return self._add_op(op, [gates, expert_idx, pos, valid, expert_out])[0]
+
+    def aggregate_spec(self, gates, expert_idx, pos, valid, expert_out,
+                       lambda_bal: float = 0.0, name=None) -> Tensor:
+        op = O.AggregateSpecOp(
+            self._fresh_name("aggregate_spec", name),
+            [self._shape_of(t) for t in (gates, expert_idx, pos, valid, expert_out)],
+            lambda_bal=lambda_bal)
+        return self._add_op(op, [gates, expert_idx, pos, valid, expert_out])[0]
+
+    def cache(self, input: Tensor, use_cached: bool = False, name=None) -> Tensor:
+        op = O.CacheOp(self._fresh_name("cache", name), [self._shape_of(input)],
+                       use_cached=use_cached)
+        return self._add_op(op, [input])[0]
+
+    # elementwise -------------------------------------------------------
+    def _unary(self, t: OperatorType, input: Tensor, name=None, scalar=0.0, base=None):
+        op = O.ElementUnaryOp(self._fresh_name(base or t.value, name),
+                              [self._shape_of(input)], unary_type=t, scalar=scalar)
+        return self._add_op(op, [input])[0]
+
+    def _binary(self, t: OperatorType, a: Tensor, b: Tensor, name=None):
+        op = O.ElementBinaryOp(self._fresh_name(t.value, name),
+                               [self._shape_of(a), self._shape_of(b)], binary_type=t)
+        return self._add_op(op, [a, b])[0]
+
+    def relu(self, x, name=None):
+        return self._unary(OperatorType.RELU, x, name)
+
+    def sigmoid(self, x, name=None):
+        return self._unary(OperatorType.SIGMOID, x, name)
+
+    def tanh(self, x, name=None):
+        return self._unary(OperatorType.TANH, x, name)
+
+    def elu(self, x, name=None):
+        return self._unary(OperatorType.ELU, x, name)
+
+    def gelu(self, x, name=None):
+        return self._unary(OperatorType.GELU, x, name)
+
+    def exp(self, x, name=None):
+        return self._unary(OperatorType.EXP, x, name)
+
+    def log(self, x, name=None):
+        return self._unary(OperatorType.LOG, x, name)
+
+    def identity(self, x, name=None):
+        return self._unary(OperatorType.IDENTITY, x, name)
+
+    def rsqrt(self, x, name=None):
+        return self._unary(OperatorType.RSQRT, x, name)
+
+    def pow(self, x, exponent: float, name=None):
+        return self._unary(OperatorType.POW, x, name, scalar=exponent)
+
+    def scalar_add(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_ADD, x, name, scalar=scalar)
+
+    def scalar_sub(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_SUB, x, name, scalar=scalar)
+
+    def scalar_multiply(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_MUL, x, name, scalar=scalar)
+
+    def scalar_true_divide(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_TRUE_DIV, x, name, scalar=scalar)
+
+    def add(self, a, b, name=None):
+        return self._binary(OperatorType.EW_ADD, a, b, name)
+
+    def subtract(self, a, b, name=None):
+        return self._binary(OperatorType.EW_SUB, a, b, name)
+
+    def multiply(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MUL, a, b, name)
+
+    def divide(self, a, b, name=None):
+        return self._binary(OperatorType.EW_DIV, a, b, name)
+
+    def max(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MAX, a, b, name)
+
+    def min(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MIN, a, b, name)
+
+    # ------------------------------------------------------------------
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type="sparse_categorical_crossentropy",
+        metrics=("accuracy",),
+        comp_mode: str = "training",
+        strategy: Optional[Dict[int, MachineView]] = None,
+    ):
+        """Pick a parallelization strategy and lower
+        (reference: FFModel::compile model.cc:2587)."""
+        from flexflow_tpu.compiler.lowering import CompiledModel, data_parallel_strategy
+
+        self.optimizer = optimizer or SGDOptimizer(
+            lr=self.config.learning_rate, weight_decay=self.config.weight_decay
+        )
+        if strategy is None:
+            if self.config.import_strategy_file:
+                from flexflow_tpu.search.strategy_io import import_strategy
+
+                strategy = import_strategy(self.config.import_strategy_file, self.graph)
+            elif self.config.only_data_parallel:
+                strategy = data_parallel_strategy(self.graph, self.config.num_devices)
+            else:
+                try:
+                    from flexflow_tpu.search.driver import optimize_strategy
+
+                    strategy = optimize_strategy(self.graph, self.config)
+                except ImportError:
+                    strategy = data_parallel_strategy(self.graph, self.config.num_devices)
+        if self.config.export_strategy_file:
+            from flexflow_tpu.search.strategy_io import export_strategy
+
+            export_strategy(self.config.export_strategy_file, self.graph, strategy)
+        if self.config.export_strategy_computation_graph_file:
+            self.graph.write_dot(
+                self.config.export_strategy_computation_graph_file, strategy
+            )
+
+        self.compiled = CompiledModel(
+            self.graph,
+            strategy,
+            self.config,
+            LossType.from_any(loss_type),
+            list(metrics),
+            self.optimizer,
+        )
+        self.params, self.state = self.compiled.init_params(self.config.seed)
+        self.opt_state = self.optimizer.init_state(self.params)
+        return self.compiled
+
+    # ------------------------------------------------------------------
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True):
+        """Training loop (reference: flexflow_cffi.py:1832 fit)."""
+        import jax
+
+        from flexflow_tpu.runtime.dataloader import SingleDataLoader
+
+        assert self.compiled is not None, "call compile() first"
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        batch_size = batch_size or self.config.batch_size
+        epochs = epochs or self.config.epochs
+        loader = SingleDataLoader(
+            self.compiled, [np.asarray(a) for a in xs], np.asarray(y),
+            batch_size, shuffle=shuffle, seed=self.config.seed,
+        )
+        if loader.num_batches == 0:
+            raise ValueError(
+                f"no full batch: {loader.num_samples} samples < batch_size {batch_size}"
+            )
+        metrics = PerfMetrics()
+        history = []
+        t_start = None
+        steps_done = 0
+        for epoch in range(epochs):
+            metrics.reset()
+            acc = None  # device-side metric accumulation; host sync once/epoch
+            for inputs, labels in loader:
+                self._rng_counter += 1
+                rng = jax.random.key(self._rng_counter)
+                (self.params, self.opt_state, self.state, loss, m) = (
+                    self.compiled.train_step(
+                        self.params, self.opt_state, self.state, rng, inputs, labels
+                    )
+                )
+                acc = m if acc is None else jax.tree.map(lambda a, b: a + b, acc, m)
+                steps_done += 1
+                if steps_done == 1:
+                    jax.block_until_ready(loss)
+                    t_start = time.perf_counter()  # skip compile time
+            metrics.update(acc)
+            if verbose:
+                print(f"epoch {epoch}: loss={float(loss):.4f} {metrics}")
+            history.append(metrics.report())
+        jax.block_until_ready(self.params)
+        elapsed = time.perf_counter() - (t_start or time.perf_counter())
+        if steps_done > 1 and elapsed > 0:
+            thr = (steps_done - 1) * batch_size / elapsed
+            if verbose:
+                print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
+            self.last_throughput = thr
+        return history
+
+    def evaluate(self, x=None, y=None, batch_size: Optional[int] = None):
+        """reference: flexflow_cffi.py:1876 eval."""
+        from flexflow_tpu.runtime.dataloader import SingleDataLoader
+
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        batch_size = batch_size or self.config.batch_size
+        loader = SingleDataLoader(
+            self.compiled, [np.asarray(a) for a in xs], np.asarray(y),
+            batch_size, shuffle=False,
+        )
+        metrics = PerfMetrics()
+        for inputs, labels in loader:
+            _, m = self.compiled.eval_step(self.params, self.state, inputs, labels)
+            metrics.update(m)
+        return metrics.report()
+
+    # ------------------------------------------------------------------
+    def get_weight(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
+        """reference: ParallelTensorBase::get_tensor (parallel_tensor.h:157)."""
+        return np.asarray(self.params[op_name][weight_name])
+
+    def set_weight(self, op_name: str, weight_name: str, value: np.ndarray) -> None:
+        import jax
+
+        old = self.params[op_name][weight_name]
+        assert tuple(old.shape) == tuple(value.shape)
+        self.params[op_name][weight_name] = jax.device_put(
+            value.astype(old.dtype), old.sharding
+        )

@@ -1,0 +1,264 @@
+"""MultiHeadAttention and BatchMatmul.
+
+Reference: src/ops/attention.{cc,cu} (cuDNN multi-head attention,
+weights stacked [qkvo, heads], embed dim unsplittable
+attention.cc:195-196) and src/ops/batch_matmul.* (cuBLAS strided).
+
+TPU-native: attention is projections + scaled dot-product, lowered
+either through plain XLA einsums or the Pallas flash-attention kernel
+(flexflow_tpu.kernels.flash_attention) when shapes allow.  Unlike the
+reference, the sequence dim IS partitionable (ring attention /
+context parallelism, a capability gap called out in SURVEY.md §5);
+head-parallel TP uses partial-sum state over the output projection —
+the same algebra as the reference's replicate+reduce xfer
+(substitution.cc:2627-2654) without materializing parallel ops for it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import DataType, ParallelTensorShape
+from flexflow_tpu.initializers import DEFAULT_WEIGHT_INIT, Initializer
+from flexflow_tpu.ops.base import (
+    REPLICA_SLOT,
+    LoweringContext,
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    WeightSpec,
+    register_op,
+)
+
+
+@register_op
+class MultiHeadAttentionOp(Operator):
+    """query [B, Sq, E], key [B, Sk, E], value [B, Sk, E] -> [B, Sq, E].
+
+    attrs: embed_dim, num_heads, kdim, vdim, dropout, use_bias, causal,
+    use_flash (prefer the Pallas kernel when on TPU).
+    """
+
+    op_type = OperatorType.MULTIHEAD_ATTENTION
+
+    def __init__(
+        self,
+        name,
+        input_shapes,
+        embed_dim: int,
+        num_heads: int,
+        kdim: int = 0,
+        vdim: int = 0,
+        dropout: float = 0.0,
+        use_bias: bool = False,
+        causal: bool = False,
+        use_flash: bool = True,
+        kernel_initializer: Initializer | None = None,
+    ):
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        assert embed_dim % num_heads == 0
+        self._kernel_init = kernel_initializer or DEFAULT_WEIGHT_INIT
+        super().__init__(
+            name,
+            input_shapes,
+            embed_dim=embed_dim,
+            num_heads=num_heads,
+            kdim=kdim,
+            vdim=vdim,
+            dropout=dropout,
+            use_bias=use_bias,
+            causal=causal,
+            use_flash=use_flash,
+        )
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        q = self.input_shapes[0]
+        return (
+            ParallelTensorShape.make(
+                (q.sizes[0], q.sizes[1], self.attrs["embed_dim"]), q.dtype
+            ),
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.attrs["embed_dim"] // self.attrs["num_heads"]
+
+    def weight_specs(self) -> Sequence[WeightSpec]:
+        a = self.attrs
+        e, h = a["embed_dim"], a["num_heads"]
+        dk = self.head_dim
+        qe = self.input_shapes[0].sizes[-1]
+        ke = self.input_shapes[1].sizes[-1]
+        ve = self.input_shapes[2].sizes[-1]
+        specs = [
+            WeightSpec("wq", (qe, h, dk), DataType.FLOAT32, self._kernel_init),
+            WeightSpec("wk", (ke, h, dk), DataType.FLOAT32, self._kernel_init),
+            WeightSpec("wv", (ve, h, dk), DataType.FLOAT32, self._kernel_init),
+            WeightSpec("wo", (h, dk, e), DataType.FLOAT32, self._kernel_init),
+        ]
+        if a["use_bias"]:
+            specs += [
+                WeightSpec("bq", (h, dk), DataType.FLOAT32, DEFAULT_WEIGHT_INIT),
+                WeightSpec("bk", (h, dk), DataType.FLOAT32, DEFAULT_WEIGHT_INIT),
+                WeightSpec("bv", (h, dk), DataType.FLOAT32, DEFAULT_WEIGHT_INIT),
+                WeightSpec("bo", (e,), DataType.FLOAT32, DEFAULT_WEIGHT_INIT),
+            ]
+        return specs
+
+    def forward(self, ctx: LoweringContext, inputs, weights):
+        a = self.attrs
+        cd = ctx.compute_dtype
+        q, k, v = (x.astype(cd) for x in inputs[:3])
+        wq, wk, wv, wo = (weights[n].astype(cd) for n in ("wq", "wk", "wv", "wo"))
+        qh = jnp.einsum("bse,ehd->bshd", q, wq)
+        kh = jnp.einsum("bse,ehd->bshd", k, wk)
+        vh = jnp.einsum("bse,ehd->bshd", v, wv)
+        if a["use_bias"]:
+            qh = qh + weights["bq"].astype(cd)
+            kh = kh + weights["bk"].astype(cd)
+            vh = vh + weights["bv"].astype(cd)
+
+        out = self._attention(ctx, qh, kh, vh)  # [b, sq, h, d]
+        y = jnp.einsum("bshd,hde->bse", out, wo, preferred_element_type=jnp.float32)
+        if a["use_bias"]:
+            y = y + weights["bo"].astype(jnp.float32)
+        return [y.astype(inputs[0].dtype)]
+
+    def _attention(self, ctx, qh, kh, vh):
+        a = self.attrs
+        scale = 1.0 / math.sqrt(self.head_dim)
+        if a["use_flash"]:
+            try:
+                from flexflow_tpu.kernels.flash_attention import flash_attention
+
+                return flash_attention(qh, kh, vh, causal=a["causal"], scale=scale)
+            except Exception:
+                pass  # fall back to the XLA path (e.g. CPU tests)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh, preferred_element_type=jnp.float32)
+        logits = logits * scale
+        if a["causal"]:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if a["dropout"] > 0.0 and ctx.train:
+            keep = 1.0 - a["dropout"]
+            mask = jax.random.bernoulli(ctx.op_rng(self.name), keep, probs.shape)
+            probs = jnp.where(mask, probs / keep, 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(qh.dtype), vh)
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        b, sq, e_deg = mv.dim_degrees
+        assert e_deg == 1, "embed dim of attention output stays whole"
+        r = mv.replica_degree  # head split -> partial sums over wo
+        q_annot = ShardAnnot((b, sq, 1), replica=r)
+        kv_annot = ShardAnnot((b, 1, 1), replica=r)  # k/v gathered over seq (ring later)
+        out = ShardAnnot(mv.dim_degrees, replica=r, partial=r > 1)
+        R = REPLICA_SLOT
+        head_w = ShardAnnot((1, r, 1), replica=b, idx=(-1, R, -1))
+        ws = [
+            head_w,  # wq [E,H,dk] split over heads
+            head_w,
+            head_w,
+            ShardAnnot((r, 1, 1), replica=b, idx=(R, -1, -1)),  # wo [H,dk,E]
+        ]
+        if self.attrs["use_bias"]:
+            hb = ShardAnnot((r, 1), replica=b, idx=(R, -1))
+            ws += [hb, hb, hb, ShardAnnot((1,), replica=b * r)]
+        return OpSharding(inputs=(q_annot, kv_annot, kv_annot), weights=tuple(ws), outputs=(out,))
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return (0, 1)  # batch and (new capability) sequence
+
+    def max_replica_degree(self) -> int:
+        return self.attrs["num_heads"]
+
+    def flops(self) -> float:
+        a = self.attrs
+        bsz, sq, e = self.output_shapes[0].sizes
+        sk = self.input_shapes[1].sizes[1]
+        h, dk = a["num_heads"], self.head_dim
+        proj = 2.0 * bsz * (sq * e * h * dk * 2 + sk * e * h * dk * 2)
+        attn = 2.0 * bsz * h * sq * sk * dk * 2
+        return proj + attn
+
+
+@register_op
+class BatchMatmulOp(Operator):
+    """[B, M, K] x [B, K, N] -> [B, M, N]; seq-length masking dims follow
+    the reference (model.h:451-455 a_seq_length_dim/b_seq_length_dim)."""
+
+    op_type = OperatorType.BATCH_MATMUL
+
+    def __init__(self, name, input_shapes, a_seq_length_dim: int = -1, b_seq_length_dim: int = -1):
+        super().__init__(
+            name,
+            input_shapes,
+            a_seq_length_dim=a_seq_length_dim,
+            b_seq_length_dim=b_seq_length_dim,
+        )
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        a, b = self.input_shapes
+        assert a.sizes[-1] == b.sizes[-2], (a.sizes, b.sizes)
+        assert a.sizes[:-2] == b.sizes[:-2]
+        return (
+            ParallelTensorShape.make(a.sizes[:-1] + (b.sizes[-1],), a.dtype),
+        )
+
+    def forward(self, ctx: LoweringContext, inputs, weights):
+        x, y = inputs
+        xc = x.astype(ctx.compute_dtype)
+        yc = y.astype(ctx.compute_dtype)
+        if ctx.seq_length > 0:
+            # mask the inactive sequence tail (reference: batch_matmul.cc
+            # a_seq_length_dim handling with FFIterationConfig)
+            if self.attrs["a_seq_length_dim"] >= 0:
+                d = self.attrs["a_seq_length_dim"] % x.ndim
+                idx = jnp.arange(x.shape[d])
+                mask = (idx < ctx.seq_length).reshape(
+                    tuple(x.shape[d] if i == d else 1 for i in range(x.ndim))
+                )
+                xc = jnp.where(mask, xc, 0)
+            if self.attrs["b_seq_length_dim"] >= 0:
+                d = self.attrs["b_seq_length_dim"] % y.ndim
+                idx = jnp.arange(y.shape[d])
+                mask = (idx < ctx.seq_length).reshape(
+                    tuple(y.shape[d] if i == d else 1 for i in range(y.ndim))
+                )
+                yc = jnp.where(mask, yc, 0)
+        z = jnp.matmul(xc, yc, preferred_element_type=jnp.float32)
+        return [z.astype(x.dtype)]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        degs = mv.dim_degrees  # [..., M, N]
+        r = mv.replica_degree  # K split
+        m, n = degs[-2], degs[-1]
+        batch = degs[:-2]
+        nd = len(degs)
+        bidx = tuple(range(nd - 2))
+        a_annot = ShardAnnot(
+            batch + (m, r), replica=n, idx=bidx + (nd - 2, REPLICA_SLOT)
+        )
+        b_annot = ShardAnnot(
+            batch + (r, n), replica=m, idx=bidx + (REPLICA_SLOT, nd - 1)
+        )
+        out = ShardAnnot(degs, replica=r, partial=r > 1)
+        return OpSharding(inputs=(a_annot, b_annot), weights=(), outputs=(out,))
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+    def max_replica_degree(self) -> int:
+        return self.input_shapes[0].sizes[-1]
+
+    def flops(self) -> float:
+        out = self.output_shapes[0]
+        return 2.0 * out.num_elements * self.input_shapes[0].sizes[-1]

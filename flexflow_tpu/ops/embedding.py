@@ -1,0 +1,132 @@
+"""Embedding lookup — the parameter-parallel workhorse (DLRM).
+
+Reference: src/ops/embedding.{cc,cu} (table partitioned over vocab or
+channel, embedding.cc:123-190; aggr none/sum/avg).  TPU-native: the
+lookup is ``jnp.take``; under a vocab-partitioned strategy the lowering
+keeps the gather local per shard with masking + partial-sum state so
+XLA emits a reduce-scatter/psum over table shards instead of
+all-gathering the table (SURVEY.md §7 hard part (e)).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import DataType, ParallelTensorShape
+from flexflow_tpu.initializers import Initializer, NormInitializer
+from flexflow_tpu.ops.base import (
+    REPLICA_SLOT,
+    LoweringContext,
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    WeightSpec,
+    register_op,
+)
+
+
+@register_op
+class EmbeddingOp(Operator):
+    """ids [B] or [B, S] (int) -> [B, D] (aggr sum/avg over S, or no S)
+    or [B, S, D] (aggr none).
+
+    attrs: num_entries (vocab), out_dim, aggr ('none'|'sum'|'avg').
+    """
+
+    op_type = OperatorType.EMBEDDING
+
+    def __init__(
+        self,
+        name,
+        input_shapes,
+        num_entries: int,
+        out_dim: int,
+        aggr: str = "none",
+        kernel_initializer: Initializer | None = None,
+        param_dtype: str = "float32",
+    ):
+        assert aggr in ("none", "sum", "avg")
+        self._kernel_init = kernel_initializer or NormInitializer(stddev=0.05)
+        super().__init__(
+            name,
+            input_shapes,
+            num_entries=num_entries,
+            out_dim=out_dim,
+            aggr=aggr,
+            param_dtype=param_dtype,
+        )
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        a = self.attrs
+        if a["aggr"] == "none":
+            sizes = x.sizes + (a["out_dim"],)
+        else:
+            sizes = x.sizes[:-1] + (a["out_dim"],) if x.ndim > 1 else (x.sizes[0], a["out_dim"])
+        return (ParallelTensorShape.make(sizes, DataType.from_any(a["param_dtype"])),)
+
+    def weight_specs(self) -> Sequence[WeightSpec]:
+        a = self.attrs
+        return (
+            WeightSpec(
+                "table",
+                (a["num_entries"], a["out_dim"]),
+                DataType.from_any(a["param_dtype"]),
+                self._kernel_init,
+            ),
+        )
+
+    def forward(self, ctx: LoweringContext, inputs, weights):
+        ids = inputs[0].astype(jnp.int32)
+        table = weights["table"]
+        a = self.attrs
+        y = jnp.take(table, ids, axis=0)  # [..., S?, D]
+        if a["aggr"] == "sum" and ids.ndim > 1:
+            y = jnp.sum(y, axis=-2)
+        elif a["aggr"] == "avg" and ids.ndim > 1:
+            y = jnp.mean(y, axis=-2)
+        return [y]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        degs = mv.dim_degrees
+        r = mv.replica_degree  # vocab split -> partial-sum rows
+        d_deg = degs[-1]  # channel split of the table
+        batch_parts = 1
+        for d in degs[:-1]:
+            batch_parts *= d
+        x = self.input_shapes[0]
+        if self.attrs["aggr"] == "none":
+            in_degs = degs[:-1]  # output = input dims + (D,)
+        else:
+            # output drops the aggregated seq dim: ids [B, S] -> out [B, D]
+            in_degs = degs[:-1] + (1,) * (x.ndim - (len(degs) - 1))
+        out_nd = len(degs)
+        return OpSharding(
+            inputs=(ShardAnnot(in_degs, replica=d_deg * r),),
+            weights=(
+                ShardAnnot(
+                    (r, d_deg), replica=batch_parts, idx=(REPLICA_SLOT, out_nd - 1)
+                ),
+            ),
+            outputs=(ShardAnnot(degs, replica=r, partial=r > 1),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+    def max_replica_degree(self) -> int:
+        return self.attrs["num_entries"]
+
+    def flops(self) -> float:
+        return float(self.output_shapes[0].num_elements)
+
+    def bytes_accessed(self) -> float:
+        # gather traffic dominates: one row per id
+        x = self.input_shapes[0]
+        rows = x.num_elements
+        return float(rows * self.attrs["out_dim"] * 4 + self.output_shapes[0].num_bytes)

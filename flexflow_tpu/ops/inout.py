@@ -1,0 +1,69 @@
+"""Input / Weight / NoOp sentinel operators.
+
+Reference: src/ops/noop.cc (OP_INPUT/OP_WEIGHT/OP_NOOP with
+input_tensor_guid used to match frontend tensors, graph.cc:1639-1648).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import ParallelTensorShape
+from flexflow_tpu.ops.base import (
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    register_op,
+)
+
+
+@register_op
+class InputOp(Operator):
+    """Graph source holding a batch input. ``tensor_guid`` links back to
+    the frontend Tensor so compile can bind feed arrays by position."""
+
+    op_type = OperatorType.INPUT
+
+    def __init__(self, name, shape: ParallelTensorShape, tensor_guid: int = -1):
+        self._shape = shape.drop_parallelism()
+        super().__init__(name, [], tensor_guid=tensor_guid)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self._shape,)
+
+    def forward(self, ctx, inputs, weights):
+        raise RuntimeError("InputOp is bound by the executor, never lowered")
+
+    def signature(self) -> Tuple:
+        return (
+            self.op_type.value,
+            self._shape.sizes,
+            self._shape.dtype.value,
+            self.attrs["tensor_guid"],
+        )
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        return OpSharding(
+            inputs=(),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return (0,) if self._shape.ndim else ()
+
+
+@register_op
+class NoOp(Operator):
+    op_type = OperatorType.NOOP
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self.input_shapes[0],)
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0]]
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))

@@ -1,0 +1,13 @@
+from flexflow_tpu.parallel.mesh import (
+    annot_partition_spec,
+    build_mesh,
+    prime_factors,
+    view_slot_axes,
+)
+
+__all__ = [
+    "annot_partition_spec",
+    "build_mesh",
+    "prime_factors",
+    "view_slot_axes",
+]

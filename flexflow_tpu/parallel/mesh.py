@@ -1,0 +1,113 @@
+"""Device-mesh construction and canonical axis assignment.
+
+The TPU replacement for the reference's device-placement machinery
+(MachineView strided boxes + FFMapper decoding,
+reference: src/mapper/mapper.cc:371-475): build ONE global
+``jax.sharding.Mesh`` whose axes are the *prime factors* of the device
+count, then map every op's abstract partition degrees onto concrete
+axis names with one deterministic rule.  Because the rule is
+deterministic, two ops that split the same logical dim by the same
+degree land on the same axes — so a data-parallel chain compiles with
+zero resharding, exactly like same-MachineView ops sharing a Legion
+index space in the reference.
+
+Physical placement within the mesh (which chip is neighbour to which)
+is delegated to jax's device ordering, which already lays slices out
+along the ICI torus.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.ops.base import REPLICA_SLOT, ShardAnnot
+
+
+def prime_factors(n: int) -> List[int]:
+    out: List[int] = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def mesh_axis_sizes(num_devices: int) -> List[Tuple[str, int]]:
+    factors = prime_factors(num_devices) or [1]
+    return [(f"x{i}", f) for i, f in enumerate(factors)]
+
+
+def build_mesh(devices: Optional[Sequence] = None):
+    """Build the global mesh over ``devices`` (default: all)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    axes = mesh_axis_sizes(len(devices))
+    names = tuple(n for n, _ in axes)
+    shape = tuple(s for _, s in axes)
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def view_slot_axes(
+    mv: MachineView, axis_pool: Sequence[Tuple[str, int]]
+) -> Dict[int, Tuple[str, ...]]:
+    """Assign mesh axes to the view's slots (output dims + replica slot).
+
+    Deterministic: slots are visited in order (0..ndim-1 then
+    REPLICA_SLOT); each slot of degree d consumes, for every prime
+    factor of d, the first unused pool axis of that size.  Raises if
+    the view does not factor into the pool (the search only generates
+    views whose total parts divide the device count).
+    """
+    used = [False] * len(axis_pool)
+    slots: Dict[int, Tuple[str, ...]] = {}
+
+    def take(degree: int) -> Tuple[str, ...]:
+        taken: List[str] = []
+        for p in prime_factors(degree):
+            for i, (name, size) in enumerate(axis_pool):
+                if not used[i] and size == p:
+                    used[i] = True
+                    taken.append(name)
+                    break
+            else:
+                raise ValueError(
+                    f"degree {degree} does not factor into mesh axes {axis_pool}"
+                )
+        return tuple(taken)
+
+    for i, d in enumerate(mv.dim_degrees):
+        slots[i] = take(d) if d > 1 else ()
+    r = mv.replica_degree
+    slots[REPLICA_SLOT] = take(r) if r > 1 else ()
+    return slots
+
+
+def annot_partition_spec(annot: ShardAnnot, slot_axes: Dict[int, Tuple[str, ...]]):
+    """Lower a ShardAnnot to a PartitionSpec using the op's slot→axes map."""
+    from jax.sharding import PartitionSpec
+
+    entries = []
+    for dim, (deg, slot) in enumerate(zip(annot.degrees, annot.parallel_idx())):
+        if deg <= 1 or slot == -1:
+            entries.append(None)
+            continue
+        axes = slot_axes.get(slot, ())
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)

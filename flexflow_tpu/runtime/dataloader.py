@@ -1,0 +1,69 @@
+"""Host→device data loading.
+
+Reference: python/flexflow_dataloader.{h,cc,cu} SingleDataLoader — full
+numpy arrays staged in zero-copy memory, then per-batch index-launch
+copies to each device.  TPU-native: per-batch ``jax.device_put`` with
+the input's NamedSharding — each host only materializes the shards the
+mesh places locally, which is the same "index-sharded load under
+control replication" behaviour (flexflow_dataloader.h:102).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class SingleDataLoader:
+    """Iterates (inputs, labels) device-placed batches over full arrays."""
+
+    def __init__(
+        self,
+        compiled,
+        xs: Sequence[np.ndarray],
+        y: np.ndarray,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+    ):
+        import jax
+
+        self.compiled = compiled
+        self.xs = [np.ascontiguousarray(a) for a in xs]
+        self.y = np.ascontiguousarray(y)
+        n = self.xs[0].shape[0]
+        for a in self.xs:
+            assert a.shape[0] == n, "all inputs must share the sample dim"
+        assert self.y.shape[0] == n
+        self.num_samples = n
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = np.random.default_rng(seed)
+        self.drop_remainder = drop_remainder
+        self._in_shardings = [
+            compiled.input_sharding(i) for i in range(len(self.xs))
+        ]
+        self._label_sharding = compiled.batch_sharding()
+        self._jax = jax
+
+    @property
+    def num_batches(self) -> int:
+        if self.drop_remainder:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        order = np.arange(self.num_samples)
+        if self.shuffle:
+            self.rng.shuffle(order)
+        bs = self.batch_size
+        for b in range(self.num_batches):
+            idx = order[b * bs : (b + 1) * bs]
+            inputs = [
+                self._jax.device_put(a[idx], sh)
+                for a, sh in zip(self.xs, self._in_shardings)
+            ]
+            labels = self._jax.device_put(self.y[idx], self._label_sharding)
+            yield inputs, labels

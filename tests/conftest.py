@@ -4,20 +4,22 @@ The reference tests multi-GPU behaviour with real GPUs
 (tests/multi_gpu_tests.sh); we instead exercise the identical SPMD code
 paths on a virtual CPU mesh — XLA compiles the same collectives, so
 sharding correctness transfers to real TPU slices.
+
+NOTE: in this environment jax is pre-imported at interpreter startup
+with the axon/TPU platform selected, so env vars are too late — we
+override via jax.config before any backend is initialized.
 """
 
-import os
+import jax
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def mesh8():
-    import jax
     from flexflow_tpu.parallel.mesh import build_mesh
 
     return build_mesh(jax.devices()[:8])

@@ -1,0 +1,86 @@
+"""End-to-end training tests on an 8-virtual-device CPU mesh —
+the correctness anchor for the data-parallel path (SURVEY.md §7 stage 2)."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+
+def make_blobs(n=256, d=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)) * 3
+    y = rng.integers(0, classes, size=n)
+    x = centers[y] + rng.normal(size=(n, d))
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def test_mlp_trains_data_parallel():
+    cfg = ff.FFConfig(batch_size=32, epochs=8, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([32, 16])
+    t = model.dense(x, 64, activation="relu")
+    t = model.dense(t, 4)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    data_x, data_y = make_blobs()
+    hist = model.fit(x=data_x, y=data_y, verbose=False)
+    assert hist[-1]["accuracy"] > 0.9, hist[-1]
+    assert hist[-1]["sparse_categorical_crossentropy"] < hist[0]["sparse_categorical_crossentropy"]
+
+
+def test_mlp_eval_and_weights_roundtrip():
+    cfg = ff.FFConfig(batch_size=32, epochs=2, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([32, 16])
+    t = model.dense(x, 32, activation="relu", name="fc1")
+    t = model.dense(t, 4, name="fc2")
+    model.compile(loss_type="sparse_categorical_crossentropy", metrics=["accuracy"])
+    data_x, data_y = make_blobs()
+    model.fit(x=data_x, y=data_y, verbose=False)
+    rep = model.evaluate(x=data_x, y=data_y)
+    assert "accuracy" in rep and rep["samples"] > 0
+    w = model.get_weight("fc1", "kernel")
+    assert w.shape == (16, 32)
+    model.set_weight("fc1", "kernel", np.zeros_like(w))
+    assert np.all(model.get_weight("fc1", "kernel") == 0)
+
+
+def test_conv_net_trains():
+    cfg = ff.FFConfig(batch_size=16, epochs=4, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([16, 8, 8, 3])
+    t = model.conv2d(x, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.pool2d(t, 2, 2, 2, 2)
+    t = model.flat(t)
+    t = model.dense(t, 4)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="sparse_categorical_crossentropy", metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    n = 128
+    data_y = rng.integers(0, 4, n).astype(np.int32)
+    # class-dependent mean images → separable
+    data_x = (rng.normal(size=(n, 8, 8, 3)) + data_y[:, None, None, None]).astype(np.float32)
+    hist = model.fit(x=data_x, y=data_y, verbose=False)
+    assert hist[-1]["accuracy"] > 0.5, hist
+
+
+def test_regression_mse():
+    cfg = ff.FFConfig(batch_size=32, epochs=10, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([32, 8])
+    t = model.dense(x, 16, activation="relu")
+    t = model.dense(t, 1)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type="mean_squared_error", metrics=["mean_squared_error"])
+    rng = np.random.default_rng(1)
+    data_x = rng.normal(size=(256, 8)).astype(np.float32)
+    w_true = rng.normal(size=(8, 1)).astype(np.float32)
+    data_y = data_x @ w_true
+    hist = model.fit(x=data_x, y=data_y, verbose=False)
+    assert hist[-1]["mean_squared_error"] < hist[0]["mean_squared_error"] * 0.5

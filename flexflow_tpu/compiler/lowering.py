@@ -52,6 +52,10 @@ def data_parallel_strategy(graph: Graph, degree: int) -> Dict[int, MachineView]:
     )
     strategy: Dict[int, MachineView] = {}
     for node in graph.topo_order():
+        fixed = node.op.fixed_machine_view()
+        if fixed is not None:
+            strategy[node.guid] = fixed
+            continue
         out = node.op.output_shapes[0]
         batch = out.sizes[0] if out.ndim else 1
         d = 1
@@ -103,9 +107,11 @@ class CompiledModel:
         self._shardings: Dict[int, OpSharding] = {}
         self._slot_axes: Dict[int, Dict[int, Tuple[str, ...]]] = {}
         for node in self._topo:
-            mv = strategy.get(node.guid) or MachineView.trivial(
-                node.op.output_shapes[0].ndim
-            )
+            mv = strategy.get(node.guid)
+            if mv is None:
+                mv = node.op.fixed_machine_view() or MachineView.trivial(
+                    node.op.output_shapes[0].ndim
+                )
             self._shardings[node.guid] = node.op.propagate(mv)
             self._slot_axes[node.guid] = view_slot_axes(mv, axis_pool)
 
@@ -168,7 +174,7 @@ class CompiledModel:
             ins = []
             for e in in_edges:
                 x = values[(e.src, e.src_idx)]
-                if e.dst_idx < len(osh.inputs):
+                if e.dst_idx < len(osh.inputs) and osh.inputs[e.dst_idx] is not None:
                     x = self._constrain(x, osh.inputs[e.dst_idx], axes)
                 ins.append(x)
             outs = node.op.forward(ctx, ins, params.get(node.op.name, {}))

@@ -242,6 +242,42 @@ class FFModel:
                        use_cached=use_cached)
         return self._add_op(op, [input])[0]
 
+    # parallel ops (reference: src/parallel_ops/*; inserted by the search
+    # or placed manually for hand-written strategies) -------------------
+    def repartition(self, input: Tensor, dim: int, degree: int, name=None) -> Tensor:
+        from flexflow_tpu.parallel.parallel_ops import RepartitionOp
+
+        op = RepartitionOp(self._fresh_name("repartition", name),
+                           [self._shape_of(input)], dim=dim, degree=degree)
+        return self._add_op(op, [input])[0]
+
+    def combine(self, input: Tensor, dim: int, degree: int = 1, name=None) -> Tensor:
+        from flexflow_tpu.parallel.parallel_ops import CombineOp
+
+        op = CombineOp(self._fresh_name("combine", name),
+                       [self._shape_of(input)], dim=dim, degree=degree)
+        return self._add_op(op, [input])[0]
+
+    def replicate(self, input: Tensor, degree: int, name=None) -> Tensor:
+        from flexflow_tpu.parallel.parallel_ops import ReplicateOp
+
+        op = ReplicateOp(self._fresh_name("replicate", name),
+                         [self._shape_of(input)], degree=degree)
+        return self._add_op(op, [input])[0]
+
+    def reduction(self, input: Tensor, degree: int, name=None) -> Tensor:
+        from flexflow_tpu.parallel.parallel_ops import ReductionOp
+
+        op = ReductionOp(self._fresh_name("reduction", name),
+                         [self._shape_of(input)], degree=degree)
+        return self._add_op(op, [input])[0]
+
+    def node_by_name(self, name: str) -> Node:
+        for node in self.graph.nodes.values():
+            if node.op.name == name:
+                return node
+        raise KeyError(name)
+
     # elementwise -------------------------------------------------------
     def _unary(self, t: OperatorType, input: Tensor, name=None, scalar=0.0, base=None):
         op = O.ElementUnaryOp(self._fresh_name(base or t.value, name),

@@ -98,9 +98,15 @@ class ShardAnnot:
 
 @dataclass(frozen=True)
 class OpSharding:
-    """Result of degree propagation for one op under one MachineView."""
+    """Result of degree propagation for one op under one MachineView.
 
-    inputs: Tuple[ShardAnnot, ...]
+    An ``inputs`` entry may be ``None`` = *unconstrained*: the producer's
+    sharding governs and no constraint is applied (parallel ops use this
+    — the sharding delta at the edge IS their data movement).  Every
+    consumer of OpSharding.inputs must handle None.
+    """
+
+    inputs: Tuple[Optional[ShardAnnot], ...]
     weights: Tuple[ShardAnnot, ...]
     outputs: Tuple[ShardAnnot, ...]
 
@@ -192,6 +198,12 @@ class Operator:
         return float(b)
 
     # ---- search hooks ----------------------------------------------------
+    def fixed_machine_view(self) -> Optional["MachineView"]:
+        """Non-None when the op's attributes pin its view (parallel ops:
+        a Repartition to degree d MUST be viewed with degree d).  Default
+        strategy builders honor this instead of guessing."""
+        return None
+
     def splittable_output_dims(self) -> Tuple[int, ...]:
         """Output dims the search may partition. Default: dim 0 (batch)."""
         return (0,) if self.output_shapes[0].ndim else ()

@@ -1,0 +1,153 @@
+"""Parallelization correctness: strategies change sharding, never
+numerics.  TP/row-parallel/head-parallel runs must match data-parallel
+bit-for-bit-ish (same seed, fp32) — the property the reference checks
+with align/ + multi-GPU smoke tests."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.machine import MachineView
+
+
+def build_mlp(cfg):
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([32, 16])
+    t = model.dense(x, 64, activation="relu", name="fc1")
+    t = model.dense(t, 32, activation="relu", name="fc2")
+    t = model.dense(t, 4, name="head")
+    return model
+
+
+def data(seed=0, n=128):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(4, 16)) * 3
+    y = rng.integers(0, 4, n)
+    x = (centers[y] + rng.normal(size=(n, 16))).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+def run_with_strategy(strategy_fn, epochs=3):
+    cfg = ff.FFConfig(batch_size=32, epochs=epochs, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32", seed=7)
+    model = build_mlp(cfg)
+    strategy = strategy_fn(model) if strategy_fn else None
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  strategy=strategy)
+    x, y = data()
+    hist = model.fit(x=x, y=y, shuffle=False, verbose=False)
+    return model, hist
+
+
+def tp_strategy(model):
+    """Hand-written tensor parallelism: fc1 column-parallel (out-dim
+    split 4 x batch 2), fc2 row-parallel (contraction split 4), head DP —
+    the replicate_linear_combine / partition_linear_combine patterns
+    (reference: substitution.cc:70-81)."""
+    s = {}
+    for node in model.graph.topo_order():
+        nd = node.op.output_shapes[0].ndim
+        s[node.guid] = MachineView.data_parallel(nd, 2) if nd else MachineView.trivial(nd)
+    fc1 = model.node_by_name("fc1")
+    s[fc1.guid] = MachineView(dim_degrees=(2, 4))  # batch 2 x out-dim 4
+    fc2 = model.node_by_name("fc2")
+    s[fc2.guid] = MachineView(dim_degrees=(2, 1), replica_degree=4)  # row-parallel
+    return s
+
+
+def test_tp_matches_dp_numerics():
+    m_dp, h_dp = run_with_strategy(None)
+    m_tp, h_tp = run_with_strategy(tp_strategy)
+    assert h_tp[-1]["accuracy"] == pytest.approx(h_dp[-1]["accuracy"], abs=0.02)
+    assert h_tp[-1]["sparse_categorical_crossentropy"] == pytest.approx(
+        h_dp[-1]["sparse_categorical_crossentropy"], rel=1e-3, abs=1e-5
+    )
+    w_dp = m_dp.get_weight("fc1", "kernel")
+    w_tp = m_tp.get_weight("fc1", "kernel")
+    np.testing.assert_allclose(w_dp, w_tp, rtol=1e-4, atol=1e-5)
+
+
+def test_tp_weight_actually_sharded():
+    m_tp, _ = run_with_strategy(tp_strategy)
+    spec = m_tp.params["fc1"]["kernel"].sharding.spec
+    # kernel [16, 64]: in-dim unsharded, out-dim over 4 devices (2 axes)
+    assert len(spec) == 2 and spec[0] is None and spec[1] is not None
+    spec2 = m_tp.params["fc2"]["kernel"].sharding.spec
+    # fc2 row-parallel: kernel [64, 32] sharded on the contraction dim
+    assert len(spec2) >= 1 and spec2[0] is not None
+
+
+def test_explicit_parallel_ops_identity():
+    """Repartition/Combine/Replicate/Reduction chain preserves values."""
+    cfg = ff.FFConfig(batch_size=16, epochs=1, num_devices=8,
+                      compute_dtype="float32", only_data_parallel=False)
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([16, 8])
+    t = model.repartition(x, dim=0, degree=4, name="rp")
+    t = model.dense(t, 8, name="fc")
+    t = model.combine(t, dim=0, degree=1, name="cb")
+    t = model.replicate(t, degree=2, name="rep")
+    t = model.dense(t, 4, name="head")
+
+    strategy = {}
+    for node in model.graph.topo_order():
+        nd = node.op.output_shapes[0].ndim
+        strategy[node.guid] = MachineView.trivial(nd)
+    strategy[model.node_by_name("rp").guid] = MachineView(dim_degrees=(4, 1))
+    strategy[model.node_by_name("fc").guid] = MachineView(dim_degrees=(4, 1))
+
+    model.compile(strategy=strategy, loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    xs, ys = data(n=16)
+    xs = xs[:, :8]
+    hist = model.fit(x=xs, y=ys, verbose=False)
+    assert hist  # runs without error; numerics covered by parity below
+
+    # identity: forward of the chain equals plain dense stack with same weights
+    import jax.numpy as jnp
+
+    logits = model.compiled.forward_fn()(model.params, model.state, [jnp.asarray(xs)])
+    k1 = model.get_weight("fc", "kernel")
+    b1 = model.get_weight("fc", "bias")
+    k2 = model.get_weight("head", "kernel")
+    b2 = model.get_weight("head", "bias")
+    ref = (xs @ k1 + b1) @ k2 + b2
+    np.testing.assert_allclose(np.asarray(logits), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_mha_head_parallel_matches_single():
+    import jax.numpy as jnp
+
+    def build(nd, strategy_fn=None):
+        cfg = ff.FFConfig(batch_size=8, epochs=1, num_devices=nd,
+                          compute_dtype="float32", only_data_parallel=True, seed=3)
+        model = ff.FFModel(cfg)
+        q = model.create_tensor([8, 10, 32])
+        t = model.multihead_attention(q, q, q, embed_dim=32, num_heads=4, name="mha")
+        t = model.mean(t, dims=[1], name="pool")
+        t = model.dense(t, 4, name="out")
+        strategy = strategy_fn(model) if strategy_fn else None
+        model.compile(strategy=strategy, loss_type="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        return model
+
+    def head_parallel(model):
+        s = {}
+        for node in model.graph.topo_order():
+            nd_ = node.op.output_shapes[0].ndim
+            s[node.guid] = MachineView.data_parallel(nd_, 2)
+        s[model.node_by_name("mha").guid] = MachineView(
+            dim_degrees=(2, 1, 1), replica_degree=4
+        )
+        return s
+
+    rng = np.random.default_rng(0)
+    xq = rng.normal(size=(8, 10, 32)).astype(np.float32)
+    m1 = build(8)
+    m2 = build(8, head_parallel)
+    # same seed -> same init weights
+    l1 = m1.compiled.forward_fn()(m1.params, m1.state, [jnp.asarray(xq)])
+    l2 = m2.compiled.forward_fn()(m2.params, m2.state, [jnp.asarray(xq)])
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-4, atol=1e-4)

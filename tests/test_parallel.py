@@ -94,8 +94,7 @@ def test_explicit_parallel_ops_identity():
     strategy = {}
     for node in model.graph.topo_order():
         nd = node.op.output_shapes[0].ndim
-        strategy[node.guid] = MachineView.trivial(nd)
-    strategy[model.node_by_name("rp").guid] = MachineView(dim_degrees=(4, 1))
+        strategy[node.guid] = node.op.fixed_machine_view() or MachineView.trivial(nd)
     strategy[model.node_by_name("fc").guid] = MachineView(dim_degrees=(4, 1))
 
     model.compile(strategy=strategy, loss_type="sparse_categorical_crossentropy",

@@ -59,6 +59,8 @@ class Graph:
         self.in_edges: Dict[int, List[Edge]] = {}
         self.out_edges: Dict[int, List[Edge]] = {}
         self._next_guid = 1
+        self._topo_cache: Optional[List[Node]] = None
+        self._hash_cache: Optional[int] = None
 
     # ---- construction ----------------------------------------------------
     def new_node(self, op) -> Node:
@@ -67,9 +69,14 @@ class Graph:
         self.add_node(node)
         return node
 
+    def _invalidate(self) -> None:
+        self._topo_cache = None
+        self._hash_cache = None
+
     def add_node(self, node: Node) -> None:
         if node.guid in self.nodes:
             return
+        self._invalidate()
         self.nodes[node.guid] = node
         self.in_edges.setdefault(node.guid, [])
         self.out_edges.setdefault(node.guid, [])
@@ -78,11 +85,13 @@ class Graph:
     def add_edge(self, src: Node, dst: Node, src_idx: int = 0, dst_idx: int = 0) -> None:
         self.add_node(src)
         self.add_node(dst)
+        self._invalidate()
         e = Edge(src.guid, dst.guid, src_idx, dst_idx)
         self.out_edges[src.guid].append(e)
         self.in_edges[dst.guid].append(e)
 
     def remove_node(self, guid: int) -> None:
+        self._invalidate()
         for e in list(self.in_edges.get(guid, [])):
             self.out_edges[e.src].remove(e)
         for e in list(self.out_edges.get(guid, [])):
@@ -132,10 +141,10 @@ class Graph:
         return out
 
     def topo_order(self) -> List[Node]:
-        """Deterministic Kahn topological order (ties by guid)."""
-        indeg = {g: len(set((e.src, e.src_idx, e.dst_idx) for e in self.in_edges[g]))
-                 for g in self.nodes}
-        # count parallel edges properly: use raw counts
+        """Deterministic Kahn topological order (ties by guid); cached —
+        the search costs one graph thousands of times."""
+        if self._topo_cache is not None:
+            return self._topo_cache
         indeg = {g: len(self.in_edges[g]) for g in self.nodes}
         ready = sorted(g for g, d in indeg.items() if d == 0)
         order: List[Node] = []
@@ -151,6 +160,7 @@ class Graph:
                     heapq.heappush(ready, e.dst)
         if len(order) != len(self.nodes):
             raise ValueError("graph has a cycle")
+        self._topo_cache = order
         return order
 
     # ---- structural hash (memoization key) -------------------------------
@@ -161,6 +171,8 @@ class Graph:
         predecessor hashes — same role as the reference's graph hash
         used to memoize DP states (reference: src/runtime/graph.cc:1356).
         """
+        if self._hash_cache is not None:
+            return self._hash_cache
         h: Dict[int, int] = {}
         for node in self.topo_order():
             sig = repr(node.op.signature()) if hasattr(node.op, "signature") else repr(node.op)
@@ -171,7 +183,9 @@ class Graph:
             h[node.guid] = int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
         sinks = sorted(h[n.guid] for n in self.sinks())
         payload = repr(sinks).encode()
-        return int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
+        out = int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
+        self._hash_cache = out
+        return out
 
     # ---- dominators & bottlenecks ----------------------------------------
     def dominators(self) -> Dict[int, Set[int]]:

@@ -1,0 +1,113 @@
+"""Search driver — Unity's outer loop, plus the legacy MCMC search.
+
+Re-implements GraphSearchHelper::graph_optimize / base_optimize
+(reference: src/runtime/substitution.cc:1779-2089): best-first search
+over the substitution space, each candidate graph costed by the DP
+(SearchHelper), pruned by ``cost > alpha * best`` and a pop budget —
+and FFModel::mcmc_optimize (reference: src/runtime/model.cc:3033-3122),
+simulated annealing over per-op views.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+import random
+from typing import Dict, Optional, Tuple
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.search.dp import SearchHelper, Strategy
+from flexflow_tpu.search.simulator import Simulator
+from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+from flexflow_tpu.search.views import candidate_views
+
+
+def optimize_strategy(
+    graph: Graph, config: FFConfig, return_graph: bool = False
+) -> "Strategy | Tuple[Graph, Strategy]":
+    """Find a good (graph, strategy). With ``return_graph=False`` only
+    strategies on the ORIGINAL graph are explored (no rewrites) — the
+    common path, since degree-views already express DP/TP/row/head
+    splits; with True, substitution variants compete too."""
+    n = config.search_devices
+    sim = Simulator(config.machine_spec, num_devices=n)
+    helper = SearchHelper(sim, n)
+
+    best_cost, best_strategy = helper.graph_cost(graph)
+    best_graph = graph
+
+    if return_graph and config.search_budget > 0:
+        xfers = generate_all_pcg_xfers(n)
+        # best-first queue over rewritten graphs (substitution.cc:2007-2089)
+        counter = 0
+        heap: list = [(best_cost, counter, graph)]
+        seen = {graph.hash()}
+        budget = config.search_budget
+        while heap and budget > 0:
+            cost, _, g = heapq.heappop(heap)
+            if cost > config.search_alpha * best_cost:
+                break
+            budget -= 1
+            for xf in xfers:
+                for m in xf.find_matches(g):
+                    g2 = xf.apply(g, m)
+                    if g2 is None:
+                        continue
+                    h = g2.hash()
+                    if h in seen:
+                        continue
+                    seen.add(h)
+                    c2, s2 = helper.graph_cost(g2)
+                    if c2 < best_cost:
+                        best_cost, best_strategy, best_graph = c2, s2, g2
+                    if c2 < config.search_alpha * best_cost:
+                        counter += 1
+                        heapq.heappush(heap, (c2, counter, g2))
+
+    if return_graph:
+        return best_graph, best_strategy
+    return best_strategy
+
+
+def mcmc_optimize(
+    graph: Graph,
+    config: FFConfig,
+    iterations: int = 500,
+    temperature: float = 0.05,
+    seed: int = 0,
+) -> Strategy:
+    """Legacy MLSys'19 search: random single-op view rewrites, accepted
+    if better or with prob exp(-alpha*delta)
+    (reference: model.cc:3033-3122 rewrite/mcmc_optimize)."""
+    n = config.search_devices
+    sim = Simulator(config.machine_spec, num_devices=n)
+    rng = random.Random(seed)
+    nodes = graph.topo_order()
+
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+
+    current = dict(data_parallel_strategy(graph, n))
+    cur_cost = sim.simulate(graph, current)
+    best, best_cost = dict(current), cur_cost
+    for _ in range(iterations):
+        node = rng.choice(nodes)
+        if node.op.fixed_machine_view() is not None:
+            continue
+        views = candidate_views(node.op, n)
+        v = rng.choice(views)
+        old = current.get(node.guid)
+        current[node.guid] = v
+        c = sim.simulate(graph, current)
+        delta = c - cur_cost
+        if delta <= 0 or rng.random() < math.exp(-delta / max(temperature * cur_cost, 1e-12)):
+            cur_cost = c
+            if c < best_cost:
+                best, best_cost = dict(current), c
+        else:
+            if old is None:
+                current.pop(node.guid, None)
+            else:
+                current[node.guid] = old
+    return best

@@ -1,0 +1,176 @@
+"""Analytic TPU cost model.
+
+Replaces the reference's measured-kernel + bandwidth-table stack
+(reference: src/runtime/machine_model.cc:57-68 SimpleMachineModel,
+src/runtime/simulator.cc:515-787 measure_operator_cost /
+estimate_xfer_cost) with a roofline model parameterized by MachineSpec:
+
+* compute: max(FLOPs/MXU-peak, bytes/HBM-bw) per shard — correct
+  first-order model for XLA-fused TPU programs, where the reference's
+  per-op cuda-event timing has no analogue (ops fuse; SURVEY.md §7
+  hard part (a)).  An optional on-device probe refines hot ops.
+* collectives: ring formulas over ICI (bandwidth-optimal on a torus):
+  allreduce 2(n-1)/n, allgather/reducescatter (n-1)/n, all_to_all
+  (n-1)/n² per direction; DCN terms added when a collective spans
+  hosts.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from flexflow_tpu.core.machine import MachineSpec, MachineView
+from flexflow_tpu.core.ptensor import ParallelTensorShape
+from flexflow_tpu.ops.base import Operator, ShardAnnot
+
+# fixed per-op dispatch overhead inside one XLA program (fusion makes
+# this tiny compared to the reference's per-task launch overhead)
+OP_OVERHEAD_S = 2e-6
+
+
+@dataclass
+class CostModel:
+    machine: MachineSpec
+
+    # ---- compute ---------------------------------------------------------
+    def op_cost(self, op: Operator, mv: MachineView, backward: bool = True) -> float:
+        """Per-iteration compute seconds for one shard of ``op`` under
+        ``mv`` (all shards run concurrently on distinct devices)."""
+        parts = max(1, mv.num_parts)
+        flops = op.flops() / parts
+        bytes_ = op.bytes_accessed() / parts
+        fwd = max(flops / self.machine.peak_flops, bytes_ / self.machine.hbm_bandwidth)
+        t = fwd + OP_OVERHEAD_S
+        if backward:
+            # bwd ≈ 2x fwd FLOPs for matmul-family, ~1x for elementwise
+            bwd_factor = 2.0 if op.flops() > 4 * op.output_shapes[0].num_elements else 1.0
+            t += bwd_factor * fwd + OP_OVERHEAD_S
+        return t
+
+    # ---- collectives -----------------------------------------------------
+    def _link_time(self, bytes_per_device: float, n: int) -> Tuple[float, float]:
+        """(ici seconds, dcn seconds) for moving bytes once around a ring
+        of n devices; adds a DCN term when the ring spans hosts."""
+        ici = bytes_per_device / self.machine.ici_bandwidth
+        dcn = 0.0
+        if n > self.machine.devices_per_host:
+            dcn = bytes_per_device / self.machine.dcn_bandwidth
+        return ici, dcn
+
+    def allreduce(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        ici, dcn = self._link_time(2.0 * (n - 1) / n * nbytes, n)
+        return ici + dcn + 2 * (n - 1) * self.machine.ici_latency
+
+    def allgather(self, nbytes_shard: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        ici, dcn = self._link_time((n - 1) * nbytes_shard, n)
+        return ici + dcn + (n - 1) * self.machine.ici_latency
+
+    def reducescatter(self, nbytes: float, n: int) -> float:
+        return self.allgather(nbytes / max(n, 1), n)
+
+    def all_to_all(self, nbytes_shard: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        # each device exchanges (n-1)/n of its shard; ICI torus is
+        # dimension-ordered so add a hop-count factor ~sqrt(n)/2
+        hops = max(1.0, math.sqrt(n) / 2.0)
+        ici, dcn = self._link_time(nbytes_shard * (n - 1) / n * hops, n)
+        return ici + dcn + (n - 1) * self.machine.ici_latency
+
+    # ---- resharding (parallel-op) cost ----------------------------------
+    def xfer_cost(
+        self,
+        shape: ParallelTensorShape,
+        src: Optional[ShardAnnot],
+        dst: Optional[ShardAnnot],
+    ) -> float:
+        """Edge cost when producer/consumer shardings differ — the role
+        of estimate_xfer_cost (reference: simulator.cc:556-731), but
+        classified into the collective GSPMD will emit."""
+        if src is None or dst is None:
+            return 0.0
+        if src.degrees == dst.degrees and src.partial == dst.partial:
+            # NOTE: replica-degree differences are deliberately free — in
+            # GSPMD a tensor is implicitly replicated over every mesh axis
+            # its spec does not use, so "replicate to r" moves no bytes
+            # (the producer's unused-axis devices already hold the value);
+            # redundant compute is parallel in wall-time.  All-gather cost
+            # appears only on sharded->unsharded dim changes (below).
+            return 0.0
+        n_src = max(1, src.num_parts)
+        n_dst = max(1, dst.num_parts)
+        total = shape.num_bytes
+        if src.partial:
+            # partial-sum producer: reduction (+ possible reshard)
+            return self.allreduce(total / max(n_dst // src.replica, 1), src.replica)
+        shard_src = total / max(n_src // max(src.replica, 1), 1)
+        n = max(n_src, n_dst)
+        src_deg = 1
+        for d in src.degrees:
+            src_deg *= d
+        dst_deg = 1
+        for d in dst.degrees:
+            dst_deg *= d
+        if dst_deg > src_deg and all(
+            dd % sd == 0 for sd, dd in zip(src.degrees, dst.degrees)
+        ):
+            # pure refinement (repartition): slicing is local when the
+            # finer sharding nests in the coarser one
+            return OP_OVERHEAD_S
+        if dst_deg < src_deg and all(
+            sd % dd == 0 for sd, dd in zip(src.degrees, dst.degrees)
+        ):
+            # combine: all-gather over the vanished degree
+            return self.allgather(shard_src, src_deg // max(dst_deg, 1))
+        # general case: all-to-all style re-shard
+        return self.all_to_all(shard_src, n)
+
+    # ---- gradient synchronization ---------------------------------------
+    def weight_sync_cost(self, op: Operator, mv: MachineView) -> float:
+        """Per-iteration grad-allreduce for weights replicated across
+        ``mv`` (reference: NCCL allreduce in optimizer, optimizer.cc:155-193;
+        here XLA's psum over the batch axes of the mesh)."""
+        try:
+            osh = op.propagate(mv)
+        except AssertionError:
+            return math.inf
+        total = 0.0
+        for ws, annot in zip(op._weight_specs, osh.weights):
+            if annot is None or annot.replica <= 1:
+                continue
+            n = 1
+            for d in ws.shape:
+                n *= d
+            shard_elems = n
+            for d in annot.degrees:
+                shard_elems //= max(d, 1)
+            total += self.allreduce(shard_elems * ws.dtype.itemsize, annot.replica)
+        return total
+
+    # ---- memory ----------------------------------------------------------
+    def op_memory(self, op: Operator, mv: MachineView) -> float:
+        """Per-device bytes: weights + activations for one shard."""
+        try:
+            osh = op.propagate(mv)
+        except AssertionError:
+            return math.inf
+        mem = 0.0
+        for ws, annot in zip(op._weight_specs, osh.weights):
+            n = 1
+            for d in ws.shape:
+                n *= d
+            for d in annot.degrees:
+                n //= max(d, 1)
+            mem += n * ws.dtype.itemsize * 3  # weight + grad + opt state
+        for shape, annot in zip(op.output_shapes, osh.outputs):
+            n = shape.num_elements
+            for d in annot.degrees:
+                n //= max(d, 1)
+            mem += n * shape.dtype.itemsize * 2  # fwd + grad
+        return mem

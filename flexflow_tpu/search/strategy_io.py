@@ -1,0 +1,47 @@
+"""Strategy export/import (reference: src/runtime/strategy.cc:26-197,
+--export-strategy/--import-strategy, config.h:140-143).
+
+Format: JSON mapping op name -> {"dims": [...], "replica": r}.  Keyed
+by op NAME (stable across runs with deterministic name generation)
+rather than guid so strategies transfer between processes.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.core.machine import MachineView
+
+
+def export_strategy(path: str, graph: Graph, strategy: Dict[int, MachineView]) -> None:
+    out = {}
+    for guid, mv in strategy.items():
+        node = graph.nodes.get(guid)
+        if node is None:
+            continue
+        if node.op.name in out:
+            raise ValueError(
+                f"duplicate op name {node.op.name!r}: strategies are keyed "
+                "by name — give layers unique names to export"
+            )
+        out[node.op.name] = {
+            "dims": list(mv.dim_degrees),
+            "replica": mv.replica_degree,
+        }
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+
+
+def import_strategy(path: str, graph: Graph) -> Dict[int, MachineView]:
+    with open(path) as f:
+        data = json.load(f)
+    strategy: Dict[int, MachineView] = {}
+    for node in graph.topo_order():
+        if node.op.name in data:
+            d = data[node.op.name]
+            strategy[node.guid] = MachineView(
+                dim_degrees=tuple(d["dims"]), replica_degree=d.get("replica", 1)
+            )
+    return strategy

@@ -1,0 +1,245 @@
+"""Graph substitutions — Unity's outer loop rewrites.
+
+Re-implements the GraphXfer machinery (reference:
+src/runtime/substitution.cc:491-760 find_matches/run;
+:1619-1758 generate_all_pcg_xfers) as first-class rewrite objects:
+a matcher over PCG nodes plus an apply() that produces a new Graph
+with parallel ops inserted/removed.
+
+Note on expressiveness: in this framework the DP assigns partition
+degrees directly, so the classic "partition_X_combine" xfers do not
+*enable* parallelism (they make data movement explicit instead of
+implicit GSPMD resharding).  They are kept because (a) explicit
+movement nodes give the search control over WHERE resharding happens
+(e.g. combine early while the tensor is small), and (b) the
+simplification xfers (fusing/cancelling adjacent parallel ops,
+reference: parallel_op.cc:25-58 join algebra) clean up searched graphs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from flexflow_tpu.core.graph import Edge, Graph, Node
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import ParallelTensorShape
+from flexflow_tpu.parallel.parallel_ops import (
+    CombineOp,
+    ReductionOp,
+    RepartitionOp,
+    ReplicateOp,
+)
+
+Match = Node
+
+
+@dataclass
+class GraphXfer:
+    """A rewrite: match a node, produce a rewritten graph."""
+
+    name: str
+    matcher: Callable[[Graph, Node], bool]
+    apply_fn: Callable[[Graph, Node], Optional[Graph]]
+
+    def find_matches(self, graph: Graph) -> List[Match]:
+        return [n for n in graph.topo_order() if self.matcher(graph, n)]
+
+    def apply(self, graph: Graph, match: Match) -> Optional[Graph]:
+        return self.apply_fn(graph, match)
+
+
+# ---------------------------------------------------------------------------
+def _insert_before(graph: Graph, node: Node, dst_idx: int, make_op) -> Optional[Graph]:
+    """New graph with ``make_op(input_shape)`` spliced into the edge
+    feeding input ``dst_idx`` of ``node``."""
+    g = graph.copy()
+    edges = [e for e in g.in_edges[node.guid] if e.dst_idx == dst_idx]
+    if not edges:
+        return None
+    e = edges[0]
+    src_shape = g.nodes[e.src].op.output_shapes[e.src_idx]
+    new_op = make_op(src_shape)
+    if new_op is None:
+        return None
+    mid = Node(g._next_guid, new_op)
+    g._next_guid += 1
+    g.add_node(mid)
+    g.in_edges[node.guid].remove(e)
+    g.out_edges[e.src].remove(e)
+    e1 = Edge(e.src, mid.guid, e.src_idx, 0)
+    e2 = Edge(mid.guid, node.guid, 0, e.dst_idx)
+    g.out_edges[e.src].append(e1)
+    g.in_edges[mid.guid].append(e1)
+    g.out_edges[mid.guid].append(e2)
+    g.in_edges[node.guid].append(e2)
+    g._invalidate()  # direct edge-list surgery bypasses add_edge
+    return g
+
+
+def _insert_after(graph: Graph, node: Node, out_idx: int, make_op) -> Optional[Graph]:
+    g = graph.copy()
+    shape = node.op.output_shapes[out_idx]
+    new_op = make_op(shape)
+    if new_op is None:
+        return None
+    mid = Node(g._next_guid, new_op)
+    g._next_guid += 1
+    g.add_node(mid)
+    outs = [e for e in g.out_edges[node.guid] if e.src_idx == out_idx]
+    for e in outs:
+        g.out_edges[node.guid].remove(e)
+        g.in_edges[e.dst].remove(e)
+        ne = Edge(mid.guid, e.dst, 0, e.dst_idx)
+        g.out_edges[mid.guid].append(ne)
+        g.in_edges[e.dst].append(ne)
+    e1 = Edge(node.guid, mid.guid, out_idx, 0)
+    g.out_edges[node.guid].append(e1)
+    g.in_edges[mid.guid].append(e1)
+    g._invalidate()
+    return g
+
+
+_xfer_counter = [0]
+
+
+def _uname(base: str) -> str:
+    _xfer_counter[0] += 1
+    return f"{base}_x{_xfer_counter[0]}"
+
+
+# ---------------------------------------------------------------------------
+def make_partition_combine_xfer(
+    op_type: OperatorType, degree: int, dim: int = 0
+) -> GraphXfer:
+    """Repartition(input, dim) → op → Combine — the
+    create_partition_*_combine family (reference: substitution.cc:70-115,
+    generated per divisor degree :1648-1712)."""
+
+    def matcher(graph: Graph, node: Node) -> bool:
+        if node.op.op_type is not op_type:
+            return False
+        if node.op.op_type.is_parallel_op():
+            return False
+        out = node.op.output_shapes[0]
+        if dim >= out.ndim or out.sizes[dim] % degree != 0:
+            return False
+        # skip if already wrapped
+        preds = [graph.nodes[e.src].op.op_type for e in graph.in_edges[node.guid]]
+        return OperatorType.REPARTITION not in preds
+
+    def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
+        g = _insert_before(
+            graph,
+            node,
+            0,
+            lambda s: RepartitionOp(_uname("repartition"), [s], dim=dim, degree=degree)
+            if dim < s.ndim and s.sizes[dim] % degree == 0
+            else None,
+        )
+        if g is None:
+            return None
+        return _insert_after(
+            g,
+            g.nodes[node.guid],
+            0,
+            lambda s: CombineOp(_uname("combine"), [s], dim=dim, degree=1),
+        )
+
+    return GraphXfer(
+        name=f"partition_{op_type.value}_combine_d{degree}_dim{dim}",
+        matcher=matcher,
+        apply_fn=apply_fn,
+    )
+
+
+def make_replicate_reduce_xfer(op_type: OperatorType, degree: int) -> GraphXfer:
+    """Replicate(input) → op(contraction-split) → Reduction — the
+    create_replicate_linear_combine / replicate_attention_reduce family
+    (reference: substitution.cc:76-93)."""
+
+    def matcher(graph: Graph, node: Node) -> bool:
+        if node.op.op_type is not op_type:
+            return False
+        if node.op.max_replica_degree() % degree != 0 or degree < 2:
+            return False
+        preds = [graph.nodes[e.src].op.op_type for e in graph.in_edges[node.guid]]
+        return OperatorType.REPLICATE not in preds
+
+    def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
+        g = _insert_before(
+            graph,
+            node,
+            0,
+            lambda s: ReplicateOp(_uname("replicate"), [s], degree=degree),
+        )
+        if g is None:
+            return None
+        return _insert_after(
+            g,
+            g.nodes[node.guid],
+            0,
+            lambda s: ReductionOp(_uname("reduction"), [s], degree=degree),
+        )
+
+    return GraphXfer(
+        name=f"replicate_{op_type.value}_reduce_d{degree}",
+        matcher=matcher,
+        apply_fn=apply_fn,
+    )
+
+
+def make_simplify_xfer() -> GraphXfer:
+    """Cancel a Repartition directly followed by its inverse Combine
+    (reference: graph simplification / fuse_parallel_ops,
+    parallel_op.cc:25-58)."""
+
+    def matcher(graph: Graph, node: Node) -> bool:
+        if node.op.op_type is not OperatorType.REPARTITION:
+            return False
+        succs = graph.successors(node.guid)
+        return (
+            len(succs) == 1
+            and graph.nodes[succs[0]].op.op_type is OperatorType.COMBINE
+            and graph.nodes[succs[0]].op.attrs.get("dim")
+            == node.op.attrs.get("dim")
+        )
+
+    def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
+        g = graph.copy()
+        comb_guid = g.successors(node.guid)[0]
+        in_e = g.in_edges[node.guid][0]
+        out_edges = list(g.out_edges[comb_guid])
+        g.remove_node(node.guid)
+        g.remove_node(comb_guid)
+        for e in out_edges:
+            ne = Edge(in_e.src, e.dst, in_e.src_idx, e.dst_idx)
+            g.out_edges[in_e.src].append(ne)
+            g.in_edges[e.dst].append(ne)
+        g._invalidate()
+        return g
+
+    return GraphXfer(
+        name="cancel_repartition_combine", matcher=matcher, apply_fn=apply_fn
+    )
+
+
+def generate_all_pcg_xfers(num_devices: int) -> List[GraphXfer]:
+    """All rewrites for the device count, one per divisor degree —
+    mirrors generate_all_pcg_xfers (reference: substitution.cc:1619-1758)."""
+    degrees = [d for d in range(2, num_devices + 1) if num_devices % d == 0]
+    xfers: List[GraphXfer] = [make_simplify_xfer()]
+    for d in degrees:
+        for t in (
+            OperatorType.LINEAR,
+            OperatorType.MULTIHEAD_ATTENTION,
+            OperatorType.EW_ADD,
+            OperatorType.RELU,
+            OperatorType.CONCAT,
+            OperatorType.SOFTMAX,
+            OperatorType.CONV2D,
+        ):
+            xfers.append(make_partition_combine_xfer(t, d, dim=0))
+        xfers.append(make_replicate_reduce_xfer(OperatorType.LINEAR, d))
+        xfers.append(make_replicate_reduce_xfer(OperatorType.MULTIHEAD_ATTENTION, d))
+    return xfers

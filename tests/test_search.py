@@ -1,0 +1,173 @@
+"""Tests for the cost model, simulator, DP search, substitutions, MCMC —
+role of the reference's search unit tests (tests/unit/test_dominators.cc
+etc.) plus strategy-quality checks the reference does via osdi22ae."""
+
+import math
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.compiler.lowering import data_parallel_strategy
+from flexflow_tpu.core.machine import MachineSpec, MachineView
+from flexflow_tpu.search.dp import SearchHelper
+from flexflow_tpu.search.driver import mcmc_optimize, optimize_strategy
+from flexflow_tpu.search.simulator import Simulator
+from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+from flexflow_tpu.search.views import candidate_views
+
+
+def mlp_model(batch=64, in_dim=128, hidden=256, classes=16):
+    cfg = ff.FFConfig(batch_size=batch, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([batch, in_dim])
+    t = m.dense(x, hidden, activation="relu", name="fc1")
+    t = m.dense(t, hidden, activation="relu", name="fc2")
+    t = m.dense(t, classes, name="head")
+    return m
+
+
+def big_weight_model(batch=8, dim=2048):
+    """Tiny batch, huge weights: data parallelism must lose to TP
+    (grad allreduce dominates) — the Unity headline scenario."""
+    cfg = ff.FFConfig(batch_size=batch, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([batch, dim])
+    t = m.dense(x, dim, activation="relu", name="fc1")
+    t = m.dense(t, dim, activation="relu", name="fc2")
+    t = m.dense(t, 16, name="head")
+    return m
+
+
+def test_candidate_views_divisibility():
+    m = mlp_model()
+    node = m.node_by_name("fc1")
+    views = candidate_views(node.op, 8)
+    assert MachineView.trivial(2) in views
+    assert MachineView.data_parallel(2, 8) in views
+    assert any(v.dim_degrees[1] > 1 for v in views)  # TP column split
+    assert any(v.replica_degree > 1 for v in views)  # row-parallel
+    for v in views:
+        assert 8 % v.num_parts == 0
+
+
+def conv_model(batch=256):
+    """Conv net: heavy per-sample compute, small weights — the regime
+    where data parallelism wins (grad sync hides under backward)."""
+    cfg = ff.FFConfig(batch_size=batch, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([batch, 32, 32, 64])
+    t = m.conv2d(x, 64, 3, 3, 1, 1, 1, 1, activation="relu", name="c1")
+    t = m.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu", name="c2")
+    t = m.flat(t)
+    t = m.dense(t, 16, name="head")
+    return m
+
+
+def test_simulator_prefers_parallel():
+    m = conv_model()
+    sim = Simulator(MachineSpec.tpu_v5e(8), num_devices=8)
+    trivial = {n.guid: MachineView.trivial(n.op.output_shapes[0].ndim)
+               for n in m.graph.topo_order()}
+    dp = data_parallel_strategy(m.graph, 8)
+    c_triv = sim.simulate(m.graph, trivial)
+    c_dp = sim.simulate(m.graph, dp)
+    assert 0 < c_dp < c_triv
+
+
+def test_simulator_invalid_strategy_is_inf():
+    m = mlp_model()
+    sim = Simulator(MachineSpec.tpu_v5e(8), num_devices=8)
+    bad = data_parallel_strategy(m.graph, 8)
+    # concat-free model: break a Linear by replicating beyond max heads etc.
+    # use an inconsistent replicate view on a parallel op instead:
+    cfg = ff.FFConfig(num_devices=8)
+    m2 = ff.FFModel(cfg)
+    x = m2.create_tensor([16, 8])
+    t = m2.replicate(x, degree=4, name="rep")
+    m2.dense(t, 8, name="fc")
+    s = {n.guid: MachineView.trivial(n.op.output_shapes[0].ndim)
+         for n in m2.graph.topo_order()}  # violates rep's fixed degree
+    assert sim.simulate(m2.graph, s) == math.inf
+
+
+def test_dp_search_beats_or_matches_dp():
+    m = mlp_model()
+    sim = Simulator(MachineSpec.tpu_v5e(8), num_devices=8)
+    helper = SearchHelper(sim, 8)
+    cost, strategy = helper.graph_cost(m.graph)
+    dp_cost = sim.simulate(m.graph, data_parallel_strategy(m.graph, 8))
+    assert cost <= dp_cost * 1.001
+    assert len(strategy) == m.graph.num_nodes
+    assert len(helper.memo) > 0
+
+
+def test_search_finds_tp_for_big_weights():
+    m = big_weight_model()
+    sim = Simulator(MachineSpec.tpu_v5e(8), num_devices=8)
+    helper = SearchHelper(sim, 8)
+    cost, strategy = helper.graph_cost(m.graph)
+    dp_cost = sim.simulate(m.graph, data_parallel_strategy(m.graph, 8))
+    assert cost < dp_cost, (cost, dp_cost)
+    # the searched strategy should shard at least one big weight
+    fc_views = [strategy[m.node_by_name(n).guid] for n in ("fc1", "fc2")]
+    assert any(v.dim_degrees[1] > 1 or v.replica_degree > 1 for v in fc_views)
+
+
+def test_optimize_strategy_end_to_end_training():
+    cfg = ff.FFConfig(batch_size=32, epochs=2, num_devices=8,
+                      only_data_parallel=False, compute_dtype="float32",
+                      search_budget=4)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([32, 16])
+    t = m.dense(x, 64, activation="relu")
+    t = m.dense(t, 4)
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 4, 128).astype(np.int32)
+    xd = (rng.normal(size=(4, 16))[y] * 3 + rng.normal(size=(128, 16))).astype(np.float32)
+    hist = m.fit(x=xd, y=y, verbose=False)
+    assert hist[-1]["accuracy"] > 0.5
+
+
+def test_mcmc_optimize_runs():
+    m = mlp_model()
+    cfg = m.config
+    s = mcmc_optimize(m.graph, cfg, iterations=50, seed=1)
+    sim = Simulator(cfg.machine_spec, num_devices=8)
+    assert sim.simulate(m.graph, s) < math.inf
+
+
+def test_substitutions_apply_and_cancel():
+    m = mlp_model()
+    xfers = generate_all_pcg_xfers(8)
+    part = next(x for x in xfers if x.name.startswith("partition_linear_combine_d2"))
+    matches = part.find_matches(m.graph)
+    assert matches
+    g2 = part.apply(m.graph, matches[0])
+    assert g2 is not None
+    assert g2.num_nodes == m.graph.num_nodes + 2
+    g2.topo_order()  # still a DAG
+    cancel = next(x for x in xfers if x.name == "cancel_repartition_combine")
+    # cancel only fires when combine directly follows repartition
+    m3 = ff.FFModel(ff.FFConfig(num_devices=8))
+    x3 = m3.create_tensor([16, 8])
+    t3 = m3.repartition(x3, dim=0, degree=4)
+    t3 = m3.combine(t3, dim=0, degree=1)
+    m3.dense(t3, 8)
+    c_matches = cancel.find_matches(m3.graph)
+    assert len(c_matches) == 1
+    g3 = cancel.apply(m3.graph, c_matches[0])
+    assert g3.num_nodes == m3.graph.num_nodes - 2
+    g3.topo_order()
+
+
+def test_strategy_export_import_roundtrip(tmp_path):
+    from flexflow_tpu.search.strategy_io import export_strategy, import_strategy
+
+    m = mlp_model()
+    dp = data_parallel_strategy(m.graph, 8)
+    p = str(tmp_path / "strategy.json")
+    export_strategy(p, m.graph, dp)
+    back = import_strategy(p, m.graph)
+    assert back == dp

@@ -1,0 +1,59 @@
+"""Shared runner for example scripts: synthetic data generation, train
+loop, throughput report — the role of each reference example's
+top_level_task + DataLoader (e.g. transformer.cc:112-211)."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Sequence
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def synthetic_inputs(model: ff.FFModel, num_samples: int, seed: int = 0) -> List[np.ndarray]:
+    """Generate arrays matching the model's input tensors (batch dim
+    replaced by num_samples)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for t in model._input_tensors:
+        shape = (num_samples,) + tuple(t.sizes[1:])
+        if t.dtype.value.startswith("int"):
+            # embedding ids: stay in-range; find the consumer's vocab if any
+            vocab = 1000
+            node, _ = model._producer[t.guid]
+            for e in model.graph.out_edges[node.guid]:
+                consumer = model.graph.nodes[e.dst].op
+                if "num_entries" in consumer.attrs:
+                    vocab = consumer.attrs["num_entries"]
+            out.append(rng.integers(0, vocab, size=shape).astype(np.int32))
+        else:
+            out.append(rng.normal(size=shape).astype(np.float32))
+    return out
+
+
+def synthetic_labels(model: ff.FFModel, num_samples: int, loss: str, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    sink = model.graph.sinks()[-1]
+    out_shape = sink.op.output_shapes[0].sizes
+    if loss == "sparse_categorical_crossentropy":
+        return rng.integers(0, out_shape[-1], num_samples).astype(np.int32)
+    return rng.normal(size=(num_samples,) + tuple(out_shape[1:])).astype(np.float32)
+
+
+def run_example(model: ff.FFModel, name: str, loss: str = "sparse_categorical_crossentropy",
+                metrics: Sequence[str] = ("accuracy",), num_samples: int = 0,
+                optimizer=None):
+    cfg = model.config
+    num_samples = num_samples or cfg.batch_size * 8
+    xs = synthetic_inputs(model, num_samples)
+    y = synthetic_labels(model, num_samples, loss)
+    t0 = time.perf_counter()
+    model.compile(optimizer=optimizer, loss_type=loss, metrics=list(metrics))
+    print(f"[{name}] compile (incl. strategy search): {time.perf_counter()-t0:.2f}s")
+    model.fit(x=xs if len(xs) > 1 else xs[0], y=y)
+    thr = getattr(model, "last_throughput", None)
+    if thr:
+        print(f"[{name}] THROUGHPUT = {thr:.2f} samples/s")
+    return model

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""DLRM example (reference: examples/cpp/DLRM/dlrm.cc; osdi22ae/dlrm.sh)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_dlrm
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_dlrm(config)
+    run_example(model, "dlrm", loss="mean_squared_error",
+                metrics=["mean_squared_error"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Mixture-of-Experts example (reference: examples/cpp/mixture_of_experts/moe.cc)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_moe
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_moe(config)
+    run_example(model, "moe")
+
+
+if __name__ == "__main__":
+    main()

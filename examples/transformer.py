@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Transformer example (reference: examples/cpp/Transformer/transformer.cc;
+osdi22ae/bert.sh runs this with -b 8 --budget 30).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_transformer
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_transformer(config, num_layers=12, hidden=512, num_heads=8,
+                              ff_dim=2048, seq_len=512)
+    run_example(model, "transformer", loss="mean_squared_error",
+                metrics=["mean_squared_error"])
+
+
+if __name__ == "__main__":
+    main()

@@ -438,13 +438,16 @@ class FFModel:
                 acc = m if acc is None else jax.tree.map(lambda a, b: a + b, acc, m)
                 steps_done += 1
                 if steps_done == 1:
-                    jax.block_until_ready(loss)
+                    float(loss)  # readback fence (block_until_ready does
+                    # not reliably fence through remote-device tunnels)
                     t_start = time.perf_counter()  # skip compile time
             metrics.update(acc)
             if verbose:
                 print(f"epoch {epoch}: loss={float(loss):.4f} {metrics}")
             history.append(metrics.report())
-        jax.block_until_ready(self.params)
+        if steps_done == 0:
+            return history
+        float(loss)  # readback fence before reading the clock
         elapsed = time.perf_counter() - (t_start or time.perf_counter())
         if steps_done > 1 and elapsed > 0:
             thr = (steps_done - 1) * batch_size / elapsed

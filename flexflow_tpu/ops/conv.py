@@ -122,6 +122,9 @@ class Conv2DOp(Operator):
         a = self.attrs
         x = inputs[0].astype(ctx.compute_dtype)
         k = weights["kernel"].astype(ctx.compute_dtype)
+        # no preferred_element_type: its transpose rule rejects the mixed
+        # bf16/fp32 cotangent; the MXU still accumulates in fp32 before
+        # rounding the output to the compute dtype
         y = jax.lax.conv_general_dilated(
             x,
             k,
@@ -129,8 +132,7 @@ class Conv2DOp(Operator):
             padding=((a["padding_h"], a["padding_h"]), (a["padding_w"], a["padding_w"])),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=a["groups"],
-            preferred_element_type=jnp.float32,
-        )
+        ).astype(jnp.float32)
         if a["use_bias"]:
             y = y + weights["bias"].astype(jnp.float32)
         y = _ACT[a["activation"]](y)

@@ -160,6 +160,7 @@ class CompiledModel:
             rng=rng,
             seq_length=self.config.iteration.seq_length,
             state_in=state,
+            mesh=self.mesh if self._multi_device else None,
         )
         values: Dict[Tuple[int, int], jax.Array] = {}
         input_pos = {n.guid: i for i, n in enumerate(self._input_nodes)}
@@ -177,6 +178,7 @@ class CompiledModel:
                 if e.dst_idx < len(osh.inputs) and osh.inputs[e.dst_idx] is not None:
                     x = self._constrain(x, osh.inputs[e.dst_idx], axes)
                 ins.append(x)
+            ctx.slot_axes = axes
             outs = node.op.forward(ctx, ins, params.get(node.op.name, {}))
             for i, y in enumerate(outs):
                 if i < len(osh.outputs):

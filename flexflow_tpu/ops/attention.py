@@ -134,13 +134,38 @@ class MultiHeadAttentionOp(Operator):
     def _attention(self, ctx, qh, kh, vh):
         a = self.attrs
         scale = 1.0 / math.sqrt(self.head_dim)
-        if a["use_flash"]:
+        # sequence parallelism: when the strategy shards the seq dim
+        # (view slot 1), run ring attention over that mesh axis instead
+        # of letting GSPMD all-gather K/V (SURVEY.md §5 new capability).
+        # Only for self-attention shapes (Sk == Sq) and when attention
+        # dropout is inactive (ring path has no dropout support).
+        seq_axes = (ctx.slot_axes or {}).get(1, ())
+        self_attn = qh.shape[1] == kh.shape[1]
+        dropout_active = a["dropout"] > 0.0 and ctx.train
+        if (
+            ctx.mesh is not None
+            and len(seq_axes) == 1
+            and self_attn
+            and not dropout_active
+        ):
+            from flexflow_tpu.parallel.ring_attention import ring_attention
+
+            return ring_attention(
+                qh, kh, vh, ctx.mesh, seq_axes[0],
+                causal=a["causal"], scale=scale,
+                batch_axes=(ctx.slot_axes or {}).get(0, ()),
+            )
+        if a["use_flash"] and not dropout_active:
             try:
                 from flexflow_tpu.kernels.flash_attention import flash_attention
 
                 return flash_attention(qh, kh, vh, causal=a["causal"], scale=scale)
             except Exception:
                 pass  # fall back to the XLA path (e.g. CPU tests)
+        from flexflow_tpu.kernels.flash_attention import _xla_attention
+
+        if not dropout_active:
+            return _xla_attention(qh, kh, vh, a["causal"], scale)
         logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh, preferred_element_type=jnp.float32)
         logits = logits * scale
         if a["causal"]:
@@ -148,10 +173,9 @@ class MultiHeadAttentionOp(Operator):
             mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
             logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
-        if a["dropout"] > 0.0 and ctx.train:
-            keep = 1.0 - a["dropout"]
-            mask = jax.random.bernoulli(ctx.op_rng(self.name), keep, probs.shape)
-            probs = jnp.where(mask, probs / keep, 0.0)
+        keep = 1.0 - a["dropout"]
+        mask = jax.random.bernoulli(ctx.op_rng(self.name), keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0)
         return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(qh.dtype), vh)
 
     def propagate(self, mv: MachineView) -> OpSharding:
@@ -159,7 +183,10 @@ class MultiHeadAttentionOp(Operator):
         assert e_deg == 1, "embed dim of attention output stays whole"
         r = mv.replica_degree  # head split -> partial sums over wo
         q_annot = ShardAnnot((b, sq, 1), replica=r)
-        kv_annot = ShardAnnot((b, 1, 1), replica=r)  # k/v gathered over seq (ring later)
+        # self-attention: K/V stay seq-sharded too (ring attention rotates
+        # them); cross-attention with a different kv length keeps K/V whole
+        kv_seq = sq if self.input_shapes[1].sizes[1] == self.input_shapes[0].sizes[1] else 1
+        kv_annot = ShardAnnot((b, kv_seq, 1), replica=r)
         out = ShardAnnot(mv.dim_degrees, replica=r, partial=r > 1)
         R = REPLICA_SLOT
         head_w = ShardAnnot((1, r, 1), replica=b, idx=(-1, R, -1))

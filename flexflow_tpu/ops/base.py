@@ -121,6 +121,7 @@ class LoweringContext:
         rng: Optional[jax.Array] = None,
         seq_length: int = -1,
         state_in: Optional[Dict[str, Any]] = None,
+        mesh=None,
     ):
         self.compute_dtype = compute_dtype
         self.train = train
@@ -128,6 +129,8 @@ class LoweringContext:
         self.seq_length = seq_length
         self.state_in = state_in or {}
         self.state_out: Dict[str, Any] = {}
+        self.mesh = mesh  # global device mesh (None on single device)
+        self.slot_axes: Optional[Dict[int, tuple]] = None  # current op's view axes
 
     def op_rng(self, op_name: str) -> jax.Array:
         if self.rng is None:

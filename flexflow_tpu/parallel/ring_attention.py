@@ -1,0 +1,149 @@
+"""Ring attention — sequence/context parallelism.
+
+The reference cannot partition MHA's sequence dim at all
+(reference: substitution.cc:2599-2654 only sample-dim repartition and
+head-split; SURVEY.md §5 calls out the gap).  Here the seq dim is a
+first-class mesh axis: Q stays resident per shard while K/V blocks
+rotate around the ring via ``lax.ppermute`` over ICI neighbours, with
+online-softmax merging across steps — attention memory per chip stays
+O(S/n), enabling long-context training.
+
+Implemented at the shard_map level (XLA-level blockwise attention per
+step; the Pallas flash kernel accelerates the inner block on TPU).
+Causal masking is handled per (q-shard, kv-shard) pair: full blocks
+below the diagonal, masked diagonal blocks, skipped blocks above.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attn(q, k, v, scale, mask_mode, q_off, k_off):
+    """One blockwise attention step returning (acc, m, l) in fp32.
+
+    q: [B, Sq, H, D], k/v: [B, Sk, H, D].
+    mask_mode: 0 = full (no mask), 1 = causal within the pair using the
+    global offsets, 2 = fully masked (skip).
+    """
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    if mask_mode == 1:
+        rows = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        cols = k_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(rows >= cols, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [b,h,q,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def _merge(acc1, m1, l1, acc2, m2, l2):
+    """Numerically-stable combine of two online-softmax partials."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return acc1 * a1 + acc2 * a2, m, l1 * a1 + l2 * a2
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    seq_axis: "str | Tuple[str, ...]",
+    causal: bool = False,
+    scale: Optional[float] = None,
+    batch_axes: Tuple[str, ...] = (),
+) -> jax.Array:
+    """Global-view ring attention: q/k/v [B, S, H, D] (self-attention:
+    Sk == Sq) sharded on dim 1 over ``seq_axis`` of ``mesh`` (and
+    optionally on dim 0 over ``batch_axes``); returns [B, S, H, D] with
+    the same sharding.  Composable under jit (uses shard_map internally)."""
+    from jax import shard_map
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    assert q.shape[1] == k.shape[1], "ring attention requires Sk == Sq"
+    axis = seq_axis if isinstance(seq_axis, str) else seq_axis[0]
+    if not isinstance(seq_axis, str) and len(seq_axis) > 1:
+        raise NotImplementedError("ring over one mesh axis at a time")
+    n = mesh.shape[axis]
+    if n == 1:
+        from flexflow_tpu.kernels.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    s_local = q.shape[1] // n
+
+    def local_fn(q_l, k_l, v_l):
+        # q_l, k_l, v_l: [B, S/n, H, D] local shards
+        idx = jax.lax.axis_index(axis)
+        q_off = idx * s_local
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def compute(k_cur, v_cur, step_i, acc, m, l):
+            src_idx = (idx - step_i) % n  # whose kv block we hold now
+            k_off = src_idx * s_local
+            if causal:
+                # 3-way: kv fully after q -> skip; fully before -> full;
+                # same shard -> diagonal mask
+                def full_fn(_):
+                    return _block_attn(q_l, k_cur, v_cur, scale, 0, 0, 0)
+
+                def diag_fn(_):
+                    return _block_attn(q_l, k_cur, v_cur, scale, 1, q_off, k_off)
+
+                def skip_fn(_):
+                    return (
+                        jnp.zeros_like(acc),
+                        jnp.full_like(m, -1e30),
+                        jnp.zeros_like(l),
+                    )
+
+                branch = jnp.where(src_idx < idx, 0, jnp.where(src_idx == idx, 1, 2))
+                acc2, m2, l2 = jax.lax.switch(
+                    branch, [full_fn, diag_fn, skip_fn], None
+                )
+            else:
+                acc2, m2, l2 = _block_attn(q_l, k_cur, v_cur, scale, 0, 0, 0)
+            return _merge(acc, m, l, acc2, m2, l2)
+
+        b, sl, h, d = q_l.shape
+        acc = jnp.zeros((b, h, sl, d), jnp.float32)
+        m = jnp.full((b, h, sl, 1), -1e30, jnp.float32)
+        l = jnp.zeros((b, h, sl, 1), jnp.float32)
+        # step 0 on the resident kv block, then n-1 rotate-and-compute
+        # steps — no trailing rotation whose result nobody reads
+        acc, m, l = compute(k_l, v_l, 0, acc, m, l)
+
+        def step(carry, step_i):
+            k_cur, v_cur, acc, m, l = carry
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            acc, m, l = compute(k_cur, v_cur, step_i, acc, m, l)
+            return (k_cur, v_cur, acc, m, l), None
+
+        if n > 1:
+            (_, _, acc, m, l), _ = jax.lax.scan(
+                step, (k_l, v_l, acc, m, l), jnp.arange(1, n)
+            )
+        out = acc / jnp.maximum(l, 1e-30)
+        return out.transpose(0, 2, 1, 3).astype(q_l.dtype)  # [B, S/n, H, D]
+
+    b_spec = None
+    if batch_axes:
+        b_spec = batch_axes[0] if len(batch_axes) == 1 else tuple(batch_axes)
+    spec = P(b_spec, axis, None, None)
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -40,6 +40,7 @@ _LAZY = {
     "ZeroInitializer": ("flexflow_tpu.initializers", "ZeroInitializer"),
     "ConstantInitializer": ("flexflow_tpu.initializers", "ConstantInitializer"),
     "NormInitializer": ("flexflow_tpu.initializers", "NormInitializer"),
+    "CheckpointManager": ("flexflow_tpu.runtime.checkpoint", "CheckpointManager"),
 }
 
 __all__ = ["__version__", *_LAZY]

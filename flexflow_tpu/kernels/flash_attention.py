@@ -27,26 +27,36 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
-try:  # pallas TPU backend
+try:  # pallas may be unavailable on some backends; the XLA paths in
+    # this module must stay importable without it
+    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     _HAS_PLTPU = True
 except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
     _HAS_PLTPU = False
 
 NEG_INF = -1e30
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
-    *, scale: float, causal: bool, block_q: int, block_k: int, q_k_offset: int
+    q_ref, k_ref, v_ref, o_ref, *refs,
+    scale: float, causal: bool, block_q: int, block_k: int, q_k_offset: int,
+    partial_out: bool = False,
 ):
     """Grid: (BH, num_q_blocks, num_k_blocks) — k innermost (sequential
     on TPU), so scratch accumulators carry across k steps.
     ``q_k_offset`` = Sk - Sq aligns the causal diagonal at the sequence
-    END (query i attends to keys <= i + offset), matching tril(k=sk-sq)."""
+    END (query i attends to keys <= i + offset), matching tril(k=sk-sq).
+    With ``partial_out`` the kernel emits UNNORMALIZED (acc, m, l) so
+    callers (ring attention) can merge partials across devices."""
+    if partial_out:
+        m_out, l_out, m_scratch, l_scratch, acc_scratch = refs
+    else:
+        m_scratch, l_scratch, acc_scratch = refs
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
     qb = pl.program_id(1)
@@ -89,8 +99,13 @@ def _flash_kernel(
 
     @pl.when(kb == nk - 1)
     def _finish():
-        l = jnp.maximum(l_scratch[:], 1e-30)
-        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+        if partial_out:
+            o_ref[0] = acc_scratch[:].astype(o_ref.dtype)
+            m_out[0] = m_scratch[:].astype(m_out.dtype)
+            l_out[0] = l_scratch[:].astype(l_out.dtype)
+        else:
+            l = jnp.maximum(l_scratch[:], 1e-30)
+            o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float,
@@ -132,7 +147,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
-def _xla_attention(q, k, v, causal, scale):
+def _xla_attention(q, k, v, causal, scale, dropout_rate=0.0, dropout_rng=None):
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
     logits = logits * scale
     if causal:
@@ -140,7 +155,114 @@ def _xla_attention(q, k, v, causal, scale):
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+def _xla_attention_partial(q, k, v, causal, scale):
+    """Unnormalized blockwise partials (acc, m, l) in fp32, layout
+    acc [B,H,Sq,D], m/l [B,H,Sq,1] — the XLA fallback twin of the
+    partial-out Pallas path, and its recompute-backward reference."""
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def _flash_forward_partial(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Pallas partial-out forward: returns (acc, m, l) shaped
+    [B,H,Sq,D] / [B,H,Sq,1] fp32."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    grid = (b * h, sq // block_q, sk // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, q_k_offset=sk - sq,
+        partial_out=True,
+    )
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    sspec = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            qspec,
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[qspec, sspec, sspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return (
+        acc.reshape(b, h, sq, d),
+        m.reshape(b, h, sq, 1),
+        l.reshape(b, h, sq, 1),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_partial_vjp(q, k, v, causal, scale, block_q, block_k):
+    return _fap_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+
+
+def flash_attention_partial(
+    q, k, v, causal: bool = False, scale: float | None = None,
+    block_q: int = 128, block_k: int = 128,
+):
+    """Blocked attention partials for cross-device merging (ring
+    attention): q,k,v [B,S,H,D] -> (acc [B,H,Sq,D], m, l [B,H,Sq,1]),
+    all fp32 and unnormalized (out = acc/l after merging)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_partial_vjp(q, k, v, causal, scale, block_q, block_k)
+
+
+def _fap_fwd(q, k, v, causal, scale, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    sq, sk = q.shape[1], k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if not _HAS_PLTPU or sq % bq != 0 or sk % bk != 0 or q.shape[-1] % 8 != 0:
+        out = _xla_attention_partial(q, k, v, causal, scale)
+    else:
+        out = _flash_forward_partial(q, k, v, causal, scale, bq, bk, interpret)
+    return out, (q, k, v)
+
+
+def _fap_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+
+    def f(q, k, v):
+        return _xla_attention_partial(q, k, v, causal, scale)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+_flash_partial_vjp.defvjp(_fap_fwd, _fap_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

@@ -166,17 +166,10 @@ class MultiHeadAttentionOp(Operator):
 
         if not dropout_active:
             return _xla_attention(qh, kh, vh, a["causal"], scale)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh, preferred_element_type=jnp.float32)
-        logits = logits * scale
-        if a["causal"]:
-            sq, sk = logits.shape[-2], logits.shape[-1]
-            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-            logits = jnp.where(mask, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        keep = 1.0 - a["dropout"]
-        mask = jax.random.bernoulli(ctx.op_rng(self.name), keep, probs.shape)
-        probs = jnp.where(mask, probs / keep, 0.0)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(qh.dtype), vh)
+        return _xla_attention(
+            qh, kh, vh, a["causal"], scale,
+            dropout_rate=a["dropout"], dropout_rng=ctx.op_rng(self.name),
+        )
 
     def propagate(self, mv: MachineView) -> OpSharding:
         b, sq, e_deg = mv.dim_degrees

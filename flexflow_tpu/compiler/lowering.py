@@ -103,7 +103,10 @@ class CompiledModel:
         assert sinks, "empty graph"
         self._sink = sinks[-1]
 
-        axis_pool = mesh_axis_sizes(int(np.prod(list(self.mesh.shape.values()))))
+        # axis pool = the mesh's own axes (minus any pipeline axis, which
+        # only the pipelined lowering may consume); for default meshes
+        # this equals mesh_axis_sizes(num_devices).
+        axis_pool = [(n, s) for n, s in self.mesh.shape.items() if n != "pp"]
         self._shardings: Dict[int, OpSharding] = {}
         self._slot_axes: Dict[int, Dict[int, Tuple[str, ...]]] = {}
         for node in self._topo:
@@ -165,29 +168,34 @@ class CompiledModel:
         values: Dict[Tuple[int, int], jax.Array] = {}
         input_pos = {n.guid: i for i, n in enumerate(self._input_nodes)}
         for node in self._topo:
-            osh = self._shardings[node.guid]
-            axes = self._slot_axes[node.guid]
-            if node.guid in input_pos:
-                x = inputs[input_pos[node.guid]]
-                values[(node.guid, 0)] = self._constrain(x, osh.outputs[0], axes)
-                continue
-            in_edges = sorted(self.graph.in_edges[node.guid], key=lambda e: e.dst_idx)
-            ins = []
-            for e in in_edges:
-                x = values[(e.src, e.src_idx)]
-                if e.dst_idx < len(osh.inputs) and osh.inputs[e.dst_idx] is not None:
-                    x = self._constrain(x, osh.inputs[e.dst_idx], axes)
-                ins.append(x)
-            ctx.slot_axes = axes
-            outs = node.op.forward(ctx, ins, params.get(node.op.name, {}))
-            for i, y in enumerate(outs):
-                if i < len(osh.outputs):
-                    y = self._constrain(y, osh.outputs[i], axes)
-                values[(node.guid, i)] = y
+            self._run_node(node, ctx, values, params, inputs, input_pos)
         logits = values[(self._sink.guid, 0)]
         new_state = dict(state)
         new_state.update(ctx.state_out)
         return logits, new_state
+
+    def _run_node(self, node, ctx, values, params, inputs, input_pos):
+        """Lower one PCG node into ``values`` (shared by the pipelined
+        subclass's apply)."""
+        osh = self._shardings[node.guid]
+        axes = self._slot_axes[node.guid]
+        if node.guid in input_pos:
+            x = inputs[input_pos[node.guid]]
+            values[(node.guid, 0)] = self._constrain(x, osh.outputs[0], axes)
+            return
+        in_edges = sorted(self.graph.in_edges[node.guid], key=lambda e: e.dst_idx)
+        ins = []
+        for e in in_edges:
+            x = values[(e.src, e.src_idx)]
+            if e.dst_idx < len(osh.inputs) and osh.inputs[e.dst_idx] is not None:
+                x = self._constrain(x, osh.inputs[e.dst_idx], axes)
+            ins.append(x)
+        ctx.slot_axes = axes
+        outs = node.op.forward(ctx, ins, params.get(node.op.name, {}))
+        for i, y in enumerate(outs):
+            if i < len(osh.outputs):
+                y = self._constrain(y, osh.outputs[i], axes)
+            values[(node.guid, i)] = y
 
     # ------------------------------------------------------------------
     def init_params(self, seed: int = 0):

@@ -357,16 +357,35 @@ class FFModel:
         metrics=("accuracy",),
         comp_mode: str = "training",
         strategy: Optional[Dict[int, MachineView]] = None,
+        pipeline=None,
+        block_of: Optional[Dict[int, int]] = None,
     ):
         """Pick a parallelization strategy and lower
-        (reference: FFModel::compile model.cc:2587)."""
+        (reference: FFModel::compile model.cc:2587).  ``pipeline`` — a
+        flexflow_tpu.parallel.pipeline.PipelineConfig enables the
+        S-stage microbatched pipeline over a ``pp`` mesh axis (a
+        capability the reference only stubbed: OP_PIPELINE,
+        ffconst.h:148)."""
         from flexflow_tpu.compiler.lowering import CompiledModel, data_parallel_strategy
 
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
+        if pipeline is not None and (
+            pipeline.num_stages < 1
+            or self.config.num_devices % pipeline.num_stages != 0
+        ):
+            raise ValueError(
+                f"pipeline.num_stages={pipeline.num_stages} must divide "
+                f"num_devices={self.config.num_devices}"
+            )
         if strategy is None:
-            if self.config.import_strategy_file:
+            if pipeline is not None:
+                # dp over the devices left after the pp axis is carved off
+                strategy = data_parallel_strategy(
+                    self.graph, self.config.num_devices // pipeline.num_stages
+                )
+            elif self.config.import_strategy_file:
                 from flexflow_tpu.search.strategy_io import import_strategy
 
                 strategy = import_strategy(self.config.import_strategy_file, self.graph)
@@ -388,14 +407,28 @@ class FFModel:
                 self.config.export_strategy_computation_graph_file, strategy
             )
 
-        self.compiled = CompiledModel(
-            self.graph,
-            strategy,
-            self.config,
-            LossType.from_any(loss_type),
-            list(metrics),
-            self.optimizer,
-        )
+        if pipeline is not None:
+            from flexflow_tpu.compiler.pipeline_lowering import PipelinedCompiledModel
+
+            self.compiled = PipelinedCompiledModel(
+                self.graph,
+                strategy,
+                self.config,
+                LossType.from_any(loss_type),
+                list(metrics),
+                self.optimizer,
+                pipeline=pipeline,
+                block_of=block_of,
+            )
+        else:
+            self.compiled = CompiledModel(
+                self.graph,
+                strategy,
+                self.config,
+                LossType.from_any(loss_type),
+                list(metrics),
+                self.optimizer,
+            )
         self.params, self.state = self.compiled.init_params(self.config.seed)
         self.opt_state = self.optimizer.init_state(self.params)
         return self.compiled

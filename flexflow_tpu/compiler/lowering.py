@@ -106,7 +106,9 @@ class CompiledModel:
         # axis pool = the mesh's own axes (minus any pipeline axis, which
         # only the pipelined lowering may consume); for default meshes
         # this equals mesh_axis_sizes(num_devices).
-        axis_pool = [(n, s) for n, s in self.mesh.shape.items() if n != "pp"]
+        _pl = getattr(self, "pipeline", None)
+        pp_axis = _pl.axis_name if _pl is not None else "pp"
+        axis_pool = [(n, s) for n, s in self.mesh.shape.items() if n != pp_axis]
         self._shardings: Dict[int, OpSharding] = {}
         self._slot_axes: Dict[int, Dict[int, Tuple[str, ...]]] = {}
         for node in self._topo:
@@ -238,12 +240,18 @@ class CompiledModel:
         params = jax.jit(_init, out_shardings=(shardings or None))(key)
 
         state: Dict[str, jax.Array] = {}
+        # replicate state vars over the whole mesh so eager (un-jitted)
+        # multi-device forward sees consistently-placed operands
+        rep = jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec())
         for node in self._topo:
             ss = getattr(node.op, "state_specs", None)
             if ss is None:
                 continue
             for name, shape, dtype, fill in ss():
-                state[f"{node.op.name}/{name}"] = jnp.full(shape, fill, dtype)
+                v = jnp.full(shape, fill, dtype)
+                if self._multi_device:
+                    v = jax.device_put(v, rep)
+                state[f"{node.op.name}/{name}"] = v
         self.param_shardings = shardings
         return params, state
 
@@ -293,8 +301,10 @@ class CompiledModel:
         return self._eval_step_fn(params, state, inputs, labels)
 
     def forward_fn(self):
-        """(params, state, inputs) -> logits — for export/inspection."""
+        """(params, state, inputs) -> logits — for export/inspection.
+        Jitted: one XLA program, same as the train step."""
 
+        @jax.jit
         def fwd(params, state, inputs):
             logits, _ = self.apply(params, state, inputs, None, train=False)
             return logits

@@ -51,15 +51,15 @@ from flexflow_tpu.parallel.pipeline import (
 _BLOCK_RE = re.compile(r"^layer(\d+)_")
 
 
-def build_pipeline_mesh(devices: Sequence, num_stages: int):
-    """Mesh with a leading ``pp`` axis of size num_stages; remaining
+def build_pipeline_mesh(devices: Sequence, num_stages: int, axis_name: str = "pp"):
+    """Mesh with a leading pipeline axis of size num_stages; remaining
     devices factor into the usual prime-sized data/model axes."""
     from jax.sharding import Mesh
 
     n = len(devices)
     assert n % num_stages == 0, f"{n} devices not divisible into {num_stages} stages"
     rest = mesh_axis_sizes(n // num_stages)
-    names = ("pp",) + tuple(a for a, _ in rest)
+    names = (axis_name,) + tuple(a for a, _ in rest)
     shape = (num_stages,) + tuple(s for _, s in rest)
     return Mesh(np.array(devices).reshape(shape), names)
 
@@ -129,7 +129,8 @@ class PipelinedCompiledModel(CompiledModel):
         config = args[2]
         if kwargs.get("mesh") is None:
             kwargs["mesh"] = build_pipeline_mesh(
-                jax.devices()[: config.num_devices], pipeline.num_stages
+                jax.devices()[: config.num_devices], pipeline.num_stages,
+                axis_name=pipeline.axis_name,
             )
         super().__init__(*args, **kwargs)
 
@@ -166,10 +167,11 @@ class PipelinedCompiledModel(CompiledModel):
         all_members = [
             {n.guid for n in blk} for blk in self._blocks
         ]
+        topo = graph.topo_order()
         for bi, blk in enumerate(self._blocks):
             member = all_members[bi]
             exits = set()
-            for node in graph.topo_order():
+            for node in topo:
                 if node.guid in member:
                     continue
                 for e in graph.in_edges[node.guid]:
@@ -260,7 +262,7 @@ class PipelinedCompiledModel(CompiledModel):
         def stage_fn(p_stage, x, const, mb_index):
             # p_stage leaves: [L/S, ...] — scan over this stage's blocks.
             key = const
-            s_idx = jax.lax.axis_index("pp") if S > 1 else 0
+            s_idx = jax.lax.axis_index(self.pipeline.axis_name) if S > 1 else 0
             # distinct key per (stage, block, microbatch): stochastic ops
             # must not reuse masks across microbatches
             key = jax.random.fold_in(jax.random.fold_in(key, s_idx), mb_index)
@@ -308,7 +310,7 @@ class PipelinedCompiledModel(CompiledModel):
                     continue  # blocks >0 share the stacked entries
                 for ws in node.op._weight_specs:
                     spec = jax.sharding.PartitionSpec(
-                        "pp", *([None] * len(ws.shape))
+                        self.pipeline.axis_name, *([None] * len(ws.shape))
                     )
                     specs.append(
                         (node.op.name, ws.name, (L,) + ws.shape,

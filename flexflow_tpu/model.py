@@ -518,3 +518,12 @@ class FFModel:
         self.params[op_name][weight_name] = jax.device_put(
             value.astype(old.dtype), old.sharding
         )
+
+    def set_state_var(self, key: str, value: np.ndarray) -> None:
+        """Overwrite one model-state entry (e.g. a batch-norm running
+        statistic, key ``"<op>/running_mean"``)."""
+        import jax
+
+        old = self.state[key]
+        assert tuple(old.shape) == tuple(value.shape), (key, old.shape, value.shape)
+        self.state[key] = jax.device_put(value.astype(old.dtype), old.sharding)

@@ -1,0 +1,180 @@
+"""Frontend importer tests — torch.fx (align/-style parity vs torch
+forward outputs, reference: align/align_test.py protocol) and the
+serialized-file round trip (reference: torch_to_flexflow format)."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from flexflow_tpu.frontends import (  # noqa: E402
+    PyTorchModel,
+    torch_to_flexflow,
+    transfer_torch_weights,
+)
+
+
+def _forward(model, params, state, xs):
+    fwd = model.compiled.forward_fn()
+    out = fwd(params, state, [np.asarray(x, np.float32) for x in xs])
+    return out if isinstance(out, (list, tuple)) else [out]
+
+
+def _import_and_run(module, np_inputs, ff_dims):
+    cfg = ff.FFConfig(batch_size=ff_dims[0][0], num_devices=1,
+                      only_data_parallel=True, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    ts = [model.create_tensor(list(d)) for d in ff_dims]
+    outs = PyTorchModel(module).torch_to_ff(model, ts)
+    assert len(outs) >= 1
+    model.compile(loss_type="mean_squared_error", metrics=["mean_squared_error"])
+    n = transfer_torch_weights(module, model)
+    assert n > 0
+    y = _forward(model, model.params, model.state, np_inputs)
+    return model, y
+
+
+class SmallMLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+class SmallCNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 8, 3, padding=1)
+        self.pool = nn.MaxPool2d(2, 2)
+        self.conv2 = nn.Conv2d(8, 8, 3, padding=1)
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(8 * 4 * 4, 10)
+
+    def forward(self, x):
+        x = self.pool(torch.relu(self.conv1(x)))
+        x = self.pool(torch.relu(self.conv2(x)))
+        return self.fc(self.flatten(x))
+
+
+class FuncZoo(nn.Module):
+    """Exercises call_function/call_method handlers."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 8)
+        self.ln = nn.LayerNorm(8)
+
+    def forward(self, x):
+        a = self.fc(x)
+        b = torch.sigmoid(a) * 2.0 + x
+        c = torch.cat([a, b], dim=1).reshape(x.shape[0], 2, 8)
+        d = c.transpose(1, 2).mean(dim=2)
+        e = self.ln(d + 1.0)
+        return torch.softmax(e / 2.0, dim=-1)
+
+
+def test_torch_mlp_parity():
+    m = SmallMLP().eval()
+    x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+    _, y = _import_and_run(m, [x], [(8, 16)])
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(y[0]), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_torch_cnn_parity_nchw_bridge():
+    m = SmallCNN().eval()
+    x = np.random.default_rng(1).normal(size=(4, 3, 16, 16)).astype(np.float32)
+    _, y = _import_and_run(m, [x], [(4, 3, 16, 16)])
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(y[0]), ref, rtol=1e-3, atol=1e-3)
+
+
+def test_torch_function_zoo_parity():
+    m = FuncZoo().eval()
+    x = np.random.default_rng(2).normal(size=(4, 8)).astype(np.float32)
+    _, y = _import_and_run(m, [x], [(4, 8)])
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(y[0]), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_torch_file_roundtrip(tmp_path):
+    m = SmallMLP().eval()
+    path = str(tmp_path / "mlp.ffir")
+    torch_to_flexflow(m, path, [torch.zeros(8, 16)])
+    cfg = ff.FFConfig(batch_size=8, num_devices=1, only_data_parallel=True,
+                      compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    t = model.create_tensor([8, 16])
+    outs = PyTorchModel(path).torch_to_ff(model, [t])
+    assert outs[0].sizes[-1] == 4
+    model.compile(loss_type="mean_squared_error", metrics=["mean_squared_error"])
+    y = _forward(model, model.params, model.state, [np.zeros((8, 16), np.float32)])
+    assert np.asarray(y[0]).shape == (8, 4)
+
+
+def test_imported_model_trains_data_parallel():
+    """Imported graphs go through the same compile/search/fit path."""
+    m = SmallMLP()
+    cfg = ff.FFConfig(batch_size=32, epochs=4, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    t = model.create_tensor([32, 16])
+    PyTorchModel(m).torch_to_ff(model, [t])
+    model.compile(loss_type="sparse_categorical_crossentropy", metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 16)) * 3
+    ys = rng.integers(0, 4, size=256)
+    xs = (centers[ys] + rng.normal(size=(256, 16))).astype(np.float32)
+    hist = model.fit(x=xs, y=ys.astype(np.int32), verbose=False)
+    assert hist[-1]["accuracy"] > 0.8
+
+
+class BNNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(3, 4, 3, padding=1)
+        self.bn = nn.BatchNorm2d(4)
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(4 * 8 * 8, 2)
+
+    def forward(self, x):
+        return self.fc(self.flatten(torch.relu(self.bn(self.conv(x)))))
+
+
+def test_torch_batchnorm_eval_parity():
+    """Trained running stats must transfer — eval-mode outputs match."""
+    m = BNNet()
+    rng = np.random.default_rng(3)
+    m.train()
+    with torch.no_grad():  # populate non-trivial running stats
+        for _ in range(4):
+            m(torch.from_numpy(rng.normal(1.5, 2.0, size=(8, 3, 8, 8)).astype(np.float32)))
+    m.eval()
+    x = rng.normal(size=(4, 3, 8, 8)).astype(np.float32)
+    model, y = _import_and_run(m, [x], [(4, 3, 8, 8)])
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(y[0]), ref, rtol=1e-3, atol=1e-3)
+
+
+def test_onnx_importer_gated():
+    try:
+        import onnx  # noqa: F401
+        has_onnx = True
+    except ImportError:
+        has_onnx = False
+    if not has_onnx:
+        from flexflow_tpu.frontends import ONNXModel
+
+        with pytest.raises(ImportError):
+            ONNXModel("nonexistent.onnx")

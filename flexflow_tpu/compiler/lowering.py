@@ -302,11 +302,14 @@ class CompiledModel:
 
     def forward_fn(self):
         """(params, state, inputs) -> logits — for export/inspection.
-        Jitted: one XLA program, same as the train step."""
+        Jitted once and cached (a fresh closure per call would recompile
+        every time)."""
+        if getattr(self, "_forward_fn", None) is None:
 
-        @jax.jit
-        def fwd(params, state, inputs):
-            logits, _ = self.apply(params, state, inputs, None, train=False)
-            return logits
+            @jax.jit
+            def fwd(params, state, inputs):
+                logits, _ = self.apply(params, state, inputs, None, train=False)
+                return logits
 
-        return fwd
+            self._forward_fn = fwd
+        return self._forward_fn

@@ -125,6 +125,10 @@ class ONNXModel:
     def handle_Gemm(self, ff, node, env, a):
         x = env[node.input[0]]
         w = self._w(node.input[1])
+        if w is None:
+            raise NotImplementedError(
+                f"Gemm with non-initializer B operand {node.input[1]!r}"
+            )
         bias = self._w(node.input[2]) if len(node.input) > 2 else None
         if a.get("transA", 0):
             raise NotImplementedError("Gemm with transA=1")
@@ -151,6 +155,10 @@ class ONNXModel:
                 self._record(name, "bias", bias)
                 self._fused_adds[id(add_node)] = node.output[0]
             return y
+        if w is not None:  # batched (>2-D) initializer — not importable
+            raise NotImplementedError(
+                f"MatMul with {w.ndim}-D initializer operand {node.input[1]!r}"
+            )
         return ff.batch_matmul(env[node.input[0]], env[node.input[1]], name=name)
 
     def _find_bias_add(self, node, out_dim):

@@ -352,8 +352,21 @@ class _Tracer:
         if fname == "dropout":
             rate = node.kwargs.get("p", node.args[1] if len(node.args) > 1 else 0.5)
             return self.emit("dropout", name, [self.ref(node.args[0])], rate=float(rate))
-        if fname == "expand":
-            # broadcast is implicit in downstream elementwise ops
+        if fname in ("expand", "expand_as", "broadcast_to"):
+            # broadcast is implicit in elementwise consumers; anything
+            # shape-sensitive (cat/reshape/matmul/...) would silently see
+            # the un-expanded shape, so reject those explicitly
+            _ELEMENTWISE_OK = {"add", "sub", "mul", "truediv", "div",
+                               "maximum", "minimum", "relu", "sigmoid",
+                               "tanh", "gelu", "exp", "log", "pow"}
+            for user in node.users:
+                uname = (user.target if isinstance(user.target, str)
+                         else getattr(user.target, "__name__", "?")).rstrip("_")
+                if user.op == "output" or uname not in _ELEMENTWISE_OK:
+                    raise NotImplementedError(
+                        f"expand() feeding non-elementwise consumer {uname!r} "
+                        "is not supported (the broadcast would be dropped)"
+                    )
             return self.emit("identity", name, [self.ref(node.args[0])])
         raise NotImplementedError(f"unsupported torch function/method {fname!r}")
 
@@ -549,11 +562,13 @@ def transfer_torch_weights(torch_module, ffmodel) -> int:
                 ffmodel.set_weight(op_name, "beta", w["bias"])
                 copied += 2
         elif isinstance(mod, nn.BatchNorm2d):
-            ffmodel.set_weight(op_name, "scale", w["weight"])
-            ffmodel.set_weight(op_name, "bias", w["bias"])
-            copied += 2
+            if "weight" in w:  # affine=False has no scale/bias
+                ffmodel.set_weight(op_name, "scale", w["weight"])
+                ffmodel.set_weight(op_name, "bias", w["bias"])
+                copied += 2
             # eval-mode parity needs the trained running statistics too
-            ffmodel.set_state_var(f"{op_name}/running_mean", w["running_mean"])
-            ffmodel.set_state_var(f"{op_name}/running_var", w["running_var"])
-            copied += 2
+            if "running_mean" in w:  # track_running_stats=False has none
+                ffmodel.set_state_var(f"{op_name}/running_mean", w["running_mean"])
+                ffmodel.set_state_var(f"{op_name}/running_var", w["running_var"])
+                copied += 2
     return copied

@@ -435,8 +435,13 @@ class FFModel:
 
     # ------------------------------------------------------------------
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
-            epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True):
-        """Training loop (reference: flexflow_cffi.py:1832 fit)."""
+            epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True,
+            callbacks: Sequence = ()):
+        """Training loop (reference: flexflow_cffi.py:1832 fit).
+
+        ``callbacks`` follow the keras callback protocol (duck-typed:
+        on_train_begin/end, on_epoch_begin, on_epoch_end(epoch, logs) —
+        return False from on_epoch_end to stop early)."""
         import jax
 
         from flexflow_tpu.runtime.dataloader import SingleDataLoader
@@ -453,11 +458,16 @@ class FFModel:
             raise ValueError(
                 f"no full batch: {loader.num_samples} samples < batch_size {batch_size}"
             )
+        for cb in callbacks:
+            cb.on_train_begin()
         metrics = PerfMetrics()
         history = []
         t_start = None
         steps_done = 0
+        stop = False
         for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
             metrics.reset()
             acc = None  # device-side metric accumulation; host sync once/epoch
             for inputs, labels in loader:
@@ -477,7 +487,16 @@ class FFModel:
             metrics.update(acc)
             if verbose:
                 print(f"epoch {epoch}: loss={float(loss):.4f} {metrics}")
-            history.append(metrics.report())
+            logs = metrics.report()
+            logs["loss"] = float(loss)
+            history.append(logs)
+            for cb in callbacks:
+                if cb.on_epoch_end(epoch, logs) is False:
+                    stop = True
+            if stop:
+                break
+        for cb in callbacks:
+            cb.on_train_end()
         if steps_done == 0:
             return history
         float(loss)  # readback fence before reading the clock

@@ -219,9 +219,15 @@ class Graph:
     def bottlenecks(self) -> List[Node]:
         """Nodes through which *every* source→sink path passes, in topo
         order, excluding sources/sinks — the sequence-split candidates
-        (reference: src/runtime/graph.cc:580 find_bottleneck_node)."""
+        (reference: src/runtime/graph.cc:580 find_bottleneck_node).
+        Runs on the native bitset engine when available
+        (native/src/graph_algos.cpp ffn_graph_bottlenecks)."""
         if not self.nodes:
             return []
+        native = self._native_call("graph_bottlenecks")
+        if native is not None:
+            idx_to_guid, result = native
+            return [self.nodes[idx_to_guid[i]] for i in result]
         sink_guids = [n.guid for n in self.sinks()]
         src_guids = {n.guid for n in self.sources()}
         dom = self.dominators()
@@ -284,7 +290,35 @@ class Graph:
             set(self.nodes) - a_guids
         )
 
+    def _native_call(self, fn_name: str):
+        """Run a native graph algorithm over dense indices (sorted-guid
+        order, matching the Python tie-breaks). None = lib unavailable."""
+        try:
+            from flexflow_tpu import native
+        except ImportError:
+            return None
+        fn = getattr(native, fn_name)
+        guids = sorted(self.nodes)
+        index = {g: i for i, g in enumerate(guids)}
+        edges = [
+            (index[e.src], index[e.dst])
+            for g in self.nodes
+            for e in self.out_edges[g]
+        ]
+        result = fn(len(guids), edges)
+        if result is None:
+            return None
+        return guids, result
+
     def weakly_connected_components(self) -> List[Set[int]]:
+        native = self._native_call("graph_components")
+        if native is not None:
+            guids, labels = native
+            comps: Dict[int, Set[int]] = {}
+            for g, lbl in zip(guids, labels):
+                comps.setdefault(lbl, set()).add(g)
+            # native labels are assigned in smallest-member order already
+            return [comps[k] for k in sorted(comps)]
         parent = {g: g for g in self.nodes}
 
         def find(x):
@@ -301,7 +335,8 @@ class Graph:
         comps: Dict[int, Set[int]] = {}
         for g in self.nodes:
             comps.setdefault(find(g), set()).add(g)
-        return [comps[k] for k in sorted(comps)]
+        # deterministic order (and native-path parity): by smallest member
+        return sorted(comps.values(), key=min)
 
     def _subgraph(self, guids: Set[int]) -> "Graph":
         g = Graph()

@@ -359,14 +359,24 @@ class _Tracer:
             _ELEMENTWISE_OK = {"add", "sub", "mul", "truediv", "div",
                                "maximum", "minimum", "relu", "sigmoid",
                                "tanh", "gelu", "exp", "log", "pow"}
+            nn = self.torch.nn
+            _ELEMENTWISE_MODULES = (nn.ReLU, nn.Sigmoid, nn.Tanh, nn.GELU,
+                                    nn.ELU, nn.Identity, nn.Dropout)
             for user in node.users:
-                uname = (user.target if isinstance(user.target, str)
-                         else getattr(user.target, "__name__", "?")).rstrip("_")
-                if user.op == "output" or uname not in _ELEMENTWISE_OK:
-                    raise NotImplementedError(
-                        f"expand() feeding non-elementwise consumer {uname!r} "
-                        "is not supported (the broadcast would be dropped)"
-                    )
+                if user.op == "call_module":
+                    mod = self.gm.get_submodule(user.target)
+                    if isinstance(mod, _ELEMENTWISE_MODULES):
+                        continue
+                    uname = type(mod).__name__
+                else:
+                    uname = (user.target if isinstance(user.target, str)
+                             else getattr(user.target, "__name__", "?")).rstrip("_")
+                    if user.op != "output" and uname in _ELEMENTWISE_OK:
+                        continue
+                raise NotImplementedError(
+                    f"expand() feeding non-elementwise consumer {uname!r} "
+                    "is not supported (the broadcast would be dropped)"
+                )
             return self.emit("identity", name, [self.ref(node.args[0])])
         raise NotImplementedError(f"unsupported torch function/method {fname!r}")
 

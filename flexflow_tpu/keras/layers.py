@@ -39,7 +39,10 @@ class Layer:
 
     def __init__(self, name: Optional[str] = None, input_shape=None):
         base = type(self).__name__.lower()
+        self._auto_named = name is None
         if name is None:
+            # provisional; models renumber auto names per model at
+            # compile time for process-independent weight keys
             i = Layer._name_counts.get(base, 0)
             Layer._name_counts[base] = i + 1
             name = f"{base}_{i}" if i else base

@@ -26,6 +26,20 @@ class _BaseModel:
         self._optimizer = None
         self.history: List[Dict[str, float]] = []
 
+    def _renumber_auto_names(self) -> None:
+        """Auto-generated layer names are renumbered per model in topo
+        order at compile time, so weight/checkpoint keys depend only on
+        the model structure — not on how many layers any earlier model
+        in the process created."""
+        counts: Dict[str, int] = {}
+        for layer in self._topo_layers():
+            if not getattr(layer, "_auto_named", False):
+                continue
+            base = type(layer).__name__.lower()
+            i = counts.get(base, 0)
+            counts[base] = i + 1
+            layer.name = f"{base}_{i}" if i else base
+
     # -- to be provided by subclasses -------------------------------------
     def _topo_layers(self) -> List[Layer]:
         raise NotImplementedError
@@ -52,7 +66,11 @@ class _BaseModel:
         self._optimizer = resolve_optimizer(optimizer, self.ffconfig)
 
         model = ff.FFModel(self.ffconfig)
+        self._renumber_auto_names()
         env: Dict[int, object] = {}
+        # input tensors are created in user order (Model(inputs=[...]) /
+        # Sequential first layer); the lowering binds fit/predict arrays
+        # by tensor creation order, so this IS the data binding order
         for inp in self._input_layers():
             kt = inp.outputs[0]
             dims = (self.ffconfig.batch_size,) + tuple(
@@ -112,7 +130,10 @@ class _BaseModel:
                 ]
             y = np.asarray(fwd(m.params, m.state, batch))
             outs.append(y[:got])
-        return np.concatenate(outs, axis=0) if outs else np.empty((0,))
+        if outs:
+            return np.concatenate(outs, axis=0)
+        out_tail = tuple(self._ff_outputs[0].sizes[1:])
+        return np.empty((0,) + out_tail, dtype=np.float32)
 
     # weight access (reference: get_weight_tensor/set_weight_tensor)
     def get_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
@@ -195,7 +216,17 @@ class Model(_BaseModel):
         return list(self._topo)
 
     def _input_layers(self):
-        return [l for l in self._topo if isinstance(l, InputLayer)]
+        # user order from Model(inputs=[...]), NOT topo discovery order —
+        # fit([xa, xb], y) must bind arrays to these positions
+        declared = [t.layer for t in self.inputs]
+        assert all(isinstance(l, InputLayer) for l in declared), (
+            "Model(inputs=...) must be Input()/InputLayer tensors")
+        extra = [l for l in self._topo
+                 if isinstance(l, InputLayer) and l not in declared]
+        assert not extra, (
+            f"graph reaches Input layers not listed in Model(inputs=...): "
+            f"{[l.name for l in extra]}")
+        return declared
 
     def _output_tensors(self):
         return list(self.outputs)

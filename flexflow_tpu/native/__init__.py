@@ -1,0 +1,223 @@
+"""ctypes bindings for the native runtime library (native/).
+
+The reference implements its graph core, search inner loop, simulator,
+and dataloader in C++ (reference: src/runtime/graph.cc, simulator.cc,
+python/flexflow_dataloader.cc); this package binds our TPU-native C++
+equivalents.  The library is built on demand with `make` (g++, no
+dependencies); every caller has a pure-Python fallback, so the package
+works — more slowly — without a toolchain.  Set FLEXFLOW_TPU_NO_NATIVE=1
+to force the fallbacks (used by tests to compare both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libflexflow_native.so")
+
+_lib = None
+_lib_tried = False
+
+
+def _configure(lib) -> None:
+    c_i32, c_f64 = ctypes.c_int32, ctypes.c_double
+    p_i32 = ctypes.POINTER(ctypes.c_int32)
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    p_f64 = ctypes.POINTER(ctypes.c_double)
+    p_u8 = ctypes.POINTER(ctypes.c_uint8)
+    p_void = ctypes.c_void_p
+
+    lib.ffn_sim_create.restype = p_void
+    lib.ffn_sim_create.argtypes = [c_i32, c_i32]
+    lib.ffn_sim_destroy.argtypes = [p_void]
+    lib.ffn_sim_add_view.argtypes = [p_void, c_i32, c_f64, c_f64, c_f64,
+                                     p_i32, c_i32, c_i32]
+    lib.ffn_sim_set_default_view.argtypes = [p_void, c_i32, c_i32]
+    lib.ffn_sim_add_edge.argtypes = [p_void, c_i32, c_i32, p_f64]
+    lib.ffn_sim_simulate.restype = c_f64
+    lib.ffn_sim_simulate.argtypes = [p_void, p_i32, c_i32]
+    lib.ffn_sim_brute_force.restype = c_f64
+    lib.ffn_sim_brute_force.argtypes = [p_void, p_i32, c_i32, p_i32, c_i32]
+    lib.ffn_sim_greedy.restype = c_f64
+    lib.ffn_sim_greedy.argtypes = [p_void, p_u8, p_i32, p_i32, c_i32]
+
+    lib.ffn_graph_topo.restype = c_i32
+    lib.ffn_graph_topo.argtypes = [c_i32, p_i32, c_i32, p_i32]
+    lib.ffn_graph_bottlenecks.restype = c_i32
+    lib.ffn_graph_bottlenecks.argtypes = [c_i32, p_i32, c_i32, p_i32]
+    lib.ffn_graph_components.restype = c_i32
+    lib.ffn_graph_components.argtypes = [c_i32, p_i32, c_i32, p_i32]
+
+    lib.ffn_gather_rows.argtypes = [p_u8, p_u8, p_i64,
+                                    ctypes.c_int64, ctypes.c_int64, c_i32]
+
+
+def get_lib():
+    """The loaded native library, building it if necessary; None when
+    disabled or unbuildable."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("FLEXFLOW_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        _configure(lib)
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+# ---------------------------------------------------------------------------
+# Simulation engine
+# ---------------------------------------------------------------------------
+
+
+class NativeSimGraph:
+    """A digested (graph, candidate views) instance on the native engine.
+
+    Node ids must be dense 0..n-1 in topological order. Per node, views
+    are registered in order; ``add_edge`` takes the row-major
+    [src_views x dst_views] xfer-seconds matrix.
+    """
+
+    def __init__(self, num_nodes: int, num_devices: int):
+        self.lib = get_lib()
+        assert self.lib is not None, "native library unavailable"
+        self.num_nodes = num_nodes
+        self._g = self.lib.ffn_sim_create(num_nodes, num_devices)
+
+    def __del__(self):
+        if getattr(self, "_g", None):
+            self.lib.ffn_sim_destroy(self._g)
+            self._g = None
+
+    def add_view(self, node: int, fwd: float, full: float, sync: float,
+                 devices: Sequence[int], valid: bool = True) -> None:
+        d = np.asarray(list(devices), dtype=np.int32)
+        self.lib.ffn_sim_add_view(self._g, node, float(fwd), float(full),
+                                  float(sync), _i32(d), len(d), int(valid))
+
+    def set_default_view(self, node: int, view: int) -> None:
+        self.lib.ffn_sim_set_default_view(self._g, node, view)
+
+    def add_edge(self, src: int, dst: int, xfer: np.ndarray) -> None:
+        x = np.ascontiguousarray(xfer, dtype=np.float64)
+        self.lib.ffn_sim_add_edge(
+            self._g, src, dst, x.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        )
+
+    def simulate(self, assignment: Sequence[int], include_update=True) -> float:
+        a = np.asarray(list(assignment), dtype=np.int32)
+        return self.lib.ffn_sim_simulate(self._g, _i32(a), int(include_update))
+
+    def brute_force(self, free_nodes: Sequence[int], base: Sequence[int],
+                    include_update=True) -> Tuple[float, np.ndarray]:
+        """Returns (best_cost, best_assignment)."""
+        f = np.asarray(list(free_nodes), dtype=np.int32)
+        a = np.asarray(list(base), dtype=np.int32)
+        cost = self.lib.ffn_sim_brute_force(self._g, _i32(f), len(f), _i32(a),
+                                            int(include_update))
+        return cost, a
+
+    def greedy(self, is_free: Sequence[bool], enum_counts: Sequence[int],
+               base: Sequence[int], include_update=True) -> Tuple[float, np.ndarray]:
+        m = np.asarray(list(is_free), dtype=np.uint8)
+        e = np.asarray(list(enum_counts), dtype=np.int32)
+        a = np.asarray(list(base), dtype=np.int32)
+        cost = self.lib.ffn_sim_greedy(
+            self._g, m.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            _i32(e), _i32(a), int(include_update))
+        return cost, a
+
+
+# ---------------------------------------------------------------------------
+# Graph algorithms
+# ---------------------------------------------------------------------------
+
+
+def _edges_array(edges: Sequence[Tuple[int, int]]) -> np.ndarray:
+    if len(edges) == 0:
+        return np.empty((0, 2), dtype=np.int32)
+    return np.asarray(edges, dtype=np.int32)
+
+
+def graph_bottlenecks(n: int, edges: Sequence[Tuple[int, int]]) -> Optional[List[int]]:
+    """Native bottleneck finding; None when the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    e = _edges_array(edges)
+    out = np.empty(max(n, 1), dtype=np.int32)
+    cnt = lib.ffn_graph_bottlenecks(n, _i32(e), len(e), _i32(out))
+    if cnt < 0:
+        raise ValueError("graph has a cycle")
+    return [int(x) for x in out[:cnt]]
+
+
+def graph_components(n: int, edges: Sequence[Tuple[int, int]]) -> Optional[List[int]]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    e = _edges_array(edges)
+    labels = np.empty(max(n, 1), dtype=np.int32)
+    lib.ffn_graph_components(n, _i32(e), len(e), _i32(labels))
+    return [int(x) for x in labels[:n]]
+
+
+def graph_topo(n: int, edges: Sequence[Tuple[int, int]]) -> Optional[List[int]]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    e = _edges_array(edges)
+    out = np.empty(max(n, 1), dtype=np.int32)
+    rc = lib.ffn_graph_topo(n, _i32(e), len(e), _i32(out))
+    if rc < 0:
+        raise ValueError("graph has a cycle")
+    return [int(x) for x in out[:n]]
+
+
+# ---------------------------------------------------------------------------
+# Dataloader gather
+# ---------------------------------------------------------------------------
+
+
+def gather_rows(src: np.ndarray, indices: np.ndarray,
+                n_threads: int = 0) -> Optional[np.ndarray]:
+    """dst[i] = src[indices[i]] via the threaded native gather;
+    None when the library is unavailable (caller falls back to np.take)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = int(src.dtype.itemsize * np.prod(src.shape[1:], dtype=np.int64))
+    if n_threads <= 0:
+        n_threads = min(8, os.cpu_count() or 1)
+    lib.ffn_gather_rows(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx), row_bytes, n_threads,
+    )
+    return out

@@ -54,6 +54,23 @@ class SingleDataLoader:
             return self.num_samples // self.batch_size
         return (self.num_samples + self.batch_size - 1) // self.batch_size
 
+    @staticmethod
+    def _gather(a: np.ndarray, idx: np.ndarray) -> np.ndarray:
+        """Shuffled-row gather; threaded native path for large batches
+        (native/src/dataloader.cpp ffn_gather_rows, the analog of the
+        reference's C++ index-copy dataloader tasks)."""
+        row_bytes = a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
+        if row_bytes * len(idx) >= 1 << 20:  # 1 MiB: threads pay off
+            try:
+                from flexflow_tpu import native
+
+                out = native.gather_rows(a, idx)
+                if out is not None:
+                    return out
+            except ImportError:
+                pass
+        return a[idx]
+
     def __iter__(self):
         order = np.arange(self.num_samples)
         if self.shuffle:
@@ -62,8 +79,8 @@ class SingleDataLoader:
         for b in range(self.num_batches):
             idx = order[b * bs : (b + 1) * bs]
             inputs = [
-                self._jax.device_put(a[idx], sh)
+                self._jax.device_put(self._gather(a, idx), sh)
                 for a, sh in zip(self.xs, self._in_shardings)
             ]
-            labels = self._jax.device_put(self.y[idx], self._label_sharding)
+            labels = self._jax.device_put(self._gather(self.y, idx), self._label_sharding)
             yield inputs, labels

@@ -147,7 +147,10 @@ class SearchHelper:
 
     # ------------------------------------------------------------------
     def _leaf_cost(self, graph, fixed, budget):
-        """Brute force over candidate-view products for free nodes."""
+        """Brute force over candidate-view products for free nodes —
+        runs on the native engine when available (native/src/
+        sim_engine.cpp ffn_sim_brute_force), falling back to the
+        equivalent Python loop."""
         free = [graph.nodes[g] for g in sorted(graph.nodes) if g not in fixed]
         if not free:
             strategy = {g: v for g, v in fixed.items() if g in graph.nodes}
@@ -158,8 +161,12 @@ class SearchHelper:
             total_combos *= len(c)
         if total_combos > 4096:
             return self._greedy_cost(graph, fixed, budget)
-        best = (math.inf, {})
         base = {g: v for g, v in fixed.items() if g in graph.nodes}
+        if total_combos > 0:
+            native = self._native_leaf(graph, base, free, choices)
+            if native is not None:
+                return native
+        best = (math.inf, {})
         for combo in itertools.product(*choices):
             strategy = dict(base)
             for node, v in zip(free, combo):
@@ -169,12 +176,35 @@ class SearchHelper:
                 best = (c, strategy)
         return best
 
+    def _native_leaf(self, graph, base, free, choices):
+        node_views = {g: [v] for g, v in base.items()}
+        for node, views in zip(free, choices):
+            node_views[node.guid] = list(views)
+        built = self.sim.build_native(graph, node_views)
+        if built is None:
+            return None
+        ns, index = built
+        assign = [0] * ns.num_nodes
+        free_idx = [index[n.guid] for n in free]
+        cost, best = ns.brute_force(free_idx, assign)
+        if not math.isfinite(cost):
+            return (math.inf, {})
+        strategy = {
+            guid: node_views[guid][best[i]] for guid, i in index.items()
+        }
+        return cost, strategy
+
     # ------------------------------------------------------------------
     def _greedy_cost(self, graph, fixed, budget):
         """Fallback for odd topologies: assign views in topo order,
         choosing each node's view to minimize the simulated cost of the
-        prefix assigned so far (keeps the xfer terms local)."""
-        strategy: Strategy = {g: v for g, v in fixed.items() if g in graph.nodes}
+        prefix assigned so far (keeps the xfer terms local).  Native
+        when available (ffn_sim_greedy)."""
+        base = {g: v for g, v in fixed.items() if g in graph.nodes}
+        native = self._native_greedy(graph, base, budget)
+        if native is not None:
+            return native
+        strategy: Strategy = dict(base)
         for node in graph.topo_order():
             if node.guid in strategy:
                 continue
@@ -186,3 +216,38 @@ class SearchHelper:
                     best_v, best_c = v, c
             strategy[node.guid] = best_v
         return self.sim.simulate(graph, strategy), strategy
+
+    def _native_greedy(self, graph, base, budget):
+        node_views = {}
+        enum_counts = {}
+        for guid, node in graph.nodes.items():
+            if guid in base:
+                node_views[guid] = [base[guid]]
+                enum_counts[guid] = 0
+            else:
+                cands = list(self._views(node, budget))
+                default = node.op.fixed_machine_view() or MachineView.trivial(
+                    node.op.output_shapes[0].ndim
+                )
+                node_views[guid] = cands + [default]
+                enum_counts[guid] = len(cands)
+        built = self.sim.build_native(graph, node_views)
+        if built is None:
+            return None
+        ns, index = built
+        n = ns.num_nodes
+        assign = [0] * n
+        is_free = [False] * n
+        counts = [0] * n
+        for guid, i in index.items():
+            counts[i] = enum_counts[guid]
+            if guid in base:
+                assign[i] = 0
+            else:
+                is_free[i] = True
+                assign[i] = len(node_views[guid]) - 1  # default view
+        cost, best = ns.greedy(is_free, counts, assign)
+        strategy = {
+            guid: node_views[guid][best[i]] for guid, i in index.items()
+        }
+        return cost, strategy

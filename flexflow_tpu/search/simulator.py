@@ -20,6 +20,8 @@ import itertools
 import math
 from typing import Dict, FrozenSet, Optional, Tuple
 
+import numpy as np
+
 from flexflow_tpu.core.graph import Graph
 from flexflow_tpu.core.machine import MachineSpec, MachineView
 from flexflow_tpu.parallel.mesh import mesh_axis_sizes, view_slot_axes
@@ -167,6 +169,61 @@ class Simulator:
             exposed = max(max(syncs), total_sync - bwd_total)
             end_time += exposed
         return end_time
+
+    # ------------------------------------------------------------------
+    def build_native(self, graph: Graph, node_views: Dict[int, list]):
+        """Digest (graph, candidate views) onto the native C++ engine
+        (native/src/sim_engine.cpp).  Returns (NativeSimGraph,
+        guid->index map) or None when the library is unavailable.
+
+        ``node_views[guid]`` lists each node's registrable views in
+        order; view indices in native assignments refer to these lists.
+        Semantics match ``simulate`` exactly (tests assert equality).
+        """
+        from flexflow_tpu import native
+
+        if native.get_lib() is None:
+            return None
+        topo = graph.topo_order()
+        index = {n.guid: i for i, n in enumerate(topo)}
+        ns = native.NativeSimGraph(len(topo), self.num_devices)
+        annots = {}  # (node_index, view_index) -> OpSharding | None
+        for i, node in enumerate(topo):
+            for vi, mv in enumerate(node_views[node.guid]):
+                osh = self._propagate(node, mv)
+                annots[(i, vi)] = osh
+                if osh is None:
+                    ns.add_view(i, 0.0, 0.0, 0.0, [], valid=False)
+                    continue
+                fwd, full, sync = self._node_costs(node, mv)
+                devs = sorted(self.view_device_set(mv))
+                ns.add_view(i, fwd, full, sync, devs, valid=True)
+        for guid in graph.nodes:
+            for e in graph.out_edges[guid]:
+                si, di = index[e.src], index[e.dst]
+                src_views = node_views[e.src]
+                dst_views = node_views[e.dst]
+                shape = graph.nodes[e.src].op.output_shapes[e.src_idx]
+                mat = []
+                for svi in range(len(src_views)):
+                    s_osh = annots[(si, svi)]
+                    for dvi in range(len(dst_views)):
+                        d_osh = annots[(di, dvi)]
+                        if s_osh is None or d_osh is None:
+                            mat.append(math.inf)
+                            continue
+                        src_annot = (
+                            s_osh.outputs[e.src_idx]
+                            if e.src_idx < len(s_osh.outputs) else None
+                        )
+                        dst_annot = (
+                            d_osh.inputs[e.dst_idx]
+                            if e.dst_idx < len(d_osh.inputs) else None
+                        )
+                        mat.append(self.cost.xfer_cost(shape, src_annot, dst_annot))
+                ns.add_edge(si, di, np.asarray(mat, dtype=np.float64).reshape(
+                    len(src_views), len(dst_views)))
+        return ns, index
 
     # ------------------------------------------------------------------
     def peak_memory(self, graph: Graph, strategy: Dict[int, MachineView]) -> float:

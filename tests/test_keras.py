@@ -109,6 +109,42 @@ def test_verify_metrics_fails_on_bad_threshold():
                   callbacks=[keras.callbacks.VerifyMetrics("accuracy", 0.999)])
 
 
+def test_functional_input_binding_order():
+    """fit([xa, xb]) must bind arrays by Model(inputs=[a, b]) position,
+    even when topo discovery reaches b first."""
+    a = keras.Input((4,))
+    b = keras.Input((4,))
+    # b's branch is discovered first in the output expression
+    hb = keras.layers.Dense(8, name="db")(b)
+    ha = keras.layers.Dense(8, name="da")(a)
+    out = keras.layers.Dense(2)(keras.layers.Concatenate()([hb, ha]))
+    model = keras.Model([a, b], out)
+    model.compile(optimizer="sgd", loss="mean_squared_error",
+                  metrics=["mean_squared_error"], config=cfg(batch_size=8))
+    xa = np.zeros((8, 4), np.float32)
+    xb = np.ones((8, 4), np.float32) * 100.0
+    # zero input a through zero da weights: prediction must depend on xb
+    model.set_weights("da", {"kernel": np.zeros((4, 8), np.float32),
+                             "bias": np.zeros((8,), np.float32)})
+    p1 = model.predict([xa, xb])
+    p2 = model.predict([xa, np.zeros_like(xb)])
+    assert not np.allclose(p1, p2), "xb was not bound to input b"
+    p3 = model.predict([np.ones_like(xa) * 7, xb])
+    np.testing.assert_allclose(p1, p3, rtol=1e-5, atol=1e-5)
+
+
+def test_auto_names_are_per_model():
+    m1 = keras.Sequential([keras.layers.Dense(4, input_shape=(4,)),
+                           keras.layers.Dense(4)])
+    m1.compile(optimizer="sgd", loss="mean_squared_error",
+               metrics=["mean_squared_error"], config=cfg(batch_size=8))
+    m2 = keras.Sequential([keras.layers.Dense(4, input_shape=(4,)),
+                           keras.layers.Dense(4)])
+    m2.compile(optimizer="sgd", loss="mean_squared_error",
+               metrics=["mean_squared_error"], config=cfg(batch_size=8))
+    assert set(m1.ffmodel.params) == set(m2.ffmodel.params)
+
+
 def test_embedding_sequential():
     model = keras.Sequential([
         keras.layers.InputLayer((8,), dtype="int32"),

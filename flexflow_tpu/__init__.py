@@ -41,6 +41,11 @@ _LAZY = {
     "ConstantInitializer": ("flexflow_tpu.initializers", "ConstantInitializer"),
     "NormInitializer": ("flexflow_tpu.initializers", "NormInitializer"),
     "CheckpointManager": ("flexflow_tpu.runtime.checkpoint", "CheckpointManager"),
+    "RecompileState": ("flexflow_tpu.runtime.recompile", "RecompileState"),
+    "StepProfiler": ("flexflow_tpu.runtime.profiler", "StepProfiler"),
+    "device_trace": ("flexflow_tpu.runtime.profiler", "device_trace"),
+    "measure_operator_cost": ("flexflow_tpu.runtime.profiler", "measure_operator_cost"),
+    "RecursiveLogger": ("flexflow_tpu.utils.logging", "RecursiveLogger"),
 }
 
 __all__ = ["__version__", *_LAZY]

@@ -48,6 +48,8 @@ class FFConfig:
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
+    export_strategy_task_graph_file: Optional[str] = None  # simulated
+    # schedule dot export (reference: config.h:142, simulator.cc:1008)
     # numerics
     compute_dtype: str = "bfloat16"  # matmul dtype on TPU
     param_dtype: str = "float32"
@@ -98,6 +100,7 @@ class FFConfig:
         p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
         p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
         p.add_argument("--machine-model-file", type=str, default=None)
+        p.add_argument("--taskgraph", dest="export_taskgraph", type=str, default=None)
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--seed", type=int, default=0)
         args, _ = p.parse_known_args(argv)
@@ -116,6 +119,7 @@ class FFConfig:
             substitution_json=args.substitution_json,
             export_strategy_file=args.export_strategy,
             import_strategy_file=args.import_strategy,
+            export_strategy_task_graph_file=args.export_taskgraph,
             machine_model_file=args.machine_model_file,
             profiling=args.profiling,
             seed=args.seed,

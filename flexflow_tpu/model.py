@@ -33,6 +33,19 @@ from flexflow_tpu import ops as O
 from flexflow_tpu.optimizers import Optimizer, SGDOptimizer
 
 
+def _merge_matching(new, old):
+    """Recursively keep ``new``'s structure, copying ``old``'s values at
+    key paths present in both with matching array shapes."""
+    if isinstance(new, dict) and isinstance(old, dict):
+        return {
+            k: _merge_matching(v, old[k]) if k in old else v
+            for k, v in new.items()
+        }
+    if hasattr(new, "shape") and hasattr(old, "shape") and new.shape == old.shape:
+        return old
+    return new
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         self.config = config or FFConfig()
@@ -406,6 +419,16 @@ class FFModel:
             self.graph.write_dot(
                 self.config.export_strategy_computation_graph_file, strategy
             )
+        if self.config.export_strategy_task_graph_file:
+            from flexflow_tpu.search.simulator import Simulator
+
+            # search_devices, not num_devices: the strategy's views were
+            # sized for the (possibly overridden) search machine
+            Simulator(
+                self.config.machine_spec, num_devices=self.config.search_devices
+            ).export_task_graph_dot(
+                self.graph, strategy, self.config.export_strategy_task_graph_file
+            )
 
         if pipeline is not None:
             from flexflow_tpu.compiler.pipeline_lowering import PipelinedCompiledModel
@@ -429,19 +452,65 @@ class FFModel:
                 list(metrics),
                 self.optimizer,
             )
+        self._compile_ctx = dict(
+            strategy=strategy, loss_type=LossType.from_any(loss_type),
+            metrics=list(metrics), pipeline=pipeline, block_of=block_of,
+        )
         self.params, self.state = self.compiled.init_params(self.config.seed)
         self.opt_state = self.optimizer.init_state(self.params)
+        return self.compiled
+
+    def recompile(self):
+        """Re-lower the (possibly altered) graph into a fresh XLA
+        program, carrying params / optimizer state / model state over
+        (reference: dynamic re-optimization, recompile_state.cc — ops
+        altered in place; here the program is rebuilt instead)."""
+        from flexflow_tpu.compiler.lowering import CompiledModel
+
+        ctx = self._compile_ctx
+        if ctx["pipeline"] is not None:
+            from flexflow_tpu.compiler.pipeline_lowering import PipelinedCompiledModel
+
+            self.compiled = PipelinedCompiledModel(
+                self.graph, ctx["strategy"], self.config, ctx["loss_type"],
+                ctx["metrics"], self.optimizer,
+                pipeline=ctx["pipeline"], block_of=ctx["block_of"],
+            )
+        else:
+            self.compiled = CompiledModel(
+                self.graph, ctx["strategy"], self.config, ctx["loss_type"],
+                ctx["metrics"], self.optimizer,
+            )
+        old_params, old_state, old_opt = self.params, self.state, self.opt_state
+        self.params, self.state = self.compiled.init_params(self.config.seed)
+        for op_name, ws in (old_params or {}).items():
+            if op_name in self.params:
+                for w_name, v in ws.items():
+                    if w_name in self.params[op_name]:
+                        self.params[op_name][w_name] = v
+        for k, v in (old_state or {}).items():
+            if k in self.state:
+                self.state[k] = v
+        # optimizer state must match the NEW param tree structure; re-init
+        # and carry over leaves whose key paths survived the alteration
+        self.opt_state = self.optimizer.init_state(self.params)
+        self.opt_state = _merge_matching(self.opt_state, old_opt)
         return self.compiled
 
     # ------------------------------------------------------------------
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True,
-            callbacks: Sequence = ()):
+            callbacks: Sequence = (), recompile_state=None):
         """Training loop (reference: flexflow_cffi.py:1832 fit).
 
         ``callbacks`` follow the keras callback protocol (duck-typed:
         on_train_begin/end, on_epoch_begin, on_epoch_end(epoch, logs) —
-        return False from on_epoch_end to stop early)."""
+        return False from on_epoch_end to stop early).
+
+        ``recompile_state`` — a runtime.recompile.RecompileState checked
+        once per iteration (reference: recompile_on_condition,
+        model.cc:2273); its alter() may mutate op attrs, after which the
+        model re-lowers with params/state carried over."""
         import jax
 
         from flexflow_tpu.runtime.dataloader import SingleDataLoader
@@ -460,6 +529,11 @@ class FFModel:
             )
         for cb in callbacks:
             cb.on_train_begin()
+        profiler = None
+        if self.config.profiling:
+            from flexflow_tpu.runtime.profiler import StepProfiler
+
+            profiler = StepProfiler()
         metrics = PerfMetrics()
         history = []
         t_start = None
@@ -473,12 +547,23 @@ class FFModel:
             for inputs, labels in loader:
                 self._rng_counter += 1
                 rng = jax.random.key(self._rng_counter)
+                if profiler is not None:
+                    profiler.start_step()
                 (self.params, self.opt_state, self.state, loss, m) = (
                     self.compiled.train_step(
                         self.params, self.opt_state, self.state, rng, inputs, labels
                     )
                 )
-                acc = m if acc is None else jax.tree.map(lambda a, b: a + b, acc, m)
+                if profiler is not None:
+                    float(loss)  # fence so the step time is real
+                    profiler.end_step()
+                if recompile_state is not None and recompile_state.check(self):
+                    # drop the accumulator AND this step's metrics: the
+                    # re-lowered program may emit a different metric tree
+                    acc = None
+                else:
+                    acc = m if acc is None else jax.tree.map(
+                        lambda a, b: a + b, acc, m)
                 steps_done += 1
                 if steps_done == 1:
                     float(loss)  # readback fence (block_until_ready does
@@ -506,6 +591,8 @@ class FFModel:
             if verbose:
                 print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
             self.last_throughput = thr
+        if profiler is not None and verbose:
+            print(f"PROFILE {profiler}")
         return history
 
     def evaluate(self, x=None, y=None, batch_size: Optional[int] = None):

@@ -1,0 +1,119 @@
+"""Profiling & tracing.
+
+Reference parity (SURVEY.md §5 tracing/profiling):
+* Legion iteration tracing → here the train step is already ONE compiled
+  XLA program (jit), so "tracing" is structural; what remains is
+  observability:
+* per-op ``profiling`` flag gating kernel timing printfs (config.h:125)
+  → ``StepProfiler`` wall-clock step timing + summary, and
+  ``device_trace`` — a context manager around jax.profiler for a real
+  XLA/TPU timeline (viewable in TensorBoard/Perfetto);
+* on-device op cost measurement (model.cu:38-74 warmup+repeat cuda
+  events) → ``measure_operator_cost``: jit the op's forward alone and
+  time it on the real chip — used to calibrate the analytic cost model.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class StepProfiler:
+    """Wall-clock per-step timing with compile-step exclusion."""
+
+    def __init__(self):
+        self.step_times: List[float] = []
+        self._t_last: Optional[float] = None
+
+    def start_step(self) -> None:
+        self._t_last = time.perf_counter()
+
+    def end_step(self) -> None:
+        if self._t_last is not None:
+            self.step_times.append(time.perf_counter() - self._t_last)
+            self._t_last = None
+
+    def summary(self, skip_first: int = 1) -> Dict[str, float]:
+        """Stats excluding the first (compile) steps."""
+        ts = np.asarray(self.step_times[skip_first:] or self.step_times)
+        if len(ts) == 0:
+            return {"steps": 0}
+        return {
+            "steps": len(ts),
+            "mean_s": float(ts.mean()),
+            "p50_s": float(np.percentile(ts, 50)),
+            "p95_s": float(np.percentile(ts, 95)),
+            "max_s": float(ts.max()),
+        }
+
+    def __str__(self) -> str:
+        s = self.summary()
+        if not s.get("steps"):
+            return "StepProfiler(no steps)"
+        return (f"steps={s['steps']} mean={s['mean_s']*1e3:.2f}ms "
+                f"p50={s['p50_s']*1e3:.2f}ms p95={s['p95_s']*1e3:.2f}ms")
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """XLA device timeline trace (TensorBoard `Profile` tab / Perfetto).
+    The TPU analog of the reference's `-lg:prof` external tooling."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def measure_operator_cost(op, machine_view=None, batch_inputs=None,
+                          warmup: int = 2, repeats: int = 5) -> float:
+    """Median wall seconds of one jitted forward of ``op`` on the real
+    device (reference: Op::measure_operator_cost + model.cu:38-74).
+
+    Builds zero inputs from the op's input shapes unless given; weights
+    are initialized via the op's specs. Used to calibrate/validate the
+    analytic CostModel against actual hardware.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.base import LoweringContext
+
+    if batch_inputs is None:
+        batch_inputs = [
+            jnp.zeros(s.sizes, s.dtype.to_numpy()) for s in op.input_shapes
+        ]
+    key = jax.random.key(0)
+    weights = {}
+    for i, ws in enumerate(getattr(op, "_weight_specs", ())):
+        weights[ws.name] = ws.initializer.init(
+            jax.random.fold_in(key, i), ws.shape, ws.dtype.to_numpy()
+        )
+    state_in = {}
+    for spec in (op.state_specs() if getattr(op, "state_specs", None) else ()):
+        name, shape, dtype, fill = spec
+        state_in[f"{op.name}/{name}"] = jnp.full(shape, fill, dtype)
+
+    def fwd(inputs, weights):
+        ctx = LoweringContext(
+            compute_dtype=jnp.float32, train=False, rng=jax.random.key(1),
+            seq_length=-1, state_in=dict(state_in), mesh=None,
+        )
+        outs = op.forward(ctx, inputs, weights)
+        return [jnp.sum(o) for o in outs]  # force materialization
+
+    jfwd = jax.jit(fwd)
+    for _ in range(warmup):
+        jax.block_until_ready(jfwd(batch_inputs, weights))
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jfwd(batch_inputs, weights))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))

@@ -31,11 +31,15 @@ def optimize_strategy(
     strategies on the ORIGINAL graph are explored (no rewrites) — the
     common path, since degree-views already express DP/TP/row/head
     splits; with True, substitution variants compete too."""
+    from flexflow_tpu.utils.logging import SEARCH_LOG as log
+
     n = config.search_devices
     sim = Simulator(config.machine_spec, num_devices=n)
     helper = SearchHelper(sim, n)
 
-    best_cost, best_strategy = helper.graph_cost(graph)
+    with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
+        best_cost, best_strategy = helper.graph_cost(graph)
+        log.log(f"baseline DP-search cost: {best_cost * 1e3:.4f} ms/iter")
     best_graph = graph
 
     if return_graph and config.search_budget > 0:
@@ -61,6 +65,8 @@ def optimize_strategy(
                     seen.add(h)
                     c2, s2 = helper.graph_cost(g2)
                     if c2 < best_cost:
+                        log.log(f"substitution improved: {best_cost * 1e3:.4f}"
+                                f" -> {c2 * 1e3:.4f} ms/iter")
                         best_cost, best_strategy, best_graph = c2, s2, g2
                     if c2 < config.search_alpha * best_cost:
                         counter += 1

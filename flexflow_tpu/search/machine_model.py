@@ -33,6 +33,31 @@ OP_OVERHEAD_S = 2e-6
 @dataclass
 class CostModel:
     machine: MachineSpec
+    # optional NetworkedMachineModel: collectives are then routed over
+    # the ICI torus with per-link contention (search/network.py) instead
+    # of the flat ring formulas
+    network: Optional[object] = None
+
+    def _net_devices(self, n: int) -> Optional[list]:
+        """Canonical device group for an n-way collective on the torus
+        (mesh order = row-major torus order, so 0..n-1 is the group the
+        lowering would use)."""
+        if self.network is None or n > self.network.topology.num_nodes:
+            return None
+        return list(range(n))
+
+    def _net_cached(self, kind: str, n: int, nbytes: float, fn) -> float:
+        """Route expansion is O(n²) for all_to_all and runs in the
+        search's innermost loop — memoize by (kind, n, nbytes): with the
+        canonical 0..n-1 group these are pure functions of the key."""
+        if not hasattr(self, "_net_cache"):
+            self._net_cache = {}
+        key = (kind, n, nbytes)
+        hit = self._net_cache.get(key)
+        if hit is None:
+            hit = fn()
+            self._net_cache[key] = hit
+        return hit
 
     # ---- compute ---------------------------------------------------------
     def op_cost(self, op: Operator, mv: MachineView, backward: bool = True) -> float:
@@ -62,12 +87,28 @@ class CostModel:
     def allreduce(self, nbytes: float, n: int) -> float:
         if n <= 1:
             return 0.0
+        devs = self._net_devices(n)
+        if devs is not None:
+            t = self._net_cached(
+                "ar", n, nbytes,
+                lambda: self.network.ring_allreduce_time(devs, nbytes))
+            if n > self.machine.devices_per_host:
+                t += 2.0 * (n - 1) / n * nbytes / self.machine.dcn_bandwidth
+            return t
         ici, dcn = self._link_time(2.0 * (n - 1) / n * nbytes, n)
         return ici + dcn + 2 * (n - 1) * self.machine.ici_latency
 
     def allgather(self, nbytes_shard: float, n: int) -> float:
         if n <= 1:
             return 0.0
+        devs = self._net_devices(n)
+        if devs is not None:
+            t = self._net_cached(
+                "ag", n, nbytes_shard,
+                lambda: self.network.allgather_time(devs, nbytes_shard))
+            if n > self.machine.devices_per_host:
+                t += (n - 1) * nbytes_shard / self.machine.dcn_bandwidth
+            return t
         ici, dcn = self._link_time((n - 1) * nbytes_shard, n)
         return ici + dcn + (n - 1) * self.machine.ici_latency
 
@@ -77,6 +118,14 @@ class CostModel:
     def all_to_all(self, nbytes_shard: float, n: int) -> float:
         if n <= 1:
             return 0.0
+        devs = self._net_devices(n)
+        if devs is not None:
+            t = self._net_cached(
+                "a2a", n, nbytes_shard,
+                lambda: self.network.all_to_all_time(devs, nbytes_shard))
+            if n > self.machine.devices_per_host:
+                t += nbytes_shard * (n - 1) / n / self.machine.dcn_bandwidth
+            return t
         # each device exchanges (n-1)/n of its shard; ICI torus is
         # dimension-ordered so add a hop-count factor ~sqrt(n)/2
         hops = max(1.0, math.sqrt(n) / 2.0)

@@ -29,10 +29,19 @@ from flexflow_tpu.search.machine_model import CostModel
 
 
 class Simulator:
-    def __init__(self, machine: MachineSpec, num_devices: Optional[int] = None):
+    def __init__(self, machine: MachineSpec, num_devices: Optional[int] = None,
+                 use_network_model: bool = True):
         self.machine = machine
         self.num_devices = num_devices or machine.num_devices
-        self.cost = CostModel(machine)
+        network = None
+        if use_network_model:
+            from flexflow_tpu.search.network import ici_network
+
+            try:
+                network = ici_network(machine, num_devices=self.num_devices)
+            except (AssertionError, ValueError):
+                network = None
+        self.cost = CostModel(machine, network=network)
         self._axis_pool = mesh_axis_sizes(self.num_devices)
         self._axis_index = {name: i for i, (name, _) in enumerate(self._axis_pool)}
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
@@ -106,8 +115,12 @@ class Simulator:
         graph: Graph,
         strategy: Dict[int, MachineView],
         include_update: bool = True,
+        schedule: Optional[list] = None,
     ) -> float:
-        """Seconds per training iteration under the strategy."""
+        """Seconds per training iteration under the strategy.  Pass a
+        list as ``schedule`` to receive per-task placement records
+        ``(op_name, start_s, finish_s, device_ids)`` — the simulated
+        task graph (reference: simulator.cc:1008-1058 dot export)."""
         ready: Dict[Tuple[int, int], float] = {}  # (guid, out_idx) -> time
         device_avail: Dict[int, float] = {d: 0.0 for d in range(self.num_devices)}
         topo = graph.topo_order()
@@ -153,6 +166,8 @@ class Simulator:
                 device_avail[d] = finish
             for i in range(len(node.op.output_shapes)):
                 ready[(node.guid, i)] = finish
+            if schedule is not None:
+                schedule.append((node.op.name, start, finish, tuple(sorted(devs))))
             end_time = max(end_time, finish)
             if include_update:
                 if sync > 0:
@@ -224,6 +239,30 @@ class Simulator:
                 ns.add_edge(si, di, np.asarray(mat, dtype=np.float64).reshape(
                     len(src_views), len(dst_views)))
         return ns, index
+
+    # ------------------------------------------------------------------
+    def export_task_graph_dot(self, graph: Graph,
+                              strategy: Dict[int, MachineView],
+                              path: str) -> float:
+        """Write the simulated schedule as graphviz (reference:
+        export_strategy_task_graph_file, simulator.cc:1008-1058).
+        Returns the simulated iteration seconds."""
+        schedule: list = []
+        cost = self.simulate(graph, strategy, schedule=schedule)
+        lines = ["digraph taskgraph {", "  rankdir=LR;"]
+        for op_name, start, finish, devs in schedule:
+            label = (f"{op_name}\\n[{start*1e3:.3f}, {finish*1e3:.3f}] ms"
+                     f"\\ndevs={list(devs)}")
+            lines.append(f'  "{op_name}" [shape=record, label="{label}"];')
+        for g in graph.nodes:
+            for e in graph.out_edges[g]:
+                a = graph.nodes[e.src].op.name
+                b = graph.nodes[e.dst].op.name
+                lines.append(f'  "{a}" -> "{b}";')
+        lines.append("}")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return cost
 
     # ------------------------------------------------------------------
     def peak_memory(self, graph: Graph, strategy: Dict[int, MachineView]) -> float:

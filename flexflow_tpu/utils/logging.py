@@ -1,0 +1,43 @@
+"""Indent-scoped search logging (reference:
+src/runtime/recursive_logger.cc + include/flexflow/utils/
+recursive_logger.h — TAG_ENTER/TAG_EXIT indented traces of the search
+recursion, e.g. substitution.cc:2011)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Optional, TextIO
+
+
+class RecursiveLogger:
+    """Depth-indented logger; enabled via FLEXFLOW_TPU_SEARCH_LOG=1 or
+    explicitly."""
+
+    def __init__(self, category: str = "search",
+                 enabled: Optional[bool] = None, stream: TextIO = None):
+        self.category = category
+        if enabled is None:
+            enabled = os.environ.get("FLEXFLOW_TPU_SEARCH_LOG", "") not in ("", "0")
+        self.enabled = enabled
+        self.stream = stream or sys.stderr
+        self.depth = 0
+
+    def log(self, msg: str) -> None:
+        if self.enabled:
+            self.stream.write(f"[{self.category}] {'  ' * self.depth}{msg}\n")
+
+    @contextlib.contextmanager
+    def enter(self, msg: str = ""):
+        """TAG_ENTER equivalent: indent everything logged inside."""
+        if msg:
+            self.log(msg)
+        self.depth += 1
+        try:
+            yield self
+        finally:
+            self.depth -= 1
+
+
+SEARCH_LOG = RecursiveLogger("search")

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""MLP_Unify example — the minimal two-branch MLP whose best strategy
+mixes data and model parallelism (reference: examples/cpp/MLP_Unify/
+mlp.cc; an osdi22ae workload).
+
+Usage: python examples/mlp_unify.py -b 64 -e 1
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_mlp_unify
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_mlp_unify(config)
+    run_example(model, "mlp_unify")
+
+
+if __name__ == "__main__":
+    main()

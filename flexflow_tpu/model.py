@@ -483,14 +483,10 @@ class FFModel:
             )
         old_params, old_state, old_opt = self.params, self.state, self.opt_state
         self.params, self.state = self.compiled.init_params(self.config.seed)
-        for op_name, ws in (old_params or {}).items():
-            if op_name in self.params:
-                for w_name, v in ws.items():
-                    if w_name in self.params[op_name]:
-                        self.params[op_name][w_name] = v
-        for k, v in (old_state or {}).items():
-            if k in self.state:
-                self.state[k] = v
+        # shape-checked carry-over: an alter() that changes a weight's
+        # shape keeps the fresh init for that weight
+        self.params = _merge_matching(self.params, old_params or {})
+        self.state = _merge_matching(self.state, old_state or {})
         # optimizer state must match the NEW param tree structure; re-init
         # and carry over leaves whose key paths survived the alteration
         self.opt_state = self.optimizer.init_state(self.params)
@@ -569,7 +565,8 @@ class FFModel:
                     float(loss)  # readback fence (block_until_ready does
                     # not reliably fence through remote-device tunnels)
                     t_start = time.perf_counter()  # skip compile time
-            metrics.update(acc)
+            if acc is not None:  # None if a recompile landed on the last batch
+                metrics.update(acc)
             if verbose:
                 print(f"epoch {epoch}: loss={float(loss):.4f} {metrics}")
             logs = metrics.report()

@@ -71,7 +71,7 @@ def device_trace(logdir: str):
         jax.profiler.stop_trace()
 
 
-def measure_operator_cost(op, machine_view=None, batch_inputs=None,
+def measure_operator_cost(op, batch_inputs=None,
                           warmup: int = 2, repeats: int = 5) -> float:
     """Median wall seconds of one jitted forward of ``op`` on the real
     device (reference: Op::measure_operator_cost + model.cu:38-74).

@@ -38,18 +38,26 @@ class CostModel:
     # of the flat ring formulas
     network: Optional[object] = None
 
-    def _net_devices(self, n: int) -> Optional[list]:
-        """Canonical device group for an n-way collective on the torus
-        (mesh order = row-major torus order, so 0..n-1 is the group the
-        lowering would use)."""
+    def _net_groups(self, n: int) -> Optional[list]:
+        """Candidate device groups for an n-way collective on the torus.
+        The cost model only knows the group SIZE, not which mesh axis it
+        rides: an inner-axis group is contiguous (0..n-1), an outer-axis
+        group is strided (0, N/n, 2N/n, ...) and crosses more links.  We
+        cost both and take the worst — underpricing outer-axis
+        communication would bias the search toward strategies whose
+        collectives are not actually cheap."""
         if self.network is None or n > self.network.topology.num_nodes:
             return None
-        return list(range(n))
+        groups = [list(range(n))]
+        stride = self.network.topology.num_nodes // n
+        if stride > 1:
+            groups.append(list(range(0, stride * n, stride)))
+        return groups
 
     def _net_cached(self, kind: str, n: int, nbytes: float, fn) -> float:
         """Route expansion is O(n²) for all_to_all and runs in the
         search's innermost loop — memoize by (kind, n, nbytes): with the
-        canonical 0..n-1 group these are pure functions of the key."""
+        canonical groups these are pure functions of the key."""
         if not hasattr(self, "_net_cache"):
             self._net_cache = {}
         key = (kind, n, nbytes)
@@ -87,11 +95,12 @@ class CostModel:
     def allreduce(self, nbytes: float, n: int) -> float:
         if n <= 1:
             return 0.0
-        devs = self._net_devices(n)
-        if devs is not None:
+        groups = self._net_groups(n)
+        if groups is not None:
             t = self._net_cached(
                 "ar", n, nbytes,
-                lambda: self.network.ring_allreduce_time(devs, nbytes))
+                lambda: max(self.network.ring_allreduce_time(g, nbytes)
+                            for g in groups))
             if n > self.machine.devices_per_host:
                 t += 2.0 * (n - 1) / n * nbytes / self.machine.dcn_bandwidth
             return t
@@ -101,11 +110,12 @@ class CostModel:
     def allgather(self, nbytes_shard: float, n: int) -> float:
         if n <= 1:
             return 0.0
-        devs = self._net_devices(n)
-        if devs is not None:
+        groups = self._net_groups(n)
+        if groups is not None:
             t = self._net_cached(
                 "ag", n, nbytes_shard,
-                lambda: self.network.allgather_time(devs, nbytes_shard))
+                lambda: max(self.network.allgather_time(g, nbytes_shard)
+                            for g in groups))
             if n > self.machine.devices_per_host:
                 t += (n - 1) * nbytes_shard / self.machine.dcn_bandwidth
             return t
@@ -118,11 +128,12 @@ class CostModel:
     def all_to_all(self, nbytes_shard: float, n: int) -> float:
         if n <= 1:
             return 0.0
-        devs = self._net_devices(n)
-        if devs is not None:
+        groups = self._net_groups(n)
+        if groups is not None:
             t = self._net_cached(
                 "a2a", n, nbytes_shard,
-                lambda: self.network.all_to_all_time(devs, nbytes_shard))
+                lambda: max(self.network.all_to_all_time(g, nbytes_shard)
+                            for g in groups))
             if n > self.machine.devices_per_host:
                 t += nbytes_shard * (n - 1) / n / self.machine.dcn_bandwidth
             return t

@@ -37,17 +37,20 @@ class Topology:
     bandwidth: Dict[Link, float] = field(default_factory=dict)  # bytes/s
     latency: Dict[Link, float] = field(default_factory=dict)  # s
     torus_dims: Tuple[int, ...] = ()  # set by the torus generator
+    adjacency: Dict[int, List[int]] = field(default_factory=dict)
 
     def add_link(self, a: int, b: int, bandwidth: float, latency: float,
                  bidirectional: bool = True) -> None:
         self.bandwidth[(a, b)] = bandwidth
         self.latency[(a, b)] = latency
+        self.adjacency.setdefault(a, []).append(b)
         if bidirectional:
             self.bandwidth[(b, a)] = bandwidth
             self.latency[(b, a)] = latency
+            self.adjacency.setdefault(b, []).append(a)
 
     def neighbors(self, a: int) -> List[int]:
-        return [d for (s, d) in self.bandwidth if s == a]
+        return self.adjacency.get(a, [])
 
     # ---- generators (reference: simulator.h:413-488) ---------------------
     @staticmethod
@@ -135,6 +138,41 @@ class ShortestPathRouting(RoutingStrategy):
         return [path]
 
 
+def _minimal_torus_route(topo: Topology, src: int, dst: int,
+                         axis_order: Sequence[int]) -> List[Link]:
+    """Minimal torus walk traversing axes in ``axis_order``, taking the
+    shorter wraparound direction per axis (the ONE implementation shared
+    by dimension-ordered and ECMP routing)."""
+    dims = topo.torus_dims
+
+    def coords(x):
+        out = []
+        for d in reversed(dims):
+            out.append(x % d)
+            x //= d
+        return list(reversed(out))
+
+    def flat(coord):
+        out = 0
+        for c, d in zip(coord, dims):
+            out = out * d + c
+        return out
+
+    cur = coords(src)
+    tgt = coords(dst)
+    path: List[Link] = []
+    for ax in axis_order:
+        d = dims[ax]
+        while cur[ax] != tgt[ax]:
+            fwd_hops = (tgt[ax] - cur[ax]) % d
+            step = 1 if fwd_hops <= d - fwd_hops else -1
+            nxt = list(cur)
+            nxt[ax] = (cur[ax] + step) % d
+            path.append((flat(cur), flat(nxt)))
+            cur = nxt
+    return path
+
+
 class DimensionOrderedRouting(RoutingStrategy):
     """TPU ICI routing: traverse torus axes in order, taking the shorter
     wraparound direction per axis — deterministic and minimal."""
@@ -142,32 +180,7 @@ class DimensionOrderedRouting(RoutingStrategy):
     def route(self, topo, src, dst):
         dims = topo.torus_dims
         assert dims, "dimension-ordered routing needs a torus topology"
-
-        def coords(x):
-            out = []
-            for d in reversed(dims):
-                out.append(x % d)
-                x //= d
-            return list(reversed(out))
-
-        def flat(coord):
-            out = 0
-            for c, d in zip(coord, dims):
-                out = out * d + c
-            return out
-
-        cur = coords(src)
-        tgt = coords(dst)
-        path: List[Link] = []
-        for ax, d in enumerate(dims):
-            while cur[ax] != tgt[ax]:
-                fwd_hops = (tgt[ax] - cur[ax]) % d
-                step = 1 if fwd_hops <= d - fwd_hops else -1
-                nxt = list(cur)
-                nxt[ax] = (cur[ax] + step) % d
-                path.append((flat(cur), flat(nxt)))
-                cur = nxt
-        return [path]
+        return [_minimal_torus_route(topo, src, dst, range(len(dims)))]
 
 
 class WeightedECMPRouting(RoutingStrategy):
@@ -183,47 +196,16 @@ class WeightedECMPRouting(RoutingStrategy):
                 else ShortestPathRouting().route(topo, src, dst)
         paths = []
         seen = set()
-        base = DimensionOrderedRouting()
         for perm in itertools.permutations(range(len(dims))):
             # reorder axis traversal by permuting the dim order
-            p = self._route_with_order(topo, src, dst, perm)
+            p = _minimal_torus_route(topo, src, dst, perm)
             key = tuple(p)
             if key not in seen:
                 seen.add(key)
                 paths.append(p)
             if len(paths) >= 4:
                 break
-        return paths or base.route(topo, src, dst)
-
-    def _route_with_order(self, topo, src, dst, order):
-        dims = topo.torus_dims
-
-        def coords(x):
-            out = []
-            for d in reversed(dims):
-                out.append(x % d)
-                x //= d
-            return list(reversed(out))
-
-        def flat(coord):
-            out = 0
-            for c, d in zip(coord, dims):
-                out = out * d + c
-            return out
-
-        cur = coords(src)
-        tgt = coords(dst)
-        path: List[Link] = []
-        for ax in order:
-            d = dims[ax]
-            while cur[ax] != tgt[ax]:
-                fwd_hops = (tgt[ax] - cur[ax]) % d
-                step = 1 if fwd_hops <= d - fwd_hops else -1
-                nxt = list(cur)
-                nxt[ax] = (cur[ax] + step) % d
-                path.append((flat(cur), flat(nxt)))
-                cur = nxt
-        return path
+        return paths or DimensionOrderedRouting().route(topo, src, dst)
 
 
 @dataclass

@@ -92,7 +92,7 @@ def test_measure_operator_cost_real_device():
 
     op = LinearOp("probe", [ParallelTensorShape.make((32, 256), "float32")],
                   out_dim=256)
-    t = measure_operator_cost(op, None, warmup=1, repeats=3)
+    t = measure_operator_cost(op, warmup=1, repeats=3)
     assert 0 < t < 1.0
 
 

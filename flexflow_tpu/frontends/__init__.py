@@ -16,10 +16,16 @@ from flexflow_tpu.frontends.torch_fx import (  # noqa: F401
     transfer_torch_weights,
 )
 from flexflow_tpu.frontends.onnx_frontend import ONNXModel  # noqa: F401
+from flexflow_tpu.frontends.tf_keras import (  # noqa: F401
+    TFKerasModel,
+    transfer_tf_weights,
+)
 
 __all__ = [
     "PyTorchModel",
     "torch_to_flexflow",
     "transfer_torch_weights",
     "ONNXModel",
+    "TFKerasModel",
+    "transfer_tf_weights",
 ]

@@ -1,0 +1,176 @@
+"""tf.keras graph-traversal frontend.
+
+Parity with the reference's experimental keras_exp frontend
+(reference: python/flexflow/keras_exp/models/model.py — traverses a
+real tf.keras Model's layer graph and emits the matching FFModel
+calls).  TensorFlow weight layouts already match this framework
+(Dense kernels are (in, out); convs are HWIO NHWC), so
+``transfer_tf_weights`` is a straight copy.
+
+TensorFlow is an optional dependency: constructing TFKerasModel
+without it raises ImportError; nothing else imports tf.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+__all__ = ["TFKerasModel", "transfer_tf_weights"]
+
+
+def _pads(padding: str, kernel) -> tuple:
+    if padding == "same":
+        return ((kernel[0] - 1) // 2, (kernel[1] - 1) // 2)
+    return (0, 0)
+
+
+class TFKerasModel:
+    """Importer for a built tf.keras functional/Sequential model."""
+
+    def __init__(self, tf_model):
+        try:
+            import tensorflow  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("tensorflow is required for TFKerasModel") from e
+        self.tf_model = tf_model
+
+    # ------------------------------------------------------------------
+    def to_ff(self, ffmodel, input_tensors: Sequence) -> List:
+        """Emit the traversed layer graph onto ``ffmodel``; returns the
+        output Tensors. ``input_tensors`` bind to tf_model.inputs in
+        order."""
+        import tensorflow as tf
+        from tensorflow.keras import layers as L
+
+        tfm = self.tf_model
+        env: Dict[int, object] = {}
+        for kt, t in zip(tfm.inputs, input_tensors):
+            env[id(kt)] = t
+
+        for layer in tfm.layers:
+            if isinstance(layer, L.InputLayer):
+                continue
+            for node in layer._inbound_nodes:
+                ins = []
+                kept = node.keras_inputs if hasattr(node, "keras_inputs") else (
+                    node.input_tensors)
+                for kt in kept:
+                    if id(kt) not in env:
+                        break
+                    ins.append(env[id(kt)])
+                else:
+                    outs = node.output_tensors if hasattr(node, "output_tensors") \
+                        else [node.outputs]
+                    if not isinstance(outs, (list, tuple)):
+                        outs = [outs]
+                    y = self._emit(ffmodel, layer, ins)
+                    for kt, t in zip(outs, y if isinstance(y, list) else [y]):
+                        env[id(kt)] = t
+        return [env[id(kt)] for kt in tfm.outputs]
+
+    # ------------------------------------------------------------------
+    def _emit(self, ff, layer, ins):
+        from tensorflow.keras import layers as L
+
+        name = layer.name
+        if isinstance(layer, L.Dense):
+            act = (layer.activation.__name__
+                   if layer.activation is not None else None)
+            act = None if act == "linear" else act
+            return ff.dense(ins[0], layer.units, activation=act,
+                            use_bias=layer.use_bias, name=name)
+        if isinstance(layer, L.Conv2D):
+            k = layer.kernel_size
+            s = layer.strides
+            ph, pw = _pads(layer.padding, k)
+            act = (layer.activation.__name__
+                   if layer.activation is not None else None)
+            act = None if act == "linear" else act
+            return ff.conv2d(ins[0], layer.filters, k[0], k[1], s[0], s[1],
+                             ph, pw, activation=act, groups=layer.groups,
+                             use_bias=layer.use_bias, name=name)
+        if isinstance(layer, (L.MaxPooling2D, L.AveragePooling2D)):
+            k = layer.pool_size
+            s = layer.strides or k
+            ph, pw = _pads(layer.padding, k)
+            pt = "max" if isinstance(layer, L.MaxPooling2D) else "avg"
+            return ff.pool2d(ins[0], k[0], k[1], s[0], s[1], ph, pw,
+                             pool_type=pt, name=name)
+        if isinstance(layer, L.GlobalAveragePooling2D):
+            return ff.mean(ins[0], dims=(1, 2), name=name)
+        if isinstance(layer, L.Flatten):
+            return ff.flat(ins[0], name=name)
+        if isinstance(layer, L.Reshape):
+            b = ins[0].sizes[0]
+            return ff.reshape(ins[0], (b,) + tuple(layer.target_shape), name=name)
+        if isinstance(layer, L.Dropout):
+            return ff.dropout(ins[0], rate=layer.rate, name=name)
+        if isinstance(layer, L.BatchNormalization):
+            return ff.batch_norm(ins[0], relu=False,
+                                 momentum=layer.momentum, name=name)
+        if isinstance(layer, L.LayerNormalization):
+            axes = layer.axis if isinstance(layer.axis, (list, tuple)) else [layer.axis]
+            return ff.layer_norm(ins[0], axes=tuple(axes),
+                                 eps=layer.epsilon, name=name)
+        if isinstance(layer, L.Embedding):
+            return ff.embedding(ins[0], layer.input_dim, layer.output_dim,
+                                name=name)
+        if isinstance(layer, L.Activation):
+            fn = getattr(ff, layer.activation.__name__, None)
+            if fn is None:
+                raise NotImplementedError(
+                    f"activation {layer.activation.__name__!r}")
+            return fn(ins[0], name=name)
+        if isinstance(layer, L.ReLU):
+            return ff.relu(ins[0], name=name)
+        if isinstance(layer, L.Softmax):
+            axis = layer.axis if isinstance(layer.axis, int) else -1
+            return ff.softmax(ins[0], axis=axis, name=name)
+        if isinstance(layer, L.Concatenate):
+            return ff.concat(list(ins), axis=layer.axis, name=name)
+        if isinstance(layer, L.Add):
+            out = ins[0]
+            for t in ins[1:]:
+                out = ff.add(out, t, name=name if len(ins) == 2 else None)
+            return out
+        if isinstance(layer, L.Subtract):
+            return ff.subtract(ins[0], ins[1], name=name)
+        if isinstance(layer, L.Multiply):
+            out = ins[0]
+            for t in ins[1:]:
+                out = ff.multiply(out, t, name=name if len(ins) == 2 else None)
+            return out
+        raise NotImplementedError(f"tf.keras layer {type(layer).__name__}")
+
+
+def transfer_tf_weights(tf_model, ffmodel) -> int:
+    """Copy trained tf.keras weights into a compiled FFModel (layouts
+    already match: Dense (in,out), Conv HWIO)."""
+    from tensorflow.keras import layers as L
+
+    copied = 0
+    for layer in tf_model.layers:
+        name = layer.name
+        if name not in ffmodel.params:
+            continue
+        w = layer.get_weights()
+        if isinstance(layer, (L.Dense, L.Conv2D)) and w:
+            ffmodel.set_weight(name, "kernel", w[0])
+            copied += 1
+            if layer.use_bias and len(w) > 1:
+                ffmodel.set_weight(name, "bias", w[1])
+                copied += 1
+        elif isinstance(layer, L.Embedding) and w:
+            ffmodel.set_weight(name, "table", w[0])
+            copied += 1
+        elif isinstance(layer, L.LayerNormalization) and len(w) == 2:
+            ffmodel.set_weight(name, "gamma", w[0])
+            ffmodel.set_weight(name, "beta", w[1])
+            copied += 2
+        elif isinstance(layer, L.BatchNormalization) and len(w) == 4:
+            ffmodel.set_weight(name, "scale", w[0])
+            ffmodel.set_weight(name, "bias", w[1])
+            ffmodel.set_state_var(f"{name}/running_mean", w[2])
+            ffmodel.set_state_var(f"{name}/running_var", w[3])
+            copied += 4
+    return copied

@@ -18,7 +18,7 @@ Usage::
     model.fit(x, y, epochs=4, callbacks=[keras.callbacks.EarlyStopping()])
 """
 
-from flexflow_tpu.keras import callbacks, layers, losses, metrics, optimizers  # noqa: F401
+from flexflow_tpu.keras import callbacks, datasets, layers, losses, metrics, optimizers  # noqa: F401
 from flexflow_tpu.keras.layers import Input  # noqa: F401
 from flexflow_tpu.keras.models import Model, Sequential  # noqa: F401
 

@@ -12,8 +12,10 @@ the expert dim is a real shardable dim (expert parallelism = sharding
 dim 0 over a mesh axis; the dispatch becomes an XLA all-to-all).
 Capacity padding keeps every shape static for XLA — the reference's
 dynamic max_size trick (moe recompile) becomes a plain static bound.
-Dispatch uses sort-free cumsum position assignment (standard TPU MoE
-formulation).
+Dispatch is sort-based (kernels/moe_dispatch.py): stable-sort of the
+token→expert assignment + narrow int scatter of slot indices + one wide
+row gather — the standard TPU MoE formulation (O(T log T), vs O(T·E)
+for the one-hot cumsum alternative).
 """
 
 from __future__ import annotations
@@ -72,28 +74,20 @@ class GroupByOp(Operator):
         )
 
     def forward(self, ctx: LoweringContext, inputs, weights):
+        from flexflow_tpu.kernels.moe_dispatch import moe_dispatch
+
         data, assign = inputs
         assign = assign.astype(jnp.int32)
         b, k = assign.shape
         e, cap = self.attrs["n_experts"], self.capacity
-        flat = assign.reshape(-1)  # [B*K] expert ids, row-major (b major)
-        onehot = jax.nn.one_hot(flat, e, dtype=jnp.int32)  # [BK, E]
-        pos_flat = jnp.cumsum(onehot, axis=0) * onehot  # 1-based position
-        pos = (jnp.sum(pos_flat, axis=1) - 1).reshape(b, k)  # [B,K] 0-based
-        valid = (pos < cap) & (pos >= 0)
-        pos_c = jnp.clip(pos, 0, cap - 1)
-        # scatter rows into [E, cap, D]
-        grouped = jnp.zeros((e, cap, data.shape[-1]), data.dtype)
-        flat_e = assign.reshape(-1)
-        flat_p = pos_c.reshape(-1)
-        flat_v = valid.reshape(-1)
-        src = jnp.repeat(data, k, axis=0) * flat_v[:, None].astype(data.dtype)
-        grouped = grouped.at[flat_e, flat_p].add(src)
+        flat_e = assign.reshape(-1)  # [B*K] expert ids, row-major (b major)
+        src = jnp.repeat(data, k, axis=0)  # token (b,k) -> row b
+        grouped, pos_flat, valid_flat = moe_dispatch(src, flat_e, e, cap)
         return [
             grouped,
             assign,
-            pos_c.astype(jnp.int32),
-            valid.astype(data.dtype),
+            jnp.clip(pos_flat, 0, cap - 1).reshape(b, k).astype(jnp.int32),
+            valid_flat.reshape(b, k).astype(data.dtype),
         ]
 
     def propagate(self, mv: MachineView) -> OpSharding:

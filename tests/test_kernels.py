@@ -94,3 +94,41 @@ def test_mha_sequence_parallel_end_to_end():
     l1 = m1.compiled.forward_fn()(m1.params, m1.state, [jnp.asarray(x)])
     l2 = m2.compiled.forward_fn()(m2.params, m2.state, [jnp.asarray(x)])
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=2e-4, atol=2e-4)
+
+
+def test_moe_dispatch_sort_based_matches_cumsum_semantics():
+    """Sort-based dispatch (kernels/moe_dispatch.py) must match the
+    arrival-order cumsum definition (reference: group_by.cc)."""
+    import jax
+    from flexflow_tpu.kernels.moe_dispatch import moe_dispatch
+
+    rng = np.random.default_rng(0)
+    T, D, E, cap = 96, 8, 5, 9  # cap small enough to force drops
+    src = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
+    flat = jnp.asarray(rng.integers(0, E, T).astype(np.int32))
+    grouped, pos, valid = moe_dispatch(src, flat, E, cap)
+
+    onehot = jax.nn.one_hot(flat, E, dtype=jnp.int32)
+    pos_ref = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+    valid_ref = pos_ref < cap
+    assert np.array_equal(np.asarray(pos), np.asarray(pos_ref))
+    assert np.array_equal(np.asarray(valid), np.asarray(valid_ref))
+    g_ref = jnp.zeros((E, cap, D), src.dtype).at[
+        flat, jnp.clip(pos_ref, 0, cap - 1)
+    ].add(src * valid_ref[:, None])
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(g_ref), rtol=1e-6)
+    # dropped tokens must receive zero gradient
+    grads = jax.grad(lambda s: moe_dispatch(s, flat, E, cap)[0].sum())(src)
+    dropped = ~np.asarray(valid)
+    assert np.all(np.asarray(grads)[dropped] == 0)
+    assert np.all(np.asarray(grads)[~dropped] == 1)
+
+
+def test_moe_dispatch_out_of_range_ids_dropped():
+    from flexflow_tpu.kernels.moe_dispatch import moe_dispatch
+
+    src = jnp.ones((4, 3), jnp.float32)
+    flat = jnp.asarray([0, -1, 7, 1], jnp.int32)  # two out-of-range ids
+    grouped, pos, valid = moe_dispatch(src, flat, n_experts=2, capacity=2)
+    assert np.array_equal(np.asarray(valid), [True, False, False, True])
+    assert float(np.asarray(grouped).sum()) == 6.0  # only 2 valid rows

@@ -18,10 +18,26 @@ from typing import Dict, List, Sequence
 __all__ = ["TFKerasModel", "transfer_tf_weights"]
 
 
-def _pads(padding: str, kernel) -> tuple:
-    if padding == "same":
-        return ((kernel[0] - 1) // 2, (kernel[1] - 1) // 2)
-    return (0, 0)
+def _pads(padding: str, kernel, strides, in_hw) -> tuple:
+    """Symmetric padding reproducing TF 'same' exactly, or raise.
+
+    TF SAME pads total = max((ceil(in/s)-1)*s + k - in, 0) per dim,
+    putting the extra pixel on the bottom/right when odd.  Our conv2d
+    only supports symmetric padding, so an odd total (strided/even-
+    kernel cases) cannot be reproduced — fail loudly instead of
+    silently shifting the feature map."""
+    if padding != "same":
+        return (0, 0)
+    out = []
+    for i in range(2):
+        s, k, n = strides[i], kernel[i], in_hw[i]
+        total = max((-(-n // s) - 1) * s + k - n, 0)
+        if total % 2:
+            raise NotImplementedError(
+                f"TF 'same' padding is asymmetric here (kernel={k}, "
+                f"stride={s}, size={n}); pad explicitly in the source model")
+        out.append(total // 2)
+    return tuple(out)
 
 
 class TFKerasModel:
@@ -66,6 +82,14 @@ class TFKerasModel:
                     y = self._emit(ffmodel, layer, ins)
                     for kt, t in zip(outs, y if isinstance(y, list) else [y]):
                         env[id(kt)] = t
+        missing = [kt for kt in tfm.outputs if id(kt) not in env]
+        if missing:
+            raise NotImplementedError(
+                "could not resolve graph outputs "
+                f"{[getattr(kt, 'name', '?') for kt in missing]}: some "
+                "layer's inputs were never produced (unsupported layer "
+                "ordering or layers shared with another model)"
+            )
         return [env[id(kt)] for kt in tfm.outputs]
 
     # ------------------------------------------------------------------
@@ -80,9 +104,13 @@ class TFKerasModel:
             return ff.dense(ins[0], layer.units, activation=act,
                             use_bias=layer.use_bias, name=name)
         if isinstance(layer, L.Conv2D):
+            if layer.data_format == "channels_first":
+                raise NotImplementedError("channels_first Conv2D")
+            if tuple(layer.dilation_rate) != (1, 1):
+                raise NotImplementedError("dilated Conv2D")
             k = layer.kernel_size
             s = layer.strides
-            ph, pw = _pads(layer.padding, k)
+            ph, pw = _pads(layer.padding, k, s, ins[0].sizes[1:3])
             act = (layer.activation.__name__
                    if layer.activation is not None else None)
             act = None if act == "linear" else act
@@ -92,7 +120,7 @@ class TFKerasModel:
         if isinstance(layer, (L.MaxPooling2D, L.AveragePooling2D)):
             k = layer.pool_size
             s = layer.strides or k
-            ph, pw = _pads(layer.padding, k)
+            ph, pw = _pads(layer.padding, k, s, ins[0].sizes[1:3])
             pt = "max" if isinstance(layer, L.MaxPooling2D) else "avg"
             return ff.pool2d(ins[0], k[0], k[1], s[0], s[1], ph, pw,
                              pool_type=pt, name=name)

@@ -372,6 +372,7 @@ class FFModel:
         strategy: Optional[Dict[int, MachineView]] = None,
         pipeline=None,
         block_of: Optional[Dict[int, int]] = None,
+        mesh=None,
     ):
         """Pick a parallelization strategy and lower
         (reference: FFModel::compile model.cc:2587).  ``pipeline`` — a
@@ -391,6 +392,11 @@ class FFModel:
             raise ValueError(
                 f"pipeline.num_stages={pipeline.num_stages} must divide "
                 f"num_devices={self.config.num_devices}"
+            )
+        if pipeline is not None and mesh is not None:
+            raise ValueError(
+                "mesh= is not supported with pipeline= (the pipelined "
+                "lowering builds its own pp-leading mesh)"
             )
         if strategy is None:
             if pipeline is not None:
@@ -451,10 +457,12 @@ class FFModel:
                 LossType.from_any(loss_type),
                 list(metrics),
                 self.optimizer,
+                mesh=mesh,
             )
         self._compile_ctx = dict(
             strategy=strategy, loss_type=LossType.from_any(loss_type),
             metrics=list(metrics), pipeline=pipeline, block_of=block_of,
+            mesh=mesh,
         )
         self.params, self.state = self.compiled.init_params(self.config.seed)
         self.opt_state = self.optimizer.init_state(self.params)
@@ -479,7 +487,7 @@ class FFModel:
         else:
             self.compiled = CompiledModel(
                 self.graph, ctx["strategy"], self.config, ctx["loss_type"],
-                ctx["metrics"], self.optimizer,
+                ctx["metrics"], self.optimizer, mesh=ctx.get("mesh"),
             )
         old_params, old_state, old_opt = self.params, self.state, self.opt_state
         self.params, self.state = self.compiled.init_params(self.config.seed)

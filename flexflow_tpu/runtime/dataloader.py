@@ -71,6 +71,25 @@ class SingleDataLoader:
                 pass
         return a[idx]
 
+    def _place(self, array: np.ndarray, idx: np.ndarray, sharding):
+        """Single host: gather + device_put. Multi-host: every process
+        holds the SAME shuffled order (seeded rng), gathers ONLY its
+        slice of the batch rows, and assembles the global jax.Array from
+        process-local rows (the reference's index-sharded load under
+        control replication, flexflow_dataloader.h:102)."""
+        jax = self._jax
+        n = jax.process_count()
+        if n <= 1:
+            return jax.device_put(self._gather(array, idx), sharding)
+        assert len(idx) % n == 0, (
+            f"multi-host batch size {len(idx)} must divide evenly over "
+            f"{n} processes"
+        )
+        per = len(idx) // n
+        lo = jax.process_index() * per
+        local = self._gather(array, idx[lo:lo + per])
+        return jax.make_array_from_process_local_data(sharding, local)
+
     def __iter__(self):
         order = np.arange(self.num_samples)
         if self.shuffle:
@@ -79,8 +98,8 @@ class SingleDataLoader:
         for b in range(self.num_batches):
             idx = order[b * bs : (b + 1) * bs]
             inputs = [
-                self._jax.device_put(self._gather(a, idx), sh)
+                self._place(a, idx, sh)
                 for a, sh in zip(self.xs, self._in_shardings)
             ]
-            labels = self._jax.device_put(self._gather(self.y, idx), self._label_sharding)
+            labels = self._place(self.y, idx, self._label_sharding)
             yield inputs, labels

@@ -121,3 +121,39 @@ def test_simulator_search_still_works_with_network_model():
     helper = SearchHelper(sim, 8)
     cost, strategy = helper.graph_cost(model.graph)
     assert math.isfinite(cost) and strategy
+
+
+def test_logical_taskgraph_simulator():
+    """Alternative simulator (reference: LogicalTaskgraphBasedSimulator,
+    simulator.h:774-816): pooled-contention comm + compute critical path."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+    from flexflow_tpu.search.taskgraph_sim import LogicalTaskGraphSimulator
+    from flexflow_tpu.search.simulator import Simulator
+
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([64, 256])
+    t = model.dense(x, 1024, activation="relu")
+    t = model.dense(t, 256)
+    t = model.dense(t, 8)
+
+    spec = MachineSpec.tpu_v5e(8)
+    lsim = LogicalTaskGraphSimulator(spec)
+    esim = Simulator(spec)
+    dp = data_parallel_strategy(model.graph, 8)
+    c_l = lsim.simulate(model.graph, dp)
+    c_e = esim.simulate(model.graph, dp)
+    assert math.isfinite(c_l) and c_l > 0
+    # both simulators agree on order of magnitude for a dp strategy
+    assert 0.1 < c_l / c_e < 10, (c_l, c_e)
+    # forward-only costs less than fwd+bwd+sync
+    assert lsim.simulate(model.graph, dp, include_update=False) < c_l
+    # a no-comm (single-device) strategy has zero pooled comm time:
+    # logical sim == pure compute critical path
+    from flexflow_tpu.core.machine import MachineView
+    triv = {n.guid: (n.op.fixed_machine_view()
+                     or MachineView.trivial(n.op.output_shapes[0].ndim))
+            for n in model.graph.topo_order()}
+    c_triv = lsim.simulate(model.graph, triv, include_update=True)
+    assert math.isfinite(c_triv) and c_triv > 0

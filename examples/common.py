@@ -44,15 +44,16 @@ def synthetic_labels(model: ff.FFModel, num_samples: int, loss: str, seed: int =
 
 def run_example(model: ff.FFModel, name: str, loss: str = "sparse_categorical_crossentropy",
                 metrics: Sequence[str] = ("accuracy",), num_samples: int = 0,
-                optimizer=None):
+                optimizer=None, recompile_state=None, skip_compile=False):
     cfg = model.config
     num_samples = num_samples or cfg.batch_size * 8
+    if not skip_compile:
+        t0 = time.perf_counter()
+        model.compile(optimizer=optimizer, loss_type=loss, metrics=list(metrics))
+        print(f"[{name}] compile (incl. strategy search): {time.perf_counter()-t0:.2f}s")
     xs = synthetic_inputs(model, num_samples)
     y = synthetic_labels(model, num_samples, loss)
-    t0 = time.perf_counter()
-    model.compile(optimizer=optimizer, loss_type=loss, metrics=list(metrics))
-    print(f"[{name}] compile (incl. strategy search): {time.perf_counter()-t0:.2f}s")
-    model.fit(x=xs if len(xs) > 1 else xs[0], y=y)
+    model.fit(x=xs if len(xs) > 1 else xs[0], y=y, recompile_state=recompile_state)
     thr = getattr(model, "last_throughput", None)
     if thr:
         print(f"[{name}] THROUGHPUT = {thr:.2f} samples/s")

@@ -224,11 +224,76 @@ def make_simplify_xfer() -> GraphXfer:
     )
 
 
+_FUSABLE_ACTS = {
+    OperatorType.RELU: "relu",
+    OperatorType.SIGMOID: "sigmoid",
+    OperatorType.TANH: "tanh",
+    OperatorType.GELU: "gelu",
+}
+
+
+def make_linear_activation_fusion_xfer() -> GraphXfer:
+    """Fuse Linear followed by a sole-consumer activation into the
+    Linear's fused-activation attribute (reference: the generated
+    linear_relu fusion xfer, substitution.cc:1619-1758).  XLA fuses the
+    kernels either way — the win is a smaller PCG for the search."""
+
+    def matcher(graph: Graph, node: Node) -> bool:
+        if node.op.op_type is not OperatorType.LINEAR:
+            return False
+        if node.op.attrs.get("activation") is not None:
+            return False
+        succs = graph.successors(node.guid)
+        if len(succs) != 1 or len(graph.out_edges[node.guid]) != 1:
+            return False
+        nxt = graph.nodes[succs[0]].op
+        return nxt.op_type in _FUSABLE_ACTS
+
+    def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
+        from flexflow_tpu.ops.linear import LinearOp
+
+        g = graph.copy()
+        act_guid = g.successors(node.guid)[0]
+        act_name = _FUSABLE_ACTS[g.nodes[act_guid].op.op_type]
+        fused = LinearOp(
+            _uname(f"{node.op.name}_{act_name}"),
+            list(node.op.input_shapes),
+            out_dim=node.op.attrs["out_dim"],
+            activation=act_name,
+            use_bias=node.op.attrs["use_bias"],
+            kernel_initializer=node.op._kernel_init,
+            bias_initializer=node.op._bias_init,
+            param_dtype=node.op.attrs.get("param_dtype", "float32"),
+        )
+        out_edges = list(g.out_edges[act_guid])
+        in_edges = list(g.in_edges[node.guid])
+        g.remove_node(node.guid)
+        g.remove_node(act_guid)
+        nn = Node(g._next_guid, fused)
+        g._next_guid += 1
+        g.add_node(nn)
+        for e in in_edges:
+            ne = Edge(e.src, nn.guid, e.src_idx, e.dst_idx)
+            g.out_edges[e.src].append(ne)
+            g.in_edges[nn.guid].append(ne)
+        for e in out_edges:
+            ne = Edge(nn.guid, e.dst, 0, e.dst_idx)
+            g.out_edges[nn.guid].append(ne)
+            g.in_edges[e.dst].append(ne)
+        g._invalidate()
+        return g
+
+    return GraphXfer(
+        name="fuse_linear_activation", matcher=matcher, apply_fn=apply_fn
+    )
+
+
 def generate_all_pcg_xfers(num_devices: int) -> List[GraphXfer]:
     """All rewrites for the device count, one per divisor degree —
     mirrors generate_all_pcg_xfers (reference: substitution.cc:1619-1758)."""
     degrees = [d for d in range(2, num_devices + 1) if num_devices % d == 0]
-    xfers: List[GraphXfer] = [make_simplify_xfer()]
+    xfers: List[GraphXfer] = [make_simplify_xfer(),
+                              make_linear_activation_fusion_xfer()]
     for d in degrees:
         for t in (
             OperatorType.LINEAR,

@@ -26,6 +26,7 @@ from typing import Dict, List, Tuple
 
 from flexflow_tpu.core.graph import Graph
 from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.search.machine_model import OP_OVERHEAD_S
 from flexflow_tpu.search.simulator import Simulator
 
 
@@ -82,7 +83,9 @@ class LogicalTaskGraphSimulator(Simulator):
                 t_edge = self.cost.xfer_cost(shape, src_annot, dst_annot)
                 if not math.isfinite(t_edge):
                     return math.inf
-                if t_edge > 0:
+                # pure-local reshards (repartition refinement) are costed
+                # at OP_OVERHEAD_S and move zero wire bytes — skip them
+                if t_edge > OP_OVERHEAD_S:
                     # time -> bottleneck-link bytes, with the collective's
                     # latency term removed first (traffic_time re-adds
                     # path latency once; charging it as payload would

@@ -171,3 +171,36 @@ def test_strategy_export_import_roundtrip(tmp_path):
     export_strategy(p, m.graph, dp)
     back = import_strategy(p, m.graph)
     assert back == dp
+
+
+def test_linear_activation_fusion_xfer():
+    """reference: the generated linear_relu fusion xfer
+    (substitution.cc:1619-1758)."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.substitution import make_linear_activation_fusion_xfer
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, compute_dtype="float32")
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 16])
+    t = m.dense(x, 32, name="fc")
+    t = m.relu(t)
+    t = m.dense(t, 4, name="out")
+
+    xf = make_linear_activation_fusion_xfer()
+    matches = xf.find_matches(m.graph)
+    assert len(matches) == 1 and matches[0].op.name == "fc"
+    g2 = xf.apply(m.graph, matches[0])
+    assert g2.num_nodes == m.graph.num_nodes - 1
+    fused = [n for n in g2.topo_order()
+             if n.op.op_type is OperatorType.LINEAR
+             and n.op.attrs.get("activation") == "relu"]
+    assert len(fused) == 1
+    # rewritten graph still topologically valid and costable
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+
+    sim = Simulator(MachineSpec.tpu_v5e(8))
+    c = sim.simulate(g2, data_parallel_strategy(g2, 8))
+    assert c > 0 and c != float("inf")

@@ -193,7 +193,20 @@ class CompiledModel:
                 x = self._constrain(x, osh.inputs[e.dst_idx], axes)
             ins.append(x)
         ctx.slot_axes = axes
-        outs = node.op.forward(ctx, ins, params.get(node.op.name, {}))
+        ws = params.get(node.op.name, {})
+        if (
+            self.config.remat
+            and getattr(node.op, "state_specs", None) is None
+            and node.op._weight_specs
+        ):
+            # rematerialize weighted stateless ops in backward: their
+            # activations are recomputed instead of saved (state-mutating
+            # ops can't be checkpointed — forward must be pure)
+            outs = jax.checkpoint(
+                lambda i, w: node.op.forward(ctx, i, w)
+            )(ins, ws)
+        else:
+            outs = node.op.forward(ctx, ins, ws)
         for i, y in enumerate(outs):
             if i < len(osh.outputs):
                 y = self._constrain(y, osh.outputs[i], axes)

@@ -277,6 +277,13 @@ class PipelinedCompiledModel(CompiledModel):
                     state_in={},
                     mesh=None,
                 )
+                if self.config.remat:
+                    # per-block activation rematerialization — the
+                    # standard memory/FLOPs trade under a scanned stack
+                    y = jax.checkpoint(
+                        lambda xx, pp: self._run_block_template(bctx, xx, pp)
+                    )(x, p_blk)
+                    return y, None
                 return self._run_block_template(bctx, x, p_blk), None
 
             x, _ = jax.lax.scan(

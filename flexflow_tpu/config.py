@@ -56,6 +56,9 @@ class FFConfig:
     # execution
     profiling: bool = False
     perform_fusion: bool = True
+    remat: bool = False  # rematerialize activations in backward
+    # (jax.checkpoint) — trades FLOPs for HBM; the reference has no
+    # equivalent (Legion keeps all activations resident)
     seed: int = 0
     iteration: IterationConfig = field(default_factory=IterationConfig)
 
@@ -102,6 +105,7 @@ class FFConfig:
         p.add_argument("--machine-model-file", type=str, default=None)
         p.add_argument("--taskgraph", dest="export_taskgraph", type=str, default=None)
         p.add_argument("--profiling", action="store_true")
+        p.add_argument("--remat", action="store_true")
         p.add_argument("--seed", type=int, default=0)
         args, _ = p.parse_known_args(argv)
         search_devs = args.search_num_workers * max(1, args.search_num_nodes or 1)
@@ -122,5 +126,6 @@ class FFConfig:
             export_strategy_task_graph_file=args.export_taskgraph,
             machine_model_file=args.machine_model_file,
             profiling=args.profiling,
+            remat=args.remat,
             seed=args.seed,
         )

@@ -18,7 +18,15 @@ Usage::
     model.fit(x, y, epochs=4, callbacks=[keras.callbacks.EarlyStopping()])
 """
 
-from flexflow_tpu.keras import callbacks, datasets, layers, losses, metrics, optimizers  # noqa: F401
+from flexflow_tpu.keras import (  # noqa: F401
+    callbacks,
+    datasets,
+    layers,
+    losses,
+    metrics,
+    optimizers,
+    preprocessing,
+)
 from flexflow_tpu.keras.layers import Input  # noqa: F401
 from flexflow_tpu.keras.models import Model, Sequential  # noqa: F401
 

@@ -142,6 +142,10 @@ class Operator:
     """Immutable operator descriptor (PCG node payload)."""
 
     op_type: OperatorType = OperatorType.NOOP
+    # True when forward() writes ctx.state_out — such ops are impure and
+    # must not be wrapped in jax.checkpoint (remat); set by every op
+    # that mutates state, with or without state_specs
+    writes_state: bool = False
 
     def __init__(
         self,

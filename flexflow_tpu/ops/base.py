@@ -221,12 +221,18 @@ class Operator:
 
     # ---- identity --------------------------------------------------------
     def signature(self) -> Tuple:
-        return (
-            self.op_type.value,
-            tuple(s.sizes for s in self.input_shapes),
-            tuple(s.dtype.value for s in self.input_shapes),
-            tuple(sorted((k, _sig_value(v)) for k, v in self.attrs.items())),
-        )
+        """Structural identity: two ops with equal signatures have equal
+        shapes/costs/propagation.  Cached — Operator is immutable."""
+        sig = getattr(self, "_sig_cache", None)
+        if sig is None:
+            sig = (
+                self.op_type.value,
+                tuple(s.sizes for s in self.input_shapes),
+                tuple(s.dtype.value for s in self.input_shapes),
+                tuple(sorted((k, _sig_value(v)) for k, v in self.attrs.items())),
+            )
+            self._sig_cache = sig
+        return sig
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.name})"

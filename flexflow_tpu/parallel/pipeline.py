@@ -120,10 +120,36 @@ def pipeline_spmd(
         (_, outbuf), _ = jax.lax.scan(
             tick, (zero, outbuf), jnp.arange(M + S - 1)
         )
-        # real outputs live on the last stage only; mask + psum
-        # broadcasts them (one ICI all-reduce of the final activations).
-        outbuf = jnp.where(s == S - 1, outbuf, jnp.zeros_like(outbuf))
-        return jax.lax.psum(outbuf, axis_name)
+        # real outputs live on the last stage only; stream them down the
+        # chain S-1 -> S-2 -> ... -> 0, one microbatch-chunk per tick
+        # (pipelined chain broadcast).  Each link carries the N-byte
+        # buffer exactly once ((S-1)·N aggregate, vs ~2(S-1)·N for a ring
+        # allreduce of the masked buffer) and chunk pipelining keeps the
+        # latency at ~N·(1+(S-2)/M)/BW, below the allreduce's
+        # ~2N·(S-1)/S/BW for M >= 2(S-2).
+        back = [(r + 1, r) for r in range(S - 1)]
+        acc0 = jnp.where(s == S - 1, outbuf, jnp.zeros_like(outbuf))
+
+        def bcast_tick(carry, t):
+            acc, cur = carry
+            send = jnp.where(s == S - 1, outbuf[jnp.clip(t, 0, M - 1)], cur)
+            recv = jax.lax.ppermute(send, axis_name, back)
+            c = t - (S - 2 - s)  # chunk arriving at this rank this tick
+            valid = jnp.logical_and(s < S - 1,
+                                    jnp.logical_and(c >= 0, c < M))
+            cidx = jnp.clip(c, 0, M - 1)
+            prev = jax.lax.dynamic_index_in_dim(acc, cidx, 0, keepdims=False)
+            acc = jax.lax.dynamic_update_index_in_dim(
+                acc, jnp.where(valid, recv, prev), cidx, 0
+            )
+            return (acc, recv), None
+
+        (acc, _), _ = jax.lax.scan(
+            bcast_tick,
+            (acc0, jnp.zeros(outbuf.shape[1:], outbuf.dtype)),
+            jnp.arange(M + S - 2),
+        )
+        return acc
 
     ndim_x = x_microbatches.ndim
     param_specs = jax.tree.map(

@@ -45,11 +45,11 @@ class Simulator:
         self._axis_pool = mesh_axis_sizes(self.num_devices)
         self._axis_index = {name: i for i, (name, _) in enumerate(self._axis_pool)}
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
-        # propagate()/op_cost results per (op identity, view) — ops are
-        # immutable and shared across graph copies, so id() is a safe key
-        # while the op is alive (graphs hold refs)
-        self._prop_cache: Dict[Tuple[int, Tuple], object] = {}
-        self._cost_cache: Dict[Tuple[int, Tuple], Tuple[float, float, float]] = {}
+        # propagate()/op_cost results per (op signature, view): structural
+        # keys stay valid across graph copies and op lifetimes (an id()
+        # key could be recycled after GC during a long search)
+        self._prop_cache: Dict[Tuple, object] = {}
+        self._cost_cache: Dict[Tuple, Tuple[float, float, float]] = {}
 
     # ------------------------------------------------------------------
     def view_device_set(self, mv: MachineView) -> FrozenSet[int]:
@@ -89,7 +89,7 @@ class Simulator:
     # ------------------------------------------------------------------
     def _node_costs(self, node, mv) -> Tuple[float, float, float]:
         """(fwd_cost, full_cost, weight_sync) cached per (op, view)."""
-        key = (id(node.op), (mv.dim_degrees, mv.replica_degree))
+        key = (node.op.signature(), (mv.dim_degrees, mv.replica_degree))
         hit = self._cost_cache.get(key)
         if hit is None:
             fwd = self.cost.op_cost(node.op, mv, backward=False)
@@ -100,7 +100,7 @@ class Simulator:
         return hit
 
     def _propagate(self, node, mv):
-        key = (id(node.op), (mv.dim_degrees, mv.replica_degree))
+        key = (node.op.signature(), (mv.dim_degrees, mv.replica_degree))
         hit = self._prop_cache.get(key)
         if hit is None:
             try:

@@ -96,6 +96,33 @@ def test_mha_sequence_parallel_end_to_end():
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=2e-4, atol=2e-4)
 
 
+def test_mha_sp_fallback_warns():
+    """A seq-sharded strategy that cannot take the ring-attention path
+    (here: cross-attention, Sk != Sq) must warn loudly instead of
+    silently all-gathering K/V."""
+    cfg = ff.FFConfig(batch_size=8, epochs=1, num_devices=8,
+                      compute_dtype="float32", only_data_parallel=True, seed=5)
+    m = ff.FFModel(cfg)
+    q = m.create_tensor([8, 16, 32])
+    kv = m.create_tensor([8, 8, 32])
+    t = m.multihead_attention(q, kv, kv, embed_dim=32, num_heads=4, name="xattn")
+    t = m.mean(t, dims=[1], name="pool")
+    m.dense(t, 4, name="out")
+    strategy = {}
+    for node in m.graph.topo_order():
+        nd = node.op.output_shapes[0].ndim
+        strategy[node.guid] = MachineView.data_parallel(nd, 2)
+    strategy[m.node_by_name("xattn").guid] = MachineView(dim_degrees=(2, 2, 1))
+    rng = np.random.default_rng(0)
+    xq = jnp.asarray(rng.normal(size=(8, 16, 32)).astype(np.float32))
+    xkv = jnp.asarray(rng.normal(size=(8, 8, 32)).astype(np.float32))
+    with pytest.warns(RuntimeWarning, match="degrades"):
+        m.compile(strategy=strategy,
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.compiled.forward_fn()(m.params, m.state, [xq, xkv])
+
+
 def test_moe_dispatch_sort_based_matches_cumsum_semantics():
     """Sort-based dispatch (kernels/moe_dispatch.py) must match the
     arrival-order cumsum definition (reference: group_by.cc)."""

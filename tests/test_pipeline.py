@@ -62,6 +62,17 @@ class TestPipelineSpmd:
             np.asarray(y), np.asarray(self._ref(params, x, L)), atol=1e-5
         )
 
+    def test_output_broadcast_uses_ppermute_not_allreduce(self):
+        """The output epilogue hands the last stage's buffer around the
+        ring with single-pair ppermutes — (S-1)·N bytes on the wire —
+        instead of psumming the masked full buffer (~2(S-1)·N)."""
+        params, x = self._setup(S=4, L=4, M=8)
+        mesh = _pp_mesh(4)
+        fn = jax.jit(lambda p, xm: pipeline_spmd(self._stage, p, xm, mesh=mesh))
+        hlo = fn.lower(params, split_microbatches(x, 8)).as_text()
+        assert "collective_permute" in hlo
+        assert "all_reduce" not in hlo
+
     def test_grad_matches_sequential(self):
         params, x = self._setup(S=4, L=4, M=8)
         mesh = _pp_mesh(4)

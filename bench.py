@@ -91,14 +91,26 @@ def main():
     from flexflow_tpu.core.machine import MachineSpec
 
     if on_tpu:
-        kind = getattr(devices[0], "device_kind", "").lower()
-        spec = (
-            MachineSpec.tpu_v5p(1) if ("v5p" in kind or "v5 p" in kind)
-            else MachineSpec.tpu_v5e(1)
+        kind = getattr(devices[0], "device_kind", "").lower().replace(" ", "")
+        # bf16 MXU peaks per chip by generation
+        known_peaks = {
+            "v5p": 4.59e14,
+            "v5e": 1.97e14,
+            "v5litepod": 1.97e14,
+            "v6e": 9.2e14,
+            "v6": 9.2e14,
+            "v4": 2.75e14,
+            "v3": 1.23e14,
+        }
+        peak = next(
+            (p for k, p in known_peaks.items() if k in kind),
+            MachineSpec.tpu_v5e(1).peak_flops,
         )
+        if not any(k in kind for k in known_peaks):
+            print(f"# warning: unknown TPU kind {kind!r}, assuming v5e peak",
+                  file=sys.stderr)
     else:
-        spec = MachineSpec.host_cpu(1)
-    peak = spec.peak_flops
+        peak = MachineSpec.host_cpu(1).peak_flops
     mfu = train_flops_per_step * steps / elapsed / (peak * len(devices))
     # vs_baseline: the reference publishes no absolute numbers
     # (BASELINE.md); its per-chip contract is utilization, so report the

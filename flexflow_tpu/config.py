@@ -34,8 +34,9 @@ class FFConfig:
     num_devices: int = 0  # 0 = all visible jax devices
     machine_spec: Optional[MachineSpec] = None
     machine_model_file: Optional[str] = None
-    # parallelization search (reference: config.h:116-157)
-    search_budget: int = 128
+    # parallelization search (reference: config.h:116-157; the osdi22ae
+    # scripts run with budgets 10-30)
+    search_budget: int = 16
     search_alpha: float = 1.05
     only_data_parallel: bool = False
     enable_parameter_parallel: bool = True

@@ -175,7 +175,14 @@ class Graph:
             return self._hash_cache
         h: Dict[int, int] = {}
         for node in self.topo_order():
-            sig = repr(node.op.signature()) if hasattr(node.op, "signature") else repr(node.op)
+            op = node.op
+            sig = getattr(op, "_sig_repr_cache", None)
+            if sig is None:
+                sig = repr(op.signature()) if hasattr(op, "signature") else repr(op)
+                try:
+                    op._sig_repr_cache = sig  # ops are immutable; see base.py
+                except AttributeError:
+                    pass
             ins = sorted(
                 (h[e.src], e.src_idx, e.dst_idx) for e in self.in_edges[node.guid]
             )

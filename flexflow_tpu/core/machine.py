@@ -116,10 +116,22 @@ class MachineView:
     product, must divide the machine's device count — the same divisor
     rule the reference uses when registering candidate views
     (reference: src/runtime/graph.cc:1778-1810).
+
+    ``start_part`` is the placement offset: the op's shards occupy the
+    contiguous device block [start_part, start_part + num_parts) — the
+    reference's MachineView.start_device_id / MachineResource
+    start_gpu_id (reference: include/flexflow/machine_view.h:14-87,
+    graph.cc:180-205 VERTICAL/HORIZONTAL resource splits).  The
+    simulator uses it to credit inter-op overlap of branches placed on
+    disjoint device blocks; the GSPMD lowering ignores it (XLA
+    time-shares the full mesh instead — degrees alone determine the
+    compiled program, so a strategy with offsets is still numerically
+    exact when lowered).
     """
 
     dim_degrees: Tuple[int, ...]
     replica_degree: int = 1
+    start_part: int = 0
 
     @property
     def num_parts(self) -> int:
@@ -136,6 +148,8 @@ class MachineView:
         s = "x".join(str(d) for d in self.dim_degrees)
         if self.replica_degree > 1:
             s += f"*R{self.replica_degree}"
+        if self.start_part:
+            s += f"@{self.start_part}"
         return f"MV[{s}]"
 
     @staticmethod

@@ -144,7 +144,15 @@ class ParallelTensorShape:
 
     @property
     def num_elements(self) -> int:
-        return int(np.prod([d.size for d in self.dims], dtype=np.int64)) if self.dims else 1
+        # cached — this sits in the cost model's innermost loop and the
+        # shape is frozen
+        n = self.__dict__.get("_num_elements")
+        if n is None:
+            n = 1
+            for d in self.dims:
+                n *= d.size
+            object.__setattr__(self, "_num_elements", n)
+        return n
 
     @property
     def num_bytes(self) -> int:

@@ -411,12 +411,19 @@ class FFModel:
             elif self.config.only_data_parallel:
                 strategy = data_parallel_strategy(self.graph, self.config.num_devices)
             else:
-                try:
-                    from flexflow_tpu.search.driver import optimize_strategy
+                # the Unity joint search IS the default compile path
+                # (reference: FFModel::compile -> graph_optimize,
+                # model.cc:2587-2655): graph rewrites compete with view
+                # assignment and the best REWRITTEN graph gets lowered —
+                # self.graph is replaced the same way the reference
+                # deserializes the optimized PCG into its operator list
+                # (convert_graph_to_operators, substitution.cc:3014)
+                from flexflow_tpu.search.driver import optimize_strategy
 
-                    strategy = optimize_strategy(self.graph, self.config)
-                except ImportError:
-                    strategy = data_parallel_strategy(self.graph, self.config.num_devices)
+                best_graph, strategy = optimize_strategy(
+                    self.graph, self.config, return_graph=True
+                )
+                self.graph = best_graph
         if self.config.export_strategy_file:
             from flexflow_tpu.search.strategy_io import export_strategy
 

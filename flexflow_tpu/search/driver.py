@@ -1,11 +1,18 @@
 """Search driver — Unity's outer loop, plus the legacy MCMC search.
 
-Re-implements GraphSearchHelper::graph_optimize / base_optimize
-(reference: src/runtime/substitution.cc:1779-2089): best-first search
-over the substitution space, each candidate graph costed by the DP
-(SearchHelper), pruned by ``cost > alpha * best`` and a pop budget —
-and FFModel::mcmc_optimize (reference: src/runtime/model.cc:3033-3122),
-simulated annealing over per-op views.
+Re-implements GraphSearchHelper (reference:
+src/runtime/substitution.cc:1779-2470):
+
+* ``optimize_strategy(return_graph=True)`` — the full Unity algorithm:
+  recursively split large graphs at low-rewrite-traffic bottlenecks
+  (find_split_node, :1879-2004), enumerate boundary shardings at each
+  split (possible_split_output_tensor_shapes, :2372 — here: the
+  bottleneck op's candidate MachineViews), and run a best-first
+  substitution search over each small-enough segment (base_optimize,
+  :2007-2089) with ``cost > alpha * best`` pruning and a pop budget,
+  every candidate costed by the DP inner loop (SearchHelper).
+* ``mcmc_optimize`` — FFModel::mcmc_optimize (reference:
+  src/runtime/model.cc:3033-3122), simulated annealing over per-op views.
 """
 
 from __future__ import annotations
@@ -13,24 +20,238 @@ from __future__ import annotations
 import heapq
 import math
 import random
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.config import FFConfig
-from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.core.graph import Graph, Node
 from flexflow_tpu.core.machine import MachineView
 from flexflow_tpu.search.dp import SearchHelper, Strategy
 from flexflow_tpu.search.simulator import Simulator
 from flexflow_tpu.search.substitution import generate_all_pcg_xfers
 from flexflow_tpu.search.views import candidate_views
 
+MAX_BOUNDARY_VIEWS = 8
+
+
+def _load_xfers(config: FFConfig, num_devices: int) -> list:
+    xfers = list(generate_all_pcg_xfers(num_devices))
+    if config.substitution_json:
+        from flexflow_tpu.search.substitution_loader import load_substitution_json
+
+        xfers += load_substitution_json(config.substitution_json)
+    return xfers
+
+
+class _UnityOptimizer:
+    """One graph_optimize run: shared memo/caches (reference:
+    cached_optimized_graphs, substitution.cc:2091-2188)."""
+
+    def __init__(self, helper: SearchHelper, config: FFConfig, xfers: list):
+        self.helper = helper
+        self.config = config
+        self.xfers = xfers
+        self.cache: Dict[Tuple, Tuple[Graph, float, Strategy]] = {}
+
+    # -- split-node choice (reference: find_split_node :1879-2004) ---------
+    def find_split_node(self, graph: Graph) -> Optional[Node]:
+        if graph.num_nodes <= self.config.base_optimize_threshold:
+            return None
+        bottlenecks = graph.bottlenecks()
+        if not bottlenecks:
+            return None
+        # score edges by how many rewrite matches touch them — splitting
+        # where no rewrite straddles keeps the segments' search spaces
+        # independent
+        edge_scores: Dict[Tuple[int, int], int] = {}
+        for xf in self.xfers:
+            for m in xf.find_matches(graph):
+                guids = (
+                    set(m.values()) if isinstance(m, dict) else {m.guid}
+                )
+                for g in guids:
+                    for e in graph.in_edges[g]:
+                        edge_scores[(e.src, e.dst)] = (
+                            edge_scores.get((e.src, e.dst), 0) + 1
+                        )
+                    for e in graph.out_edges[g]:
+                        edge_scores[(e.src, e.dst)] = (
+                            edge_scores.get((e.src, e.dst), 0) + 1
+                        )
+        threshold = self.config.base_optimize_threshold
+        best, best_key = None, None
+        for bn in bottlenecks:
+            weight = sum(
+                edge_scores.get((e.src, e.dst), 0)
+                for e in graph.out_edges[bn.guid]
+            )
+            try:
+                pre, _post = graph.split_at_node(bn)
+            except ValueError:
+                continue
+            size = pre.num_nodes
+            # prefer low rewrite traffic, then pre-size closest to (but
+            # under) the threshold (reference tie-break :1980-1999)
+            under = size <= threshold
+            key = (weight, 0 if under else 1, -size if under else size)
+            if best_key is None or key < best_key:
+                best, best_key = bn, key
+        return best
+
+    # -- boundary view enumeration (reference: :2372) ----------------------
+    def _boundary_views(self, node: Node) -> List[MachineView]:
+        views = candidate_views(
+            node.op, self.helper.num_devices, max_views=MAX_BOUNDARY_VIEWS
+        )
+        return views[:MAX_BOUNDARY_VIEWS]
+
+    # -- recursive sequence optimization (reference: :2190-2370) -----------
+    def sequence_optimize(
+        self, graph: Graph, fixed: Strategy
+    ) -> Tuple[Graph, float, Strategy]:
+        # node-id set included: isomorphic segments with different guids
+        # must not share cached strategies/graphs (see dp.py memo note)
+        key = (
+            graph.hash(),
+            frozenset(graph.nodes),
+            tuple(sorted((g, v) for g, v in fixed.items() if g in graph.nodes)),
+        )
+        hit = self.cache.get(key)
+        if hit is not None:
+            return hit
+        bn = self.find_split_node(graph)
+        if bn is None or bn.guid in fixed:
+            result = self.base_optimize(graph, fixed)
+        else:
+            try:
+                pre, post = graph.split_at_node(bn)
+            except ValueError:
+                result = self.base_optimize(graph, fixed)
+                self.cache[key] = result
+                return result
+            best: Tuple[Optional[Graph], float, Strategy] = (None, math.inf, {})
+            best_bound = math.inf
+            for v in self._boundary_views(bn):
+                f2 = dict(fixed)
+                f2[bn.guid] = v
+                g_pre, c_pre, s_pre = self.sequence_optimize(pre, f2)
+                if c_pre >= best_bound:
+                    continue
+                g_post, c_post, s_post = self.sequence_optimize(post, f2)
+                # c_pre + c_post double-counts the pinned bottleneck and
+                # ignores cross-segment overlap — it is only a pruning
+                # bound; the merged graph's own simulation decides
+                # (dp.graph_cost re-validates the same way)
+                total = c_pre + c_post
+                if total >= best_bound * 1.5:
+                    continue
+                best_bound = min(best_bound, total)
+                merged_g, merged_s = _merge_split(
+                    g_pre, s_pre, g_post, s_post, bn.guid
+                )
+                merged_s[bn.guid] = v
+                c_true = self.helper.sim.simulate(merged_g, merged_s)
+                if c_true < best[1]:
+                    best = (merged_g, c_true, merged_s)
+            if best[0] is None:
+                result = self.base_optimize(graph, fixed)
+            else:
+                result = best  # type: ignore[assignment]
+        self.cache[key] = result
+        return result
+
+    # -- best-first over substitutions (reference: :2007-2089) -------------
+    def base_optimize(
+        self, graph: Graph, fixed: Strategy
+    ) -> Tuple[Graph, float, Strategy]:
+        helper, config = self.helper, self.config
+        best_cost, best_strategy = helper.graph_cost(graph, fixed)
+        best_graph = graph
+        counter = 0
+        heap: list = [(best_cost, counter, graph)]
+        seen = {graph.hash()}
+        budget = config.search_budget
+        pinned = set(fixed)
+        while heap and budget > 0:
+            cost, _, g = heapq.heappop(heap)
+            if cost > config.search_alpha * best_cost:
+                break
+            budget -= 1
+            for xf in self.xfers:
+                for m in xf.find_matches(g):
+                    g2 = xf.apply(g, m)
+                    if g2 is None:
+                        continue
+                    # a rewrite must not consume a pinned boundary node
+                    if any(p not in g2.nodes for p in pinned if p in g.nodes):
+                        continue
+                    h = g2.hash()
+                    if h in seen:
+                        continue
+                    seen.add(h)
+                    c2, s2 = helper.graph_cost(g2, fixed)
+                    if c2 < best_cost:
+                        best_cost, best_strategy, best_graph = c2, s2, g2
+                    if c2 < config.search_alpha * best_cost:
+                        counter += 1
+                        heapq.heappush(heap, (c2, counter, g2))
+        return best_graph, best_cost, best_strategy
+
+
+def _merge_split(
+    pre_g: Graph,
+    pre_s: Strategy,
+    post_g: Graph,
+    post_s: Strategy,
+    bn_guid: int,
+) -> Tuple[Graph, Strategy]:
+    """Union of the two optimized segments.  Original nodes are disjoint
+    apart from the shared bottleneck; nodes INSERTED by rewrites may
+    collide between segments (both sides allocate from the same starting
+    guid) and are renumbered on the post side."""
+    g = Graph()
+    g._next_guid = max(pre_g._next_guid, post_g._next_guid)
+    for guid, n in pre_g.nodes.items():
+        g.nodes[guid] = n
+        g.in_edges[guid] = list(pre_g.in_edges[guid])
+        g.out_edges[guid] = list(pre_g.out_edges[guid])
+    remap: Dict[int, int] = {}
+    for guid in post_g.nodes:
+        if guid in pre_g.nodes and guid != bn_guid:
+            remap[guid] = g._next_guid
+            g._next_guid += 1
+    from flexflow_tpu.core.graph import Edge
+
+    for guid, n in post_g.nodes.items():
+        ng = remap.get(guid, guid)
+        if ng not in g.nodes:
+            g.nodes[ng] = n if ng == guid else Node(ng, n.op)
+            g.in_edges.setdefault(ng, [])
+            g.out_edges.setdefault(ng, [])
+    for guid in post_g.nodes:
+        for e in post_g.out_edges[guid]:
+            ne = Edge(
+                remap.get(e.src, e.src),
+                remap.get(e.dst, e.dst),
+                e.src_idx,
+                e.dst_idx,
+            )
+            g.out_edges[ne.src].append(ne)
+            g.in_edges[ne.dst].append(ne)
+    strategy = dict(pre_s)
+    for guid, v in post_s.items():
+        strategy[remap.get(guid, guid)] = v
+    g._invalidate()
+    return g, strategy
+
 
 def optimize_strategy(
     graph: Graph, config: FFConfig, return_graph: bool = False
 ) -> "Strategy | Tuple[Graph, Strategy]":
-    """Find a good (graph, strategy). With ``return_graph=False`` only
-    strategies on the ORIGINAL graph are explored (no rewrites) — the
-    common path, since degree-views already express DP/TP/row/head
-    splits; with True, substitution variants compete too."""
+    """Find a good (graph, strategy).  With ``return_graph=True`` — the
+    default compile path — the joint Unity search runs: graph rewrites
+    compete with view assignment and the best REWRITTEN graph is
+    returned for lowering.  With False only strategies on the original
+    graph are explored (strategy-only mode, e.g. for export)."""
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     n = config.search_devices
@@ -43,34 +264,16 @@ def optimize_strategy(
     best_graph = graph
 
     if return_graph and config.search_budget > 0:
-        xfers = generate_all_pcg_xfers(n)
-        # best-first queue over rewritten graphs (substitution.cc:2007-2089)
-        counter = 0
-        heap: list = [(best_cost, counter, graph)]
-        seen = {graph.hash()}
-        budget = config.search_budget
-        while heap and budget > 0:
-            cost, _, g = heapq.heappop(heap)
-            if cost > config.search_alpha * best_cost:
-                break
-            budget -= 1
-            for xf in xfers:
-                for m in xf.find_matches(g):
-                    g2 = xf.apply(g, m)
-                    if g2 is None:
-                        continue
-                    h = g2.hash()
-                    if h in seen:
-                        continue
-                    seen.add(h)
-                    c2, s2 = helper.graph_cost(g2)
-                    if c2 < best_cost:
-                        log.log(f"substitution improved: {best_cost * 1e3:.4f}"
-                                f" -> {c2 * 1e3:.4f} ms/iter")
-                        best_cost, best_strategy, best_graph = c2, s2, g2
-                    if c2 < config.search_alpha * best_cost:
-                        counter += 1
-                        heapq.heappush(heap, (c2, counter, g2))
+        xfers = _load_xfers(config, n)
+        opt = _UnityOptimizer(helper, config, xfers)
+        with log.enter(f"unity outer loop: {len(xfers)} xfers"):
+            g2, c2, s2 = opt.sequence_optimize(graph, {})
+            if c2 < best_cost and s2:
+                log.log(
+                    f"substitution improved: {best_cost * 1e3:.4f}"
+                    f" -> {c2 * 1e3:.4f} ms/iter"
+                )
+                best_cost, best_strategy, best_graph = c2, s2, g2
 
     if return_graph:
         return best_graph, best_strategy

@@ -152,9 +152,26 @@ class CostModel:
     ) -> float:
         """Edge cost when producer/consumer shardings differ — the role
         of estimate_xfer_cost (reference: simulator.cc:556-731), but
-        classified into the collective GSPMD will emit."""
+        classified into the collective GSPMD will emit.  Memoized — the
+        search evaluates the same (shape, src, dst) triple millions of
+        times (reference caches the same way, simulator.cc:515-554)."""
         if src is None or dst is None:
             return 0.0
+        if not hasattr(self, "_xfer_cache"):
+            self._xfer_cache = {}
+        key = (shape.num_bytes, src, dst)
+        hit = self._xfer_cache.get(key)
+        if hit is None:
+            hit = self._xfer_cost_uncached(shape, src, dst)
+            self._xfer_cache[key] = hit
+        return hit
+
+    def _xfer_cost_uncached(
+        self,
+        shape: ParallelTensorShape,
+        src: ShardAnnot,
+        dst: ShardAnnot,
+    ) -> float:
         if src.degrees == dst.degrees and src.partial == dst.partial:
             # NOTE: replica-degree differences are deliberately free — in
             # GSPMD a tensor is implicitly replicated over every mesh axis
@@ -190,6 +207,15 @@ class CostModel:
             return self.allgather(shard_src, src_deg // max(dst_deg, 1))
         # general case: all-to-all style re-shard
         return self.all_to_all(shard_src, n)
+
+    def placement_move_cost(
+        self, shape: ParallelTensorShape, src: Optional[ShardAnnot]
+    ) -> float:
+        """Cost of relocating a tensor between disjoint device blocks
+        (views with different start_part): each shard crosses ICI once."""
+        parts = max(1, src.num_parts) if src is not None else 1
+        shard = shape.num_bytes / parts
+        return shard / self.machine.ici_bandwidth + self.machine.ici_latency
 
     # ---- gradient synchronization ---------------------------------------
     def weight_sync_cost(self, op: Operator, mv: MachineView) -> float:

@@ -16,7 +16,6 @@ when they should.
 
 from __future__ import annotations
 
-import itertools
 import math
 from typing import Dict, FrozenSet, Optional, Tuple
 
@@ -24,7 +23,6 @@ import numpy as np
 
 from flexflow_tpu.core.graph import Graph
 from flexflow_tpu.core.machine import MachineSpec, MachineView
-from flexflow_tpu.parallel.mesh import mesh_axis_sizes, view_slot_axes
 from flexflow_tpu.search.machine_model import CostModel
 
 
@@ -42,8 +40,6 @@ class Simulator:
             except (AssertionError, ValueError):
                 network = None
         self.cost = CostModel(machine, network=network)
-        self._axis_pool = mesh_axis_sizes(self.num_devices)
-        self._axis_index = {name: i for i, (name, _) in enumerate(self._axis_pool)}
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
         # propagate()/op_cost results per (op signature, view): structural
         # keys stay valid across graph copies and op lifetimes (an id()
@@ -53,38 +49,20 @@ class Simulator:
 
     # ------------------------------------------------------------------
     def view_device_set(self, mv: MachineView) -> FrozenSet[int]:
-        """Device ids covered by a view = all devices whose coordinates
-        vary over the view's axes (others replicate).  Ops using
-        disjoint axis sets that *cover* different devices can overlap."""
-        key = (mv.dim_degrees, mv.replica_degree)
-        if key in self._device_sets:
-            return self._device_sets[key]
-        try:
-            slots = view_slot_axes(mv, self._axis_pool)
-        except ValueError:
-            self._device_sets[key] = frozenset(range(self.num_devices))
-            return self._device_sets[key]
-        used_axes = set()
-        for axes in slots.values():
-            used_axes.update(axes)
-        if len(used_axes) == len(self._axis_pool):
-            out = frozenset(range(self.num_devices))
-        else:
-            # devices with coordinate 0 on unused axes = canonical shard set
-            sizes = [s for _, s in self._axis_pool]
-            ids = []
-            ranges = [
-                range(s) if name in used_axes else range(1)
-                for (name, s) in self._axis_pool
-            ]
-            for coord in itertools.product(*ranges):
-                dev = 0
-                for c, s in zip(coord, sizes):
-                    dev = dev * s + c
-                ids.append(dev)
-            out = frozenset(ids)
-        self._device_sets[key] = out
-        return out
+        """Device ids covered by a view: the contiguous block
+        [start_part, start_part + num_parts) — the reference's stride-1
+        MachineView box (machine_view.h:14-87).  Ops whose blocks are
+        disjoint can overlap in time (inter-op parallelism from
+        VERTICAL/HORIZONTAL resource splits); nested blocks (divisor
+        degrees at the same start) serialize, like same-device ops."""
+        key = (mv.num_parts, mv.start_part)
+        hit = self._device_sets.get(key)
+        if hit is None:
+            n = min(max(1, mv.num_parts), self.num_devices)
+            start = mv.start_part % self.num_devices
+            hit = frozenset((start + i) % self.num_devices for i in range(n))
+            self._device_sets[key] = hit
+        return hit
 
     # ------------------------------------------------------------------
     def _node_costs(self, node, mv) -> Tuple[float, float, float]:
@@ -155,6 +133,12 @@ class Simulator:
                 )
                 shape = graph.nodes[e.src].op.output_shapes[e.src_idx]
                 xfer = self.cost.xfer_cost(shape, src_annot, dst_annot)
+                if src_mv.start_part != mv.start_part:
+                    # producer and consumer live on different device
+                    # blocks: every shard moves at least one hop even
+                    # when shardings agree (reference charges this via
+                    # per-pair xfers, simulator.cc:599-731)
+                    xfer += self.cost.placement_move_cost(shape, src_annot)
                 start = max(start, ready.get((e.src, e.src_idx), 0.0) + xfer)
             devs = self.view_device_set(mv)
             for d in devs:
@@ -235,7 +219,15 @@ class Simulator:
                             d_osh.inputs[e.dst_idx]
                             if e.dst_idx < len(d_osh.inputs) else None
                         )
-                        mat.append(self.cost.xfer_cost(shape, src_annot, dst_annot))
+                        x = self.cost.xfer_cost(shape, src_annot, dst_annot)
+                        if (
+                            src_views[svi].start_part
+                            != dst_views[dvi].start_part
+                        ):
+                            # keep exact parity with simulate()'s
+                            # cross-block movement charge
+                            x += self.cost.placement_move_cost(shape, src_annot)
+                        mat.append(x)
                 ns.add_edge(si, di, np.asarray(mat, dtype=np.float64).reshape(
                     len(src_views), len(dst_views)))
         return ns, index

@@ -29,6 +29,7 @@ def export_strategy(path: str, graph: Graph, strategy: Dict[int, MachineView]) -
         out[node.op.name] = {
             "dims": list(mv.dim_degrees),
             "replica": mv.replica_degree,
+            "start": mv.start_part,
         }
     with open(path, "w") as f:
         json.dump(out, f, indent=2, sort_keys=True)
@@ -42,6 +43,8 @@ def import_strategy(path: str, graph: Graph) -> Dict[int, MachineView]:
         if node.op.name in data:
             d = data[node.op.name]
             strategy[node.guid] = MachineView(
-                dim_degrees=tuple(d["dims"]), replica_degree=d.get("replica", 1)
+                dim_degrees=tuple(d["dims"]),
+                replica_degree=d.get("replica", 1),
+                start_part=d.get("start", 0),
             )
     return strategy

@@ -288,23 +288,200 @@ def make_linear_activation_fusion_xfer() -> GraphXfer:
     )
 
 
+def make_parallel_chain_fusion_xfer() -> GraphXfer:
+    """Collapse chains of adjacent parallel ops: a Repartition / Combine
+    / Replicate whose every consumer is itself a parallel op is
+    redundant — all four are identity computations whose only content is
+    the sharding constraint, and the downstream op re-constrains.  This
+    is the FusedParallelOp join algebra (reference:
+    src/runtime/parallel_op.cc:25-58, fused_parallel_op.cc) expressed as
+    deletion: the fused chain IS the last op's constraint."""
+
+    _SPLICEABLE = {
+        OperatorType.REPARTITION,
+        OperatorType.COMBINE,
+        OperatorType.REPLICATE,
+    }
+
+    def matcher(graph: Graph, node: Node) -> bool:
+        if node.op.op_type not in _SPLICEABLE:
+            return False
+        outs = graph.out_edges[node.guid]
+        if not outs or not graph.in_edges[node.guid]:
+            return False
+        return all(
+            graph.nodes[e.dst].op.op_type.is_parallel_op() for e in outs
+        )
+
+    def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
+        g = graph.copy()
+        in_e = g.in_edges[node.guid][0]
+        out_edges = list(g.out_edges[node.guid])
+        g.remove_node(node.guid)
+        for e in out_edges:
+            ne = Edge(in_e.src, e.dst, in_e.src_idx, e.dst_idx)
+            g.out_edges[in_e.src].append(ne)
+            g.in_edges[e.dst].append(ne)
+        g._invalidate()
+        return g
+
+    return GraphXfer(
+        name="fuse_parallel_op_chain", matcher=matcher, apply_fn=apply_fn
+    )
+
+
+def make_combine_concat_sink_xfer() -> GraphXfer:
+    """N branches each ending Combine(dim d) feeding one Concat: drop
+    the per-branch combines and combine ONCE after the concat — the
+    branches stay sharded through the concat and the expensive gather
+    happens on the concatenated tensor a single time (reference:
+    create_combine_inception / create_partition_concat_combine,
+    substitution.cc:1693-1758)."""
+
+    def matcher(graph: Graph, node: Node) -> bool:
+        if node.op.op_type is not OperatorType.CONCAT:
+            return False
+        in_edges = graph.in_edges[node.guid]
+        if len(in_edges) < 2:
+            return False
+        keys = set()
+        for e in in_edges:
+            p = graph.nodes[e.src]
+            if p.op.op_type is not OperatorType.COMBINE:
+                return False
+            if len(graph.out_edges[e.src]) != 1:
+                return False
+            keys.add((p.op.attrs["dim"], p.op.attrs["degree"]))
+        if len(keys) != 1:  # uniform (dim, degree) or the sunk combine
+            return False  # would express a different sharding
+        return next(iter(keys))[0] != node.op.attrs.get("axis")
+
+    def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
+        g = graph.copy()
+        dim = degree = None
+        for e in list(g.in_edges[node.guid]):
+            comb = g.nodes[e.src]
+            dim = comb.op.attrs["dim"]
+            degree = comb.op.attrs["degree"]
+            up = g.in_edges[comb.guid][0]
+            out_edges = list(g.out_edges[comb.guid])
+            g.remove_node(comb.guid)
+            for oe in out_edges:
+                ne = Edge(up.src, oe.dst, up.src_idx, oe.dst_idx)
+                g.out_edges[up.src].append(ne)
+                g.in_edges[oe.dst].append(ne)
+        g._invalidate()
+        return _insert_after(
+            g,
+            g.nodes[node.guid],
+            0,
+            lambda s: CombineOp(_uname("combine"), [s], dim=dim, degree=degree),
+        )
+
+    return GraphXfer(
+        name="sink_combine_through_concat", matcher=matcher, apply_fn=apply_fn
+    )
+
+
+_HOISTABLE_UNARY = {
+    OperatorType.RELU,
+    OperatorType.SIGMOID,
+    OperatorType.TANH,
+    OperatorType.GELU,
+    OperatorType.EXP,
+    OperatorType.IDENTITY,
+}
+
+
+def make_unary_hoist_partition_xfer() -> GraphXfer:
+    """A unary op fanning out to k branches that each immediately
+    Repartition the same way: hoist ONE Repartition above the unary and
+    delete the k copies — the shared activation is resharded once,
+    before the cheap elementwise op (reference:
+    leading_relu_branch_partition, substitution.cc:1735-1748)."""
+
+    def matcher(graph: Graph, node: Node) -> bool:
+        if node.op.op_type not in _HOISTABLE_UNARY:
+            return False
+        outs = graph.out_edges[node.guid]
+        if len(outs) < 2:
+            return False
+        keys = set()
+        for e in outs:
+            c = graph.nodes[e.dst]
+            if c.op.op_type is not OperatorType.REPARTITION:
+                return False
+            keys.add((c.op.attrs["dim"], c.op.attrs["degree"]))
+        if len(keys) != 1:
+            return False
+        # not already partitioned above
+        preds = [graph.nodes[e.src].op.op_type for e in graph.in_edges[node.guid]]
+        return OperatorType.REPARTITION not in preds
+
+    def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
+        reps = [graph.nodes[e.dst] for e in graph.out_edges[node.guid]]
+        dim = reps[0].op.attrs["dim"]
+        degree = reps[0].op.attrs["degree"]
+        g = _insert_before(
+            graph,
+            node,
+            0,
+            lambda s: RepartitionOp(_uname("repartition"), [s], dim=dim, degree=degree)
+            if dim < s.ndim and s.sizes[dim] % degree == 0
+            else None,
+        )
+        if g is None:
+            return None
+        for rep in reps:
+            up = g.in_edges[rep.guid][0]
+            out_edges = list(g.out_edges[rep.guid])
+            g.remove_node(rep.guid)
+            for oe in out_edges:
+                ne = Edge(up.src, oe.dst, up.src_idx, oe.dst_idx)
+                g.out_edges[up.src].append(ne)
+                g.in_edges[oe.dst].append(ne)
+        g._invalidate()
+        return g
+
+    return GraphXfer(
+        name="hoist_partition_above_unary", matcher=matcher, apply_fn=apply_fn
+    )
+
+
+_PARTITION_DIMS = {
+    OperatorType.LINEAR: (0, 1),
+    OperatorType.MULTIHEAD_ATTENTION: (0, 1),  # dim 1 = sequence (SP)
+    OperatorType.EW_ADD: (0, 1),
+    OperatorType.RELU: (0,),
+    OperatorType.CONCAT: (0,),
+    OperatorType.SOFTMAX: (0,),
+    OperatorType.CONV2D: (0,),
+    OperatorType.POOL2D: (0,),
+    OperatorType.FLAT: (0,),
+    OperatorType.LAYERNORM: (0,),
+    OperatorType.EMBEDDING: (0,),
+}
+
+
 def generate_all_pcg_xfers(num_devices: int) -> List[GraphXfer]:
     """All rewrites for the device count, one per divisor degree —
-    mirrors generate_all_pcg_xfers (reference: substitution.cc:1619-1758)."""
+    mirrors generate_all_pcg_xfers (reference: substitution.cc:1619-1758):
+    partition/combine families per op type and dim, replicate/reduce
+    (row- and head-parallel), branch combining for inception-style PCGs,
+    partition hoisting, linear+activation fusion, and the parallel-op
+    chain simplifications."""
     degrees = [d for d in range(2, num_devices + 1) if num_devices % d == 0]
-    xfers: List[GraphXfer] = [make_simplify_xfer(),
-                              make_linear_activation_fusion_xfer()]
+    xfers: List[GraphXfer] = [
+        make_simplify_xfer(),
+        make_parallel_chain_fusion_xfer(),
+        make_linear_activation_fusion_xfer(),
+        make_combine_concat_sink_xfer(),
+        make_unary_hoist_partition_xfer(),
+    ]
     for d in degrees:
-        for t in (
-            OperatorType.LINEAR,
-            OperatorType.MULTIHEAD_ATTENTION,
-            OperatorType.EW_ADD,
-            OperatorType.RELU,
-            OperatorType.CONCAT,
-            OperatorType.SOFTMAX,
-            OperatorType.CONV2D,
-        ):
-            xfers.append(make_partition_combine_xfer(t, d, dim=0))
+        for t, dims in _PARTITION_DIMS.items():
+            for dim in dims:
+                xfers.append(make_partition_combine_xfer(t, d, dim=dim))
         xfers.append(make_replicate_reduce_xfer(OperatorType.LINEAR, d))
         xfers.append(make_replicate_reduce_xfer(OperatorType.MULTIHEAD_ATTENTION, d))
     return xfers

@@ -173,6 +173,199 @@ def test_strategy_export_import_roundtrip(tmp_path):
     assert back == dp
 
 
+def test_inception_search_beats_dp_and_trivial_in_simulator():
+    """Search-quality gate on the reference's showcase model
+    (reference: scripts/osdi22ae/inception.sh): the DP search must beat
+    both the trivial and the pure batch-parallel placement in the
+    simulator, without ever hitting the greedy fallback."""
+    from flexflow_tpu.models import build_inception_v3
+
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, only_data_parallel=True)
+    m = build_inception_v3(cfg)
+    sim = Simulator(MachineSpec.tpu_v5e(8), num_devices=8)
+    helper = SearchHelper(sim, 8)
+    cost, strategy = helper.graph_cost(m.graph)
+    c_dp = sim.simulate(m.graph, data_parallel_strategy(m.graph, 8))
+    trivial = {n.guid: MachineView.trivial(n.op.output_shapes[0].ndim)
+               for n in m.graph.topo_order()}
+    c_triv = sim.simulate(m.graph, trivial)
+    assert helper.greedy_hits == 0
+    assert cost < c_dp, (cost, c_dp)
+    assert cost < c_triv
+    assert len(strategy) == m.graph.num_nodes
+
+
+def test_no_greedy_fallback_on_model_zoo():
+    """The structured splits (sequence / component / interior) must
+    cover every zoo topology (VERDICT r1: no _greedy_cost hit)."""
+    from flexflow_tpu.models import build_dlrm, build_transformer
+
+    cfg = ff.FFConfig(batch_size=32, num_devices=8, only_data_parallel=True)
+    zoo = [
+        build_transformer(cfg, num_layers=2, hidden=64, num_heads=4,
+                          ff_dim=128, seq_len=16).graph,
+        build_dlrm(cfg).graph,
+        mlp_model().graph,
+        conv_model().graph,
+    ]
+    for graph in zoo:
+        helper = SearchHelper(Simulator(MachineSpec.tpu_v5e(8), num_devices=8), 8)
+        cost, strategy = helper.graph_cost(graph)
+        assert math.isfinite(cost)
+        assert helper.greedy_hits == 0, graph
+
+
+def test_vertical_component_split_uses_disjoint_device_blocks():
+    """Two independent overhead-bound chains: running them concurrently
+    on disjoint half-machines (VERTICAL split, reference:
+    graph.cc:180-205) beats time-sharing the full machine, and the
+    chosen strategy shows it via start_part offsets."""
+    cfg = ff.FFConfig(batch_size=32, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    for br in ("a", "b"):
+        t = m.create_tensor([32, 8], name=f"in_{br}")
+        for i in range(6):
+            t = m.dense(t, 8, name=f"{br}{i}")
+    sim = Simulator(MachineSpec.tpu_v5e(8), num_devices=8)
+    helper = SearchHelper(sim, 8)
+    cost, strategy = helper.graph_cost(m.graph)
+    starts = {v.start_part for v in strategy.values()}
+    assert len(starts) > 1, strategy  # branches placed on different blocks
+    seq = dict(strategy)
+    import dataclasses as dc
+
+    seq = {g: dc.replace(v, start_part=0) for g, v in seq.items()}
+    assert cost <= sim.simulate(m.graph, seq)
+
+
+def test_unity_rewrite_improves_badly_placed_parallel_ops():
+    """A graph with a gratuitous Combine->Repartition round-trip between
+    two sharded matmuls: the chain-fusion/cancel xfers must remove it
+    and the joint search must return a strictly cheaper graph
+    (reference: the whole point of graph_optimize,
+    substitution.cc:1779)."""
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    def build():
+        cfg = ff.FFConfig(batch_size=64, num_devices=8,
+                          only_data_parallel=True)
+        m = ff.FFModel(cfg)
+        x = m.create_tensor([64, 256])
+        t = m.repartition(x, dim=0, degree=8, name="p0")
+        t = m.dense(t, 256, name="fc1")
+        t = m.combine(t, dim=0, degree=1, name="c_mid")  # gratuitous
+        t = m.repartition(t, dim=0, degree=8, name="p_mid")
+        t = m.dense(t, 256, name="fc2")
+        m.dense(t, 16, name="head")
+        return m
+
+    m = build()
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, search_budget=8)
+    sim = Simulator(MachineSpec.tpu_v5e(8), num_devices=8)
+    helper = SearchHelper(sim, 8)
+    c_orig, _ = helper.graph_cost(m.graph)
+    g2, s2 = optimize_strategy(m.graph, cfg, return_graph=True)
+    c_new = sim.simulate(g2, s2)
+    assert g2.num_nodes < m.graph.num_nodes  # round-trip removed
+    assert c_new < c_orig
+
+
+def test_parallel_chain_fusion_xfer_unit():
+    """Join algebra (reference: parallel_op.cc:25-58): a parallel op
+    followed only by parallel ops is spliced out."""
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.substitution import make_parallel_chain_fusion_xfer
+
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    x = m.create_tensor([16, 8])
+    t = m.repartition(x, dim=0, degree=2, name="r1")
+    t = m.repartition(t, dim=1, degree=2, name="r2")
+    m.dense(t, 8, name="fc")
+    xf = make_parallel_chain_fusion_xfer()
+    matches = xf.find_matches(m.graph)
+    assert [mm.op.name for mm in matches] == ["r1"]
+    g2 = xf.apply(m.graph, matches[0])
+    assert g2.num_nodes == m.graph.num_nodes - 1
+    names = {n.op.name for n in g2.topo_order()}
+    assert "r1" not in names and "r2" in names
+    sim = Simulator(MachineSpec.tpu_v5e(8))
+    assert sim.simulate(g2, data_parallel_strategy(g2, 8)) < math.inf
+
+
+def test_combine_concat_sink_xfer_unit():
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.substitution import make_combine_concat_sink_xfer
+
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    x = m.create_tensor([16, 8])
+    outs = []
+    for i in range(3):
+        t = m.dense(x, 8, name=f"b{i}")
+        outs.append(m.combine(t, dim=0, degree=1, name=f"c{i}"))
+    m.concat(outs, axis=1, name="cat")
+    xf = make_combine_concat_sink_xfer()
+    matches = xf.find_matches(m.graph)
+    assert len(matches) == 1 and matches[0].op.name == "cat"
+    g2 = xf.apply(m.graph, matches[0])
+    # 3 combines removed, 1 inserted after the concat
+    assert g2.num_nodes == m.graph.num_nodes - 2
+    combines = [n for n in g2.topo_order()
+                if n.op.op_type is OperatorType.COMBINE]
+    assert len(combines) == 1
+    cat = next(n for n in g2.topo_order() if n.op.name == "cat")
+    assert g2.successors(cat.guid) == [combines[0].guid]
+
+
+def test_unary_hoist_partition_xfer_unit():
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.substitution import make_unary_hoist_partition_xfer
+
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    x = m.create_tensor([16, 8])
+    t = m.relu(x, name="act")
+    for i in range(3):
+        p = m.repartition(t, dim=0, degree=4, name=f"p{i}")
+        m.dense(p, 8, name=f"fc{i}")
+    xf = make_unary_hoist_partition_xfer()
+    matches = xf.find_matches(m.graph)
+    assert len(matches) == 1 and matches[0].op.name == "act"
+    g2 = xf.apply(m.graph, matches[0])
+    assert g2.num_nodes == m.graph.num_nodes - 2  # 3 removed, 1 added
+    reps = [n for n in g2.topo_order()
+            if n.op.op_type is OperatorType.REPARTITION]
+    assert len(reps) == 1
+    act = next(n for n in g2.topo_order() if n.op.name == "act")
+    assert g2.predecessors(act.guid) == [reps[0].guid]
+
+
+def test_substitution_json_loader_reference_corpus():
+    """The --substitution-json path loads the reference's rule format
+    (reference: substitution_loader.cc, substitutions/
+    graph_subst_3_v2.json) and the rules rewrite our PCG."""
+    import os
+
+    from flexflow_tpu.search.substitution_loader import load_rule_collection
+
+    path = "/root/reference/substitutions/graph_subst_3_v2.json"
+    if not os.path.exists(path):
+        pytest.skip("reference corpus not available")
+    rules, skipped = load_rule_collection(path)
+    assert len(rules) > 300  # the expressible subset
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    x = m.create_tensor([16, 8, 4])
+    t = m.repartition(x, dim=1, degree=2)
+    t = m.repartition(t, dim=0, degree=2)
+    m.dense(t, 8)
+    applied = 0
+    for r in rules:
+        for match in r.find_matches(m.graph):
+            g2 = r.apply(m.graph, match)
+            if g2 is not None:
+                g2.topo_order()  # valid DAG
+                applied += 1
+    assert applied > 0
+
+
 def test_linear_activation_fusion_xfer():
     """reference: the generated linear_relu fusion xfer
     (substitution.cc:1619-1758)."""

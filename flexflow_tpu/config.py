@@ -45,6 +45,10 @@ class FFConfig:
     search_num_devices: int = 0  # override devices for search (search a big
     # strategy on a small machine, reference: graph.cc:1535-1540)
     base_optimize_threshold: int = 10
+    search_timeout_s: float = 45.0  # wall-clock bound on the joint
+    # search; <=0 disables.  The reference bounds work via --budget
+    # alone (substitution.cc:2007); a hard deadline guarantees compile
+    # latency at any model scale
     substitution_json: Optional[str] = None
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
@@ -100,6 +104,7 @@ class FFConfig:
         p.add_argument("--search-num-nodes", type=int, default=0)
         p.add_argument("--search-num-workers", type=int, default=0)
         p.add_argument("--base-optimize-threshold", type=int, default=10)
+        p.add_argument("--search-timeout", dest="search_timeout", type=float, default=45.0)
         p.add_argument("--substitution-json", type=str, default=None)
         p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
         p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
@@ -121,6 +126,7 @@ class FFConfig:
             only_data_parallel=args.only_data_parallel,
             search_num_devices=search_devs,
             base_optimize_threshold=args.base_optimize_threshold,
+            search_timeout_s=args.search_timeout,
             substitution_json=args.substitution_json,
             export_strategy_file=args.export_strategy,
             import_strategy_file=args.import_strategy,

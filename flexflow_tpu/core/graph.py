@@ -61,6 +61,7 @@ class Graph:
         self._next_guid = 1
         self._topo_cache: Optional[List[Node]] = None
         self._hash_cache: Optional[int] = None
+        self._node_hash_cache: Optional[Dict[int, int]] = None
 
     # ---- construction ----------------------------------------------------
     def new_node(self, op) -> Node:
@@ -72,6 +73,7 @@ class Graph:
     def _invalidate(self) -> None:
         self._topo_cache = None
         self._hash_cache = None
+        self._node_hash_cache = None
 
     def add_node(self, node: Node) -> None:
         if node.guid in self.nodes:
@@ -164,6 +166,17 @@ class Graph:
         return order
 
     # ---- structural hash (memoization key) -------------------------------
+    def _sig_repr(self, node: Node) -> str:
+        op = node.op
+        sig = getattr(op, "_sig_repr_cache", None)
+        if sig is None:
+            sig = repr(op.signature()) if hasattr(op, "signature") else repr(op)
+            try:
+                op._sig_repr_cache = sig  # ops are immutable; see base.py
+            except AttributeError:
+                pass
+        return sig
+
     def hash(self) -> int:
         """Structure-and-op hash, stable across guid renumbering.
 
@@ -175,14 +188,7 @@ class Graph:
             return self._hash_cache
         h: Dict[int, int] = {}
         for node in self.topo_order():
-            op = node.op
-            sig = getattr(op, "_sig_repr_cache", None)
-            if sig is None:
-                sig = repr(op.signature()) if hasattr(op, "signature") else repr(op)
-                try:
-                    op._sig_repr_cache = sig  # ops are immutable; see base.py
-                except AttributeError:
-                    pass
+            sig = self._sig_repr(node)
             ins = sorted(
                 (h[e.src], e.src_idx, e.dst_idx) for e in self.in_edges[node.guid]
             )
@@ -193,6 +199,66 @@ class Graph:
         out = int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
         self._hash_cache = out
         return out
+
+    def node_hashes(self) -> Dict[int, int]:
+        """Bidirectional per-node structural hashes: combines each
+        node's ancestor-refined and descendant-refined hash, so two
+        nodes get equal hashes only when their full structural contexts
+        match.  Nodes with equal hashes are interchangeable under graph
+        isomorphism — the basis for guid-independent DP memoization
+        (reference memoizes by the same kind of structural hash,
+        graph.cc:1356; here per-node so cached *strategies* can be
+        remapped onto isomorphic segments, e.g. repeated transformer
+        layers)."""
+        if self._node_hash_cache is not None:
+            return self._node_hash_cache
+        topo = self.topo_order()
+        anc: Dict[int, int] = {}
+        for node in topo:
+            ins = sorted(
+                (anc[e.src], e.src_idx, e.dst_idx)
+                for e in self.in_edges[node.guid]
+            )
+            anc[node.guid] = hash((self._sig_repr(node), tuple(ins)))
+        desc: Dict[int, int] = {}
+        for node in reversed(topo):
+            outs = sorted(
+                (desc[e.dst], e.src_idx, e.dst_idx)
+                for e in self.out_edges[node.guid]
+            )
+            desc[node.guid] = hash((self._sig_repr(node), tuple(outs)))
+        combined = {g: hash((anc[g], desc[g])) for g in self.nodes}
+        self._node_hash_cache = combined
+        return combined
+
+    def remap(self, mapping: Dict[int, int], fresh_start: Optional[int] = None) -> Tuple["Graph", Dict[int, int]]:
+        """New graph with guids renamed through ``mapping``; nodes not in
+        the mapping get fresh guids from ``fresh_start`` (default: after
+        every mapped guid).  Returns (graph, full mapping incl. fresh
+        assignments).  Used to transplant a cached optimized segment onto
+        an isomorphic segment with different guids."""
+        full = dict(mapping)
+        nxt = fresh_start if fresh_start is not None else (
+            max(list(mapping.values()) + [self._next_guid]) + 1
+        )
+        for guid in sorted(self.nodes):
+            if guid not in full:
+                full[guid] = nxt
+                nxt += 1
+        g = Graph()
+        g._next_guid = nxt
+        for guid in self.nodes:
+            ng = full[guid]
+            n = self.nodes[guid]
+            g.nodes[ng] = n if ng == guid else Node(ng, n.op)
+            g.in_edges[ng] = []
+            g.out_edges[ng] = []
+        for guid in self.nodes:
+            for e in self.out_edges[guid]:
+                ne = Edge(full[e.src], full[e.dst], e.src_idx, e.dst_idx)
+                g.out_edges[ne.src].append(ne)
+                g.in_edges[ne.dst].append(ne)
+        return g, full
 
     # ---- dominators & bottlenecks ----------------------------------------
     def dominators(self) -> Dict[int, Set[int]]:

@@ -50,9 +50,19 @@ class DataType(enum.Enum):
 
     @property
     def itemsize(self) -> int:
-        if self is DataType.BFLOAT16:
-            return 2
-        return self.to_numpy().itemsize
+        return _ITEMSIZE[self]
+
+
+# itemsize sits in the cost model's innermost loop; np.dtype() per call
+# is measurably hot during search
+_ITEMSIZE = {
+    DataType.FLOAT32: 4,
+    DataType.FLOAT16: 2,
+    DataType.BFLOAT16: 2,
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.BOOL: 1,
+}
 
 
 @dataclass(frozen=True)
@@ -156,7 +166,11 @@ class ParallelTensorShape:
 
     @property
     def num_bytes(self) -> int:
-        return self.num_elements * self.dtype.itemsize
+        n = self.__dict__.get("_num_bytes")
+        if n is None:
+            n = self.num_elements * self.dtype.itemsize
+            object.__setattr__(self, "_num_bytes", n)
+        return n
 
     @property
     def total_degree(self) -> int:

@@ -31,9 +31,81 @@ from typing import Dict, List, Optional, Tuple
 from flexflow_tpu.core.graph import Graph, Node
 from flexflow_tpu.core.machine import MachineView
 from flexflow_tpu.search.simulator import Simulator
-from flexflow_tpu.search.views import candidate_views
+from flexflow_tpu.search.views import boundary_views, candidate_views
 
 Strategy = Dict[int, MachineView]
+
+# canonical strategy: ((node_structural_hash, view), ...) ordered by
+# (hash, guid) at store time — guid-free, remappable onto isomorphic
+# graphs (see Graph.node_hashes)
+CanonStrategy = Tuple[Tuple[int, MachineView], ...]
+
+
+def canon_fixed_views(graph: Graph, fixed: Strategy) -> Tuple:
+    """Guid-free canonical form of pinned boundary views — the shared
+    memo-key component for the DP memo and the driver's segment cache
+    (must stay in lock-step; both import this)."""
+    nh = graph.node_hashes()
+    return tuple(
+        sorted(
+            (nh[g], v.dim_degrees, v.replica_degree, v.start_part)
+            for g, v in fixed.items()
+            if g in graph.nodes
+        )
+    )
+
+
+def canonicalize_strategy(graph: Graph, strategy: Strategy) -> CanonStrategy:
+    nh = graph.node_hashes()
+    order = sorted(
+        (g for g in strategy if g in graph.nodes), key=lambda g: (nh[g], g)
+    )
+    return tuple((nh[g], strategy[g]) for g in order)
+
+
+def reconstruct_strategy(
+    graph: Graph, canon: CanonStrategy, fixed: Optional[Strategy] = None
+) -> Optional[Strategy]:
+    """Map a canonical strategy onto ``graph``'s guids.  Nodes sharing a
+    structural hash are interchangeable; ``fixed`` guids are pinned to
+    their required views first (a group sibling takes the other view).
+    Returns (strategy, ambiguous): ``ambiguous`` is True when any hash
+    group holds >1 node — the in-group guid-order pairing is then not
+    guaranteed to follow a single isomorphism across groups, so the
+    caller must re-simulate rather than trust the cached cost.  Strategy
+    is None when the canonical form does not fit at all (hash
+    collision — caller recomputes)."""
+    nh = graph.node_hashes()
+    groups: Dict[int, List[int]] = {}
+    for g in sorted(graph.nodes):
+        groups.setdefault(nh[g], []).append(g)
+    views: Dict[int, List[MachineView]] = {}
+    for h, v in canon:
+        views.setdefault(h, []).append(v)
+    strategy: Strategy = {}
+    fixed = fixed or {}
+    ambiguous = False
+    for h, guids in groups.items():
+        vs = views.get(h)
+        if vs is None or len(vs) != len(guids):
+            return None, False
+        if len(guids) > 1:
+            ambiguous = True
+        vs = list(vs)
+        rest = []
+        for g in guids:
+            want = fixed.get(g)
+            if want is not None:
+                try:
+                    vs.remove(want)
+                except ValueError:
+                    return None, False
+                strategy[g] = want
+            else:
+                rest.append(g)
+        for g, v in zip(rest, vs):
+            strategy[g] = v
+    return strategy, ambiguous
 
 
 class SearchHelper:
@@ -43,7 +115,7 @@ class SearchHelper:
         num_devices: int,
         leaf_threshold: int = 4,
         max_views_per_op: int = 16,
-        max_bottleneck_tries: int = 3,
+        max_bottleneck_tries: int = 2,
     ):
         self.sim = simulator
         self.num_devices = num_devices
@@ -68,6 +140,19 @@ class SearchHelper:
             self._views_cache[key] = views
         return self._views_cache[key]
 
+    def _bviews(self, node: Node, budget: int, start: int = 0) -> List[MachineView]:
+        """Compact diverse view set for split-boundary pinning — the DP
+        state count is intervals x boundary-view products, so this stays
+        at the reference's ~4-view scale (graph.cc:1778 registers only
+        1-D divisor views)."""
+        key = ("b", node.op.signature(), budget, start)
+        if key not in self._views_cache:
+            views = boundary_views(node.op, budget)
+            if start:
+                views = [dataclasses.replace(v, start_part=start) for v in views]
+            self._views_cache[key] = views
+        return self._views_cache[key]
+
     def _fixed_view(self, node: Node, start: int) -> Optional[MachineView]:
         fv = node.op.fixed_machine_view()
         if fv is not None and start:
@@ -87,21 +172,51 @@ class SearchHelper:
         devices beginning at device ``start``."""
         fixed = fixed or {}
         budget = budget or self.num_devices
-        # the structural hash alone is NOT a safe key for strategies:
-        # repeated blocks (Inception) yield isomorphic subgraphs with
-        # different guids, and a memoized strategy under foreign guids
-        # would silently drop from merges — include the node-id set
-        key = (
-            graph.hash(),
-            frozenset(graph.nodes),
-            tuple(sorted((g, v) for g, v in fixed.items() if g in graph.nodes)),
-            budget,
-            start,
-        )
-        if key in self.memo:
-            return self.memo[key]
+        # structural memo: keyed by graph hash + guid-free canonical
+        # fixed views, so isomorphic segments with different guids
+        # (repeated transformer layers, Inception blocks) share work.
+        # Cached strategies are canonical and remapped onto the caller's
+        # guids (reconstruct_strategy); round 2's guid-set key blocked
+        # exactly this sharing and made 12-layer search intractable.
+        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
+        hit = self.memo.get(key)
+        if hit is not None:
+            cost, canon = hit
+            strategy, ambiguous = reconstruct_strategy(graph, canon, fixed)
+            if strategy is not None:
+                if ambiguous:
+                    # multi-member hash groups: the in-group pairing may
+                    # not follow one isomorphism, so the cached cost may
+                    # not match this strategy — ground it in the sim
+                    cost = self.sim.simulate(graph, strategy)
+                return cost, strategy
 
         cost, strategy = self._graph_cost_uncached(graph, fixed, budget, start)
+        return self._finish(graph, key, cost, strategy, fixed, budget, start)
+
+    def graph_cost_only(
+        self,
+        graph: Graph,
+        fixed: Optional[Strategy] = None,
+        budget: Optional[int] = None,
+        start: int = 0,
+    ) -> float:
+        """Cost without strategy materialization — memo hits skip the
+        canonical-strategy reconstruction, which dominates enumeration
+        loops (the reference's templated float-only graph_cost,
+        graph.cc:1456-1526, exists for exactly this reason)."""
+        fixed = fixed or {}
+        budget = budget or self.num_devices
+        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
+        hit = self.memo.get(key)
+        if hit is not None:
+            # the cached cost is achievable on any isomorphic graph, so
+            # no reconstruction is needed for cost-only queries
+            return hit[0]
+        cost, strategy = self._graph_cost_uncached(graph, fixed, budget, start)
+        return self._finish(graph, key, cost, strategy, fixed, budget, start)[0]
+
+    def _finish(self, graph, key, cost, strategy, fixed, budget, start):
         # Re-validate against the simulator: split-based composition
         # over-counts boundary nodes and assumes realizable overlap; the
         # event-driven sim of the full (sub)graph is ground truth.
@@ -115,9 +230,8 @@ class SearchHelper:
         c_dp = self.sim.simulate(graph, dp)
         if c_dp < cost:
             cost, strategy = c_dp, dp
-        result = (cost, strategy)
-        self.memo[key] = result
-        return result
+        self.memo[key] = (cost, canonicalize_strategy(graph, strategy))
+        return cost, strategy
 
     def _default_strategy(self, graph, fixed, budget, start) -> Strategy:
         """Batch-parallel-where-possible assignment honoring ``fixed``
@@ -173,28 +287,32 @@ class SearchHelper:
             if (large and bottlenecks)
             else self._pick_bottlenecks(bottlenecks)
         )
-        max_bviews = 6 if large else self.max_views_per_op
-        best = (math.inf, {})
+        # enumerate with cost-only DP; materialize the winner's strategy
+        # once at the end (memo hits make it two reconstructions)
+        best_c, best_plan = math.inf, None
         for bn in tries:
             try:
                 pre, post = graph.split_at_node(bn)
             except ValueError:
                 continue
-            for v in self._views(bn, budget, start)[:max_bviews]:
+            for v in self._bviews(bn, budget, start):
                 f2 = dict(fixed)
                 f2[bn.guid] = v
-                c_pre, s_pre = self.graph_cost(pre, f2, budget, start)
-                if c_pre >= best[0]:
+                c_pre = self.graph_cost_only(pre, f2, budget, start)
+                if c_pre >= best_c:
                     continue
-                c_post, s_post = self.graph_cost(post, f2, budget, start)
+                c_post = self.graph_cost_only(post, f2, budget, start)
                 total = c_pre + c_post
-                if total < best[0]:
-                    s = dict(s_pre)
-                    s.update(s_post)
-                    s[bn.guid] = v
-                    best = (total, s)
-        if best[0] < math.inf:
-            return best
+                if total < best_c:
+                    best_c, best_plan = total, (pre, post, f2, bn.guid, v)
+        if best_plan is not None:
+            pre, post, f2, bn_guid, v = best_plan
+            _, s_pre = self.graph_cost(pre, f2, budget, start)
+            _, s_post = self.graph_cost(post, f2, budget, start)
+            s = dict(s_pre)
+            s.update(s_post)
+            s[bn_guid] = v
+            return best_c, s
 
         # no usable bottleneck: nonsequence split BETWEEN the boundary
         # nodes — drop sources/sinks, partition the interior's parallel
@@ -205,7 +323,9 @@ class SearchHelper:
         interior = self._interior_split(graph, fixed, budget, start)
         if interior is not None:
             return interior
-        return self._greedy_cost(graph, fixed, budget, start)
+        # leaf brute force (compact-view fallback inside) before the
+        # per-node greedy — mid-size branch interiors land here
+        return self._leaf_cost(graph, fixed, budget, start)
 
     def _interior_split(self, graph, fixed, budget, start):
         srcs = {g for g in graph.nodes if not graph.in_edges[g]}
@@ -220,7 +340,7 @@ class SearchHelper:
             return None
         unfixed = sorted(b for b in bounds if b not in fixed)
         choice_lists = [
-            self._views(graph.nodes[b], budget, start)[:4] for b in unfixed
+            self._bviews(graph.nodes[b], budget, start) for b in unfixed
         ]
         n_combos = 1
         for c in choice_lists:
@@ -234,11 +354,12 @@ class SearchHelper:
             f2 = dict(fixed)
             for b, v in zip(unfixed, combo):
                 f2[b] = v
-            c_in, s_in = self._component_cost(
-                inner, f2, budget, start, comps
+            c_in, _ = self._component_cost(
+                inner, f2, budget, start, comps, cost_only=True
             )
             if c_in >= best[0]:
                 continue
+            _, s_in = self._component_cost(inner, f2, budget, start, comps)
             strategy = {g: v for g, v in f2.items() if g in graph.nodes}
             strategy.update(s_in)
             c = self.sim.simulate(graph, strategy)
@@ -277,43 +398,45 @@ class SearchHelper:
                 pairs.append((a, b))
         return pairs
 
-    def _component_cost(self, graph, fixed, budget, start, comps):
+    def _component_cost(self, graph, fixed, budget, start, comps, cost_only=False):
         """Independent subgraphs, reference-style first-vs-rest
         recursion (graph.cc:161-295): SEQUENTIAL (both use the full
         budget, costs add) vs VERTICAL (disjoint device blocks, costs
-        max) over every valid budget split, both orientations."""
+        max) over every valid budget split, both orientations.
+        Enumerates with cost-only DP; the winner's strategies are
+        materialized once at the end."""
         comps = sorted(comps, key=lambda c: (-len(c), min(c)))
         first = graph._subgraph(comps[0])
         rest_guids = set(graph.nodes) - comps[0]
         rest = graph._subgraph(rest_guids)
 
-        def merge(r1, r2):
-            s = dict(r1[1])
-            s.update(r2[1])
-            return s
-
         # SEQUENTIAL: full budget for both, run one after the other
-        r_first = self.graph_cost(first, fixed, budget, start)
-        r_rest = self.graph_cost(rest, fixed, budget, start)
-        best = (r_first[0] + r_rest[0], merge(r_first, r_rest))
+        c_seq = self.graph_cost_only(first, fixed, budget, start) + \
+            self.graph_cost_only(rest, fixed, budget, start)
+        # plan: (ga, a_budget, a_start, gb, b_budget, b_start)
+        best_c = c_seq
+        best_plan = (first, budget, start, rest, budget, start)
 
         # VERTICAL: disjoint contiguous blocks, run concurrently
         for a, b in self._sub_budgets(budget):
             for first_a in (True, False):  # flip_graphs (graph.cc:172)
-                if first_a:
-                    ra = self.graph_cost(first, fixed, a, start)
-                    if ra[0] >= best[0]:
-                        continue
-                    rb = self.graph_cost(rest, fixed, b, start + a)
-                else:
-                    ra = self.graph_cost(rest, fixed, a, start)
-                    if ra[0] >= best[0]:
-                        continue
-                    rb = self.graph_cost(first, fixed, b, start + a)
-                par = max(ra[0], rb[0])
-                if par < best[0]:
-                    best = (par, merge(ra, rb))
-        return best
+                ga, gb = (first, rest) if first_a else (rest, first)
+                ca = self.graph_cost_only(ga, fixed, a, start)
+                if ca >= best_c:
+                    continue
+                cb = self.graph_cost_only(gb, fixed, b, start + a)
+                par = max(ca, cb)
+                if par < best_c:
+                    best_c = par
+                    best_plan = (ga, a, start, gb, b, start + a)
+        if cost_only:
+            return best_c, None
+        ga, ba, sa, gb, bb, sb = best_plan
+        _, s_a = self.graph_cost(ga, fixed, ba, sa)
+        _, s_b = self.graph_cost(gb, fixed, bb, sb)
+        s = dict(s_a)
+        s.update(s_b)
+        return best_c, s
 
     # ------------------------------------------------------------------
     def _leaf_cost(self, graph, fixed, budget, start):
@@ -329,6 +452,15 @@ class SearchHelper:
         total_combos = 1
         for c in choices:
             total_combos *= len(c)
+        if total_combos > 262144:
+            # rich view products too big: fall back to the compact
+            # boundary sets (still covers DP/TP/hybrid/contraction) —
+            # vastly better than the per-node greedy for mid-size
+            # multi-branch interiors (attention blocks)
+            choices = [self._bviews(n, budget, start) for n in free]
+            total_combos = 1
+            for c in choices:
+                total_combos *= len(c)
         base = {g: v for g, v in fixed.items() if g in graph.nodes}
         if 0 < total_combos <= 262144:
             # the native engine enumerates big products cheaply

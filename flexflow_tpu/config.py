@@ -50,6 +50,9 @@ class FFConfig:
     # alone (substitution.cc:2007); a hard deadline guarantees compile
     # latency at any model scale
     substitution_json: Optional[str] = None
+    calibration_file: Optional[str] = None  # persisted measured
+    # per-(op, view) costs (search/calibration.py); the search loads it
+    # when present (reference: ProfilingRecord, simulator.cc:515-554)
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
@@ -106,6 +109,7 @@ class FFConfig:
         p.add_argument("--base-optimize-threshold", type=int, default=10)
         p.add_argument("--search-timeout", dest="search_timeout", type=float, default=45.0)
         p.add_argument("--substitution-json", type=str, default=None)
+        p.add_argument("--calibration-file", type=str, default=None)
         p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
         p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
         p.add_argument("--machine-model-file", type=str, default=None)
@@ -128,6 +132,7 @@ class FFConfig:
             base_optimize_threshold=args.base_optimize_threshold,
             search_timeout_s=args.search_timeout,
             substitution_json=args.substitution_json,
+            calibration_file=args.calibration_file,
             export_strategy_file=args.export_strategy,
             import_strategy_file=args.import_strategy,
             export_strategy_task_graph_file=args.export_taskgraph,

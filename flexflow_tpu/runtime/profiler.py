@@ -72,13 +72,16 @@ def device_trace(logdir: str):
 
 
 def measure_operator_cost(op, batch_inputs=None,
-                          warmup: int = 2, repeats: int = 5) -> float:
+                          warmup: int = 2, repeats: int = 5,
+                          weight_shapes=None) -> float:
     """Median wall seconds of one jitted forward of ``op`` on the real
     device (reference: Op::measure_operator_cost + model.cu:38-74).
 
     Builds zero inputs from the op's input shapes unless given; weights
-    are initialized via the op's specs. Used to calibrate/validate the
-    analytic CostModel against actual hardware.
+    are initialized via the op's specs (``weight_shapes`` overrides
+    per-weight shapes — calibration probes ops at their per-SHARD
+    shapes, see search/calibration.py). Results feed the CalibrationTable
+    consulted by CostModel.op_cost before its roofline fallback.
     """
     import jax
     import jax.numpy as jnp
@@ -92,8 +95,9 @@ def measure_operator_cost(op, batch_inputs=None,
     key = jax.random.key(0)
     weights = {}
     for i, ws in enumerate(getattr(op, "_weight_specs", ())):
+        shape = (weight_shapes or {}).get(ws.name, ws.shape)
         weights[ws.name] = ws.initializer.init(
-            jax.random.fold_in(key, i), ws.shape, ws.dtype.to_numpy()
+            jax.random.fold_in(key, i), shape, ws.dtype.to_numpy()
         )
     state_in = {}
     for spec in (op.state_specs() if getattr(op, "state_specs", None) else ()):

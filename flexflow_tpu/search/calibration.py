@@ -1,0 +1,156 @@
+"""Measured per-(op, view) cost calibration.
+
+The reference ranks strategies with MEASURED kernel times, cached per
+(op params, machine view) and collected on a real GPU inside the search
+(reference: src/runtime/simulator.cc:515-554 ProfilingRecord cache;
+src/runtime/model.cu:38-74 warmup+repeat cuda-event timing).  The TPU
+analogue measures one jitted forward of the op at its per-shard shapes
+on the real chip (runtime/profiler.measure_operator_cost) and persists
+the result in a ``CalibrationTable`` that ``CostModel.op_cost`` consults
+before its analytic roofline fallback.
+
+Because XLA fuses aggressively, a lone-op probe is an upper bound on
+the op's in-graph cost (SURVEY.md §7 hard part (a)); it still captures
+the shard-size nonlinearities (MXU tiling, small-matmul inefficiency)
+the roofline cannot, which is what strategy *ranking* needs.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Dict, Optional, Tuple
+
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.core.machine import MachineView
+
+Key = Tuple[str, Tuple[int, ...], int]
+
+
+class CalibrationTable:
+    """Persisted measured-forward-seconds per (op signature, view) —
+    the reference's ProfilingRecord hash cache (simulator.cc:515-554),
+    with a JSON file standing in for the in-memory lifetime of the
+    reference's single search task."""
+
+    def __init__(self):
+        self._t: Dict[Key, float] = {}
+
+    @staticmethod
+    def key(op, mv: MachineView) -> Key:
+        return (
+            repr(op.signature()),
+            tuple(mv.dim_degrees),
+            int(mv.replica_degree),
+        )
+
+    def get(self, op, mv: MachineView) -> Optional[float]:
+        return self._t.get(self.key(op, mv))
+
+    def put(self, op, mv: MachineView, seconds: float) -> None:
+        self._t[self.key(op, mv)] = float(seconds)
+
+    def __len__(self) -> int:
+        return len(self._t)
+
+    def save(self, path: str) -> None:
+        rows = [
+            {"sig": k[0], "degrees": list(k[1]), "replica": k[2], "seconds": v}
+            for k, v in sorted(self._t.items())
+        ]
+        with open(path, "w") as f:
+            json.dump({"version": 1, "records": rows}, f, indent=1)
+
+    @staticmethod
+    def load(path: str) -> "CalibrationTable":
+        table = CalibrationTable()
+        with open(path) as f:
+            data = json.load(f)
+        for r in data.get("records", []):
+            table._t[(r["sig"], tuple(r["degrees"]), int(r["replica"]))] = float(
+                r["seconds"]
+            )
+        return table
+
+
+def _shard_sizes(sizes, annot) -> Tuple[int, ...]:
+    if annot is None:
+        return tuple(sizes)
+    out = []
+    for i, s in enumerate(sizes):
+        d = annot.degrees[i] if i < len(annot.degrees) else 1
+        out.append(max(1, s // max(d, 1)))
+    return tuple(out)
+
+
+def measure_op_view(
+    op, mv: MachineView, warmup: int = 1, repeats: int = 3
+) -> Optional[float]:
+    """Median seconds of one jitted forward of ``op`` at the per-shard
+    shapes ``mv`` induces (via the op's own degree propagation), on the
+    live jax backend.  None when the op cannot be probed standalone
+    (shape-monomorphic forward, invalid view) — callers keep the
+    roofline for those."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.runtime.profiler import measure_operator_cost
+
+    try:
+        osh = op.propagate(mv)
+    except AssertionError:
+        return None
+    try:
+        inputs = [
+            jnp.zeros(_shard_sizes(s.sizes, a), s.dtype.to_numpy())
+            for s, a in zip(op.input_shapes, osh.inputs)
+        ]
+        weight_shapes = {
+            ws.name: _shard_sizes(ws.shape, a)
+            for ws, a in zip(getattr(op, "_weight_specs", ()), osh.weights)
+        }
+        return measure_operator_cost(
+            op,
+            batch_inputs=inputs,
+            warmup=warmup,
+            repeats=repeats,
+            weight_shapes=weight_shapes,
+        )
+    except Exception:
+        # ops whose forward bakes in logical sizes (reshape etc.) can't
+        # be probed at shard shapes; the analytic model covers them
+        return None
+
+
+def calibrate_graph(
+    graph: Graph,
+    num_devices: int,
+    table: Optional[CalibrationTable] = None,
+    time_budget_s: float = 120.0,
+    repeats: int = 3,
+) -> CalibrationTable:
+    """Fill ``table`` with measurements for every distinct
+    (op signature, candidate view) in ``graph`` — the probe set the
+    search will actually query (reference measures lazily mid-search,
+    simulator.cc:515; measuring up front keeps the search itself pure).
+    Budget-bounded: stops adding new probes when the wall budget is
+    spent (existing entries are never re-measured)."""
+    from flexflow_tpu.search.views import boundary_views, candidate_views
+
+    table = table or CalibrationTable()
+    deadline = time.monotonic() + time_budget_s
+    for node in graph.topo_order():
+        op = node.op
+        views = list(candidate_views(op, num_devices))
+        for bv in boundary_views(op, num_devices):
+            if bv not in views:
+                views.append(bv)
+        for mv in views:
+            if table.get(op, mv) is not None:
+                continue
+            if time.monotonic() > deadline:
+                return table
+            t = measure_op_view(op, mv, repeats=repeats)
+            if t is not None and math.isfinite(t) and t > 0:
+                table.put(op, mv, t)
+    return table

@@ -367,7 +367,15 @@ def optimize_strategy(
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     n = config.search_devices
-    sim = Simulator(config.machine_spec, num_devices=n)
+    calibration = None
+    if config.calibration_file:
+        import os
+
+        from flexflow_tpu.search.calibration import CalibrationTable
+
+        if os.path.exists(config.calibration_file):
+            calibration = CalibrationTable.load(config.calibration_file)
+    sim = Simulator(config.machine_spec, num_devices=n, calibration=calibration)
     helper = SearchHelper(sim, n)
 
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):

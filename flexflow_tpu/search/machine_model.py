@@ -37,6 +37,10 @@ class CostModel:
     # the ICI torus with per-link contention (search/network.py) instead
     # of the flat ring formulas
     network: Optional[object] = None
+    # optional CalibrationTable of MEASURED per-(op, view) forward
+    # seconds from the real chip — consulted before the roofline
+    # (reference: ProfilingRecord cache, simulator.cc:515-554)
+    calibration: Optional[object] = None
 
     def _net_groups(self, n: int) -> Optional[list]:
         """Candidate device groups for an n-way collective on the torus.
@@ -70,11 +74,20 @@ class CostModel:
     # ---- compute ---------------------------------------------------------
     def op_cost(self, op: Operator, mv: MachineView, backward: bool = True) -> float:
         """Per-iteration compute seconds for one shard of ``op`` under
-        ``mv`` (all shards run concurrently on distinct devices)."""
-        parts = max(1, mv.num_parts)
-        flops = op.flops() / parts
-        bytes_ = op.bytes_accessed() / parts
-        fwd = max(flops / self.machine.peak_flops, bytes_ / self.machine.hbm_bandwidth)
+        ``mv`` (all shards run concurrently on distinct devices).
+        A calibration measurement for (op, view) overrides the
+        roofline forward estimate when available."""
+        fwd = None
+        if self.calibration is not None:
+            fwd = self.calibration.get(op, mv)
+        if fwd is None:
+            parts = max(1, mv.num_parts)
+            flops = op.flops() / parts
+            bytes_ = op.bytes_accessed() / parts
+            fwd = max(
+                flops / self.machine.peak_flops,
+                bytes_ / self.machine.hbm_bandwidth,
+            )
         t = fwd + OP_OVERHEAD_S
         if backward:
             # bwd ≈ 2x fwd FLOPs for matmul-family, ~1x for elementwise

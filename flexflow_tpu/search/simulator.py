@@ -28,7 +28,7 @@ from flexflow_tpu.search.machine_model import CostModel
 
 class Simulator:
     def __init__(self, machine: MachineSpec, num_devices: Optional[int] = None,
-                 use_network_model: bool = True):
+                 use_network_model: bool = True, calibration=None):
         self.machine = machine
         self.num_devices = num_devices or machine.num_devices
         network = None
@@ -39,7 +39,7 @@ class Simulator:
                 network = ici_network(machine, num_devices=self.num_devices)
             except (AssertionError, ValueError):
                 network = None
-        self.cost = CostModel(machine, network=network)
+        self.cost = CostModel(machine, network=network, calibration=calibration)
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
         # propagate()/op_cost results per (op signature, view): structural
         # keys stay valid across graph copies and op lifetimes (an id()

@@ -1,0 +1,94 @@
+"""Cost-model calibration: measured per-(op, view) costs override the
+roofline and change search decisions (reference: ProfilingRecord cache,
+src/runtime/simulator.cc:515-554; on-device timing model.cu:38-74)."""
+
+import math
+
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.search.calibration import (
+    CalibrationTable,
+    calibrate_graph,
+    measure_op_view,
+)
+from flexflow_tpu.search.dp import SearchHelper
+from flexflow_tpu.search.simulator import Simulator
+
+
+def mlp_model(batch=64, in_dim=128, hidden=256, classes=16):
+    cfg = ff.FFConfig(batch_size=batch, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([batch, in_dim])
+    t = m.dense(x, hidden, activation="relu", name="fc1")
+    t = m.dense(t, classes, name="head")
+    return m
+
+
+def test_table_roundtrip(tmp_path):
+    m = mlp_model()
+    op = m.node_by_name("fc1").op
+    table = CalibrationTable()
+    table.put(op, MachineView.data_parallel(2, 8), 1.5e-4)
+    table.put(op, MachineView.trivial(2), 9e-4)
+    p = str(tmp_path / "calib.json")
+    table.save(p)
+    loaded = CalibrationTable.load(p)
+    assert len(loaded) == 2
+    assert loaded.get(op, MachineView.data_parallel(2, 8)) == pytest.approx(1.5e-4)
+    assert loaded.get(op, MachineView.trivial(2)) == pytest.approx(9e-4)
+
+
+def test_injected_measurements_flip_search_ranking():
+    """The VERDICT r2 contract: a search decision must be reversible by
+    measurements alone.  For this small dense layer the roofline keeps
+    fc1 UNSHARDED (compute is tiny; any sharding pays sync/xfer).
+    Inject measurements saying the unsharded kernel is pathologically
+    slow on real hardware while every sharded variant is fast, and the
+    search must start sharding that op."""
+    m = mlp_model()
+    g = m.graph
+    n_dev = 8
+
+    def searched_parts(calibration):
+        sim = Simulator(m.config.machine_spec, num_devices=n_dev,
+                        calibration=calibration)
+        helper = SearchHelper(sim, n_dev)
+        _, strategy = helper.graph_cost(g)
+        fc1 = m.node_by_name("fc1")
+        return strategy[fc1.guid].num_parts
+
+    assert searched_parts(None) == 1  # roofline: trivial wins
+
+    fc1_op = m.node_by_name("fc1").op
+    table = CalibrationTable()
+    from flexflow_tpu.search.views import boundary_views, candidate_views
+
+    views = list(candidate_views(fc1_op, n_dev)) + list(
+        boundary_views(fc1_op, n_dev)
+    )
+    for mv in views:
+        table.put(fc1_op, mv, 5e-2 if mv.num_parts == 1 else 1e-6)
+    assert searched_parts(table) > 1  # measurements flipped the ranking
+
+
+def test_measure_and_calibrate_graph_smoke():
+    """measure_op_view probes a sharded dense layer on the live backend
+    (CPU mesh in tests; the real chip under bench) and calibrate_graph
+    fills a table for a small graph within its budget."""
+    m = mlp_model(batch=32, in_dim=16, hidden=16, classes=4)
+    op = m.node_by_name("fc1").op
+    t_full = measure_op_view(op, MachineView.trivial(2), warmup=1, repeats=2)
+    assert t_full is not None and math.isfinite(t_full) and t_full > 0
+    t_shard = measure_op_view(op, MachineView.data_parallel(2, 8),
+                              warmup=1, repeats=2)
+    assert t_shard is not None and t_shard > 0
+
+    table = calibrate_graph(m.graph, 8, time_budget_s=20.0, repeats=1)
+    assert len(table) > 0
+    # the search consumes the table through the simulator
+    sim = Simulator(m.config.machine_spec, num_devices=8, calibration=table)
+    helper = SearchHelper(sim, 8)
+    cost, strategy = helper.graph_cost(m.graph)
+    assert math.isfinite(cost) and strategy

@@ -10,10 +10,15 @@ persist across grid steps.
 Layout: q, k, v are [B, S, H, D] ("bshd", matching the MHA op).  The
 kernel runs per (batch*head, q-block) with KV blocks innermost.
 
-Backward: custom_vjp with an XLA recompute backward (standard
-einsum-based gradients).  A fully-blocked Pallas backward is future
-work; the forward already gives the memory win where it matters for
-long-context inference/training forward activations.
+Backward: fully blocked Pallas kernels (flash-attention backward) —
+the forward saves per-row logsumexp; the backward recomputes scores
+block-by-block and accumulates dq (one kernel, kv-blocks inner) and
+dk/dv (second kernel, q-blocks inner) in VMEM scratch, so no [Sq, Sk]
+matrix ever exists in HBM in either direction.  (The reference has a
+monolithic cuDNN backward, src/ops/attention.cu; blocked recompute is
+the TPU-native formulation.)  The partial-output variant used by ring
+attention chunks its recompute backward over q blocks for the same
+O(S·block) memory bound.
 
 On non-TPU backends the kernel runs in interpreter mode so tests cover
 the same code path.
@@ -45,16 +50,20 @@ NEG_INF = -1e30
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, *refs,
     scale: float, causal: bool, block_q: int, block_k: int, q_k_offset: int,
-    partial_out: bool = False,
+    partial_out: bool = False, save_lse: bool = False,
 ):
     """Grid: (BH, num_q_blocks, num_k_blocks) — k innermost (sequential
     on TPU), so scratch accumulators carry across k steps.
     ``q_k_offset`` = Sk - Sq aligns the causal diagonal at the sequence
     END (query i attends to keys <= i + offset), matching tril(k=sk-sq).
     With ``partial_out`` the kernel emits UNNORMALIZED (acc, m, l) so
-    callers (ring attention) can merge partials across devices."""
+    callers (ring attention) can merge partials across devices.  With
+    ``save_lse`` it additionally emits per-row logsumexp — the residual
+    the blocked backward needs."""
     if partial_out:
         m_out, l_out, m_scratch, l_scratch, acc_scratch = refs
+    elif save_lse:
+        lse_out, m_scratch, l_scratch, acc_scratch = refs
     else:
         m_scratch, l_scratch, acc_scratch = refs
     kb = pl.program_id(2)
@@ -106,10 +115,13 @@ def _flash_kernel(
         else:
             l = jnp.maximum(l_scratch[:], 1e-30)
             o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+            if save_lse:
+                lse_out[0] = (m_scratch[:] + jnp.log(l)).astype(lse_out.dtype)
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float,
-                   block_q: int, block_k: int, interpret: bool):
+                   block_q: int, block_k: int, interpret: bool,
+                   save_lse: bool = False):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     # [B, S, H, D] -> [B*H, S, D]
@@ -125,26 +137,188 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, q_k_offset=sk - sq,
+        save_lse=save_lse,
     )
     scratch = [
         pltpu.VMEM((block_q, 1), jnp.float32),
         pltpu.VMEM((block_q, 1), jnp.float32),
         pltpu.VMEM((block_q, d), jnp.float32),
     ]
-    out = pl.pallas_call(
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    out_specs = qspec
+    out_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)
+    if save_lse:
+        out_specs = [qspec, pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32)]
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            qspec,
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    if save_lse:
+        out, lse = res
+        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
+    return res.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scratch,
+    *, scale: float, causal: bool, block_q: int, block_k: int, q_k_offset: int,
+):
+    """dq = sum_j ds_ij @ k_j, ds = p * (do v^T - delta) * scale.
+    Grid (BH, nq, nk), kv innermost; dq accumulates in VMEM scratch."""
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    run = True
+    if causal:
+        run = (kb * block_k) <= (qb * block_q + block_q - 1 + q_k_offset)
+
+    @pl.when(run if causal else True)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [bq, 1]
+        delta = delta_ref[0]  # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows + q_k_offset >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_scratch[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scratch, dv_scratch,
+    *, scale: float, causal: bool, block_q: int, block_k: int, q_k_offset: int,
+):
+    """dk_j = sum_i ds_ij^T @ q_i, dv_j = sum_i p_ij^T @ do_i.
+    Grid (BH, nk, nq), q innermost; dk/dv accumulate in VMEM scratch."""
+    ib = pl.program_id(2)
+    nq = pl.num_programs(2)
+    jb = pl.program_id(1)
+
+    @pl.when(ib == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    run = True
+    if causal:
+        # the i-block contributes unless every row is masked for every
+        # col of the j-block: max row + offset >= min col
+        run = (ib * block_q + block_q - 1 + q_k_offset) >= (jb * block_k)
+
+    @pl.when(run if causal else True)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = ib * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = jb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows + q_k_offset >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_scratch[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, D]
+
+    @pl.when(ib == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal, scale,
+                    block_q, block_k, interpret):
+    """Blocked flash backward: q,k,v,o,do [B,S,H,D], lse [B*H,Sq,1]."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dot = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d).astype(jnp.float32)
+    ot = o.transpose(0, 2, 1, 3).reshape(b * h, sq, d).astype(jnp.float32)
+    delta = jnp.sum(dot * ot, axis=-1, keepdims=True)  # [BH, Sq, 1]
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    rspec = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
+    kernel_kw = dict(scale=scale, causal=causal, block_q=block_q,
+                     block_k=block_k, q_k_offset=sk - sq)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **kernel_kw),
+        grid=(b * h, sq // block_q, sk // block_k),
+        in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # roles of the two non-BH grid axes swap: axis1 = kv block, axis2 = q
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    rspec2 = pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **kernel_kw),
+        grid=(b * h, sk // block_k, sq // block_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rspec2, rspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
 
 
 def _xla_attention(q, k, v, causal, scale, dropout_rate=0.0, dropout_rng=None):
@@ -252,14 +426,64 @@ def _fap_fwd(q, k, v, causal, scale, block_q, block_k):
     return out, (q, k, v)
 
 
+def _xla_attention_partial_at(q, k, v, causal, scale, row_offset, sq_total):
+    """_xla_attention_partial for a q-chunk whose first row sits at
+    global position ``row_offset`` of a length-``sq_total`` query
+    sequence (the causal mask is global, so chunking must not shift the
+    diagonal)."""
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        sk = s.shape[-1]
+        rows = row_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(rows + (sk - sq_total) >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
 def _fap_bwd(causal, scale, block_q, block_k, res, g):
+    """Recompute backward CHUNKED over q blocks: peak memory
+    O(block_q · Sk) per step instead of the full [Sq, Sk] matrix, with
+    dk/dv accumulated in a scan carry."""
     q, k, v = res
+    b, sq, h, d = q.shape
+    bq = min(block_q, sq)
+    if sq % bq != 0 or sq == bq:
+        def f(q, k, v):
+            return _xla_attention_partial(q, k, v, causal, scale)
 
-    def f(q, k, v):
-        return _xla_attention_partial(q, k, v, causal, scale)
+        _, vjp = jax.vjp(f, q, k, v)
+        return vjp(g)
+    dacc, dm, dl = g
+    nq = sq // bq
+    q_chunks = q.reshape(b, nq, bq, h, d).transpose(1, 0, 2, 3, 4)
+    dacc_c = dacc.reshape(b, h, nq, bq, d).transpose(2, 0, 1, 3, 4)
+    dm_c = dm.reshape(b, h, nq, bq, 1).transpose(2, 0, 1, 3, 4)
+    dl_c = dl.reshape(b, h, nq, bq, 1).transpose(2, 0, 1, 3, 4)
+    offsets = jnp.arange(nq, dtype=jnp.int32) * bq
 
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+    def body(carry, args):
+        dk_acc, dv_acc = carry
+        qc, daccc, dmc, dlc, off = args
+
+        def f(qc, k, v):
+            return _xla_attention_partial_at(qc, k, v, causal, scale, off, sq)
+
+        _, vjp = jax.vjp(f, qc, k, v)
+        dqc, dkc, dvc = vjp((daccc, dmc, dlc))
+        return (dk_acc + dkc, dv_acc + dvc), dqc
+
+    (dk, dv), dq_chunks = jax.lax.scan(
+        body,
+        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
+        (q_chunks, dacc_c, dm_c, dl_c, offsets),
+    )
+    dq = dq_chunks.transpose(1, 0, 2, 3, 4).reshape(b, sq, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash_partial_vjp.defvjp(_fap_fwd, _fap_bwd)
@@ -287,22 +511,32 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     bk = min(block_k, sk)
     if not _HAS_PLTPU or sq % bq != 0 or sk % bk != 0 or q.shape[-1] % 8 != 0:
         out = _xla_attention(q, k, v, causal, scale)  # shape fallback
-    else:
-        out = _flash_forward(q, k, v, causal, scale, bq, bk, interpret)
-    return out, (q, k, v)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_forward(q, k, v, causal, scale, bq, bk, interpret,
+                              save_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, res, g):
-    """Recompute backward via XLA (standard attention gradients)."""
-    q, k, v = res
+    """Blocked Pallas backward using the saved logsumexp; peak memory
+    O(S·block) (the round-2 recompute backward re-materialized the full
+    [Sq, Sk] probs and gave back the forward's memory win)."""
+    q, k, v, o, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if lse is None:
+        # forward took the XLA fallback (odd shapes): recompute backward
+        def f(q, k, v):
+            return _xla_attention(q, k, v, causal, scale)
 
-    def f(q, k, v):
-        return _xla_attention(q, k, v, causal, scale)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+        _, vjp = jax.vjp(f, q, k, v)
+        return vjp(g)
+    sq, sk = q.shape[1], k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    interpret = jax.default_backend() != "tpu"
+    return _flash_backward(q, k, v, o, lse, g, causal, scale, bq, bk,
+                           interpret)
 
 
 _flash_attention_vjp.defvjp(_fa_fwd, _fa_bwd)

@@ -276,23 +276,72 @@ class CompiledModel:
                 loss = loss + v
         return loss
 
-    def _build_train_step(self):
+    def _raw_step(self, params, opt_state, state, rng, inputs, labels):
         optimizer = self.optimizer
 
-        def step(params, opt_state, state, rng, inputs, labels):
-            def loss_fn(p):
-                logits, new_state = self.apply(p, state, inputs, rng, train=True)
-                loss = self._loss_from(logits, labels, new_state)
-                return loss, (logits, new_state)
+        def loss_fn(p):
+            logits, new_state = self.apply(p, state, inputs, rng, train=True)
+            loss = self._loss_from(logits, labels, new_state)
+            return loss, (logits, new_state)
 
-            (loss, (logits, new_state)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
-            new_params, new_opt_state = optimizer.apply(params, grads, opt_state)
-            m = compute_metrics(self.metric_types, self.loss_type, logits, labels)
-            return new_params, new_opt_state, new_state, loss, m
+        (loss, (logits, new_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        new_params, new_opt_state = optimizer.apply(params, grads, opt_state)
+        m = compute_metrics(self.metric_types, self.loss_type, logits, labels)
+        return new_params, new_opt_state, new_state, loss, m
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+    def _build_train_step(self):
+        return jax.jit(self._raw_step, donate_argnums=(0, 1, 2))
+
+    def _build_train_steps(self):
+        def multi(params, opt_state, state, rng, inputs_stacked, labels_stacked):
+            n = labels_stacked.shape[0]
+            keys = jax.random.split(rng, n)
+
+            def body(carry, xs):
+                p, o, s = carry
+                key, inp, lab = xs
+                p, o, s, loss, m = self._raw_step(p, o, s, key, list(inp), lab)
+                return (p, o, s), (loss, m)
+
+            (p, o, s), (losses, ms) = jax.lax.scan(
+                body, (params, opt_state, state),
+                (keys, tuple(inputs_stacked), labels_stacked),
+            )
+            return p, o, s, losses, ms
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def train_steps(self, params, opt_state, state, rng, inputs_stacked,
+                    labels_stacked):
+        """Run N training steps inside ONE compiled program
+        (jax.lax.scan over stacked batches) — the XLA-native analogue
+        of Legion iteration tracing (reference: begin_trace/end_trace,
+        flexflow_cffi.py:1867-1874): per-call dispatch overhead is paid
+        once per N steps instead of every step.
+
+        ``inputs_stacked``: list of arrays [N, B, ...]; ``labels_stacked``
+        [N, B, ...].  Returns (params, opt_state, state, losses [N],
+        metrics stacked over N)."""
+        if getattr(self, "_train_steps_fn", None) is None:
+            self._train_steps_fn = self._build_train_steps()
+        return self._train_steps_fn(params, opt_state, state, rng,
+                                    tuple(inputs_stacked), labels_stacked)
+
+    def stacked_input_sharding(self, i: int):
+        """Sharding for a [N, B, ...] stack of the i-th input (leading
+        step axis unsharded)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        base = self.input_sharding(i).spec
+        return NamedSharding(self.mesh, PartitionSpec(None, *base))
+
+    def stacked_batch_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        base = self.batch_sharding().spec
+        return NamedSharding(self.mesh, PartitionSpec(None, *base))
 
     def _build_eval_step(self):
         def step(params, state, inputs, labels):

@@ -84,3 +84,55 @@ def test_regression_mse():
     data_y = data_x @ w_true
     hist = model.fit(x=data_x, y=data_y, verbose=False)
     assert hist[-1]["mean_squared_error"] < hist[0]["mean_squared_error"] * 0.5
+
+
+def test_train_steps_matches_sequential():
+    """train_steps (scanned multi-step, the Legion-trace analogue) must
+    produce the same params/losses as N sequential train_step calls."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = ff.FFConfig(batch_size=16, num_devices=8, only_data_parallel=True,
+                      compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([16, 8])
+    t = model.dense(x, 16, activation="relu")
+    t = model.dense(t, 4)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.default_rng(3)
+    n = 4
+    xs = rng.normal(size=(n, 16, 8)).astype(np.float32)
+    ys = rng.integers(0, 4, size=(n, 16)).astype(np.int32)
+
+    import copy
+    c = model.compiled
+    p1, o1, s1 = model.params, model.opt_state, model.state
+    key = jax.random.key(7)
+    keys = jax.random.split(key, n)
+    for i in range(n):
+        xi = jax.device_put(xs[i], c.input_sharding(0))
+        yi = jax.device_put(ys[i], c.batch_sharding())
+        p1, o1, s1, loss_seq, m = c.train_step(p1, o1, s1, keys[i], [xi], yi)
+
+    model2 = ff.FFModel(cfg)
+    x2 = model2.create_tensor([16, 8])
+    t2 = model2.dense(x2, 16, activation="relu")
+    t2 = model2.dense(t2, 4)
+    model2.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=["accuracy"])
+    c2 = model2.compiled
+    # same init: seed-deterministic
+    xs_d = jax.device_put(xs, c2.stacked_input_sharding(0))
+    ys_d = jax.device_put(ys, c2.stacked_batch_sharding())
+    p2, o2, s2, losses, ms = c2.train_steps(
+        model2.params, model2.opt_state, model2.state, key, [xs_d], ys_d)
+    assert losses.shape == (n,)
+    np.testing.assert_allclose(float(losses[-1]), float(loss_seq), rtol=1e-5)
+    for opname in p1:
+        for wname in p1[opname]:
+            np.testing.assert_allclose(
+                np.asarray(p1[opname][wname]), np.asarray(p2[opname][wname]),
+                rtol=1e-5, atol=1e-6)

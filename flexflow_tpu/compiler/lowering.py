@@ -194,6 +194,17 @@ class CompiledModel:
             ins.append(x)
         ctx.slot_axes = axes
         ws = params.get(node.op.name, {})
+        if self._multi_device:
+            # ops with an explicit-SPMD lowering (shard_map +
+            # collectives) take it when the sharding calls for it —
+            # e.g. vocab-split embedding emits a masked local gather +
+            # psum instead of whatever GSPMD would pick for the global
+            # jnp.take (SURVEY.md §7 hard part (e))
+            outs = node.op.forward_sharded(ctx, ins, ws, osh)
+            if outs is not None:
+                for i, y in enumerate(outs):
+                    values[(node.guid, i)] = y
+                return
         if (
             self.config.remat
             and getattr(node.op, "state_specs", None) is None

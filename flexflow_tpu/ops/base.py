@@ -176,6 +176,20 @@ class Operator:
     ) -> List[jax.Array]:
         raise NotImplementedError(type(self).__name__)
 
+    def forward_sharded(
+        self,
+        ctx: LoweringContext,
+        inputs: List[jax.Array],
+        weights: Dict[str, jax.Array],
+        osh: "OpSharding",
+    ) -> Optional[List[jax.Array]]:
+        """Optional explicit-SPMD lowering: return outputs computed with
+        shard_map/collectives when GSPMD's default partitioning of
+        ``forward`` would be wrong or slow for this op's sharding (e.g.
+        a vocab-split embedding gather), or None to use ``forward``.
+        Only called on multi-device meshes."""
+        return None
+
     def propagate(self, mv: MachineView) -> OpSharding:
         """Default rule: elementwise-style — every input shares the
         output's annotation (valid only when input rank == output rank);

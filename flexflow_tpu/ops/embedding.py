@@ -92,6 +92,64 @@ class EmbeddingOp(Operator):
             y = jnp.mean(y, axis=-2)
         return [y]
 
+    def forward_sharded(self, ctx, inputs, weights, osh):
+        """Vocab-split lowering (reference: table partitioned over vocab,
+        embedding.cc:123-190): shard_map over the vocab mesh axes does a
+        masked LOCAL gather on each table shard and a psum across
+        shards — XLA emits one allreduce of [.., D]-shaped activations
+        and never gathers the table (GSPMD's default for a global
+        jnp.take on a vocab-sharded operand can replicate the table).
+        The gradient of the masked local gather is a local scatter-add
+        into the shard, so table grads stay sharded too."""
+        vocab_axes = (ctx.slot_axes or {}).get(REPLICA_SLOT, ())
+        if not vocab_axes or ctx.mesh is None:
+            return None
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from flexflow_tpu.parallel.mesh import annot_partition_spec
+
+        a = self.attrs
+        mesh = ctx.mesh
+        ids_spec = annot_partition_spec(osh.inputs[0], ctx.slot_axes)
+        w_spec = annot_partition_spec(osh.weights[0], ctx.slot_axes)
+        out_spec = annot_partition_spec(osh.outputs[0], ctx.slot_axes)
+        r = 1
+        for ax in vocab_axes:
+            r *= mesh.shape[ax]
+        vshard = a["num_entries"] // r
+
+        def local(ids, table):
+            ids = ids.astype(jnp.int32)
+            idx = jnp.int32(0)
+            for ax in vocab_axes:
+                idx = idx * mesh.shape[ax] + jax.lax.axis_index(ax)
+            lo = idx * vshard
+            local_ids = ids - lo
+            valid = (local_ids >= 0) & (local_ids < vshard)
+            rows = jnp.where(valid, local_ids, 0)
+            y = jnp.take(table, rows, axis=0)
+            y = jnp.where(valid[..., None], y, jnp.zeros((), table.dtype))
+            if a["aggr"] in ("sum", "avg") and ids.ndim > 1:
+                y = jnp.sum(y, axis=-2)
+            y = jax.lax.psum(y, vocab_axes)
+            if a["aggr"] == "avg" and ids.ndim > 1:
+                y = y / ids.shape[-1]
+            return y
+
+        # the ids are constrained to their annot first so shard_map sees
+        # the layout its in_spec declares
+        ids = jax.lax.with_sharding_constraint(
+            inputs[0], NamedSharding(mesh, ids_spec)
+        )
+        fn = shard_map(
+            local, mesh=mesh,
+            in_specs=(ids_spec, w_spec),
+            out_specs=out_spec,
+            check_rep=False,
+        )
+        return [fn(ids, weights["table"])]
+
     def propagate(self, mv: MachineView) -> OpSharding:
         degs = mv.dim_degrees
         r = mv.replica_degree  # vocab split -> partial-sum rows

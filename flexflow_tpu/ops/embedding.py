@@ -146,7 +146,7 @@ class EmbeddingOp(Operator):
             local, mesh=mesh,
             in_specs=(ids_spec, w_spec),
             out_specs=out_spec,
-            check_rep=False,
+            check_vma=False,
         )
         return [fn(ids, weights["table"])]
 

@@ -101,6 +101,12 @@ class Simulator:
         task graph (reference: simulator.cc:1008-1058 dot export)."""
         ready: Dict[Tuple[int, int], float] = {}  # (guid, out_idx) -> time
         device_avail: Dict[int, float] = {d: 0.0 for d in range(self.num_devices)}
+        # per-device COMM timelines for weight-grad allreduces
+        # (reference: simulator.cc:1062-1186 schedules NCCL allreduces
+        # under device availability): same-device syncs serialize on the
+        # shared ICI links, disjoint-device syncs overlap, and comm
+        # overlaps later compute (async collectives).
+        comm_avail: Dict[int, float] = {d: 0.0 for d in range(self.num_devices)}
         topo = graph.topo_order()
         shardings = {}
         for node in topo:
@@ -115,8 +121,7 @@ class Simulator:
             shardings[node.guid] = (mv, osh)
 
         end_time = 0.0
-        syncs = []
-        bwd_total = 0.0
+        end_comm = 0.0
         for node in topo:
             mv, osh = shardings[node.guid]
             start = 0.0
@@ -153,21 +158,16 @@ class Simulator:
             if schedule is not None:
                 schedule.append((node.op.name, start, finish, tuple(sorted(devs))))
             end_time = max(end_time, finish)
-            if include_update:
-                if sync > 0:
-                    syncs.append(sync)
-                bwd_total += full - fwd
+            if include_update and sync > 0:
+                s = finish
+                for d in devs:
+                    s = max(s, comm_avail[d])
+                f = s + sync
+                for d in devs:
+                    comm_avail[d] = f
+                end_comm = max(end_comm, f)
 
-        if include_update and syncs:
-            # weight-grad allreduces overlap with backward compute (XLA
-            # schedules collectives concurrently with independent compute;
-            # the reference models the same via device-availability
-            # scheduling, simulator.cc:1062-1186).  Exposed time = what
-            # backward cannot hide, at least the final gradient's own sync.
-            total_sync = sum(syncs)
-            exposed = max(max(syncs), total_sync - bwd_total)
-            end_time += exposed
-        return end_time
+        return max(end_time, end_comm)
 
     # ------------------------------------------------------------------
     def build_native(self, graph: Graph, node_views: Dict[int, list]):

@@ -44,7 +44,7 @@ struct SimGraph {
   std::vector<Edge> edges;
   std::vector<std::vector<int32_t>> in_edges;  // node -> edge indices
   // scratch reused across simulate calls
-  std::vector<double> ready, avail;
+  std::vector<double> ready, avail, comm;
 };
 
 const double kInf = std::numeric_limits<double>::infinity();
@@ -53,11 +53,10 @@ double simulate(SimGraph* g, const int32_t* assign, int include_update) {
   const size_t n = g->nodes.size();
   g->ready.assign(n, 0.0);
   g->avail.assign(static_cast<size_t>(g->num_devices), 0.0);
+  g->comm.assign(static_cast<size_t>(g->num_devices), 0.0);
 
   double end_time = 0.0;
-  double bwd_total = 0.0;
-  double sync_total = 0.0;
-  double sync_max = 0.0;
+  double end_comm = 0.0;
 
   for (size_t i = 0; i < n; ++i) {
     int32_t vi = assign[i] >= 0 ? assign[i] : g->default_view[i];
@@ -83,22 +82,24 @@ double simulate(SimGraph* g, const int32_t* assign, int include_update) {
     for (int32_t d : v.devices) g->avail[d] = finish;
     g->ready[i] = finish;
     if (finish > end_time) end_time = finish;
-    if (include_update) {
-      bwd_total += v.full - v.fwd;
-      if (v.sync > 0.0) {
-        sync_total += v.sync;
-        if (v.sync > sync_max) sync_max = v.sync;
+    if (include_update && v.sync > 0.0) {
+      // weight-grad allreduce scheduled on per-device COMM timelines
+      // (reference: simulator.cc:1062-1186 device-availability
+      // scheduling of NCCL allreduces): ready when the op's compute
+      // completes; same-device syncs serialize on the shared links,
+      // disjoint-device syncs overlap; comm overlaps later compute
+      // (async collectives over ICI).
+      double s = finish;
+      for (int32_t d : v.devices) {
+        if (g->comm[d] > s) s = g->comm[d];
       }
+      double f = s + v.sync;
+      for (int32_t d : v.devices) g->comm[d] = f;
+      if (f > end_comm) end_comm = f;
     }
   }
 
-  if (include_update && sync_total > 0.0) {
-    // grad allreduce overlaps backward compute; exposed = what backward
-    // cannot hide, at least the last gradient's own sync
-    double exposed = sync_total - bwd_total;
-    if (sync_max > exposed) exposed = sync_max;
-    end_time += exposed;
-  }
+  if (end_comm > end_time) end_time = end_comm;
   return end_time;
 }
 

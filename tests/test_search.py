@@ -397,3 +397,41 @@ def test_linear_activation_fusion_xfer():
     sim = Simulator(MachineSpec.tpu_v5e(8))
     c = sim.simulate(g2, data_parallel_strategy(g2, 8))
     assert c > 0 and c != float("inf")
+
+
+def test_weight_sync_per_device_scheduling():
+    """Per-device comm scheduling (reference: simulator.cc:1062-1186):
+    two syncs on the SAME device block serialize; the same two syncs on
+    DISJOINT blocks overlap — so disjoint placement ranks strictly
+    better, a distinction the old global exposure formula could not
+    make."""
+    import dataclasses
+
+    from flexflow_tpu.core.machine import MachineSpec
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 2048])
+    a = m.dense(x, 2048, name="wa")  # big weights -> real sync cost
+    b = m.dense(x, 2048, name="wb")
+    t = m.add(a, b, name="join")
+    g = m.graph
+    sim = Simulator(cfg.machine_spec, num_devices=8)
+    wa, wb = m.node_by_name("wa"), m.node_by_name("wb")
+
+    def strat(start_b):
+        s = data_parallel_strategy(g, 8)
+        va = MachineView(dim_degrees=(4, 1), replica_degree=1, start_part=0)
+        vb = MachineView(dim_degrees=(4, 1), replica_degree=1,
+                         start_part=start_b)
+        s[wa.guid] = va
+        s[wb.guid] = vb
+        return s
+
+    c_same = sim.simulate(g, strat(0))     # both on devices 0-3
+    c_disj = sim.simulate(g, strat(4))     # wb on devices 4-7
+    assert c_disj < c_same, (c_disj, c_same)
+    # sanity: the gap is at least one sync's worth of serialization
+    sync = sim.cost.weight_sync_cost(wa.op, strat(0)[wa.guid])
+    assert sync > 0
+    assert c_same - c_disj > 0.25 * sync, (c_same, c_disj, sync)

@@ -38,7 +38,8 @@ def _configure(lib) -> None:
     lib.ffn_sim_create.argtypes = [c_i32, c_i32]
     lib.ffn_sim_destroy.argtypes = [p_void]
     lib.ffn_sim_add_view.argtypes = [p_void, c_i32, c_f64, c_f64, c_f64,
-                                     p_i32, c_i32, c_i32]
+                                     c_f64, p_i32, c_i32, p_i32, c_i32, c_i32]
+    lib.ffn_sim_set_mem_cap.argtypes = [p_void, c_f64]
     lib.ffn_sim_set_default_view.argtypes = [p_void, c_i32, c_i32]
     lib.ffn_sim_add_edge.argtypes = [p_void, c_i32, c_i32, p_f64]
     lib.ffn_sim_simulate.restype = c_f64
@@ -112,10 +113,16 @@ class NativeSimGraph:
             self._g = None
 
     def add_view(self, node: int, fwd: float, full: float, sync: float,
-                 devices: Sequence[int], valid: bool = True) -> None:
+                 devices: Sequence[int], comm_devices: Sequence[int] = (),
+                 mem: float = 0.0, valid: bool = True) -> None:
         d = np.asarray(list(devices), dtype=np.int32)
+        c = np.asarray(list(comm_devices), dtype=np.int32)
         self.lib.ffn_sim_add_view(self._g, node, float(fwd), float(full),
-                                  float(sync), _i32(d), len(d), int(valid))
+                                  float(sync), float(mem), _i32(d), len(d),
+                                  _i32(c), len(c), int(valid))
+
+    def set_mem_cap(self, cap: float) -> None:
+        self.lib.ffn_sim_set_mem_cap(self._g, float(cap))
 
     def set_default_view(self, node: int, view: int) -> None:
         self.lib.ffn_sim_set_default_view(self._g, node, view)

@@ -101,7 +101,7 @@ class LogicalTaskGraphSimulator(Simulator):
             devs = self.view_device_set(mv)
             for d in devs:
                 start = max(start, avail[d])
-            fwd, full, sync = self._node_costs(node, mv)
+            fwd, full, sync, _mem = self._node_costs(node, mv)
             finish = start + (full if include_update else fwd)
             for d in devs:
                 avail[d] = finish

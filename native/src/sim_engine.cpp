@@ -26,7 +26,9 @@ struct View {
   double fwd = 0.0;        // forward-only duration
   double full = 0.0;       // fwd+bwd duration
   double sync = 0.0;       // weight-gradient sync cost
-  std::vector<int32_t> devices;  // device ids this view occupies
+  double mem = 0.0;        // per-device bytes this view places
+  std::vector<int32_t> devices;       // compute-timeline device ids
+  std::vector<int32_t> comm_devices;  // sync comm-group device ids
   bool valid = true;       // invalid views poison the strategy (inf)
 };
 
@@ -43,8 +45,9 @@ struct SimGraph {
   std::vector<int32_t> default_view;     // used when assignment[i] < 0
   std::vector<Edge> edges;
   std::vector<std::vector<int32_t>> in_edges;  // node -> edge indices
+  double mem_cap = std::numeric_limits<double>::infinity();
   // scratch reused across simulate calls
-  std::vector<double> ready, avail, comm;
+  std::vector<double> ready, avail, comm, mem;
 };
 
 const double kInf = std::numeric_limits<double>::infinity();
@@ -54,9 +57,11 @@ double simulate(SimGraph* g, const int32_t* assign, int include_update) {
   g->ready.assign(n, 0.0);
   g->avail.assign(static_cast<size_t>(g->num_devices), 0.0);
   g->comm.assign(static_cast<size_t>(g->num_devices), 0.0);
+  g->mem.assign(static_cast<size_t>(g->num_devices), 0.0);
 
   double end_time = 0.0;
   double end_comm = 0.0;
+  double mem_peak = 0.0;
 
   for (size_t i = 0; i < n; ++i) {
     int32_t vi = assign[i] >= 0 ? assign[i] : g->default_view[i];
@@ -79,7 +84,11 @@ double simulate(SimGraph* g, const int32_t* assign, int include_update) {
     }
     double dur = include_update ? v.full : v.fwd;
     double finish = start + dur;
-    for (int32_t d : v.devices) g->avail[d] = finish;
+    for (int32_t d : v.devices) {
+      g->avail[d] = finish;
+      g->mem[d] += v.mem;
+      if (g->mem[d] > mem_peak) mem_peak = g->mem[d];
+    }
     g->ready[i] = finish;
     if (finish > end_time) end_time = finish;
     if (include_update && v.sync > 0.0) {
@@ -90,15 +99,16 @@ double simulate(SimGraph* g, const int32_t* assign, int include_update) {
       // disjoint-device syncs overlap; comm overlaps later compute
       // (async collectives over ICI).
       double s = finish;
-      for (int32_t d : v.devices) {
+      for (int32_t d : v.comm_devices) {
         if (g->comm[d] > s) s = g->comm[d];
       }
       double f = s + v.sync;
-      for (int32_t d : v.devices) g->comm[d] = f;
+      for (int32_t d : v.comm_devices) g->comm[d] = f;
       if (f > end_comm) end_comm = f;
     }
   }
 
+  if (mem_peak > g->mem_cap) return kInf;
   if (end_comm > end_time) end_time = end_comm;
   return end_time;
 }
@@ -119,16 +129,22 @@ SimGraph* ffn_sim_create(int32_t num_nodes, int32_t num_devices) {
 void ffn_sim_destroy(SimGraph* g) { delete g; }
 
 // Register one candidate view for node `i`.
-// devices: `n_devices` device ids; valid=0 marks a poisoned view.
+// devices: `n_devices` compute-timeline device ids; comm_devices:
+// `n_comm` sync comm-group device ids; valid=0 marks a poisoned view.
+void ffn_sim_set_mem_cap(SimGraph* g, double cap) { g->mem_cap = cap; }
+
 void ffn_sim_add_view(SimGraph* g, int32_t i, double fwd, double full,
-                      double sync, const int32_t* devices, int32_t n_devices,
-                      int32_t valid) {
+                      double sync, double mem, const int32_t* devices,
+                      int32_t n_devices, const int32_t* comm_devices,
+                      int32_t n_comm, int32_t valid) {
   View v;
   v.fwd = fwd;
   v.full = full;
   v.sync = sync;
+  v.mem = mem;
   v.valid = valid != 0;
   v.devices.assign(devices, devices + n_devices);
+  v.comm_devices.assign(comm_devices, comm_devices + n_comm);
   g->nodes[i].push_back(std::move(v));
 }
 

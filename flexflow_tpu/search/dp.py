@@ -382,18 +382,28 @@ class SearchHelper:
 
     # ------------------------------------------------------------------
     def _sub_budgets(self, budget: int) -> List[Tuple[int, int]]:
-        """(first, rest) device-count pairs for a VERTICAL resource
-        split.  Both sides must be budgets whose view degrees can lower
-        onto the global mesh, i.e. divisors of the machine size; the
-        rest side takes the largest valid budget that fits."""
+        """(first, rest) device-count pairs for a VERTICAL or
+        HORIZONTAL resource split (reference: graph.cc:161-295 tries
+        gpu-dim and node-dim resource partitions).  VERTICAL budgets
+        are divisors of the machine size (view degrees must factor
+        onto the global mesh); HORIZONTAL adds whole-host multiples —
+        node-granular partitions that need not divide the device count
+        (e.g. 16 of 24 devices = 2 of 3 hosts).  Each side's views are
+        still divisor-constrained; the budget only bounds them."""
         divs = [d for d in range(1, self.num_devices + 1)
                 if self.num_devices % d == 0]
+        cands = set(divs)
+        dph = getattr(self.sim.machine, "devices_per_host", 0)
+        if 1 < dph < self.num_devices:
+            cands.update(
+                k * dph for k in range(1, self.num_devices // dph + 1)
+            )
         pairs = []
-        for a in divs:
+        for a in sorted(cands):
             if a >= budget:
                 continue
             rest = budget - a
-            b = max((d for d in divs if d <= rest), default=0)
+            b = max((d for d in sorted(cands) if d <= rest), default=0)
             if b >= 1:
                 pairs.append((a, b))
         return pairs

@@ -65,6 +65,15 @@ _OP_TYPES: Dict[str, OperatorType] = {
     "OP_EW_MAX": OperatorType.EW_MAX,
     "OP_EW_MIN": OperatorType.EW_MIN,
     "OP_MULTIHEAD_ATTENTION": OperatorType.MULTIHEAD_ATTENTION,
+    # MoE + scalar subset (reference enum substitution_loader.h:52-71)
+    "OP_GROUP_BY": OperatorType.GROUP_BY,
+    "OP_AGGREGATE": OperatorType.AGGREGATE,
+    "OP_AGG_SPEC": OperatorType.AGGREGATE_SPEC,
+    "OP_TOPK": OperatorType.TOPK,
+    "OP_SCALAR_MULTIPLY": OperatorType.SCALAR_MUL,
+    "OP_SCALAR_ADD": OperatorType.SCALAR_ADD,
+    "OP_SCALAR_SUB": OperatorType.SCALAR_SUB,
+    "OP_SCALAR_TRUE_DIV": OperatorType.SCALAR_TRUE_DIV,
     "OP_PARTITION": OperatorType.REPARTITION,
     "OP_REPARTITION": OperatorType.REPARTITION,
     "OP_COMBINE": OperatorType.COMBINE,
@@ -78,6 +87,41 @@ _PARALLEL_TYPES = {
     OperatorType.COMBINE,
     OperatorType.REPLICATE,
     OperatorType.REDUCTION,
+}
+
+# dst op types constructible from input shapes + pattern params alone —
+# no same-typed source op ("donor") needed (e.g. TASO rules whose dst
+# introduces a Concat/activation the source pattern lacks)
+_DONORLESS_TYPES = {
+    OperatorType.CONCAT,
+    OperatorType.RELU,
+    OperatorType.SIGMOID,
+    OperatorType.TANH,
+    OperatorType.ELU,
+    OperatorType.IDENTITY,
+    OperatorType.EW_ADD,
+    OperatorType.EW_MUL,
+    OperatorType.EW_SUB,
+    OperatorType.EW_DIV,
+    OperatorType.EW_MAX,
+    OperatorType.EW_MIN,
+}
+
+_EW_BINARY_TYPES = {
+    OperatorType.EW_ADD,
+    OperatorType.EW_MUL,
+    OperatorType.EW_SUB,
+    OperatorType.EW_DIV,
+    OperatorType.EW_MAX,
+    OperatorType.EW_MIN,
+}
+
+_UNARY_TYPES = {
+    OperatorType.RELU,
+    OperatorType.SIGMOID,
+    OperatorType.TANH,
+    OperatorType.ELU,
+    OperatorType.IDENTITY,
 }
 
 
@@ -148,6 +192,17 @@ class PatternRule:
             for slot, (src_id, ts_id) in enumerate(pat.inputs):
                 e = next((e for e in in_edges if e.dst_idx == slot), None)
                 if e is None:
+                    # no tensor edge at this slot.  The TASO corpus wires
+                    # weights as explicit pattern inputs (linear = (x, w));
+                    # our ops OWN their weights, so an external ref with no
+                    # edge binds the op's own weight tensor instead.
+                    if src_id < 0 and node.op._weight_specs:
+                        srcref = ("w", guid, slot)
+                        if ts_id in new_ext and new_ext[ts_id] != srcref:
+                            ok = False
+                            break
+                        new_ext[ts_id] = srcref
+                        continue
                     ok = False
                     break
                 if src_id >= 0:
@@ -199,8 +254,11 @@ class PatternRule:
     # -- application -------------------------------------------------------
     def apply(self, graph: Graph, match: Dict[int, int]) -> Optional[Graph]:
         g = graph.copy()
-        # resolve external inputs from the matched source ops
+        # resolve external inputs from the matched source ops; externals
+        # with no tensor edge are the matched op's OWN weights (see
+        # _extend) and resolve to their owner for donor lookup
         ext: Dict[int, Tuple[int, int]] = {}
+        w_ext: Dict[int, int] = {}  # ts_id -> owning node guid
         for p_idx, guid in match.items():
             pat = self.src_ops[p_idx]
             for slot, (src_id, ts_id) in enumerate(pat.inputs):
@@ -209,6 +267,9 @@ class PatternRule:
                         (e for e in g.in_edges[guid] if e.dst_idx == slot), None
                     )
                     if e is None:
+                        if graph.nodes[guid].op._weight_specs:
+                            w_ext[ts_id] = guid
+                            continue
                         return None
                     ext[ts_id] = (e.src, e.src_idx)
 
@@ -229,11 +290,17 @@ class PatternRule:
         new_nodes: Dict[int, Node] = {}
         for d_idx, dpat in enumerate(self.dst_ops):
             in_refs = []
+            donor_hint: Optional[int] = None
             for (src_id, ts_id) in dpat.inputs:
                 if src_id < 0:
-                    if ts_id not in ext:
+                    if ts_id in ext:
+                        in_refs.append(ext[ts_id])
+                    elif ts_id in w_ext:
+                        # weight slot: our dst op owns its weight — no
+                        # edge; the weight's owner is the attr donor
+                        donor_hint = w_ext[ts_id]
+                    else:
                         return None
-                    in_refs.append(ext[ts_id])
                 else:
                     dn = new_nodes.get(src_id)
                     if dn is None:
@@ -245,7 +312,7 @@ class PatternRule:
                 if src_node is None or src_idx >= len(src_node.op.output_shapes):
                     return None
                 in_shapes.append(src_node.op.output_shapes[src_idx])
-            op = self._make_dst_op(dpat, in_shapes, match, graph)
+            op = self._make_dst_op(dpat, in_shapes, match, graph, donor_hint)
             if op is None:
                 return None
             node = Node(g._next_guid, op)
@@ -274,7 +341,26 @@ class PatternRule:
             return None
         return g
 
-    def _make_dst_op(self, dpat: PatternOp, in_shapes, match, src_graph):
+    def _donor_pattern_idx(self, dpat: PatternOp) -> Optional[int]:
+        """Which source-pattern op donates attrs to ``dpat``: the unique
+        same-typed src op, or — with several candidates — the one
+        sharing an external input id (the corpus wires each op's weight
+        as a distinct external tensor, so sharing ``-k`` identifies the
+        pre-rewrite twin, the reference's matchOpX convention)."""
+        cands = [
+            i for i, s in enumerate(self.src_ops) if s.type is dpat.type
+        ]
+        if len(cands) == 1:
+            return cands[0]
+        d_ext = {ts for (sid, ts) in dpat.inputs if sid < 0}
+        for i in cands:
+            s_ext = {ts for (sid, ts) in self.src_ops[i].inputs if sid < 0}
+            if d_ext & s_ext:
+                return i
+        return None
+
+    def _make_dst_op(self, dpat: PatternOp, in_shapes, match, src_graph,
+                     donor_hint: Optional[int] = None):
         if dpat.type in _PARALLEL_TYPES:
             dim, deg = dpat.parallel_dim_degree()
             if deg is None:
@@ -291,21 +377,56 @@ class PatternRule:
             if dpat.type is OperatorType.REPLICATE:
                 return ReplicateOp(_un("replicate"), [shape], degree=deg)
             return ReductionOp(_un("reduction"), [shape], degree=deg)
-        # compute op: clone the unique same-typed source op's attributes
-        donors = [
-            src_graph.nodes[guid]
-            for p_idx, guid in match.items()
-            if self.src_ops[p_idx].type is dpat.type
-        ]
-        if len(donors) != 1:
+        # compute op: clone a source op's attributes.  Donor priority:
+        # the weight owner bound to this dst op's weight slot, then the
+        # external-id-matched pattern twin, then the unique same-typed
+        # source; some types need no donor at all (shapes + params
+        # suffice).
+        donor = None
+        if donor_hint is not None and (
+            src_graph.nodes[donor_hint].op.op_type is dpat.type
+        ):
+            donor = src_graph.nodes[donor_hint].op
+        if donor is None:
+            di = self._donor_pattern_idx(dpat)
+            if di is not None and di in match:
+                donor = src_graph.nodes[match[di]].op
+        if donor is not None:
+            try:
+                return type(donor)(
+                    _un(donor.name), list(in_shapes), **donor.attrs
+                )
+            except Exception:
+                return None
+        if dpat.type not in _DONORLESS_TYPES or not in_shapes:
             return None
-        donor = donors[0].op
         try:
-            return type(donor)(
-                _un(donor.name), list(in_shapes), **donor.attrs
+            if dpat.type is OperatorType.CONCAT:
+                nd = dpat.params.get("PM_NUMDIM", in_shapes[0].ndim)
+                ax = _logical_dim(dpat.params.get("PM_AXIS", 0), nd)
+                from flexflow_tpu.ops.shape_ops import ConcatOp
+
+                return ConcatOp(_un("concat"), list(in_shapes), axis=ax)
+            from flexflow_tpu.ops.elementwise import (
+                ElementBinaryOp,
+                ElementUnaryOp,
             )
+
+            if dpat.type in _EW_BINARY_TYPES:
+                if len(in_shapes) != 2:
+                    return None
+                return ElementBinaryOp(
+                    _un(dpat.type.value), list(in_shapes),
+                    binary_type=dpat.type,
+                )
+            if dpat.type in _UNARY_TYPES:
+                return ElementUnaryOp(
+                    _un(dpat.type.value), [in_shapes[0]],
+                    unary_type=dpat.type,
+                )
         except Exception:
             return None
+        return None
 
 
 def _un(base: str) -> str:
@@ -353,11 +474,16 @@ def _parse_rule(r: dict) -> Optional[PatternRule]:
         for (src_id, _) in d.inputs:
             if src_id >= i:
                 return None
-    # dst compute ops need a unique donor of the same type among src
+    # dst compute ops need an attr donor (unique same-type src op, or
+    # an external-id-matched twin) unless the type is constructible
+    # from shapes + params alone
+    rule_probe = PatternRule(name="", src_ops=src, dst_ops=dst,
+                             mapped_outputs=[])
     for d in dst:
-        if d.type not in _PARALLEL_TYPES:
-            if sum(1 for s in src if s.type is d.type) != 1:
-                return None
+        if d.type in _PARALLEL_TYPES or d.type in _DONORLESS_TYPES:
+            continue
+        if rule_probe._donor_pattern_idx(d) is None:
+            return None
     mapped = [
         (m["srcOpId"], m["srcTsId"], m["dstOpId"], m["dstTsId"])
         for m in r.get("mappedOutput", [])

@@ -361,7 +361,10 @@ def test_substitution_json_loader_reference_corpus():
     if not os.path.exists(path):
         pytest.skip("reference corpus not available")
     rules, skipped = load_rule_collection(path)
-    assert len(rules) > 300  # the expressible subset
+    assert len(rules) > 450  # round-3 loader: weight-slot matching +
+    # external-id donors + donor-less Concat/EW/unary constructors
+    # (573/640 as of r3; the rest are weight-concat rules our
+    # weight-owning ops cannot express)
     m = ff.FFModel(ff.FFConfig(num_devices=8))
     x = m.create_tensor([16, 8, 4])
     t = m.repartition(x, dim=1, degree=2)
@@ -448,3 +451,25 @@ def test_weight_sync_per_device_scheduling():
     sync = sim.cost.weight_sync_cost(wa.op, strat(0)[wa.guid])
     assert sync > 0
     assert c_same - c_disj > 0.25 * sync, (c_same, c_disj, sync)
+
+
+def test_horizontal_host_granular_budget_splits():
+    """HORIZONTAL resource partitions (reference: graph.cc:161-295 node
+    -dim splits): on a 3-host x 8-device machine the nonsequence split
+    enumerates whole-host budgets that are NOT divisors of the device
+    count (16 of 24), alongside the divisor-based VERTICAL splits."""
+    spec = MachineSpec.tpu_v5e(24)
+    sim = Simulator(spec, num_devices=24)
+    helper = SearchHelper(sim, 24)
+    pairs = helper._sub_budgets(24)
+    assert (16, 8) in pairs, pairs       # 2 hosts vs 1 host (HORIZONTAL)
+    assert (8, 16) in pairs, pairs
+    assert (12, 12) in pairs, pairs      # divisor split (VERTICAL)
+    # and the search still completes on a 2-component graph at 24 devs
+    cfg = ff.FFConfig(batch_size=48, num_devices=24, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    for br in ("p", "q"):
+        t = m.create_tensor([48, 16], name=f"hin_{br}")
+        t = m.dense(t, 16, name=f"h{br}0")
+    cost, strategy = helper.graph_cost(m.graph)
+    assert math.isfinite(cost) and strategy

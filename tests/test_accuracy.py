@@ -1,0 +1,66 @@
+"""Accuracy regression tier (reference: tests/accuracy_tests.sh runs
+the example models with `-a` for N epochs and a ModelVerification
+callback asserts the reached accuracy — keras/callbacks.py
+VerifyMetrics).  CI-speed form: reduced model/dataset sizes, the same
+train-to-threshold discipline, on the 8-virtual-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras import datasets
+
+
+def test_alexnet_mlp_reaches_accuracy():
+    """The reference's alexnet accuracy gate (accuracy_tests.sh:10) at
+    CI scale: a conv+MLP net on synthetic CIFAR-shaped blobs must reach
+    >=90% train accuracy in a few epochs."""
+    cfg = ff.FFConfig(batch_size=32, epochs=6, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32",
+                      seed=11)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([32, 16, 16, 3], name="image")
+    t = m.conv2d(x, 16, 5, 5, 1, 1, 2, 2, activation="relu", name="conv1")
+    t = m.pool2d(t, 2, 2, 2, 2, name="pool1")
+    t = m.conv2d(t, 32, 3, 3, 1, 1, 1, 1, activation="relu", name="conv2")
+    t = m.pool2d(t, 2, 2, 2, 2, name="pool2")
+    t = m.flat(t, name="flat")
+    t = m.dense(t, 128, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.02),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    n, classes = 512, 4
+    centers = rng.normal(size=(classes, 16 * 16 * 3)).astype(np.float32)
+    y = rng.integers(0, classes, n).astype(np.int32)
+    xs = (centers[y] * 1.5 + rng.normal(size=(n, 16 * 16 * 3))
+          ).reshape(n, 16, 16, 3).astype(np.float32)
+    hist = m.fit(x=xs, y=y, verbose=False)
+    assert hist[-1]["accuracy"] >= 0.9, hist[-1]
+
+
+def test_keras_mnist_reaches_accuracy():
+    """The reference's keras-MNIST accuracy gate (accuracy_tests.sh
+    keras tier, callbacks.VerifyMetrics) through OUR keras frontend and
+    dataset loader (real MNIST when cached locally, deterministic
+    synthetic with the real shapes otherwise)."""
+    from flexflow_tpu import keras
+
+    (x_train, y_train), _ = datasets.mnist.load_data()
+    x_train = (x_train[:1024].astype(np.float32) / 255.0).reshape(-1, 784)
+    y_train = y_train[:1024].astype(np.int32)
+
+    model = keras.Sequential([
+        keras.layers.Dense(64, activation="relu", input_shape=(784,)),
+        keras.layers.Dense(10),
+    ])
+    cfg = ff.FFConfig(batch_size=64, epochs=8, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    cb = keras.callbacks.VerifyMetrics(metric="accuracy", threshold=0.85)
+    hist = model.fit(x_train, y_train, verbose=False, callbacks=[cb])
+    assert hist[-1]["accuracy"] >= 0.85, hist[-1]

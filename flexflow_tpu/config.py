@@ -64,6 +64,10 @@ class FFConfig:
     # execution
     profiling: bool = False
     perform_fusion: bool = True
+    trace_steps: int = 1  # >1: fit() runs this many optimizer steps per
+    # compiled call (lax.scan over stacked batches) — the XLA-native
+    # analogue of the reference's Legion iteration tracing
+    # (flexflow_cffi.py:1867-1874), amortizing per-step dispatch
     remat: bool = False  # rematerialize activations in backward
     # (jax.checkpoint) — trades FLOPs for HBM; the reference has no
     # equivalent (Legion keeps all activations resident)
@@ -115,6 +119,7 @@ class FFConfig:
         p.add_argument("--machine-model-file", type=str, default=None)
         p.add_argument("--taskgraph", dest="export_taskgraph", type=str, default=None)
         p.add_argument("--profiling", action="store_true")
+        p.add_argument("--trace-steps", dest="trace_steps", type=int, default=1)
         p.add_argument("--remat", action="store_true")
         p.add_argument("--seed", type=int, default=0)
         args, _ = p.parse_known_args(argv)
@@ -138,6 +143,7 @@ class FFConfig:
             export_strategy_task_graph_file=args.export_taskgraph,
             machine_model_file=args.machine_model_file,
             profiling=args.profiling,
+            trace_steps=args.trace_steps,
             remat=args.remat,
             seed=args.seed,
         )

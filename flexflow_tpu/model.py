@@ -549,22 +549,54 @@ class FFModel:
         history = []
         t_start = None
         steps_done = 0
+        steps_at_t0 = 0
         stop = False
+        # iteration tracing: run config.trace_steps optimizer steps per
+        # compiled call (train_steps scan) — the Legion begin/end_trace
+        # analogue.  Incompatible with per-step profiling/recompile
+        # checks, which need host control between steps.
+        trace_n = max(1, int(getattr(self.config, "trace_steps", 1)))
+        use_trace = (
+            trace_n > 1
+            and profiler is None
+            and recompile_state is None
+            and jax.process_count() == 1
+            and loader.num_batches >= trace_n
+        )
         for epoch in range(epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             metrics.reset()
             acc = None  # device-side metric accumulation; host sync once/epoch
-            for inputs, labels in loader:
+            batch_iter = (
+                loader.iter_traced(trace_n) if use_trace else
+                (("single", i, l) for i, l in loader)
+            )
+            for kind, inputs, labels in batch_iter:
                 self._rng_counter += 1
                 rng = jax.random.key(self._rng_counter)
                 if profiler is not None:
                     profiler.start_step()
-                (self.params, self.opt_state, self.state, loss, m) = (
-                    self.compiled.train_step(
-                        self.params, self.opt_state, self.state, rng, inputs, labels
+                if kind == "stack":
+                    (self.params, self.opt_state, self.state, losses, ms) = (
+                        self.compiled.train_steps(
+                            self.params, self.opt_state, self.state, rng,
+                            inputs, labels
+                        )
                     )
-                )
+                    loss = losses[-1]
+                    # summing the stacked per-step metric trees equals
+                    # the single-step accumulation below
+                    m = jax.tree.map(lambda a: a.sum(axis=0), ms)
+                    n_this = len(losses)
+                else:
+                    (self.params, self.opt_state, self.state, loss, m) = (
+                        self.compiled.train_step(
+                            self.params, self.opt_state, self.state, rng,
+                            inputs, labels
+                        )
+                    )
+                    n_this = 1
                 if profiler is not None:
                     float(loss)  # fence so the step time is real
                     profiler.end_step()
@@ -575,11 +607,12 @@ class FFModel:
                 else:
                     acc = m if acc is None else jax.tree.map(
                         lambda a, b: a + b, acc, m)
-                steps_done += 1
-                if steps_done == 1:
+                steps_done += n_this
+                if t_start is None:
                     float(loss)  # readback fence (block_until_ready does
                     # not reliably fence through remote-device tunnels)
                     t_start = time.perf_counter()  # skip compile time
+                    steps_at_t0 = steps_done
             if acc is not None:  # None if a recompile landed on the last batch
                 metrics.update(acc)
             if verbose:
@@ -598,8 +631,8 @@ class FFModel:
             return history
         float(loss)  # readback fence before reading the clock
         elapsed = time.perf_counter() - (t_start or time.perf_counter())
-        if steps_done > 1 and elapsed > 0:
-            thr = (steps_done - 1) * batch_size / elapsed
+        if steps_done > steps_at_t0 and elapsed > 0:
+            thr = (steps_done - steps_at_t0) * batch_size / elapsed
             if verbose:
                 print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
             self.last_throughput = thr

@@ -103,3 +103,42 @@ class SingleDataLoader:
             ]
             labels = self._place(self.y, idx, self._label_sharding)
             yield inputs, labels
+
+    def iter_traced(self, n: int):
+        """Yield ('stack', inputs, labels) with a leading [n] step axis
+        for CompiledModel.train_steps (the iteration-trace analogue),
+        then any trailing batches that don't fill a stack as
+        ('single', inputs, labels).  Single-process only."""
+        jax = self._jax
+        order = np.arange(self.num_samples)
+        if self.shuffle:
+            self.rng.shuffle(order)
+        bs = self.batch_size
+        stacks = self.num_batches // n
+        st_in_sh = [
+            self.compiled.stacked_input_sharding(i) for i in range(len(self.xs))
+        ]
+        st_lb_sh = self.compiled.stacked_batch_sharding()
+        for s in range(stacks):
+            idx = order[s * n * bs : (s + 1) * n * bs]
+            inputs = [
+                jax.device_put(
+                    self._gather(a, idx).reshape((n, bs) + a.shape[1:]), sh
+                )
+                for a, sh in zip(self.xs, st_in_sh)
+            ]
+            labels = jax.device_put(
+                self._gather(self.y, idx).reshape((n, bs) + self.y.shape[1:]),
+                st_lb_sh,
+            )
+            yield "stack", inputs, labels
+        for b in range(stacks * n, self.num_batches):
+            idx = order[b * bs : (b + 1) * bs]
+            yield (
+                "single",
+                [
+                    self._place(a, idx, sh)
+                    for a, sh in zip(self.xs, self._in_shardings)
+                ],
+                self._place(self.y, idx, self._label_sharding),
+            )

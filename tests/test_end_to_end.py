@@ -136,3 +136,31 @@ def test_train_steps_matches_sequential():
             np.testing.assert_allclose(
                 np.asarray(p1[opname][wname]), np.asarray(p2[opname][wname]),
                 rtol=1e-5, atol=1e-6)
+
+
+def test_fit_with_trace_steps_matches_metrics():
+    """fit() with config.trace_steps>1 (scanned multi-step, Legion-trace
+    analogue) must reach the same training quality as single-step fit
+    and report identical accumulated metrics for the same data order."""
+    def run(trace_steps):
+        cfg = ff.FFConfig(batch_size=32, epochs=6, num_devices=8,
+                          only_data_parallel=True, compute_dtype="float32",
+                          seed=5, trace_steps=trace_steps)
+        model = ff.FFModel(cfg)
+        x = model.create_tensor([32, 16])
+        t = model.dense(x, 32, activation="relu")
+        t = model.dense(t, 4)
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+                      loss_type="sparse_categorical_crossentropy",
+                      metrics=["accuracy", "sparse_categorical_crossentropy"])
+        data_x, data_y = make_blobs(n=256)
+        return model.fit(x=data_x, y=data_y, shuffle=False, verbose=False)
+
+    h1 = run(1)
+    h4 = run(4)
+    assert h4[-1]["accuracy"] > 0.9, h4[-1]
+    for a, b in zip(h1, h4):
+        np.testing.assert_allclose(a["accuracy"], b["accuracy"], atol=1e-6)
+        np.testing.assert_allclose(
+            a["sparse_categorical_crossentropy"],
+            b["sparse_categorical_crossentropy"], rtol=1e-5)

@@ -38,6 +38,9 @@ class OperatorType(enum.Enum):
     TOPK = "topk"
     MEAN = "mean"
     GATHER = "gather"
+    STACK = "stack"      # TPU-native: batched-branch fusion feeds
+    UNSTACK = "unstack"  # (see ops/shape_ops.py StackOp/UnstackOp)
+    BATCHED_EMBEDDING = "batched_embedding"
 
     # elementwise binary (reference: src/ops/element_binary.cc)
     EW_ADD = "ew_add"

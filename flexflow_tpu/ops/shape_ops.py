@@ -248,3 +248,85 @@ class CastOp(Operator):
 
     def splittable_output_dims(self) -> Tuple[int, ...]:
         return tuple(range(self.output_shapes[0].ndim))
+
+
+@register_op
+class StackOp(Operator):
+    """K same-shaped inputs -> [K, ...] (TPU-native batched-branch
+    fusion feed; no reference equivalent — the reference realizes
+    branch parallelism by PLACING subgraphs on disjoint GPUs,
+    graph.cc:180-205, which GSPMD cannot express.  Stacking the
+    branches and sharding the new leading dim expresses the same
+    parallelism as pure SPMD)."""
+
+    op_type = OperatorType.STACK
+
+    def __init__(self, name, input_shapes):
+        first = input_shapes[0]
+        for s in input_shapes[1:]:
+            assert s.sizes == first.sizes and s.dtype == first.dtype
+        super().__init__(name, input_shapes)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        return (
+            ParallelTensorShape.make(
+                (len(self.input_shapes),) + x.sizes, x.dtype
+            ),
+        )
+
+    def forward(self, ctx, inputs, weights):
+        return [jnp.stack(inputs, axis=0)]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        # inputs unconstrained: GSPMD moves each branch's tensor to
+        # wherever the sharded stack places it (like parallel ops)
+        out = ShardAnnot(mv.dim_degrees, mv.replica_degree)
+        return OpSharding(
+            inputs=(None,) * len(self.input_shapes),
+            weights=(),
+            outputs=(out,),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+    def flops(self) -> float:
+        return 0.0
+
+
+@register_op
+class UnstackOp(Operator):
+    """[K, ...] -> K outputs [...] (inverse of StackOp).  The view
+    ranges over the OUTPUT dims; the branch dim is gathered."""
+
+    op_type = OperatorType.UNSTACK
+
+    def __init__(self, name, input_shapes):
+        super().__init__(name, input_shapes)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        k = x.sizes[0]
+        return tuple(
+            ParallelTensorShape.make(x.sizes[1:], x.dtype) for _ in range(k)
+        )
+
+    def forward(self, ctx, inputs, weights):
+        x = inputs[0]
+        return [x[i] for i in range(x.shape[0])]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        out = ShardAnnot(mv.dim_degrees, mv.replica_degree)
+        in_a = ShardAnnot((1,) + mv.dim_degrees, mv.replica_degree)
+        return OpSharding(
+            inputs=(in_a,),
+            weights=(),
+            outputs=(out,) * len(self.output_shapes),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+    def flops(self) -> float:
+        return 0.0

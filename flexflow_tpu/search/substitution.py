@@ -472,6 +472,7 @@ def generate_all_pcg_xfers(num_devices: int) -> List[GraphXfer]:
     chain simplifications."""
     degrees = [d for d in range(2, num_devices + 1) if num_devices % d == 0]
     xfers: List[GraphXfer] = [
+        BatchEmbeddingsXfer(),
         make_simplify_xfer(),
         make_parallel_chain_fusion_xfer(),
         make_linear_activation_fusion_xfer(),
@@ -485,3 +486,92 @@ def generate_all_pcg_xfers(num_devices: int) -> List[GraphXfer]:
         xfers.append(make_replicate_reduce_xfer(OperatorType.LINEAR, d))
         xfers.append(make_replicate_reduce_xfer(OperatorType.MULTIHEAD_ATTENTION, d))
     return xfers
+
+
+class BatchEmbeddingsXfer:
+    """Fuse K parallel same-signature embeddings into
+    Stack(ids) -> BatchedEmbedding -> Unstack (TPU-native branch
+    batching; no reference equivalent — the reference PLACES each
+    table's subgraph on different GPUs instead, mapper.cc:371-475,
+    which pure-SPMD GSPMD cannot express.  Sharding the stacked branch
+    dim realizes the same table parallelism).  Duck-typed like
+    GraphXfer (find_matches/apply)."""
+
+    name = "batch_parallel_embeddings"
+
+    def find_matches(self, graph: Graph) -> List[Dict[int, int]]:
+        groups: Dict[Tuple, List[int]] = {}
+        for n in graph.topo_order():
+            if n.op.op_type is OperatorType.EMBEDDING:
+                groups.setdefault(n.op.signature(), []).append(n.guid)
+        return [
+            {i: g for i, g in enumerate(gs)}
+            for gs in groups.values()
+            if len(gs) >= 2
+        ]
+
+    def apply(self, graph: Graph, match: Dict[int, int]) -> Optional[Graph]:
+        from flexflow_tpu.ops.embedding import BatchedEmbeddingOp
+        from flexflow_tpu.ops.shape_ops import StackOp, UnstackOp
+
+        g = graph.copy()
+        guids = [match[i] for i in range(len(match))]
+        ops = [g.nodes[gu].op for gu in guids]
+        a = ops[0].attrs
+        id_srcs = []
+        for gu in guids:
+            e = next((e for e in g.in_edges[gu] if e.dst_idx == 0), None)
+            if e is None:
+                return None
+            id_srcs.append((e.src, e.src_idx))
+        in_shapes = [g.nodes[s].op.output_shapes[si] for s, si in id_srcs]
+
+        stack = Node(g._next_guid, StackOp(_uname("stack_ids"), in_shapes))
+        g._next_guid += 1
+        g.add_node(stack)
+        for slot, (s, si) in enumerate(id_srcs):
+            e = Edge(s, stack.guid, si, slot)
+            g.out_edges[s].append(e)
+            g.in_edges[stack.guid].append(e)
+
+        be = Node(
+            g._next_guid,
+            BatchedEmbeddingOp(
+                _uname("batched_embed"),
+                [stack.op.output_shapes[0]],
+                num_tables=len(guids),
+                num_entries=a["num_entries"],
+                out_dim=a["out_dim"],
+                aggr=a["aggr"],
+                kernel_initializer=ops[0]._kernel_init,
+                param_dtype=a["param_dtype"],
+            ),
+        )
+        g._next_guid += 1
+        g.add_node(be)
+        e = Edge(stack.guid, be.guid, 0, 0)
+        g.out_edges[stack.guid].append(e)
+        g.in_edges[be.guid].append(e)
+
+        un = Node(
+            g._next_guid, UnstackOp(_uname("unstack"), [be.op.output_shapes[0]])
+        )
+        g._next_guid += 1
+        g.add_node(un)
+        e = Edge(be.guid, un.guid, 0, 0)
+        g.out_edges[be.guid].append(e)
+        g.in_edges[un.guid].append(e)
+
+        for k, gu in enumerate(guids):
+            for old in list(g.out_edges[gu]):
+                ne = Edge(un.guid, old.dst, k, old.dst_idx)
+                g.out_edges[un.guid].append(ne)
+                g.in_edges[old.dst].append(ne)
+        for gu in guids:
+            g.remove_node(gu)
+        g._invalidate()
+        try:
+            g.topo_order()
+        except ValueError:
+            return None
+        return g

@@ -268,7 +268,7 @@ def test_searched_dlrm_strategy_shards_a_table():
     sharded = []
     for guid, mv in strategy.items():
         op = best_graph.nodes[guid].op
-        if op.op_type.name == "EMBEDDING":
+        if op.op_type.name in ("EMBEDDING", "BATCHED_EMBEDDING"):
             osh = op.propagate(mv)
             w = osh.weights[0]
             if any(d > 1 for d in w.degrees):

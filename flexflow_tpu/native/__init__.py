@@ -60,18 +60,34 @@ def _configure(lib) -> None:
                                     ctypes.c_int64, ctypes.c_int64, c_i32]
 
 
+def _lib_stale() -> bool:
+    """True when the built .so predates any native source (the ABI has
+    changed across rounds; loading a stale library would mis-call new
+    signatures)."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    src_dir = os.path.join(_NATIVE_DIR, "src")
+    newest = os.path.getmtime(os.path.join(_NATIVE_DIR, "Makefile")) if \
+        os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")) else 0.0
+    if os.path.isdir(src_dir):
+        for f in os.listdir(src_dir):
+            newest = max(newest, os.path.getmtime(os.path.join(src_dir, f)))
+    return newest > lib_mtime
+
+
 def get_lib():
-    """The loaded native library, building it if necessary; None when
-    disabled or unbuildable."""
+    """The loaded native library, (re)building it when missing or stale;
+    None when disabled or unbuildable."""
     global _lib, _lib_tried
     if _lib_tried:
         return _lib
     _lib_tried = True
     if os.environ.get("FLEXFLOW_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_LIB_PATH):
+    if _lib_stale():
         try:
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-B"], check=True,
                            capture_output=True, timeout=120)
         except (subprocess.SubprocessError, OSError):
             return None
@@ -79,7 +95,9 @@ def get_lib():
         lib = ctypes.CDLL(_LIB_PATH)
         _configure(lib)
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a symbol missing from a stale/foreign .so —
+        # fall back to the pure-Python paths rather than crash
         _lib = None
     return _lib
 

@@ -117,6 +117,10 @@ class EmbeddingOp(Operator):
         r = 1
         for ax in vocab_axes:
             r *= mesh.shape[ax]
+        if a["num_entries"] % r != 0:
+            # uneven vocab split: shard_map cannot tile the table dim;
+            # fall back to the GSPMD path, which pads
+            return None
         vshard = a["num_entries"] // r
 
         def local(ids, table):

@@ -114,7 +114,9 @@ class SingleDataLoader:
         if self.shuffle:
             self.rng.shuffle(order)
         bs = self.batch_size
-        stacks = self.num_batches // n
+        # stacks use FULL batches only — with drop_remainder=False the
+        # final partial batch goes through the 'single' path below
+        stacks = (self.num_samples // bs) // n
         st_in_sh = [
             self.compiled.stacked_input_sharding(i) for i in range(len(self.xs))
         ]

@@ -71,11 +71,11 @@ class Simulator:
         degrees at the same start) serialize, like same-device ops.
         With use_start=False the offset is ignored (default executable
         mode, where GSPMD has no placement offsets)."""
-        key = (mv.num_parts, mv.start_part if use_start else 0)
+        start = (mv.start_part % self.num_devices) if use_start else 0
+        key = (mv.num_parts, start)
         hit = self._device_sets.get(key)
         if hit is None:
             n = min(max(1, mv.num_parts), self.num_devices)
-            start = mv.start_part % self.num_devices
             hit = frozenset((start + i) % self.num_devices for i in range(n))
             self._device_sets[key] = hit
         return hit

@@ -13,8 +13,18 @@ from flexflow_tpu.models import build_transformer
 
 def main():
     config = ff.FFConfig.parse_args()
-    model = build_transformer(config, num_layers=12, hidden=512, num_heads=8,
-                              ff_dim=2048, seq_len=512)
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        # full reference size (transformer.cc:112-211: 12-layer encoder)
+        model = build_transformer(config, num_layers=12, hidden=512,
+                                  num_heads=8, ff_dim=2048, seq_len=512)
+    else:
+        # CPU smoke size: XLA CPU compiles the full-size 8-way-sharded
+        # program impractically slowly (SPMD rematerialization); the
+        # reference sizes examples per-hardware via flags the same way
+        model = build_transformer(config, num_layers=4, hidden=256,
+                                  num_heads=4, ff_dim=512, seq_len=128)
     run_example(model, "transformer", loss="mean_squared_error",
                 metrics=["mean_squared_error"])
 

@@ -61,10 +61,16 @@ def compute_loss(loss_type: LossType, logits: jax.Array, labels: jax.Array) -> j
     if loss_type is LossType.CATEGORICAL_CROSSENTROPY:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.mean(jnp.sum(labels.astype(jnp.float32) * logp, axis=-1))
-    if loss_type in (
-        LossType.MEAN_SQUARED_ERROR,
-        LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
-    ):
+    if loss_type is LossType.MEAN_SQUARED_ERROR:
+        # Keras semantics for the Keras-named loss: mean over ALL
+        # elements.  (The reference's MSE kernel divides by batch only,
+        # loss_functions.h:26-63 — that scale made gradients grow with
+        # the per-sample element count, so the default lr diverged on
+        # seq models; use _AVG_REDUCE below for reference parity.)
+        d = logits.astype(jnp.float32) - _match_shape(labels, logits)
+        return jnp.mean(d * d)
+    if loss_type is LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+        # reference parity: sum over non-batch dims, mean over batch
         d = logits.astype(jnp.float32) - _match_shape(labels, logits)
         return jnp.mean(jnp.sum(d * d, axis=tuple(range(1, d.ndim))))
     if loss_type is LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:

@@ -28,7 +28,9 @@ def _ff_weight_grads(module, x, target):
     t = model.create_tensor(list(x.shape))
     PyTorchModel(module).torch_to_ff(model, [t])
     model.compile(optimizer=ff.SGDOptimizer(lr=1.0, momentum=0.0, weight_decay=0.0),
-                  loss_type="mean_squared_error",
+                  loss_type="mean_squared_error_avg_reduce",  # reference
+                  # loss semantics — matches the torch-side sum-per-
+                  # sample/mean-over-batch reduction below
                   metrics=["mean_squared_error"])
     transfer_torch_weights(module, model)
     logits = np.asarray(
@@ -181,7 +183,7 @@ def test_align_view_embedding():
     t = model.create_tensor([n, 4], dtype="int32")
     PyTorchModel(m).torch_to_ff(model, [t])
     model.compile(optimizer=ff.SGDOptimizer(lr=1.0, momentum=0.0, weight_decay=0.0),
-                  loss_type="mean_squared_error", metrics=["mean_squared_error"])
+                  loss_type="mean_squared_error_avg_reduce", metrics=["mean_squared_error"])
     transfer_torch_weights(m, model)
     ff_out = np.asarray(model.compiled.forward_fn()(
         model.params, model.state, [ids.astype(np.int32)]))

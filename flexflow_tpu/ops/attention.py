@@ -176,7 +176,15 @@ class MultiHeadAttentionOp(Operator):
                 causal=a["causal"], scale=scale,
                 batch_axes=(ctx.slot_axes or {}).get(0, ()),
             )
-        if a["use_flash"] and not dropout_active:
+        # Shape heuristic (measured on v5e, see kernels/flash_attention):
+        # below ~512 keys the [Sq,Sk] tile fits comfortably and XLA's
+        # fused attention beats the Pallas kernel's launch + lse/delta
+        # traffic; above it flash wins (3x at 4k, and XLA falls off a
+        # memory cliff by 8k).  Long-Sq cross-attention also wants flash
+        # (the materialized logits scale with Sq*Sk).
+        sq_, sk_ = qh.shape[1], kh.shape[1]
+        flash_profitable = sk_ >= 512 or sq_ * sk_ >= 512 * 2048
+        if a["use_flash"] and flash_profitable and not dropout_active:
             try:
                 from flexflow_tpu.kernels.flash_attention import flash_attention
 

@@ -34,7 +34,10 @@ def _model_specs():
         build_candle_uno,
         build_dlrm,
         build_inception_v3,
+        build_mlp_unify,
+        build_resnext50,
         build_transformer,
+        build_xdl,
     )
 
     return {
@@ -68,6 +71,28 @@ def _model_specs():
             exec_build=None,  # 299x299 convs are not executable in
             # reasonable time on a CPU mesh; sim-only there
             exec_batch=16,
+        ),
+        # the remaining osdi22ae scripts: resnext-50.sh, xdl.sh, mlp.sh
+        "resnext50": dict(
+            build=lambda cfg: build_resnext50(cfg),
+            batch=64, budget=10, loss="sparse_categorical_crossentropy",
+            exec_build=None,  # 224x224 grouped convs: sim-only on CPU
+            exec_batch=16,
+        ),
+        "xdl": dict(
+            build=lambda cfg: build_xdl(cfg),
+            batch=64, budget=20, loss="mean_squared_error",
+            exec_build=lambda cfg: build_xdl(
+                cfg, num_tables=8, vocab=20000, embedding_dim=16,
+                mlp=(64, 32, 1)),
+            exec_batch=64,
+        ),
+        "mlp": dict(
+            build=lambda cfg: build_mlp_unify(cfg),
+            batch=64, budget=20, loss="sparse_categorical_crossentropy",
+            exec_build=lambda cfg: build_mlp_unify(
+                cfg, in_dim=512, hidden=(512, 512, 512)),
+            exec_batch=32,
         ),
     }
 
@@ -163,7 +188,9 @@ def execute_pair(name, spec, n_devices, steps):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--models", default="bert,dlrm,candle_uno,inception")
+    ap.add_argument(
+        "--models",
+        default="bert,dlrm,candle_uno,inception,resnext50,xdl,mlp")
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--cpu-mesh", action="store_true",
@@ -173,6 +200,12 @@ def main():
     ap.add_argument("--calibrate", action="store_true",
                     help="measure per-(op,view) costs on the live backend "
                          "first (search/calibration.py) and rank with them")
+    ap.add_argument("--load-calibration", action="store_true",
+                    help="rank with an existing --calibration-file (e.g. "
+                         "measured earlier on the real TPU) instead of "
+                         "probing the live backend — the way to combine "
+                         "TPU-calibrated sim ratios with CPU-mesh "
+                         "executed ratios")
     ap.add_argument("--calibration-file", default="CALIBRATION.json")
     args = ap.parse_args()
 
@@ -187,7 +220,13 @@ def main():
     specs = _model_specs()
     names = [n for n in args.models.split(",") if n in specs]
     calibration = None
-    if args.calibrate:
+    if args.load_calibration:
+        from flexflow_tpu.search.calibration import CalibrationTable
+
+        calibration = CalibrationTable.load(args.calibration_file)
+        print(f"# loaded {len(calibration)} calibration records from "
+              f"{args.calibration_file}")
+    elif args.calibrate:
         from flexflow_tpu.search.calibration import (
             CalibrationTable,
             calibrate_graph,
@@ -195,7 +234,21 @@ def main():
 
         import flexflow_tpu as ff
 
-        calibration = CalibrationTable()
+        live = jax.devices()[0].platform
+        if os.path.exists(args.calibration_file):
+            calibration = CalibrationTable.load(args.calibration_file)
+            if calibration.backend not in (None, live):
+                # mixing probes from different backends would mislabel
+                # the table's provenance — start fresh on this backend
+                print(f"# existing calibration is from "
+                      f"{calibration.backend!r}, live backend is {live!r}: "
+                      f"recalibrating from scratch")
+                calibration = CalibrationTable()
+            else:
+                print(f"# resuming calibration: {len(calibration)} existing "
+                      f"records")
+        else:
+            calibration = CalibrationTable()
         for n in names:
             cfg = ff.FFConfig(batch_size=specs[n]["batch"],
                               num_devices=args.devices)
@@ -207,6 +260,8 @@ def main():
 
     report = {"devices": args.devices,
               "calibrated": bool(calibration) and len(calibration) > 0,
+              "calibration_backend": getattr(calibration, "backend", None)
+              if calibration else None,
               "backend": jax.devices()[0].platform,
               "models": {}}
     can_exec = len(jax.devices()) >= args.devices
@@ -245,9 +300,14 @@ def main():
             f"{r.get('exec_ratio', '—')} | "
             f"{r.get('exec_backend', '—')}/{r.get('exec_scale', '—')} | "
             f"{r['search_seconds']} |")
+    cal_note = (
+        f"Calibrated cost model: {report['calibrated']}"
+        + (f" (probes measured on {report['calibration_backend']})."
+           if report.get("calibration_backend") else ".")
+    )
     lines += [
         "",
-        f"Calibrated cost model: {report['calibrated']}.",
+        cal_note,
         "Honesty notes: the simulator's DLRM DP cost is dominated by the "
         "full-table gradient allreduce (the real phenomenon Unity "
         "exploits, dlrm.cc + osdi22ae/dlrm.sh); executed ratios on a CPU "

@@ -73,9 +73,11 @@ def device_trace(logdir: str):
 
 def measure_operator_cost(op, batch_inputs=None,
                           warmup: int = 2, repeats: int = 5,
-                          weight_shapes=None) -> float:
+                          weight_shapes=None):
     """Median wall seconds of one jitted forward of ``op`` on the real
-    device (reference: Op::measure_operator_cost + model.cu:38-74).
+    device, or None when the op has no floating input/weight to thread
+    a timing dependence through (reference: Op::measure_operator_cost +
+    model.cu:38-74).
 
     Builds zero inputs from the op's input shapes unless given; weights
     are initialized via the op's specs (``weight_shapes`` overrides
@@ -104,20 +106,68 @@ def measure_operator_cost(op, batch_inputs=None,
         name, shape, dtype, fill = spec
         state_in[f"{op.name}/{name}"] = jnp.full(shape, fill, dtype)
 
-    def fwd(inputs, weights):
-        ctx = LoweringContext(
-            compute_dtype=jnp.float32, train=False, rng=jax.random.key(1),
-            seq_length=-1, state_in=dict(state_in), mesh=None,
-        )
-        outs = op.forward(ctx, inputs, weights)
-        return [jnp.sum(o) for o in outs]  # force materialization
+    # Through a remote-device tunnel (axon) a single dispatch costs tens
+    # of ms and block_until_ready can hang outright, so per-op timing
+    # must (a) fence with a host scalar readback and (b) amortize: run
+    # the op N times inside ONE jitted lax.scan with a serial data
+    # dependence through the carry, then difference two scan lengths —
+    # both the round-trip latency and the dispatch cost cancel.
+    # Serial dependence: perturb the first floating input (or weight)
+    # by a scalar derived from the previous iteration's outputs.
+    tgt_kind, tgt_key = None, None
+    for i, x in enumerate(batch_inputs):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            tgt_kind, tgt_key = "input", i
+            break
+    if tgt_kind is None:
+        for name, w in weights.items():
+            if jnp.issubdtype(jnp.asarray(w).dtype, jnp.floating):
+                tgt_kind, tgt_key = "weight", name
+                break
+    if tgt_kind is None:
+        # no floating leaf to thread the carry through: the scan body
+        # would be loop-invariant, XLA would hoist the op out, and the
+        # "measurement" would be the 1e-9 floor — poisoning the
+        # calibration table with a free op.  Decline instead; callers
+        # keep the analytic roofline for such (integer-only) ops.
+        return None
 
-    jfwd = jax.jit(fwd)
-    for _ in range(warmup):
-        jax.block_until_ready(jfwd(batch_inputs, weights))
-    times = []
-    for _ in range(repeats):
+    def make(n):
+        def fn(inputs, weights):
+            def body(c, _):
+                ins = list(inputs)
+                ws = dict(weights)
+                if tgt_kind == "input":
+                    ins[tgt_key] = ins[tgt_key] + c.astype(ins[tgt_key].dtype)
+                elif tgt_kind == "weight":
+                    ws[tgt_key] = ws[tgt_key] + c.astype(ws[tgt_key].dtype)
+                ctx = LoweringContext(
+                    compute_dtype=jnp.float32, train=False,
+                    rng=jax.random.key(1), seq_length=-1,
+                    state_in=dict(state_in), mesh=None,
+                )
+                outs = op.forward(ctx, ins, ws)
+                s = sum(jnp.sum(o).astype(jnp.float32) for o in outs)
+                # tiny magnitude keeps the perturbation from changing
+                # the op's numeric regime while preserving dependence
+                return s * jnp.float32(1e-30), None
+
+            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
+            return c
+
+        return jax.jit(fn)
+
+    n1, n2 = 2, 2 + 5 * max(1, repeats)
+    j1, j2 = make(n1), make(n2)
+    for _ in range(max(1, warmup)):
+        float(j1(batch_inputs, weights))
+        float(j2(batch_inputs, weights))
+    diffs = []
+    for _ in range(max(1, repeats)):
         t0 = time.perf_counter()
-        jax.block_until_ready(jfwd(batch_inputs, weights))
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+        float(j1(batch_inputs, weights))
+        t1 = time.perf_counter()
+        float(j2(batch_inputs, weights))
+        diffs.append((time.perf_counter() - t1) - (t1 - t0))
+    per_iter = float(np.median(diffs)) / (n2 - n1)
+    return max(per_iter, 1e-9)

@@ -36,6 +36,7 @@ class CalibrationTable:
 
     def __init__(self):
         self._t: Dict[Key, float] = {}
+        self.backend: Optional[str] = None  # platform the probes ran on
 
     @staticmethod
     def key(op, mv: MachineView) -> Key:
@@ -55,18 +56,29 @@ class CalibrationTable:
         return len(self._t)
 
     def save(self, path: str) -> None:
+        if self.backend is None:
+            try:
+                import jax
+
+                self.backend = jax.devices()[0].platform
+            except Exception:  # pragma: no cover
+                pass
         rows = [
             {"sig": k[0], "degrees": list(k[1]), "replica": k[2], "seconds": v}
             for k, v in sorted(self._t.items())
         ]
         with open(path, "w") as f:
-            json.dump({"version": 1, "records": rows}, f, indent=1)
+            json.dump(
+                {"version": 1, "backend": self.backend, "records": rows},
+                f, indent=1,
+            )
 
     @staticmethod
     def load(path: str) -> "CalibrationTable":
         table = CalibrationTable()
         with open(path) as f:
             data = json.load(f)
+        table.backend = data.get("backend")
         for r in data.get("records", []):
             table._t[(r["sig"], tuple(r["degrees"]), int(r["replica"]))] = float(
                 r["seconds"]
@@ -149,6 +161,13 @@ def calibrate_graph(
             if table.get(op, mv) is not None:
                 continue
             if time.monotonic() > deadline:
+                from flexflow_tpu.utils.logging import SEARCH_LOG as log
+
+                log.log(
+                    f"calibration budget ({time_budget_s:.0f}s) spent at "
+                    f"node {node.op.name!r}: later (op, view) probes keep "
+                    f"the analytic roofline"
+                )
                 return table
             t = measure_op_view(op, mv, repeats=repeats)
             if t is not None and math.isfinite(t) and t > 0:

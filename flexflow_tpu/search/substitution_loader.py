@@ -89,11 +89,15 @@ _PARALLEL_TYPES = {
     OperatorType.REDUCTION,
 }
 
+# TASO ActiMode encoding used by the corpus' PM_ACTI values
+_ACTI_MAP = {0: None, 1: "sigmoid", 2: "relu", 3: "tanh"}
+
 # dst op types constructible from input shapes + pattern params alone —
 # no same-typed source op ("donor") needed (e.g. TASO rules whose dst
 # introduces a Concat/activation the source pattern lacks)
 _DONORLESS_TYPES = {
     OperatorType.CONCAT,
+    OperatorType.SPLIT,
     OperatorType.RELU,
     OperatorType.SIGMOID,
     OperatorType.TANH,
@@ -196,12 +200,16 @@ class PatternRule:
                     # weights as explicit pattern inputs (linear = (x, w));
                     # our ops OWN their weights, so an external ref with no
                     # edge binds the op's own weight tensor instead.
+                    # Externals are identified by their negative opId —
+                    # tsId is 0 throughout the corpus: keying by tsId
+                    # would conflate distinct externals (-1 vs -2) and
+                    # only ever match rules whose externals coincide.
                     if src_id < 0 and node.op._weight_specs:
                         srcref = ("w", guid, slot)
-                        if ts_id in new_ext and new_ext[ts_id] != srcref:
+                        if src_id in new_ext and new_ext[src_id] != srcref:
                             ok = False
                             break
-                        new_ext[ts_id] = srcref
+                        new_ext[src_id] = srcref
                         continue
                     ok = False
                     break
@@ -212,12 +220,11 @@ class PatternRule:
                         ok = False
                         break
                 else:
-                    key = ts_id
                     srcref = (e.src, e.src_idx)
-                    if key in new_ext and new_ext[key] != srcref:
+                    if src_id in new_ext and new_ext[src_id] != srcref:
                         ok = False
                         break
-                    new_ext[key] = srcref
+                    new_ext[src_id] = srcref
             if not ok:
                 continue
             binding[i] = guid
@@ -236,6 +243,14 @@ class PatternRule:
                 ndim = node.op.output_shapes[0].ndim
                 if node.op.attrs.get("dim") != _logical_dim(dim, ndim):
                     return False
+        if "PM_ACTI" in pat.params and pat.type is OperatorType.LINEAR:
+            # TASO rules distinguish fused-activation linears (e.g.
+            # taso_rule_257 rewrites a relu twin differently); matching
+            # a none-activation node with a relu pattern would rewrite
+            # to a semantically different graph
+            want = _ACTI_MAP.get(pat.params["PM_ACTI"], "?")
+            if node.op.attrs.get("activation") != want:
+                return False
         return True
 
     def _escape_check(self, graph, binding) -> bool:
@@ -257,8 +272,8 @@ class PatternRule:
         # resolve external inputs from the matched source ops; externals
         # with no tensor edge are the matched op's OWN weights (see
         # _extend) and resolve to their owner for donor lookup
-        ext: Dict[int, Tuple[int, int]] = {}
-        w_ext: Dict[int, int] = {}  # ts_id -> owning node guid
+        ext: Dict[int, Tuple[int, int]] = {}  # external opId -> tensor ref
+        w_ext: Dict[int, int] = {}  # external opId -> owning node guid
         for p_idx, guid in match.items():
             pat = self.src_ops[p_idx]
             for slot, (src_id, ts_id) in enumerate(pat.inputs):
@@ -268,21 +283,23 @@ class PatternRule:
                     )
                     if e is None:
                         if graph.nodes[guid].op._weight_specs:
-                            w_ext[ts_id] = guid
+                            w_ext[src_id] = guid
                             continue
                         return None
-                    ext[ts_id] = (e.src, e.src_idx)
+                    ext[src_id] = (e.src, e.src_idx)
 
-        # collect external consumers of mapped outputs before deletion
-        rewires: List[Tuple[Edge, int, int]] = []  # (old edge, dstOp, dstTs)
+        # collect external consumers of mapped outputs before deletion,
+        # remembering the shape each consumer expects
+        rewires: List[Tuple[Edge, int, int, Tuple[int, ...]]] = []
         bound = set(match.values())
         for s_op, s_ts, d_op, d_ts in self.mapped_outputs:
             guid = match.get(s_op)
             if guid is None:
                 return None
+            old_shape = tuple(g.nodes[guid].op.output_shapes[s_ts].sizes)
             for e in list(g.out_edges[guid]):
                 if e.dst not in bound and e.src_idx == s_ts:
-                    rewires.append((e, d_op, d_ts))
+                    rewires.append((e, d_op, d_ts, old_shape))
 
         # instantiate destination ops in index order (inputs may only
         # reference lower indices or externals, which holds for the
@@ -293,12 +310,12 @@ class PatternRule:
             donor_hint: Optional[int] = None
             for (src_id, ts_id) in dpat.inputs:
                 if src_id < 0:
-                    if ts_id in ext:
-                        in_refs.append(ext[ts_id])
-                    elif ts_id in w_ext:
+                    if src_id in ext:
+                        in_refs.append(ext[src_id])
+                    elif src_id in w_ext:
                         # weight slot: our dst op owns its weight — no
                         # edge; the weight's owner is the attr donor
-                        donor_hint = w_ext[ts_id]
+                        donor_hint = w_ext[src_id]
                     else:
                         return None
                 else:
@@ -312,7 +329,8 @@ class PatternRule:
                 if src_node is None or src_idx >= len(src_node.op.output_shapes):
                     return None
                 in_shapes.append(src_node.op.output_shapes[src_idx])
-            op = self._make_dst_op(dpat, in_shapes, match, graph, donor_hint)
+            op = self._make_dst_op(dpat, in_shapes, match, graph, donor_hint,
+                                   work_graph=g, in_refs=in_refs)
             if op is None:
                 return None
             node = Node(g._next_guid, op)
@@ -327,9 +345,15 @@ class PatternRule:
         # delete matched source ops, then rewire external consumers
         for guid in match.values():
             g.remove_node(guid)
-        for old_e, d_op, d_ts in rewires:
+        for old_e, d_op, d_ts, old_shape in rewires:
             dn = new_nodes.get(d_op)
             if dn is None:
+                return None
+            if (d_ts >= len(dn.op.output_shapes)
+                    or tuple(dn.op.output_shapes[d_ts].sizes) != old_shape):
+                # the instantiated dst graph does not reproduce the
+                # tensor this consumer was reading — reject instead of
+                # silently corrupting downstream shapes
                 return None
             ne = Edge(dn.guid, old_e.dst, d_ts, old_e.dst_idx)
             g.out_edges[dn.guid].append(ne)
@@ -343,24 +367,69 @@ class PatternRule:
 
     def _donor_pattern_idx(self, dpat: PatternOp) -> Optional[int]:
         """Which source-pattern op donates attrs to ``dpat``: the unique
-        same-typed src op, or — with several candidates — the one
-        sharing an external input id (the corpus wires each op's weight
-        as a distinct external tensor, so sharing ``-k`` identifies the
-        pre-rewrite twin, the reference's matchOpX convention)."""
+        same-typed param-consistent src op, or — with several
+        candidates — the one sharing an external input id (the corpus
+        wires each op's weight as a distinct external tensor ``-k``, so
+        sharing the id identifies the pre-rewrite twin, the reference's
+        matchOpX convention)."""
+
+        # PM_ACTI is overridden from dpat at instantiation (see
+        # _make_dst_op), so donors may legitimately differ on it (the
+        # relu-fusion family, e.g. taso_rule_257's dst relu-linear
+        # donates from the plain src linear)
+        overridable = (
+            {"PM_ACTI"} if dpat.type is OperatorType.LINEAR else set()
+        )
+
+        def params_consistent(s: PatternOp) -> bool:
+            shared = (set(s.params) & set(dpat.params)) - overridable
+            return all(s.params[k] == dpat.params[k] for k in shared)
+
         cands = [
-            i for i, s in enumerate(self.src_ops) if s.type is dpat.type
+            i for i, s in enumerate(self.src_ops)
+            if s.type is dpat.type and params_consistent(s)
         ]
         if len(cands) == 1:
             return cands[0]
-        d_ext = {ts for (sid, ts) in dpat.inputs if sid < 0}
-        for i in cands:
-            s_ext = {ts for (sid, ts) in self.src_ops[i].inputs if sid < 0}
-            if d_ext & s_ext:
-                return i
+        # several candidates: the pre-rewrite twin is the one sharing an
+        # external tensor id — externals are identified by their
+        # (negative) opId; tsId is 0 throughout the corpus and
+        # identifies nothing
+        d_ext = {sid for (sid, ts) in dpat.inputs if sid < 0}
+        ext_matches = [
+            i for i in cands
+            if d_ext & {sid for (sid, ts) in self.src_ops[i].inputs
+                        if sid < 0}
+        ]
+        if len(ext_matches) == 1:
+            return ext_matches[0]
+        pool = ext_matches or cands
+        if not pool:
+            return None
+        # still ambiguous: prefer an exact-param twin (e.g. the same
+        # PM_ACTI); otherwise any candidate works IF the pool is
+        # mutually param-identical modulo overridable keys (rule 257:
+        # two linears sharing weight -4, differing only in fused acti) —
+        # apply-time shape re-propagation rejects bad instantiations
+        exact = [
+            i for i in pool
+            if self.src_ops[i].params == dpat.params
+        ]
+        if len(exact) == 1:
+            return exact[0]
+        first = self.src_ops[pool[0]]
+        if all(
+            {k: v for k, v in self.src_ops[i].params.items()
+             if k not in overridable}
+            == {k: v for k, v in first.params.items() if k not in overridable}
+            for i in pool[1:]
+        ):
+            return pool[0]
         return None
 
     def _make_dst_op(self, dpat: PatternOp, in_shapes, match, src_graph,
-                     donor_hint: Optional[int] = None):
+                     donor_hint: Optional[int] = None,
+                     work_graph=None, in_refs=None):
         if dpat.type in _PARALLEL_TYPES:
             dim, deg = dpat.parallel_dim_degree()
             if deg is None:
@@ -393,8 +462,15 @@ class PatternRule:
                 donor = src_graph.nodes[match[di]].op
         if donor is not None:
             try:
+                attrs = dict(donor.attrs)
+                if "PM_ACTI" in dpat.params and dpat.type is OperatorType.LINEAR:
+                    # the dst op's own declared activation wins over the
+                    # donor's (e.g. taso_rule_257 fuses the src relu
+                    # INTO the rewritten linear)
+                    attrs["activation"] = _ACTI_MAP.get(
+                        dpat.params["PM_ACTI"])
                 return type(donor)(
-                    _un(donor.name), list(in_shapes), **donor.attrs
+                    _un(donor.name), list(in_shapes), **attrs
                 )
             except Exception:
                 return None
@@ -407,6 +483,42 @@ class PatternRule:
                 from flexflow_tpu.ops.shape_ops import ConcatOp
 
                 return ConcatOp(_un("concat"), list(in_shapes), axis=ax)
+            if dpat.type is OperatorType.SPLIT:
+                # batched-communication rules (taso_rule_419 family):
+                # split sizes come from the upstream dst Concat this
+                # Split undoes — trace through intervening parallel ops
+                n_out = dpat.params.get("PM_NUM_OUTPUTS")
+                if not n_out:
+                    return None
+                ax = _logical_dim(dpat.params.get("PM_AXIS", 0),
+                                  in_shapes[0].ndim)
+                from flexflow_tpu.ops.shape_ops import ConcatOp, SplitOp
+
+                sizes = None
+                if work_graph is not None and in_refs:
+                    node = work_graph.nodes.get(in_refs[0][0])
+                    for _ in range(8):
+                        if node is None:
+                            break
+                        if isinstance(node.op, ConcatOp):
+                            if node.op.attrs.get("axis") == ax and len(
+                                    node.op.input_shapes) == n_out:
+                                sizes = [s.sizes[ax]
+                                         for s in node.op.input_shapes]
+                            break
+                        if node.op.op_type not in _PARALLEL_TYPES:
+                            break
+                        e = next((e for e in work_graph.in_edges[node.guid]
+                                  if e.dst_idx == 0), None)
+                        node = work_graph.nodes.get(e.src) if e else None
+                if sizes is None:
+                    if in_shapes[0].sizes[ax] % n_out != 0:
+                        return None
+                    sizes = [in_shapes[0].sizes[ax] // n_out] * n_out
+                if sum(sizes) != in_shapes[0].sizes[ax]:
+                    return None
+                return SplitOp(_un("split"), [in_shapes[0]],
+                               sizes=tuple(sizes), axis=ax)
             from flexflow_tpu.ops.elementwise import (
                 ElementBinaryOp,
                 ElementUnaryOp,

@@ -361,10 +361,10 @@ def test_substitution_json_loader_reference_corpus():
     if not os.path.exists(path):
         pytest.skip("reference corpus not available")
     rules, skipped = load_rule_collection(path)
-    assert len(rules) > 450  # round-3 loader: weight-slot matching +
-    # external-id donors + donor-less Concat/EW/unary constructors
-    # (573/640 as of r3; the rest are weight-concat rules our
-    # weight-owning ops cannot express)
+    assert len(rules) == 640 and skipped == 0  # full corpus as of r3:
+    # weight-slot matching, external-id (negative opId) keyed donors,
+    # PM_ACTI-aware matching/instantiation, donor-less
+    # Concat/Split/EW/unary constructors
     m = ff.FFModel(ff.FFConfig(num_devices=8))
     x = m.create_tensor([16, 8, 4])
     t = m.repartition(x, dim=1, degree=2)
@@ -473,3 +473,65 @@ def test_horizontal_host_granular_budget_splits():
         t = m.dense(t, 16, name=f"h{br}0")
     cost, strategy = helper.graph_cost(m.graph)
     assert math.isfinite(cost) and strategy
+
+
+def test_json_batched_comm_rule_applies_split():
+    """The taso_rule_419 family (partition(x1) + partition(x2) ->
+    split(partition(concat(x1, x2)))) requires distinct externals keyed
+    by negative opId and a donor-less Split sized from the dst Concat —
+    both round-3 loader fixes.  Verify one such rule fires on a graph
+    with two DIFFERENT input tensors and yields uneven split sizes."""
+    import os
+
+    from flexflow_tpu.search.substitution_loader import load_rule_collection
+
+    path = "/root/reference/substitutions/graph_subst_3_v2.json"
+    if not os.path.exists(path):
+        pytest.skip("reference corpus not available")
+    rules, _ = load_rule_collection(path)
+    rule = next(r for r in rules if r.name == "taso_rule_419")
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    # the rule concats along logical axis 0 (PM_AXIS 2 of NUMDIM 3):
+    # different batch sizes -> uneven split sizes
+    x1 = m.create_tensor([16, 8, 4])
+    x2 = m.create_tensor([24, 8, 4])
+    a = m.repartition(x1, dim=1, degree=2)
+    b = m.repartition(x2, dim=1, degree=2)
+    m.dense(a, 8)
+    m.dense(b, 8)
+    matches = rule.find_matches(m.graph)
+    assert matches, "rule must match two partitions of DISTINCT tensors"
+    applied = None
+    for match in matches:
+        applied = rule.apply(m.graph, match)
+        if applied is not None:
+            break
+    assert applied is not None
+    applied.topo_order()
+    split_ops = [n.op for n in applied.nodes.values()
+                 if n.op.__class__.__name__ == "SplitOp"]
+    assert split_ops and tuple(split_ops[0].attrs["sizes"]) == (16, 24)
+
+
+def test_json_rule_acti_matching_discriminates():
+    """PM_ACTI on a LINEAR pattern must only match graph linears with
+    that activation (taso_rule_257 distinguishes a relu twin; matching
+    a plain linear with a relu pattern would change semantics)."""
+    import os
+
+    from flexflow_tpu.search.substitution_loader import load_rule_collection
+
+    path = "/root/reference/substitutions/graph_subst_3_v2.json"
+    if not os.path.exists(path):
+        pytest.skip("reference corpus not available")
+    rules, _ = load_rule_collection(path)
+    rule = next(r for r in rules if r.name == "taso_rule_257")
+    # src pattern: reduce(x) -> linear(acti=0) AND linear(x, acti=relu)
+    # sharing the same weight external.  Build the graph WITHOUT the
+    # relu linear: the rule must not match.
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    x = m.create_tensor([16, 8])
+    r_ = m.reduction(m.replicate(x, degree=2), degree=2)
+    m.dense(r_, 8)  # acti None
+    m.dense(x, 8)   # acti None (pattern wants relu here)
+    assert rule.find_matches(m.graph) == []

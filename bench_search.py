@@ -223,6 +223,12 @@ def main():
     if args.load_calibration:
         from flexflow_tpu.search.calibration import CalibrationTable
 
+        if args.calibrate:
+            print("# --load-calibration takes precedence over --calibrate: "
+                  "using the existing file, no new probes")
+        if not os.path.exists(args.calibration_file):
+            ap.error(f"--load-calibration: {args.calibration_file} does not "
+                     "exist (run with --calibrate first, e.g. on the TPU)")
         calibration = CalibrationTable.load(args.calibration_file)
         print(f"# loaded {len(calibration)} calibration records from "
               f"{args.calibration_file}")

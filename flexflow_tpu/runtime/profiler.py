@@ -75,9 +75,11 @@ def measure_operator_cost(op, batch_inputs=None,
                           warmup: int = 2, repeats: int = 5,
                           weight_shapes=None):
     """Median wall seconds of one jitted forward of ``op`` on the real
-    device, or None when the op has no floating input/weight to thread
-    a timing dependence through (reference: Op::measure_operator_cost +
-    model.cu:38-74).
+    device, or None when the op cannot be measured meaningfully: no
+    floating input/weight to thread a timing dependence through, or the
+    op is cheaper than timer noise (a clamped floor would mark it free
+    in the calibration table).  Reference: Op::measure_operator_cost +
+    model.cu:38-74.
 
     Builds zero inputs from the op's input shapes unless given; weights
     are initialized via the op's specs (``weight_shapes`` overrides
@@ -170,4 +172,10 @@ def measure_operator_cost(op, batch_inputs=None,
         float(j2(batch_inputs, weights))
         diffs.append((time.perf_counter() - t1) - (t1 - t0))
     per_iter = float(np.median(diffs)) / (n2 - n1)
-    return max(per_iter, 1e-9)
+    if per_iter <= 0:
+        # the op is cheaper than timer noise: a clamped floor would be
+        # stored as a real measurement and mark the (op, view) as free,
+        # so the search would over-place work on it — decline and let
+        # the analytic roofline rank it instead
+        return None
+    return per_iter

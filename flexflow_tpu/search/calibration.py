@@ -149,7 +149,9 @@ def calibrate_graph(
     spent (existing entries are never re-measured)."""
     from flexflow_tpu.search.views import boundary_views, candidate_views
 
-    table = table or CalibrationTable()
+    # NOT `table or ...`: an empty CalibrationTable is falsy (__len__ == 0),
+    # and the caller's table must be filled in place
+    table = table if table is not None else CalibrationTable()
     deadline = time.monotonic() + time_budget_s
     for node in graph.topo_order():
         op = node.op

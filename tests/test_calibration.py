@@ -77,7 +77,9 @@ def test_measure_and_calibrate_graph_smoke():
     """measure_op_view probes a sharded dense layer on the live backend
     (CPU mesh in tests; the real chip under bench) and calibrate_graph
     fills a table for a small graph within its budget."""
-    m = mlp_model(batch=32, in_dim=16, hidden=16, classes=4)
+    # shapes large enough that one forward clears timer noise on a CPU
+    # backend — sub-noise probes now decline (return None) by design
+    m = mlp_model(batch=512, in_dim=512, hidden=1024, classes=64)
     op = m.node_by_name("fc1").op
     t_full = measure_op_view(op, MachineView.trivial(2), warmup=1, repeats=2)
     assert t_full is not None and math.isfinite(t_full) and t_full > 0
@@ -92,3 +94,16 @@ def test_measure_and_calibrate_graph_smoke():
     helper = SearchHelper(sim, 8)
     cost, strategy = helper.graph_cost(m.graph)
     assert math.isfinite(cost) and strategy
+
+
+def test_calibrate_graph_fills_caller_table_in_place():
+    """Regression: an EMPTY CalibrationTable is falsy (__len__ == 0), so a
+    `table or CalibrationTable()` default silently discarded the caller's
+    table — bench_search passed a fresh table, calibrate_graph filled a
+    private one, and the artifact reported 'calibrated 0 records'."""
+    m = mlp_model(batch=512, in_dim=512, hidden=1024, classes=64)
+    mine = CalibrationTable()
+    assert not mine  # the precondition that triggered the bug
+    out = calibrate_graph(m.graph, 8, mine, time_budget_s=20.0, repeats=1)
+    assert out is mine
+    assert len(mine) > 0

@@ -53,6 +53,13 @@ class FFConfig:
     calibration_file: Optional[str] = None  # persisted measured
     # per-(op, view) costs (search/calibration.py); the search loads it
     # when present (reference: ProfilingRecord, simulator.cc:515-554)
+    calibrate: bool = False  # probe this graph's (op, view) costs on
+    # the live backend at compile time and rank with them — the
+    # reference's default behavior (it measures lazily mid-search,
+    # simulator.cc:515; model.cu:38-74).  Off by default here because
+    # probing costs real wall time per compile; combined with
+    # calibration_file the probes persist and later compiles are free
+    calibration_budget_s: float = 60.0  # wall bound on compile-time probes
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
@@ -114,6 +121,9 @@ class FFConfig:
         p.add_argument("--search-timeout", dest="search_timeout", type=float, default=45.0)
         p.add_argument("--substitution-json", type=str, default=None)
         p.add_argument("--calibration-file", type=str, default=None)
+        p.add_argument("--calibrate", action="store_true")
+        p.add_argument("--calibration-budget", dest="calibration_budget",
+                       type=float, default=60.0)
         p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
         p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
         p.add_argument("--machine-model-file", type=str, default=None)
@@ -138,6 +148,8 @@ class FFConfig:
             search_timeout_s=args.search_timeout,
             substitution_json=args.substitution_json,
             calibration_file=args.calibration_file,
+            calibrate=args.calibrate,
+            calibration_budget_s=args.calibration_budget,
             export_strategy_file=args.export_strategy,
             import_strategy_file=args.import_strategy,
             export_strategy_task_graph_file=args.export_taskgraph,

@@ -46,6 +46,13 @@ class MachineSpec:
     dcn_bandwidth: float = 3.125e9  # bytes/s per host (25 Gbps)
     dcn_latency: float = 10e-6
     name: str = "tpu_v5e"
+    # the jax platform this spec models ("tpu" or "cpu") — measured
+    # calibration records are only coherent with a simulator whose
+    # machine model describes the backend they were probed on.  An
+    # explicit field (not a name heuristic): custom-named models from
+    # --machine-model-file stay correctly classified, and to_file /
+    # from_file round-trip it.
+    platform: str = "tpu"
 
     # ---- constructors ----------------------------------------------------
     @staticmethod
@@ -68,13 +75,24 @@ class MachineSpec:
     @staticmethod
     def host_cpu(num_devices: int = 8) -> "MachineSpec":
         """Virtual-device CPU machine for tests (same role as the
-        reference's --search-num-workers override, graph.cc:1535-1540)."""
+        reference's --search-num-workers override, graph.cc:1535-1540).
+
+        Measured on the CI-style host (often ONE physical core serving
+        all virtual devices): ~7e10 FLOP/s f32 matmul for the WHOLE
+        host, so per-device peak is host/num_devices — virtual devices
+        serialize, parallel speedup on this "mesh" is zero and the
+        model must say so or the search picks replication-heavy
+        strategies that execution loses.  An 8-way psum is ONE fused
+        XLA op: ~510 us fixed + ~4.6 GB/s ring bandwidth; spread the
+        fixed cost over the ring formula's 2(n-1) hops."""
         return MachineSpec(
             num_devices=num_devices,
-            peak_flops=1e11,
+            peak_flops=7e10 / max(1, num_devices),
             hbm_bandwidth=5e10,
-            ici_bandwidth=1e10,
+            ici_bandwidth=4.6e9,
+            ici_latency=3.6e-5,
             name="host_cpu",
+            platform="cpu",
         )
 
     @staticmethod

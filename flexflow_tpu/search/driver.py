@@ -375,6 +375,51 @@ def optimize_strategy(
 
         if os.path.exists(config.calibration_file):
             calibration = CalibrationTable.load(config.calibration_file)
+    target = config.machine_spec.platform
+    if calibration is not None and calibration.backend not in (None, target):
+        # measured records are only coherent with a simulator whose
+        # machine model describes the backend they were probed on —
+        # e.g. CPU dense milliseconds would poison a TPU-modeled search
+        # (searching a TPU strategy FROM a CPU host with a TPU-probed
+        # table is fine: the reference's search-on-small-machine
+        # pattern, graph.cc:1535-1540)
+        log.log(
+            f"ignoring calibration probed on {calibration.backend!r} "
+            f"(machine model is {config.machine_spec.name!r})"
+        )
+        calibration = None
+    can_probe = False
+    if config.calibrate:
+        # probe this graph's (op, view) costs on the live backend before
+        # ranking — the reference's default (it measures lazily inside
+        # the search, simulator.cc:515-554; model.cu:38-74).  Probes
+        # resume from the loaded table; with calibration_file set they
+        # persist, so repeat compiles pay nothing.
+        import jax
+
+        live = jax.devices()[0].platform
+        can_probe = live == target
+        if not can_probe:
+            log.log(
+                f"calibrate requested but the live backend ({live!r}) "
+                f"does not match the machine model "
+                f"({config.machine_spec.name!r}): keeping the analytic "
+                f"roofline.  Probe on the modeled backend and pass "
+                f"--calibration-file instead."
+            )
+        else:
+            from flexflow_tpu.search.calibration import calibrate_graph
+
+            with log.enter(
+                f"calibrating (op, view) costs on the live backend "
+                f"(budget {config.calibration_budget_s:.0f}s)"
+            ):
+                calibration = calibrate_graph(
+                    graph, n, calibration,
+                    time_budget_s=config.calibration_budget_s)
+                log.log(f"{len(calibration)} measured records")
+            if config.calibration_file:
+                calibration.save(config.calibration_file)
     sim = Simulator(config.machine_spec, num_devices=n, calibration=calibration)
     helper = SearchHelper(sim, n)
 
@@ -394,6 +439,36 @@ def optimize_strategy(
         with log.enter(f"unity outer loop: {len(xfers)} xfers"):
             opt._score_edges(graph)
             g2, c2, s2 = opt.sequence_optimize(graph, {})
+            if (c2 < best_cost and s2 and can_probe
+                    and calibration is not None and g2 is not graph):
+                # rewrites can introduce ops the pre-rewrite probe pass
+                # never measured; comparing measured originals (lone-op
+                # probes are upper bounds) against roofline rewrites
+                # (optimistic) biases acceptance toward rewrites.  Probe
+                # the rewritten graph's new (op, view)s — inside the
+                # remaining --search-timeout budget — and re-SCORE both
+                # candidate (graph, strategy) pairs with the same table
+                # before accepting (a bounded re-simulation, not two
+                # fresh full searches).
+                from flexflow_tpu.search.calibration import calibrate_graph
+
+                budget = config.calibration_budget_s
+                if deadline is not None:
+                    budget = min(budget, max(0.0, deadline - time.monotonic()))
+                n_before = len(calibration)
+                if budget > 0:
+                    calibrate_graph(g2, n, calibration, time_budget_s=budget)
+                if len(calibration) > n_before:
+                    log.log(
+                        f"probed {len(calibration) - n_before} rewritten-"
+                        f"graph records; re-scoring on equal footing"
+                    )
+                    if config.calibration_file:
+                        calibration.save(config.calibration_file)
+                    sim2 = Simulator(config.machine_spec, num_devices=n,
+                                     calibration=calibration)
+                    best_cost = sim2.simulate(graph, best_strategy)
+                    c2 = sim2.simulate(g2, s2)
             if c2 < best_cost and s2:
                 log.log(
                     f"substitution improved: {best_cost * 1e3:.4f}"

@@ -107,3 +107,100 @@ def test_calibrate_graph_fills_caller_table_in_place():
     out = calibrate_graph(m.graph, 8, mine, time_budget_s=20.0, repeats=1)
     assert out is mine
     assert len(mine) > 0
+
+
+def test_compile_time_calibration_probes_and_persists(tmp_path):
+    """FFConfig(calibrate=True) makes the default compile path probe
+    this graph's (op, view) costs on the live backend and rank with
+    them — the reference's default behavior (simulator.cc:515-554,
+    model.cu:38-74) — persisting to calibration_file for later runs."""
+    import json
+    import os
+
+    from flexflow_tpu.core.machine import MachineSpec
+
+    path = str(tmp_path / "cal.json")
+    # machine model must describe the live backend for probes to be
+    # coherent (the driver declines to probe otherwise)
+    cfg = ff.FFConfig(batch_size=512, num_devices=8, search_budget=2,
+                      calibrate=True, calibration_file=path,
+                      calibration_budget_s=25.0,
+                      machine_spec=MachineSpec.host_cpu(8))
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([512, 512])
+    t = m.dense(x, 1024, activation="relu", name="fc1")
+    t = m.dense(t, 64, name="head")
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+    assert os.path.exists(path)
+    with open(path) as f:
+        data = json.load(f)
+    assert len(data["records"]) > 0
+    assert data["backend"] == "cpu"  # tests run on the CPU mesh
+
+    # second compile resumes from the persisted table (no growth needed,
+    # just correctness of the load path through FFConfig)
+    cfg2 = ff.FFConfig(batch_size=512, num_devices=8, search_budget=2,
+                       calibration_file=path,
+                       machine_spec=MachineSpec.host_cpu(8))
+    m2 = ff.FFModel(cfg2)
+    x2 = m2.create_tensor([512, 512])
+    t2 = m2.dense(x2, 1024, activation="relu", name="fc1")
+    t2 = m2.dense(t2, 64, name="head")
+    m2.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+
+
+def test_mismatched_backend_calibration_ignored(tmp_path):
+    """A table probed on a backend the machine model does not describe
+    must not override the roofline (TPU-probed milliseconds are
+    incoherent with a CPU-modeled simulator and vice versa): the driver
+    discards it and ranks analytically.  A TPU table WITH a TPU machine
+    model on a CPU host stays valid — the reference's
+    search-on-small-machine pattern (graph.cc:1535-1540)."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.views import boundary_views, candidate_views
+
+    m = mlp_model()
+    fc1 = m.node_by_name("fc1")
+    views = list(candidate_views(fc1.op, 8)) + list(
+        boundary_views(fc1.op, 8))
+
+    def tpu_table(path, punish_unsharded):
+        t = CalibrationTable()
+        for mv in views:
+            slow = (mv.num_parts == 1) if punish_unsharded \
+                else (mv.num_parts > 1)
+            t.put(fc1.op, mv, 5e-2 if slow else 1e-6)
+        t.backend = "tpu"
+        t.save(path)
+        return path
+
+    # the CPU roofline SHARDS this layer (low peak flops -> compute
+    # dominates); a consulted table punishing sharding would flip it to
+    # unsharded.  With a cpu machine model the tpu-probed table must be
+    # discarded, so the sharded roofline pick survives.
+    path_ps = tpu_table(str(tmp_path / "punish_shard.json"),
+                        punish_unsharded=False)
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, search_budget=0,
+                      calibration_file=path_ps,
+                      machine_spec=MachineSpec.host_cpu(8))
+    strategy = optimize_strategy(m.graph, cfg)
+    assert strategy[fc1.guid].num_parts > 1
+
+    # the TPU roofline keeps this layer UNSHARDED; the same-backend
+    # table punishing unsharded IS consulted and flips the ranking —
+    # even though tests run on a CPU host (the reference's
+    # search-on-small-machine pattern)
+    path_pu = tpu_table(str(tmp_path / "punish_unsharded.json"),
+                        punish_unsharded=True)
+    cfg_tpu = ff.FFConfig(batch_size=64, num_devices=8, search_budget=0,
+                          calibration_file=path_pu)
+    assert cfg_tpu.machine_spec.platform == "tpu"  # the default model
+    strategy2 = optimize_strategy(m.graph, cfg_tpu)
+    assert strategy2[fc1.guid].num_parts > 1
+    # and the punishing-sharded table, consulted on the tpu model,
+    # keeps it unsharded — proving consultation, not coincidence
+    cfg_tpu2 = ff.FFConfig(batch_size=64, num_devices=8, search_budget=0,
+                           calibration_file=path_ps)
+    strategy3 = optimize_strategy(m.graph, cfg_tpu2)
+    assert strategy3[fc1.guid].num_parts == 1

@@ -144,7 +144,7 @@ class MultiHeadAttentionOp(Operator):
         dropout_active = a["dropout"] > 0.0 and ctx.train
         ring_ok = (
             ctx.mesh is not None
-            and len(seq_axes) == 1
+            and len(seq_axes) >= 1
             and self_attn
             and not dropout_active
         )
@@ -156,8 +156,7 @@ class MultiHeadAttentionOp(Operator):
             import warnings
 
             reason = (
-                "seq sharded over multiple mesh axes" if len(seq_axes) > 1
-                else "cross-attention (Sk != Sq)" if not self_attn
+                "cross-attention (Sk != Sq)" if not self_attn
                 else "attention dropout active" if dropout_active
                 else "no device mesh"
             )
@@ -172,7 +171,7 @@ class MultiHeadAttentionOp(Operator):
             from flexflow_tpu.parallel.ring_attention import ring_attention
 
             return ring_attention(
-                qh, kh, vh, ctx.mesh, seq_axes[0],
+                qh, kh, vh, ctx.mesh, tuple(seq_axes),
                 causal=a["causal"], scale=scale,
                 batch_axes=(ctx.slot_axes or {}).get(0, ()),
             )

@@ -72,10 +72,17 @@ def ring_attention(
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     assert q.shape[1] == k.shape[1], "ring attention requires Sk == Sq"
-    axis = seq_axis if isinstance(seq_axis, str) else seq_axis[0]
-    if not isinstance(seq_axis, str) and len(seq_axis) > 1:
-        raise NotImplementedError("ring over one mesh axis at a time")
-    n = mesh.shape[axis]
+    # a seq degree that does not exist as one mesh axis (the mesh is
+    # built from prime factors, so degree 4 on 8 devices is two axes)
+    # rides the PRODUCT ring: ppermute/axis_index over an axis-name
+    # tuple use linearized indices consistent with PartitionSpec order
+    axes = (seq_axis,) if isinstance(seq_axis, str) else tuple(seq_axis)
+    # collectives and PartitionSpec accept the (possibly length-1)
+    # axis-name tuple uniformly — no str/tuple dual form needed
+    axis = axes
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
     if n == 1:
         from flexflow_tpu.kernels.flash_attention import flash_attention
 
@@ -141,7 +148,7 @@ def ring_attention(
     b_spec = None
     if batch_axes:
         b_spec = batch_axes[0] if len(batch_axes) == 1 else tuple(batch_axes)
-    spec = P(b_spec, axis, None, None)
+    spec = P(b_spec, axes, None, None)
     return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

@@ -218,8 +218,18 @@ class CostModel:
         ):
             # combine: all-gather over the vanished degree
             return self.allgather(shard_src, src_deg // max(dst_deg, 1))
-        # general case: all-to-all style re-shard
-        return self.all_to_all(shard_src, n)
+        if src_deg == dst_deg and src.replica == dst.replica:
+            # pure dim-to-dim migration at constant total degree (e.g.
+            # [B/8, S] -> [B, S/8]): GSPMD emits a true all-to-all
+            return self.all_to_all(shard_src, n)
+        # mixed transition (degrees change AND migrate across dims, or
+        # the replica factor changes): the SPMD partitioner's fallback
+        # is "involuntary full rematerialization" — all-gather to
+        # replicated, then slice locally (observed XLA warning
+        # spmd_partitioner.cc:652).  Charging only an all-to-all here
+        # made the search pick reshardings that execution pays full
+        # gather for.
+        return self.allgather(shard_src, src_deg) + OP_OVERHEAD_S
 
     def placement_move_cost(
         self, shape: ParallelTensorShape, src: Optional[ShardAnnot]

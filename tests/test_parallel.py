@@ -349,3 +349,28 @@ def test_placement_sim_agrees_with_execution():
     t_off = exec_step_time(True)
     assert t_off > 0.5 * t_same, (t_off, t_same)
     assert t_off < 2.0 * t_same, (t_off, t_same)
+
+
+def test_xfer_cost_mixed_transition_charges_full_remat():
+    """GSPMD implements an axis-migration resharding whose total degree
+    or replica factor changes by 'involuntary full rematerialization'
+    (all-gather + local slice; XLA spmd_partitioner.cc:652 warning) —
+    the xfer model must charge that, not an optimistic all-to-all.
+    Pure degree-preserving dim migrations keep the all-to-all price."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.core.ptensor import ParallelTensorShape
+    from flexflow_tpu.ops.base import ShardAnnot
+    from flexflow_tpu.search.machine_model import CostModel
+
+    cm = CostModel(machine=MachineSpec.tpu_v5e(8))
+    shape = ParallelTensorShape.make((64, 4096), "float32")
+
+    # [B/8, E] -> [B, E/8]: classic all-to-all, stays cheap
+    pure = cm.xfer_cost(shape, ShardAnnot((8, 1)), ShardAnnot((1, 8)))
+    # [B, E/8] -> [B/2, E] + replica 4: degree shrinks AND migrates —
+    # the involuntary-remat case observed from XLA
+    mixed = cm.xfer_cost(
+        shape, ShardAnnot((1, 8)), ShardAnnot((2, 1), replica=4))
+    assert mixed > pure * 2, (mixed, pure)
+    # and the remat price is at least the gather of the full tensor
+    assert mixed >= cm.allgather(shape.num_bytes / 8, 8)

@@ -277,7 +277,12 @@ def test_unity_rewrite_improves_badly_placed_parallel_ops():
     c_orig, _ = helper.graph_cost(m.graph)
     g2, s2 = optimize_strategy(m.graph, cfg, return_graph=True)
     c_new = sim.simulate(g2, s2)
-    assert g2.num_nodes < m.graph.num_nodes  # round-trip removed
+    # the gratuitous round-trip must be gone — either cancelled outright
+    # or replaced wholesale by a cheaper rewrite (the search is free to
+    # pick e.g. a TP pipeline with MORE nodes if the simulator ranks it
+    # better; the contract is the round-trip's removal + a strict win)
+    names = {node.op.name for node in g2.topo_order()}
+    assert not {"c_mid", "p_mid"} <= names
     assert c_new < c_orig
 
 

@@ -97,16 +97,27 @@ def _model_specs():
     }
 
 
-def simulate_pair(name, spec, n_devices, calibration=None):
+def simulate_pair(name, spec, n_devices, calibration=None,
+                  calibration_file=None):
     import flexflow_tpu as ff
     from flexflow_tpu.compiler.lowering import data_parallel_strategy
     from flexflow_tpu.search.driver import optimize_strategy
     from flexflow_tpu.search.simulator import Simulator
 
     cfg = ff.FFConfig(batch_size=spec["batch"], num_devices=n_devices,
-                      search_budget=spec["budget"])
+                      search_budget=spec["budget"],
+                      # the SEARCH must rank with the measured table too,
+                      # or it optimizes the roofline and the calibrated
+                      # re-simulation below exposes a bad pick
+                      calibration_file=calibration_file)
     model = spec["build"](cfg)
     g = model.graph
+    if calibration is not None and (
+            calibration.backend not in (None, cfg.machine_spec.platform)):
+        print(f"# {name}: calibration probed on {calibration.backend!r} is "
+              f"incoherent with machine model {cfg.machine_spec.name!r}; "
+              "simulating with the roofline")
+        calibration = None
     sim = Simulator(cfg.machine_spec, num_devices=n_devices,
                     calibration=calibration)
     c_dp = sim.simulate(g, data_parallel_strategy(g, n_devices))
@@ -117,6 +128,10 @@ def simulate_pair(name, spec, n_devices, calibration=None):
                      calibration=calibration).simulate(best_graph, strategy)
     return {
         "nodes": g.num_nodes,
+        # whether THIS model's sim numbers actually consulted measured
+        # records (False when the table was discarded as incoherent
+        # with the machine model above)
+        "sim_calibrated": calibration is not None,
         "sim_dp_ms": round(c_dp * 1e3, 4),
         "sim_searched_ms": round(c_se * 1e3, 4),
         "sim_ratio": round(c_dp / c_se, 3) if c_se > 0 else None,
@@ -147,25 +162,38 @@ def _steady_step_seconds(model, xs, y, steps):
     return (time.perf_counter() - t0) / steps
 
 
-def execute_pair(name, spec, n_devices, steps):
+def execute_pair(name, spec, n_devices, steps, calibration_file=None):
     """Measure real per-step seconds for DP vs searched strategies on
     the live mesh.  Returns None when the model has no executable
     reduced config."""
     if spec["exec_build"] is None:
         return None
+    import os
+
     import jax
 
     import flexflow_tpu as ff
     from examples.common import synthetic_inputs, synthetic_labels
     from flexflow_tpu.compiler.lowering import data_parallel_strategy
+    from flexflow_tpu.core.machine import MachineSpec
 
     on_cpu = jax.devices()[0].platform == "cpu"
 
     results = {}
     for mode in ("dp", "searched"):
+        # the osdi22ae contract runs searched-vs-DP on the SAME hardware,
+        # with the search targeting that hardware — on a CPU mesh the
+        # search must rank with the CPU machine model, not the TPU one
+        # (a TPU-optimal strategy can be a CPU pessimization); on the
+        # real accelerator the search gets the calibration file too, so
+        # the executed strategy is the one the calibrated sim ranked
         cfg = ff.FFConfig(batch_size=spec["exec_batch"], num_devices=n_devices,
                           search_budget=spec["budget"],
                           compute_dtype="float32" if on_cpu else "bfloat16",
+                          machine_spec=(MachineSpec.host_cpu(n_devices)
+                                        if on_cpu else None),
+                          calibration_file=(None if on_cpu
+                                            else calibration_file),
                           only_data_parallel=(mode == "dp"))
         model = spec["exec_build"](cfg)
         if mode == "dp":
@@ -179,6 +207,11 @@ def execute_pair(name, spec, n_devices, steps):
     return {
         "exec_backend": jax.devices()[0].platform,
         "exec_devices": n_devices,
+        # virtual devices share the host's physical cores: when cores <
+        # devices, per-device compute serializes and compute-parallel
+        # strategies cannot win — only work/communication-avoiding wins
+        # (DLRM-style) are observable on such a host
+        "exec_host_cores": os.cpu_count(),
         "exec_scale": "reduced" if on_cpu else "full",
         "exec_dp_ms": round(results["dp"] * 1e3, 3),
         "exec_searched_ms": round(results["searched"] * 1e3, 3),
@@ -207,6 +240,10 @@ def main():
                          "TPU-calibrated sim ratios with CPU-mesh "
                          "executed ratios")
     ap.add_argument("--calibration-file", default="CALIBRATION.json")
+    ap.add_argument("--out-prefix", default="BENCH_SEARCH",
+                    help="artifact file prefix — point smoke runs at a "
+                         "scratch prefix so they never overwrite the "
+                         "committed full artifact")
     args = ap.parse_args()
 
     import os
@@ -272,21 +309,32 @@ def main():
               "models": {}}
     can_exec = len(jax.devices()) >= args.devices
     for n in names:
-        row = simulate_pair(n, specs[n], args.devices, calibration)
+        row = simulate_pair(
+            n, specs[n], args.devices, calibration,
+            calibration_file=(args.calibration_file
+                              if calibration is not None else None))
         if can_exec:
             try:
-                ex = execute_pair(n, specs[n], args.devices, args.steps)
+                ex = execute_pair(
+                    n, specs[n], args.devices, args.steps,
+                    calibration_file=(args.calibration_file
+                                      if calibration is not None else None))
             except Exception as e:  # honest artifact: record the failure
                 ex = {"exec_error": f"{type(e).__name__}: {e}"}
             if ex:
                 row.update(ex)
         report["models"][n] = row
         print(json.dumps({"model": n, **row}))
+    # "calibrated" must mean the sims CONSULTED measurements, not merely
+    # that a table object existed (it may have been discarded per-model
+    # as incoherent with the machine model)
+    report["calibrated"] = any(
+        r.get("sim_calibrated") for r in report["models"].values())
 
-    with open("BENCH_SEARCH.json", "w") as f:
+    with open(f"{args.out_prefix}.json", "w") as f:
         json.dump(report, f, indent=1)
     lines = [
-        "# BENCH_SEARCH — searched strategy vs pure data parallelism",
+        f"# {args.out_prefix} — searched strategy vs pure data parallelism",
         "",
         "Reference contract: scripts/osdi22ae/*.sh (searched vs "
         "`--only-data-parallel`, same hardware).  Simulated costs are for "
@@ -316,12 +364,20 @@ def main():
         cal_note,
         "Honesty notes: the simulator's DLRM DP cost is dominated by the "
         "full-table gradient allreduce (the real phenomenon Unity "
-        "exploits, dlrm.cc + osdi22ae/dlrm.sh); executed ratios on a CPU "
-        "mesh validate the ORDERING, not TPU magnitudes.",
+        "exploits, dlrm.cc + osdi22ae/dlrm.sh).  Executed ratios on a CPU "
+        "mesh are bounded by the host: with fewer physical cores than "
+        "virtual devices (see exec_host_cores) per-device compute "
+        "serializes, so only work/communication-AVOIDING strategies "
+        "(DLRM/XDL/CANDLE-Uno/MLP table+reduction sharding) can show "
+        "real wins there; compute-parallel strategies (BERT TP/SP) "
+        "additionally pay GSPMD resharding copies that dwarf their "
+        "benefit on such a host — their contract number is the "
+        "TPU-machine-model sim ratio, which the calibrated table makes "
+        "falsifiable.",
     ]
-    with open("BENCH_SEARCH.md", "w") as f:
+    with open(f"{args.out_prefix}.md", "w") as f:
         f.write("\n".join(lines) + "\n")
-    print("# wrote BENCH_SEARCH.json / BENCH_SEARCH.md")
+    print(f"# wrote {args.out_prefix}.json / {args.out_prefix}.md")
 
 
 if __name__ == "__main__":

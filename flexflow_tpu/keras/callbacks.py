@@ -115,3 +115,21 @@ class EpochVerifyMetrics(Callback):
             value = logs.get(self.metric)
             assert value is not None and value >= self.threshold, (
                 f"epoch {epoch}: {self.metric}={value} < {self.threshold}")
+
+
+class ModelCheckpoint(Callback):
+    """Snapshot the full training state each ``every`` epochs
+    (params, optimizer state, rng counter — runtime/checkpoint.py).
+    Beyond the reference, whose keras callbacks only verify metrics;
+    restore with ``CheckpointManager(directory).restore(ffmodel)`` or
+    ``FFModel.fit(checkpoint_dir=..., resume=True)``."""
+
+    def __init__(self, directory: str, every: int = 1, max_to_keep: int = 3):
+        from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+        self.every = max(1, every)
+        self.manager = CheckpointManager(directory, max_to_keep=max_to_keep)
+
+    def on_epoch_end(self, epoch: int, logs: Dict[str, float]):
+        if (epoch + 1) % self.every == 0:
+            self.manager.save(epoch, self.model.ffmodel)

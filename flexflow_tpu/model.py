@@ -511,7 +511,9 @@ class FFModel:
     # ------------------------------------------------------------------
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True,
-            callbacks: Sequence = (), recompile_state=None):
+            callbacks: Sequence = (), recompile_state=None,
+            checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
+            resume: bool = False):
         """Training loop (reference: flexflow_cffi.py:1832 fit).
 
         ``callbacks`` follow the keras callback protocol (duck-typed:
@@ -521,12 +523,28 @@ class FFModel:
         ``recompile_state`` — a runtime.recompile.RecompileState checked
         once per iteration (reference: recompile_on_condition,
         model.cc:2273); its alter() may mutate op attrs, after which the
-        model re-lowers with params/state carried over."""
+        model re-lowers with params/state carried over.
+
+        ``checkpoint_dir`` — snapshot the full training state (params,
+        optimizer state, rng counter) every ``checkpoint_every`` epochs;
+        with ``resume=True`` training continues from the latest
+        snapshot's next epoch.  Beyond the reference, which has no
+        model checkpointing (SURVEY.md §5); runtime/checkpoint.py."""
         import jax
 
         from flexflow_tpu.runtime.dataloader import SingleDataLoader
 
         assert self.compiled is not None, "call compile() first"
+        ckpt_mgr = None
+        start_epoch = 0
+        if checkpoint_dir is not None:
+            from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(checkpoint_dir)
+            if resume and ckpt_mgr.latest_step() is not None:
+                start_epoch = ckpt_mgr.restore(self) + 1
+        elif resume:
+            raise ValueError("resume=True requires checkpoint_dir")
         xs = x if isinstance(x, (list, tuple)) else [x]
         batch_size = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs
@@ -534,6 +552,12 @@ class FFModel:
             self.compiled, [np.asarray(a) for a in xs], np.asarray(y),
             batch_size, shuffle=shuffle, seed=self.config.seed,
         )
+        if start_epoch and shuffle:
+            # fast-forward the shuffle stream: a resumed epoch N must see
+            # the N-th permutation, not replay epoch 0's order
+            ff_order = np.arange(loader.num_samples)
+            for _ in range(start_epoch):
+                loader.rng.shuffle(ff_order)
         if loader.num_batches == 0:
             raise ValueError(
                 f"no full batch: {loader.num_samples} samples < batch_size {batch_size}"
@@ -563,7 +587,7 @@ class FFModel:
             and jax.process_count() == 1
             and loader.num_batches >= trace_n
         )
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             metrics.reset()
@@ -623,6 +647,11 @@ class FFModel:
             for cb in callbacks:
                 if cb.on_epoch_end(epoch, logs) is False:
                     stop = True
+            if ckpt_mgr is not None and (
+                (epoch + 1) % max(1, checkpoint_every) == 0
+                or epoch == epochs - 1 or stop
+            ):
+                ckpt_mgr.save(epoch, self)
             if stop:
                 break
         for cb in callbacks:

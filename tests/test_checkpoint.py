@@ -123,3 +123,82 @@ def test_shape_mismatch_rejected(tmp_path):
     m2.compile(loss_type="mean_squared_error", metrics=["mean_squared_error"])
     with pytest.raises(Exception):
         mgr.restore(m2)
+
+
+def test_fit_checkpoint_dir_and_resume(tmp_path):
+    """fit(checkpoint_dir=...) snapshots each epoch; a new fit with
+    resume=True restores the latest snapshot and continues from the
+    NEXT epoch — interrupted training picks up where it left off."""
+    d = str(tmp_path / "ckpt")
+    rng = np.random.RandomState(0)
+    x = rng.randn(24, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=(24,)).astype(np.int32)
+
+    m1 = _make_model()
+    m1.fit(x, y, batch_size=8, epochs=3, verbose=False, checkpoint_dir=d)
+    mgr = CheckpointManager(d)
+    assert mgr.latest_step() == 2  # epochs 0..2 saved (every=1)
+
+    # fresh model, same topology: resume continues at epoch 3
+    m2 = _make_model()
+    hist = m2.fit(x, y, batch_size=8, epochs=5, verbose=False,
+                  checkpoint_dir=d, resume=True)
+    assert len(hist) == 2  # epochs 3 and 4 only
+    assert mgr.latest_step() == 4
+
+    # resume with everything already trained: no epochs run
+    m3 = _make_model()
+    hist3 = m3.fit(x, y, batch_size=8, epochs=5, verbose=False,
+                   checkpoint_dir=d, resume=True)
+    assert hist3 == []
+
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        m3.fit(x, y, batch_size=8, epochs=1, verbose=False, resume=True)
+
+
+def test_keras_model_checkpoint_callback(tmp_path):
+    from flexflow_tpu import keras
+
+    d = str(tmp_path / "kc")
+    model = keras.Sequential([
+        keras.layers.Dense(16, activation="relu", input_shape=(8,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"],
+                  config=ff.FFConfig(batch_size=8, num_devices=1,
+                                     only_data_parallel=True))
+    rng = np.random.RandomState(1)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(16,)).astype(np.int32)
+    model.fit(x, y, epochs=2,
+              callbacks=[keras.callbacks.ModelCheckpoint(d)])
+    assert CheckpointManager(d).latest_step() == 1
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    """Interrupt+resume must be EQUIVALENT to an uninterrupted run:
+    the shuffle stream is fast-forwarded (a resumed epoch N sees the
+    N-th permutation, not epoch 0's) and the dropout rng counter is
+    restored, so final parameters match bit-for-bit."""
+    import jax
+
+    d = str(tmp_path / "eq")
+    rng = np.random.RandomState(3)
+    x = rng.randn(24, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=(24,)).astype(np.int32)
+
+    straight = _make_model()
+    straight.fit(x, y, batch_size=8, epochs=2, verbose=False)
+
+    part1 = _make_model()
+    part1.fit(x, y, batch_size=8, epochs=1, verbose=False, checkpoint_dir=d)
+    part2 = _make_model()
+    part2.fit(x, y, batch_size=8, epochs=2, verbose=False,
+              checkpoint_dir=d, resume=True)
+
+    a = jax.tree_util.tree_leaves(straight.params)
+    b = jax.tree_util.tree_leaves(part2.params)
+    for u, v in zip(a, b):
+        np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                   rtol=0, atol=0)

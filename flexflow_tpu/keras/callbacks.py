@@ -122,14 +122,31 @@ class ModelCheckpoint(Callback):
     (params, optimizer state, rng counter — runtime/checkpoint.py).
     Beyond the reference, whose keras callbacks only verify metrics;
     restore with ``CheckpointManager(directory).restore(ffmodel)`` or
-    ``FFModel.fit(checkpoint_dir=..., resume=True)``."""
+    ``fit(checkpoint_dir=..., resume=True)``.  Works under both
+    keras ``Model.fit`` and ``FFModel.fit``; the final epoch (or the
+    epoch early stopping halts on) is always snapshotted even when it
+    falls between ``every`` marks."""
 
     def __init__(self, directory: str, every: int = 1, max_to_keep: int = 3):
         from flexflow_tpu.runtime.checkpoint import CheckpointManager
 
         self.every = max(1, every)
         self.manager = CheckpointManager(directory, max_to_keep=max_to_keep)
+        self._last_seen: Optional[int] = None
+        self._last_saved: Optional[int] = None
+
+    def _ffmodel(self):
+        # keras Model.fit binds the keras wrapper; FFModel.fit binds
+        # the FFModel itself
+        return getattr(self.model, "ffmodel", None) or self.model
 
     def on_epoch_end(self, epoch: int, logs: Dict[str, float]):
+        self._last_seen = epoch
         if (epoch + 1) % self.every == 0:
-            self.manager.save(epoch, self.model.ffmodel)
+            self.manager.save(epoch, self._ffmodel())
+            self._last_saved = epoch
+
+    def on_train_end(self) -> None:
+        if self._last_seen is not None and self._last_saved != self._last_seen:
+            self.manager.save(self._last_seen, self._ffmodel())
+            self._last_saved = self._last_seen

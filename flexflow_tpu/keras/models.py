@@ -93,15 +93,18 @@ class _BaseModel:
 
     # ------------------------------------------------------------------
     def fit(self, x=None, y=None, epochs: int = 1, batch_size: Optional[int] = None,
-            callbacks: Sequence = (), shuffle: bool = True, verbose: bool = True):
+            callbacks: Sequence = (), shuffle: bool = True, verbose: bool = True,
+            **fit_kwargs):
         """Training with callbacks — delegates to FFModel.fit, the single
-        train loop (reference: base_model.py:195-256 + callbacks.py)."""
+        train loop (reference: base_model.py:195-256 + callbacks.py).
+        Extra kwargs (checkpoint_dir/checkpoint_every/resume,
+        recompile_state) pass through to FFModel.fit."""
         assert self.ffmodel is not None, "call compile() first"
         for cb in callbacks:
             cb.set_model(self)
         self.history = self.ffmodel.fit(
             x=x, y=y, batch_size=batch_size, epochs=epochs, shuffle=shuffle,
-            verbose=verbose, callbacks=callbacks,
+            verbose=verbose, callbacks=callbacks, **fit_kwargs,
         )
         return self.history
 

@@ -538,6 +538,14 @@ class FFModel:
         ckpt_mgr = None
         start_epoch = 0
         if checkpoint_dir is not None:
+            if jax.process_count() > 1:
+                # every process would np.asarray globally-sharded params
+                # (raises on non-addressable shards) and race on the
+                # same step directory — loud unsupported-feature guard
+                raise NotImplementedError(
+                    "checkpoint_dir in fit() is single-host only; use an "
+                    "orbax multihost checkpointer for multi-process runs"
+                )
             from flexflow_tpu.runtime.checkpoint import CheckpointManager
 
             ckpt_mgr = CheckpointManager(checkpoint_dir)
@@ -545,6 +553,12 @@ class FFModel:
                 start_epoch = ckpt_mgr.restore(self) + 1
         elif resume:
             raise ValueError("resume=True requires checkpoint_dir")
+        for cb in callbacks:
+            # keras callback protocol: bind the model before training
+            # (works for both FFModel.fit and the keras Model.fit path,
+            # which re-binds with the keras wrapper afterwards)
+            if hasattr(cb, "set_model") and getattr(cb, "model", None) is None:
+                cb.set_model(self)
         xs = x if isinstance(x, (list, tuple)) else [x]
         batch_size = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs

@@ -175,6 +175,18 @@ def test_keras_model_checkpoint_callback(tmp_path):
               callbacks=[keras.callbacks.ModelCheckpoint(d)])
     assert CheckpointManager(d).latest_step() == 1
 
+    # every > epochs: the final epoch is still snapshotted (train-end)
+    d2 = str(tmp_path / "kc2")
+    model.fit(x, y, epochs=2,
+              callbacks=[keras.callbacks.ModelCheckpoint(d2, every=5)])
+    assert CheckpointManager(d2).latest_step() == 1
+
+    # the keras fit path forwards checkpoint kwargs to FFModel.fit
+    d3 = str(tmp_path / "kc3")
+    model.fit(x, y, epochs=2, checkpoint_dir=d3)
+    h = model.fit(x, y, epochs=3, checkpoint_dir=d3, resume=True)
+    assert len(h) == 1  # epoch 2 only
+
 
 def test_resume_matches_uninterrupted_run(tmp_path):
     """Interrupt+resume must be EQUIVALENT to an uninterrupted run:

@@ -308,17 +308,14 @@ def main():
               "backend": jax.devices()[0].platform,
               "models": {}}
     can_exec = len(jax.devices()) >= args.devices
+    cal_file = args.calibration_file if calibration is not None else None
     for n in names:
-        row = simulate_pair(
-            n, specs[n], args.devices, calibration,
-            calibration_file=(args.calibration_file
-                              if calibration is not None else None))
+        row = simulate_pair(n, specs[n], args.devices, calibration,
+                            calibration_file=cal_file)
         if can_exec:
             try:
-                ex = execute_pair(
-                    n, specs[n], args.devices, args.steps,
-                    calibration_file=(args.calibration_file
-                                      if calibration is not None else None))
+                ex = execute_pair(n, specs[n], args.devices, args.steps,
+                                  calibration_file=cal_file)
             except Exception as e:  # honest artifact: record the failure
                 ex = {"exec_error": f"{type(e).__name__}: {e}"}
             if ex:

@@ -51,9 +51,7 @@ def _zoo():
         "dlrm": dict(
             # reference default is 8x 1M-row tables; 4x 1M keeps the f32
             # weight+grad+Adam footprint inside one chip's HBM
-            build=lambda cfg: __import__(
-                "flexflow_tpu.models", fromlist=["build_dlrm"]
-            ).build_dlrm(cfg, embedding_sizes=(1000000,) * 4),
+            build=lambda cfg: build_dlrm(cfg, embedding_sizes=(1000000,) * 4),
             batch=64, loss="mean_squared_error"),
         "xdl": dict(build=build_xdl, batch=64, loss="mean_squared_error"),
         "candle_uno": dict(build=build_candle_uno, batch=64,
@@ -134,10 +132,11 @@ def main():
     args = ap.parse_args()
 
     zoo = _zoo()
+    unknown = [n for n in args.models.split(",") if n not in zoo]
+    if unknown:
+        ap.error(f"unknown models {unknown}; valid: {sorted(zoo)}")
     report = {}
     for name in args.models.split(","):
-        if name not in zoo:
-            continue
         try:
             row = bench_model(name, zoo[name])
         except Exception as e:  # honest artifact: record the failure

@@ -11,6 +11,13 @@ class Callback:
     def set_model(self, model) -> None:
         self.model = model
 
+    @property
+    def ffmodel(self):
+        """The underlying FFModel regardless of fit entry point: keras
+        ``Model.fit`` binds the keras wrapper (which holds ``.ffmodel``),
+        ``FFModel.fit`` binds the FFModel itself."""
+        return getattr(self.model, "ffmodel", None) or self.model
+
     def on_train_begin(self) -> None:
         pass
 
@@ -37,14 +44,14 @@ class LearningRateScheduler(Callback):
 
     def on_epoch_begin(self, epoch: int) -> None:
         lr = float(self.schedule(epoch))
-        opt = self.model.ffmodel.optimizer
+        opt = self.ffmodel.optimizer
         if hasattr(opt, "alpha"):
             if opt.alpha != lr:
                 opt.alpha = lr
-                self.model.ffmodel.compiled._train_step_fn = None
+                self.ffmodel.compiled._train_step_fn = None
         elif opt.lr != lr:
             opt.lr = lr
-            self.model.ffmodel.compiled._train_step_fn = None
+            self.ffmodel.compiled._train_step_fn = None
 
 
 class EarlyStopping(Callback):
@@ -135,18 +142,20 @@ class ModelCheckpoint(Callback):
         self._last_seen: Optional[int] = None
         self._last_saved: Optional[int] = None
 
-    def _ffmodel(self):
-        # keras Model.fit binds the keras wrapper; FFModel.fit binds
-        # the FFModel itself
-        return getattr(self.model, "ffmodel", None) or self.model
+    def on_train_begin(self) -> None:
+        # a reused callback must not mistake a PREVIOUS run's final save
+        # for this run's (the stale-state skip would drop the new run's
+        # final snapshot)
+        self._last_seen = None
+        self._last_saved = None
 
     def on_epoch_end(self, epoch: int, logs: Dict[str, float]):
         self._last_seen = epoch
         if (epoch + 1) % self.every == 0:
-            self.manager.save(epoch, self._ffmodel())
+            self.manager.save(epoch, self.ffmodel)
             self._last_saved = epoch
 
     def on_train_end(self) -> None:
         if self._last_seen is not None and self._last_saved != self._last_seen:
-            self.manager.save(self._last_seen, self._ffmodel())
+            self.manager.save(self._last_seen, self.ffmodel)
             self._last_saved = self._last_seen

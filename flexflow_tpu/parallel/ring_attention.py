@@ -76,10 +76,9 @@ def ring_attention(
     # built from prime factors, so degree 4 on 8 devices is two axes)
     # rides the PRODUCT ring: ppermute/axis_index over an axis-name
     # tuple use linearized indices consistent with PartitionSpec order
-    axes = (seq_axis,) if isinstance(seq_axis, str) else tuple(seq_axis)
     # collectives and PartitionSpec accept the (possibly length-1)
     # axis-name tuple uniformly — no str/tuple dual form needed
-    axis = axes
+    axes = (seq_axis,) if isinstance(seq_axis, str) else tuple(seq_axis)
     n = 1
     for a in axes:
         n *= mesh.shape[a]
@@ -92,7 +91,7 @@ def ring_attention(
 
     def local_fn(q_l, k_l, v_l):
         # q_l, k_l, v_l: [B, S/n, H, D] local shards
-        idx = jax.lax.axis_index(axis)
+        idx = jax.lax.axis_index(axes)
         q_off = idx * s_local
         perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -133,8 +132,8 @@ def ring_attention(
 
         def step(carry, step_i):
             k_cur, v_cur, acc, m, l = carry
-            k_cur = jax.lax.ppermute(k_cur, axis, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            k_cur = jax.lax.ppermute(k_cur, axes, perm)
+            v_cur = jax.lax.ppermute(v_cur, axes, perm)
             acc, m, l = compute(k_cur, v_cur, step_i, acc, m, l)
             return (k_cur, v_cur, acc, m, l), None
 

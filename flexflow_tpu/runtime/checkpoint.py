@@ -133,6 +133,18 @@ class CheckpointManager:
     def save(self, step: int, model) -> str:
         """Snapshot a compiled FFModel's full training state."""
         assert model.compiled is not None, "compile() before save"
+        import jax
+
+        if jax.process_count() > 1:
+            # every process would np.asarray globally-sharded params
+            # (raises on non-addressable shards) and race on the same
+            # step directory — loud unsupported-feature guard at the
+            # layer every entry point (fit checkpoint_dir, keras
+            # ModelCheckpoint, direct calls) goes through
+            raise NotImplementedError(
+                "CheckpointManager.save is single-host only; use an "
+                "orbax multihost checkpointer for multi-process runs"
+            )
         state_trees = {
             "params": model.params,
             "opt_state": model.opt_state,

@@ -132,11 +132,12 @@ def main():
     args = ap.parse_args()
 
     zoo = _zoo()
-    unknown = [n for n in args.models.split(",") if n not in zoo]
+    names = [n for n in args.models.split(",") if n]
+    unknown = [n for n in names if n not in zoo]
     if unknown:
         ap.error(f"unknown models {unknown}; valid: {sorted(zoo)}")
     report = {}
-    for name in args.models.split(","):
+    for name in names:
         try:
             row = bench_model(name, zoo[name])
         except Exception as e:  # honest artifact: record the failure

@@ -16,7 +16,16 @@ from flexflow_tpu.models import build_mlp_unify
 
 def main():
     config = ff.FFConfig.parse_args()
-    model = build_mlp_unify(config)
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        model = build_mlp_unify(config)  # full 8192^3 (mlp.cc)
+    else:
+        # CPU/virtual-mesh smoke size: three 8192^2 dense layers take
+        # minutes per epoch on a 1-core host; the reference sizes its
+        # examples per-hardware via flags the same way
+        model = build_mlp_unify(config, in_dim=1024,
+                                hidden=(1024, 1024, 1024))
     run_example(model, "mlp_unify")
 
 

@@ -35,6 +35,10 @@ class MachineSpec:
     """
 
     num_devices: int = 1
+    # size of one ICI domain — a host for CPU machines, a SLICE for
+    # multislice TPU (ICI spans all chips of a slice; DCN links slices).
+    # Collectives confined to one domain ride ICI; crossing ones add a
+    # DCN term (search/machine_model.py _spans_dcn).
     devices_per_host: int = 8
     peak_flops: float = 1.97e14  # TPU v5e bf16 MXU peak
     hbm_bandwidth: float = 8.1e11  # bytes/s

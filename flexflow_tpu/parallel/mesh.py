@@ -57,38 +57,51 @@ def build_mesh(devices: Optional[Sequence] = None):
     return Mesh(dev_array, names)
 
 
+def assign_slot_axes(
+    slot_degrees: Sequence[int], pool_sizes: Sequence[int]
+) -> List[Tuple[int, ...]]:
+    """THE canonical slot→axis assignment rule, shared by the lowering
+    (view_slot_axes below) and the cost model's DCN classifier
+    (search/machine_model.py _slot_axes): slots are visited in order;
+    each slot of degree d consumes, for every prime factor of d, the
+    first unused pool axis of that size.  Returns per-slot tuples of
+    pool-axis INDICES; raises ValueError if a degree does not factor
+    into the remaining pool."""
+    used = [False] * len(pool_sizes)
+    out: List[Tuple[int, ...]] = []
+    for d in slot_degrees:
+        taken: List[int] = []
+        for p in prime_factors(d):
+            for i, size in enumerate(pool_sizes):
+                if not used[i] and size == p:
+                    used[i] = True
+                    taken.append(i)
+                    break
+            else:
+                raise ValueError(
+                    f"degree {d} does not factor into mesh axes {list(pool_sizes)}"
+                )
+        out.append(tuple(taken))
+    return out
+
+
 def view_slot_axes(
     mv: MachineView, axis_pool: Sequence[Tuple[str, int]]
 ) -> Dict[int, Tuple[str, ...]]:
     """Assign mesh axes to the view's slots (output dims + replica slot).
 
-    Deterministic: slots are visited in order (0..ndim-1 then
-    REPLICA_SLOT); each slot of degree d consumes, for every prime
-    factor of d, the first unused pool axis of that size.  Raises if
-    the view does not factor into the pool (the search only generates
-    views whose total parts divide the device count).
+    Deterministic (assign_slot_axes): slots are visited in order
+    (0..ndim-1 then REPLICA_SLOT).  Raises if the view does not factor
+    into the pool (the search only generates views whose total parts
+    divide the device count).
     """
-    used = [False] * len(axis_pool)
-    slots: Dict[int, Tuple[str, ...]] = {}
-
-    def take(degree: int) -> Tuple[str, ...]:
-        taken: List[str] = []
-        for p in prime_factors(degree):
-            for i, (name, size) in enumerate(axis_pool):
-                if not used[i] and size == p:
-                    used[i] = True
-                    taken.append(name)
-                    break
-            else:
-                raise ValueError(
-                    f"degree {degree} does not factor into mesh axes {axis_pool}"
-                )
-        return tuple(taken)
-
-    for i, d in enumerate(mv.dim_degrees):
-        slots[i] = take(d) if d > 1 else ()
-    r = mv.replica_degree
-    slots[REPLICA_SLOT] = take(r) if r > 1 else ()
+    degrees = list(mv.dim_degrees) + [mv.replica_degree]
+    idx = assign_slot_axes(degrees, [s for _, s in axis_pool])
+    slots: Dict[int, Tuple[str, ...]] = {
+        i: tuple(axis_pool[j][0] for j in idx[i])
+        for i in range(len(mv.dim_degrees))
+    }
+    slots[REPLICA_SLOT] = tuple(axis_pool[j][0] for j in idx[-1])
     return slots
 
 

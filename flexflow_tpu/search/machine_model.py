@@ -12,7 +12,19 @@ estimate_xfer_cost) with a roofline model parameterized by MachineSpec:
 * collectives: ring formulas over ICI (bandwidth-optimal on a torus):
   allreduce 2(n-1)/n, allgather/reducescatter (n-1)/n, all_to_all
   (n-1)/n² per direction; DCN terms added when a collective spans
-  hosts.
+  ICI domains (hosts on CPU machines, slices on multislice TPU).
+
+Whether a collective crosses DCN depends on WHICH mesh axes it rides,
+not just its size: the lowering's deterministic axis assignment
+(parallel/mesh.py view_slot_axes) gives the first (outermost, strided)
+pool axes to the first view slots, and jax device ordering keeps an
+ICI domain's devices contiguous — so an outer-axis group of size 2 on
+a 2-slice machine crosses DCN while an inner-axis group of size
+devices_per_host does not.  The cost model replays that assignment
+(``_slot_axes``) so DP-across-slices weight syncs are priced at DCN
+bandwidth and within-slice TP collectives at ICI bandwidth — the
+scaling-book multislice recipe.  Callers without slot context fall
+back to the size heuristic (n > devices_per_host).
 """
 
 from __future__ import annotations
@@ -23,7 +35,8 @@ from typing import Dict, Optional, Tuple
 
 from flexflow_tpu.core.machine import MachineSpec, MachineView
 from flexflow_tpu.core.ptensor import ParallelTensorShape
-from flexflow_tpu.ops.base import Operator, ShardAnnot
+from flexflow_tpu.ops.base import REPLICA_SLOT, Operator, ShardAnnot
+from flexflow_tpu.parallel.mesh import assign_slot_axes, prime_factors
 
 # fixed per-op dispatch overhead inside one XLA program (fusion makes
 # this tiny compared to the reference's per-task launch overhead)
@@ -41,6 +54,79 @@ class CostModel:
     # seconds from the real chip — consulted before the roofline
     # (reference: ProfilingRecord cache, simulator.cc:515-554)
     calibration: Optional[object] = None
+    # device count the search runs against (--search-num-nodes style
+    # overrides make this differ from machine.num_devices); the mesh
+    # the strategies lower onto has THIS many devices, so slot→axis
+    # assignment must factor it, not the spec's chip count
+    num_devices: Optional[int] = None
+
+    # ---- slice topology --------------------------------------------------
+    def _slot_axes(self, slot_degrees: Tuple[int, ...]):
+        """Per-slot (stride, size) mesh axes under the lowering's
+        canonical take-first assignment (parallel/mesh.py
+        assign_slot_axes over the prime-factor pool, devices in jax
+        order: axis i has stride = product of later factor sizes).
+        Returns None when a degree does not factor into the pool
+        (invalid view — callers fall back to the size heuristic)."""
+        if not hasattr(self, "_slot_axes_cache"):
+            self._slot_axes_cache = {}
+        if slot_degrees in self._slot_axes_cache:
+            return self._slot_axes_cache[slot_degrees]
+        pool = prime_factors(self.num_devices or self.machine.num_devices)
+        strides = [1] * len(pool) if pool else []
+        for i in range(len(pool) - 2, -1, -1):
+            strides[i] = strides[i + 1] * pool[i + 1]
+        try:
+            idx = assign_slot_axes(slot_degrees, pool)
+            result = tuple(
+                tuple((strides[j], pool[j]) for j in taken) for taken in idx
+            )
+        except ValueError:
+            result = None
+        self._slot_axes_cache[slot_degrees] = result
+        return result
+
+    @staticmethod
+    def _vanished_axes(slot_axes, retained_degree: int):
+        """Axes of one slot that a resharding actually moves.  The dst
+        annot replays the same take-first rule, so its retained factors
+        consume the first SIZE-MATCHING axes of the slot (not simply
+        the first k — with mixed primes, e.g. slot degree 6 = axes
+        (2, 3), a retained degree 3 keeps the size-3 axis); whatever
+        is left over is what the collective rides."""
+        remaining = list(slot_axes)
+        for p in prime_factors(retained_degree):
+            for k, (_, size) in enumerate(remaining):
+                if size == p:
+                    del remaining[k]
+                    break
+        return remaining
+
+    def _spans_dcn(
+        self, slot_degrees: Tuple[int, ...], active_slots, retained=None
+    ) -> Optional[bool]:
+        """Does a collective riding ``active_slots`` of a view with
+        ``slot_degrees`` cross an ICI-domain (slice) boundary?  An axis
+        of stride s and size f spans devices [base, base + s*f); with
+        contiguous devices-per-domain blocks it stays inside one domain
+        iff s*f <= devices_per_host.  ``retained[slot]`` is the degree
+        the destination keeps on that slot — its size-matched axes are
+        excluded (only the vanished axes move).  None = assignment
+        failed."""
+        dph = self.machine.devices_per_host
+        if (self.num_devices or self.machine.num_devices) <= dph:
+            return False
+        axes = self._slot_axes(tuple(slot_degrees))
+        if axes is None:
+            return None
+        retained = retained or {}
+        for slot in active_slots:
+            ax = axes[slot]
+            if slot in retained:
+                ax = self._vanished_axes(ax, retained[slot])
+            if any(stride * size > dph for (stride, size) in ax):
+                return True
+        return False
 
     def _net_groups(self, n: int) -> Optional[list]:
         """Candidate device groups for an n-way collective on the torus.
@@ -96,16 +182,27 @@ class CostModel:
         return t
 
     # ---- collectives -----------------------------------------------------
-    def _link_time(self, bytes_per_device: float, n: int) -> Tuple[float, float]:
+    def _crosses(self, n: int, spans_dcn: Optional[bool]) -> bool:
+        """Does an n-way collective ride DCN?  Axis-aware when the
+        caller resolved it (spans_dcn), size heuristic otherwise."""
+        if spans_dcn is not None:
+            return spans_dcn
+        return n > self.machine.devices_per_host
+
+    def _link_time(
+        self, bytes_per_device: float, n: int, spans_dcn: Optional[bool] = None
+    ) -> Tuple[float, float]:
         """(ici seconds, dcn seconds) for moving bytes once around a ring
-        of n devices; adds a DCN term when the ring spans hosts."""
+        of n devices; adds a DCN term when the ring spans ICI domains."""
         ici = bytes_per_device / self.machine.ici_bandwidth
         dcn = 0.0
-        if n > self.machine.devices_per_host:
+        if self._crosses(n, spans_dcn):
             dcn = bytes_per_device / self.machine.dcn_bandwidth
         return ici, dcn
 
-    def allreduce(self, nbytes: float, n: int) -> float:
+    def allreduce(
+        self, nbytes: float, n: int, spans_dcn: Optional[bool] = None
+    ) -> float:
         if n <= 1:
             return 0.0
         groups = self._net_groups(n)
@@ -114,13 +211,15 @@ class CostModel:
                 "ar", n, nbytes,
                 lambda: max(self.network.ring_allreduce_time(g, nbytes)
                             for g in groups))
-            if n > self.machine.devices_per_host:
+            if self._crosses(n, spans_dcn):
                 t += 2.0 * (n - 1) / n * nbytes / self.machine.dcn_bandwidth
             return t
-        ici, dcn = self._link_time(2.0 * (n - 1) / n * nbytes, n)
+        ici, dcn = self._link_time(2.0 * (n - 1) / n * nbytes, n, spans_dcn)
         return ici + dcn + 2 * (n - 1) * self.machine.ici_latency
 
-    def allgather(self, nbytes_shard: float, n: int) -> float:
+    def allgather(
+        self, nbytes_shard: float, n: int, spans_dcn: Optional[bool] = None
+    ) -> float:
         if n <= 1:
             return 0.0
         groups = self._net_groups(n)
@@ -129,16 +228,20 @@ class CostModel:
                 "ag", n, nbytes_shard,
                 lambda: max(self.network.allgather_time(g, nbytes_shard)
                             for g in groups))
-            if n > self.machine.devices_per_host:
+            if self._crosses(n, spans_dcn):
                 t += (n - 1) * nbytes_shard / self.machine.dcn_bandwidth
             return t
-        ici, dcn = self._link_time((n - 1) * nbytes_shard, n)
+        ici, dcn = self._link_time((n - 1) * nbytes_shard, n, spans_dcn)
         return ici + dcn + (n - 1) * self.machine.ici_latency
 
-    def reducescatter(self, nbytes: float, n: int) -> float:
-        return self.allgather(nbytes / max(n, 1), n)
+    def reducescatter(
+        self, nbytes: float, n: int, spans_dcn: Optional[bool] = None
+    ) -> float:
+        return self.allgather(nbytes / max(n, 1), n, spans_dcn)
 
-    def all_to_all(self, nbytes_shard: float, n: int) -> float:
+    def all_to_all(
+        self, nbytes_shard: float, n: int, spans_dcn: Optional[bool] = None
+    ) -> float:
         if n <= 1:
             return 0.0
         groups = self._net_groups(n)
@@ -147,13 +250,13 @@ class CostModel:
                 "a2a", n, nbytes_shard,
                 lambda: max(self.network.all_to_all_time(g, nbytes_shard)
                             for g in groups))
-            if n > self.machine.devices_per_host:
+            if self._crosses(n, spans_dcn):
                 t += nbytes_shard * (n - 1) / n / self.machine.dcn_bandwidth
             return t
         # each device exchanges (n-1)/n of its shard; ICI torus is
         # dimension-ordered so add a hop-count factor ~sqrt(n)/2
         hops = max(1.0, math.sqrt(n) / 2.0)
-        ici, dcn = self._link_time(nbytes_shard * (n - 1) / n * hops, n)
+        ici, dcn = self._link_time(nbytes_shard * (n - 1) / n * hops, n, spans_dcn)
         return ici + dcn + (n - 1) * self.machine.ici_latency
 
     # ---- resharding (parallel-op) cost ----------------------------------
@@ -196,9 +299,17 @@ class CostModel:
         n_src = max(1, src.num_parts)
         n_dst = max(1, dst.num_parts)
         total = shape.num_bytes
+        # slot degrees in the producer view's assignment order,
+        # approximated by the tensor's own dim order (exact when the
+        # annot's parallel_idx is the identity — the common case)
+        src_slots = tuple(src.degrees) + (src.replica,)
         if src.partial:
-            # partial-sum producer: reduction (+ possible reshard)
-            return self.allreduce(total / max(n_dst // src.replica, 1), src.replica)
+            # partial-sum producer: reduction (+ possible reshard).
+            # The psum rides the replica/contraction slot.
+            spans = self._spans_dcn(src_slots, [len(src.degrees)])
+            return self.allreduce(
+                total / max(n_dst // src.replica, 1), src.replica, spans
+            )
         shard_src = total / max(n_src // max(src.replica, 1), 1)
         n = max(n_src, n_dst)
         src_deg = 1
@@ -216,12 +327,30 @@ class CostModel:
         if dst_deg < src_deg and all(
             sd % dd == 0 for sd, dd in zip(src.degrees, dst.degrees)
         ):
-            # combine: all-gather over the vanished degree
-            return self.allgather(shard_src, src_deg // max(dst_deg, 1))
+            # combine: all-gather over the vanished degree — only the
+            # TAIL axes of each shrinking slot move (the retained dst
+            # degree keeps the slot's first-assigned axes)
+            shrink = [
+                i for i, (sd, dd) in enumerate(zip(src.degrees, dst.degrees))
+                if sd > dd
+            ]
+            spans = self._spans_dcn(
+                src_slots, shrink, {i: dst.degrees[i] for i in shrink},
+            )
+            return self.allgather(shard_src, src_deg // max(dst_deg, 1), spans)
         if src_deg == dst_deg and src.replica == dst.replica:
             # pure dim-to-dim migration at constant total degree (e.g.
-            # [B/8, S] -> [B, S/8]): GSPMD emits a true all-to-all
-            return self.all_to_all(shard_src, n)
+            # [B/8, S] -> [B, S/8]): GSPMD emits a true all-to-all over
+            # the axes each shrinking slot releases
+            moved = [
+                i for i, (sd, dd) in enumerate(zip(src.degrees, dst.degrees))
+                if sd > dd
+            ]
+            spans = self._spans_dcn(
+                src_slots, moved,
+                {i: math.gcd(src.degrees[i], dst.degrees[i]) for i in moved},
+            )
+            return self.all_to_all(shard_src, n, spans)
         # mixed transition (degrees change AND migrate across dims, or
         # the replica factor changes): the SPMD partitioner's fallback
         # is "involuntary full rematerialization" — all-gather to
@@ -229,7 +358,10 @@ class CostModel:
         # spmd_partitioner.cc:652).  Charging only an all-to-all here
         # made the search pick reshardings that execution pays full
         # gather for.
-        return self.allgather(shard_src, src_deg) + OP_OVERHEAD_S
+        spans = self._spans_dcn(
+            src_slots, [i for i, d in enumerate(src.degrees) if d > 1]
+        )
+        return self.allgather(shard_src, src_deg, spans) + OP_OVERHEAD_S
 
     def placement_move_cost(
         self, shape: ParallelTensorShape, src: Optional[ShardAnnot]
@@ -249,6 +381,10 @@ class CostModel:
             osh = op.propagate(mv)
         except AssertionError:
             return math.inf
+        # view slot degrees in the lowering's assignment order
+        # (output dims, then the replica/contraction slot)
+        nslots = len(mv.dim_degrees)
+        slot_degrees = tuple(mv.dim_degrees) + (mv.replica_degree,)
         total = 0.0
         for ws, annot in zip(op._weight_specs, osh.weights):
             if annot is None or annot.replica <= 1:
@@ -259,7 +395,22 @@ class CostModel:
             shard_elems = n
             for d in annot.degrees:
                 shard_elems //= max(d, 1)
-            total += self.allreduce(shard_elems * ws.dtype.itemsize, annot.replica)
+            # the grad psum rides every view slot the weight itself
+            # does NOT consume (the weight is replicated across them)
+            weight_slots = {
+                s for s, d in zip(annot.parallel_idx(), annot.degrees)
+                if d > 1 and s != -1
+            }
+            active = [
+                i for i in range(nslots)
+                if slot_degrees[i] > 1 and i not in weight_slots
+            ]
+            if mv.replica_degree > 1 and REPLICA_SLOT not in weight_slots:
+                active.append(nslots)
+            spans = self._spans_dcn(slot_degrees, active)
+            total += self.allreduce(
+                shard_elems * ws.dtype.itemsize, annot.replica, spans
+            )
         return total
 
     # ---- memory ----------------------------------------------------------

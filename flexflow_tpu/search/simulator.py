@@ -53,7 +53,8 @@ class Simulator:
                 network = ici_network(machine, num_devices=self.num_devices)
             except (AssertionError, ValueError):
                 network = None
-        self.cost = CostModel(machine, network=network, calibration=calibration)
+        self.cost = CostModel(machine, network=network, calibration=calibration,
+                              num_devices=self.num_devices)
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
         # propagate()/op_cost results per (op signature, view): structural
         # keys stay valid across graph copies and op lifetimes (an id()

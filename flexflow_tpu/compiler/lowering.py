@@ -277,7 +277,113 @@ class CompiledModel:
                     v = jax.device_put(v, rep)
                 state[f"{node.op.name}/{name}"] = v
         self.param_shardings = shardings
+        self._zero_shardings = None
+        if getattr(self.config, "zero_dp_shard", False) and self._multi_device:
+            zs: Dict[str, Dict[str, jax.sharding.NamedSharding]] = {}
+            for op_name, w_name, shape, _, _, sh in specs:
+                zs.setdefault(op_name, {})[w_name] = self._zero_augmented(
+                    sh, shape
+                )
+            self._zero_shardings = zs
         return params, state
+
+    # ------------------------------------------------------------------
+    def _zero_augmented(self, sh, shape):
+        """ZeRO-1 / weight-update sharding (arXiv:2004.13336): extend a
+        weight's PartitionSpec with the mesh axes the weight is
+        replicated over, placed on the largest evenly-divisible dim.
+        Optimizer state stored with this sharding makes GSPMD lower the
+        grad psum to reduce-scatter and the updated-weight broadcast to
+        all-gather — same ring bytes, 1/replication the memory and
+        update compute."""
+        spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
+        used = set()
+        for e in spec:
+            if e is None:
+                continue
+            for a in (e if isinstance(e, tuple) else (e,)):
+                used.add(a)
+        free = [(n, s) for n, s in self.mesh.shape.items()
+                if n not in used and s > 1]
+        if not free:
+            return sh
+        for d in sorted(range(len(shape)), key=lambda i: -shape[i]):
+            cur = spec[d]
+            cur_axes = () if cur is None else (
+                cur if isinstance(cur, tuple) else (cur,)
+            )
+            deg = 1
+            for a in cur_axes:
+                deg *= self.mesh.shape[a]
+            rem = shape[d] // deg if deg and shape[d] % deg == 0 else 0
+            extra = []
+            for n, s in free:
+                if rem and rem % s == 0:
+                    extra.append(n)
+                    rem //= s
+            if extra:
+                spec[d] = tuple(cur_axes) + tuple(extra)
+                free = [(n, s) for n, s in free if n not in extra]
+            if not free:
+                break
+        while spec and spec[-1] is None:
+            spec.pop()
+        return jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(*spec)
+        )
+
+    def shard_opt_state(self, opt_state):
+        """Re-place freshly initialized optimizer state under the
+        ZeRO-1 shardings (no-op unless config.zero_dp_shard).  Slots
+        mirroring the params tree (Adam m/v, SGD momentum v) are
+        sharded; scalars (step) stay replicated."""
+        if getattr(self, "_zero_shardings", None) is None:
+            return opt_state
+        out = {}
+        for slot, sub in opt_state.items():
+            if isinstance(sub, dict):
+                out[slot] = {
+                    op: {
+                        w: jax.device_put(x, self._zero_shardings[op][w])
+                        for w, x in ws.items()
+                    }
+                    for op, ws in sub.items()
+                }
+            else:
+                out[slot] = sub
+        return out
+
+    def _constrain_update(self, new_params, new_opt_state):
+        """Pin the post-update shardings inside the jitted step: params
+        back to their layer shardings (the all-gather side of ZeRO),
+        optimizer slots to the augmented shardings (the reduce-scatter
+        side)."""
+        if getattr(self, "_zero_shardings", None) is None:
+            return new_params, new_opt_state
+        new_params = {
+            op: {
+                w: jax.lax.with_sharding_constraint(
+                    x, self.param_shardings[op][w]
+                )
+                for w, x in ws.items()
+            }
+            for op, ws in new_params.items()
+        }
+        out = {}
+        for slot, sub in new_opt_state.items():
+            if isinstance(sub, dict):
+                out[slot] = {
+                    op: {
+                        w: jax.lax.with_sharding_constraint(
+                            x, self._zero_shardings[op][w]
+                        )
+                        for w, x in ws.items()
+                    }
+                    for op, ws in sub.items()
+                }
+            else:
+                out[slot] = sub
+        return new_params, out
 
     # ------------------------------------------------------------------
     def _loss_from(self, logits, labels, new_state):
@@ -299,6 +405,9 @@ class CompiledModel:
             loss_fn, has_aux=True
         )(params)
         new_params, new_opt_state = optimizer.apply(params, grads, opt_state)
+        new_params, new_opt_state = self._constrain_update(
+            new_params, new_opt_state
+        )
         m = compute_metrics(self.metric_types, self.loss_type, logits, labels)
         return new_params, new_opt_state, new_state, loss, m
 

@@ -78,6 +78,14 @@ class FFConfig:
     remat: bool = False  # rematerialize activations in backward
     # (jax.checkpoint) — trades FLOPs for HBM; the reference has no
     # equivalent (Legion keeps all activations resident)
+    zero_dp_shard: bool = False  # ZeRO-1 / weight-update sharding
+    # (arXiv:2004.13336): shard optimizer state (and the update
+    # compute) of replicated weights over the mesh axes they are
+    # replicated on.  Grad psum becomes reduce-scatter + all-gather of
+    # the update (same ring bytes), optimizer memory and update FLOPs
+    # drop by the replication factor.  Beyond the reference (its PS
+    # mode reduces on ONE owner device, optimizer.cc:90-155 — this
+    # spreads the update over all of them)
     seed: int = 0
     iteration: IterationConfig = field(default_factory=IterationConfig)
 
@@ -131,6 +139,8 @@ class FFConfig:
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--trace-steps", dest="trace_steps", type=int, default=1)
         p.add_argument("--remat", action="store_true")
+        p.add_argument("--zero-dp-shard", dest="zero_dp_shard",
+                       action="store_true")
         p.add_argument("--seed", type=int, default=0)
         args, _ = p.parse_known_args(argv)
         search_devs = args.search_num_workers * max(1, args.search_num_nodes or 1)
@@ -157,5 +167,6 @@ class FFConfig:
             profiling=args.profiling,
             trace_steps=args.trace_steps,
             remat=args.remat,
+            zero_dp_shard=args.zero_dp_shard,
             seed=args.seed,
         )

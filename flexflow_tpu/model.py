@@ -398,6 +398,13 @@ class FFModel:
                 "mesh= is not supported with pipeline= (the pipelined "
                 "lowering builds its own pp-leading mesh)"
             )
+        if pipeline is not None and self.config.zero_dp_shard:
+            raise NotImplementedError(
+                "zero_dp_shard is not supported with pipeline= yet — the "
+                "pipelined lowering manages its own per-stage placement; "
+                "silently ignoring the flag would leave optimizer state "
+                "replicated while the user expects 1/N memory"
+            )
         if strategy is None:
             if pipeline is not None:
                 # dp over the devices left after the pp axis is carved off
@@ -473,6 +480,7 @@ class FFModel:
         )
         self.params, self.state = self.compiled.init_params(self.config.seed)
         self.opt_state = self.optimizer.init_state(self.params)
+        self.opt_state = self.compiled.shard_opt_state(self.opt_state)
         return self.compiled
 
     def recompile(self):
@@ -506,6 +514,7 @@ class FFModel:
         # and carry over leaves whose key paths survived the alteration
         self.opt_state = self.optimizer.init_state(self.params)
         self.opt_state = _merge_matching(self.opt_state, old_opt)
+        self.opt_state = self.compiled.shard_opt_state(self.opt_state)
         return self.compiled
 
     # ------------------------------------------------------------------

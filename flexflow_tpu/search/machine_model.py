@@ -106,10 +106,13 @@ class CostModel:
         self, slot_degrees: Tuple[int, ...], active_slots, retained=None
     ) -> Optional[bool]:
         """Does a collective riding ``active_slots`` of a view with
-        ``slot_degrees`` cross an ICI-domain (slice) boundary?  An axis
-        of stride s and size f spans devices [base, base + s*f); with
-        contiguous devices-per-domain blocks it stays inside one domain
-        iff s*f <= devices_per_host.  ``retained[slot]`` is the degree
+        ``slot_degrees`` cross an ICI-domain (slice) boundary?  Groups
+        along an axis of stride s and size f always live in ALIGNED
+        blocks of span s*f (inner axes contribute < s to the base,
+        outer axes multiples of the span), so a group stays inside one
+        contiguous devices-per-domain block iff the span both fits and
+        DIVIDES the domain size — span 3 with domain 8 crosses at the
+        [6,9) block even though 3 < 8.  ``retained[slot]`` is the degree
         the destination keeps on that slot — its size-matched axes are
         excluded (only the vanished axes move).  None = assignment
         failed."""
@@ -124,8 +127,10 @@ class CostModel:
             ax = axes[slot]
             if slot in retained:
                 ax = self._vanished_axes(ax, retained[slot])
-            if any(stride * size > dph for (stride, size) in ax):
-                return True
+            for stride, size in ax:
+                span = stride * size
+                if span > dph or dph % span != 0:
+                    return True
         return False
 
     def _net_groups(self, n: int) -> Optional[list]:

@@ -130,6 +130,24 @@ def test_mixed_prime_combine_matches_retained_axes_by_size():
     assert t_multi > t_flat * 5, (t_flat, t_multi)
 
 
+def test_unaligned_span_crosses_domain_boundary():
+    """12 devices, 8 per domain: a degree-3 group (stride-1 axis, span
+    3) fits inside 8 but does NOT divide it — the aligned 3-blocks are
+    [0,3) [3,6) [6,9) [9,12) and [6,9) straddles the domain boundary,
+    so the gather must be priced at DCN despite span < domain."""
+    from flexflow_tpu.core.ptensor import ParallelTensorShape
+    from flexflow_tpu.ops.base import ShardAnnot
+
+    spec12 = dataclasses.replace(MachineSpec.tpu_v5e(12), devices_per_host=8)
+    spec12_flat = dataclasses.replace(spec12, devices_per_host=12)
+    cm_multi = CostModel(spec12, num_devices=12)
+    cm_flat = CostModel(spec12_flat, num_devices=12)
+    shape = ParallelTensorShape.make((48, 4096), "float32")
+    t_multi = cm_multi.xfer_cost(shape, ShardAnnot((3, 1)), ShardAnnot((1, 1)))
+    t_flat = cm_flat.xfer_cost(shape, ShardAnnot((3, 1)), ShardAnnot((1, 1)))
+    assert t_multi > t_flat * 5, (t_flat, t_multi)
+
+
 def test_dp8_sync_crosses_dcn_on_two_slices():
     """Full 8-way DP sync spans both slices on the 2x4 machine (size
     heuristic and axis rule agree here)."""

@@ -296,6 +296,8 @@ class CompiledModel:
         grad psum to reduce-scatter and the updated-weight broadcast to
         all-gather — same ring bytes, 1/replication the memory and
         update compute."""
+        from flexflow_tpu.parallel.mesh import place_zero_factors
+
         spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
         used = set()
         for e in spec:
@@ -307,7 +309,8 @@ class CompiledModel:
                 if n not in used and s > 1]
         if not free:
             return sh
-        for d in sorted(range(len(shape)), key=lambda i: -shape[i]):
+        extents = []
+        for d in range(len(shape)):
             cur = spec[d]
             cur_axes = () if cur is None else (
                 cur if isinstance(cur, tuple) else (cur,)
@@ -315,43 +318,46 @@ class CompiledModel:
             deg = 1
             for a in cur_axes:
                 deg *= self.mesh.shape[a]
-            rem = shape[d] // deg if deg and shape[d] % deg == 0 else 0
-            extra = []
-            for n, s in free:
-                if rem and rem % s == 0:
-                    extra.append(n)
-                    rem //= s
-            if extra:
-                spec[d] = tuple(cur_axes) + tuple(extra)
-                free = [(n, s) for n, s in free if n not in extra]
-            if not free:
-                break
+            extents.append(
+                shape[d] // deg if deg and shape[d] % deg == 0 else 1
+            )
+        for d, fi in place_zero_factors(extents, [s for _, s in free]):
+            cur = spec[d]
+            cur_axes = () if cur is None else (
+                cur if isinstance(cur, tuple) else (cur,)
+            )
+            spec[d] = tuple(cur_axes) + (free[fi][0],)
         while spec and spec[-1] is None:
             spec.pop()
         return jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec(*spec)
         )
 
-    def shard_opt_state(self, opt_state):
-        """Re-place freshly initialized optimizer state under the
-        ZeRO-1 shardings (no-op unless config.zero_dp_shard).  Slots
-        mirroring the params tree (Adam m/v, SGD momentum v) are
-        sharded; scalars (step) stay replicated."""
-        if getattr(self, "_zero_shardings", None) is None:
-            return opt_state
+    @staticmethod
+    def _map_param_slots(opt_state, leaf_fn):
+        """Apply ``leaf_fn(op, w, x)`` to every leaf of the optimizer
+        slots that mirror the params tree (Adam m/v, SGD momentum v);
+        scalar slots (step) pass through."""
         out = {}
         for slot, sub in opt_state.items():
             if isinstance(sub, dict):
                 out[slot] = {
-                    op: {
-                        w: jax.device_put(x, self._zero_shardings[op][w])
-                        for w, x in ws.items()
-                    }
+                    op: {w: leaf_fn(op, w, x) for w, x in ws.items()}
                     for op, ws in sub.items()
                 }
             else:
                 out[slot] = sub
         return out
+
+    def shard_opt_state(self, opt_state):
+        """Re-place freshly initialized optimizer state under the
+        ZeRO-1 shardings (no-op unless config.zero_dp_shard)."""
+        if getattr(self, "_zero_shardings", None) is None:
+            return opt_state
+        return self._map_param_slots(
+            opt_state,
+            lambda op, w, x: jax.device_put(x, self._zero_shardings[op][w]),
+        )
 
     def _constrain_update(self, new_params, new_opt_state):
         """Pin the post-update shardings inside the jitted step: params
@@ -369,21 +375,13 @@ class CompiledModel:
             }
             for op, ws in new_params.items()
         }
-        out = {}
-        for slot, sub in new_opt_state.items():
-            if isinstance(sub, dict):
-                out[slot] = {
-                    op: {
-                        w: jax.lax.with_sharding_constraint(
-                            x, self._zero_shardings[op][w]
-                        )
-                        for w, x in ws.items()
-                    }
-                    for op, ws in sub.items()
-                }
-            else:
-                out[slot] = sub
-        return new_params, out
+        new_opt_state = self._map_param_slots(
+            new_opt_state,
+            lambda op, w, x: jax.lax.with_sharding_constraint(
+                x, self._zero_shardings[op][w]
+            ),
+        )
+        return new_params, new_opt_state
 
     # ------------------------------------------------------------------
     def _loss_from(self, logits, labels, new_state):

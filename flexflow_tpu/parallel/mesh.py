@@ -85,6 +85,30 @@ def assign_slot_axes(
     return out
 
 
+def place_zero_factors(
+    extents: Sequence[int], factor_sizes: Sequence[int]
+) -> List[Tuple[int, int]]:
+    """THE greedy placement rule for ZeRO-1 optimizer-state sharding,
+    shared by the execution lowering (compiler/lowering.py
+    _zero_augmented) and the search's memory model
+    (search/machine_model.py op_memory) so feasibility is judged by
+    exactly what execution will do: weight dims are visited
+    largest-remaining-extent first, replication factors in pool order,
+    and a factor lands on the first visited dim it divides evenly.
+    Returns (dim, factor_index) placements; factors that fit nowhere
+    are simply not placed (that share of the state stays replicated)."""
+    remaining = list(range(len(factor_sizes)))
+    ext = list(extents)
+    out: List[Tuple[int, int]] = []
+    for d in sorted(range(len(ext)), key=lambda i: -ext[i]):
+        for fi in list(remaining):
+            if ext[d] > 1 and ext[d] % factor_sizes[fi] == 0:
+                out.append((d, fi))
+                ext[d] //= factor_sizes[fi]
+                remaining.remove(fi)
+    return out
+
+
 def view_slot_axes(
     mv: MachineView, axis_pool: Sequence[Tuple[str, int]]
 ) -> Dict[int, Tuple[str, ...]]:

@@ -420,7 +420,8 @@ def optimize_strategy(
                 log.log(f"{len(calibration)} measured records")
             if config.calibration_file:
                 calibration.save(config.calibration_file)
-    sim = Simulator(config.machine_spec, num_devices=n, calibration=calibration)
+    sim = Simulator(config.machine_spec, num_devices=n, calibration=calibration,
+                    zero_dp_shard=config.zero_dp_shard)
     helper = SearchHelper(sim, n)
 
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
@@ -466,7 +467,8 @@ def optimize_strategy(
                     if config.calibration_file:
                         calibration.save(config.calibration_file)
                     sim2 = Simulator(config.machine_spec, num_devices=n,
-                                     calibration=calibration)
+                                     calibration=calibration,
+                                     zero_dp_shard=config.zero_dp_shard)
                     best_cost = sim2.simulate(graph, best_strategy)
                     c2 = sim2.simulate(g2, s2)
             if c2 < best_cost and s2:
@@ -494,7 +496,8 @@ def mcmc_optimize(
     from flexflow_tpu.search.views import candidate_views
 
     n = config.search_devices
-    sim = Simulator(config.machine_spec, num_devices=n)
+    sim = Simulator(config.machine_spec, num_devices=n,
+                    zero_dp_shard=config.zero_dp_shard)
     rng = random.Random(seed)
     nodes = graph.topo_order()
 

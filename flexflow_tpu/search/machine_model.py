@@ -36,7 +36,11 @@ from typing import Dict, Optional, Tuple
 from flexflow_tpu.core.machine import MachineSpec, MachineView
 from flexflow_tpu.core.ptensor import ParallelTensorShape
 from flexflow_tpu.ops.base import REPLICA_SLOT, Operator, ShardAnnot
-from flexflow_tpu.parallel.mesh import assign_slot_axes, prime_factors
+from flexflow_tpu.parallel.mesh import (
+    assign_slot_axes,
+    place_zero_factors,
+    prime_factors,
+)
 
 # fixed per-op dispatch overhead inside one XLA program (fusion makes
 # this tiny compared to the reference's per-task launch overhead)
@@ -59,6 +63,11 @@ class CostModel:
     # the strategies lower onto has THIS many devices, so slot→axis
     # assignment must factor it, not the spec's chip count
     num_devices: Optional[int] = None
+    # execution shards optimizer state of replicated weights over their
+    # replication axes (config.zero_dp_shard) — memory feasibility must
+    # credit the 1/replica optimizer share or the search rejects
+    # strategies that actually fit
+    zero_dp_shard: bool = False
 
     # ---- slice topology --------------------------------------------------
     def _slot_axes(self, slot_degrees: Tuple[int, ...]):
@@ -432,7 +441,32 @@ class CostModel:
                 n *= d
             for d in annot.degrees:
                 n //= max(d, 1)
-            mem += n * ws.dtype.itemsize * 3  # weight + grad + opt state
+            w = n * ws.dtype.itemsize
+            opt = w  # one optimizer-state share (weight + grad + opt)
+            if self.zero_dp_shard:
+                # mirror execution exactly (lowering._zero_augmented):
+                # state shards over the mesh axes the weight does NOT
+                # consume — implicit replication included — but only
+                # onto evenly-divisible dims (place_zero_factors is THE
+                # shared rule); unplaceable factors stay replicated, so
+                # an indivisible weight is NOT credited savings it
+                # won't get at runtime
+                nd = self.num_devices or self.machine.num_devices
+                sharded = 1
+                for d in annot.degrees:
+                    sharded *= max(d, 1)
+                if sharded >= 1 and nd % sharded == 0 and nd > sharded:
+                    extents = [
+                        s // max(d, 1) if d and s % max(d, 1) == 0 else 1
+                        for s, d in zip(ws.shape, annot.degrees)
+                    ]
+                    free = prime_factors(nd // sharded)
+                    placed = place_zero_factors(extents, free)
+                    achieved = 1
+                    for _, fi in placed:
+                        achieved *= free[fi]
+                    opt = w / achieved
+            mem += w * 2 + opt
         for shape, annot in zip(op.output_shapes, osh.outputs):
             n = shape.num_elements
             for d in annot.degrees:

@@ -29,7 +29,7 @@ from flexflow_tpu.search.machine_model import CostModel
 class Simulator:
     def __init__(self, machine: MachineSpec, num_devices: Optional[int] = None,
                  use_network_model: bool = True, calibration=None,
-                 placement_overlap: bool = False):
+                 placement_overlap: bool = False, zero_dp_shard: bool = False):
         self.machine = machine
         self.num_devices = num_devices or machine.num_devices
         # placement_overlap=True credits inter-op COMPUTE overlap for
@@ -54,7 +54,8 @@ class Simulator:
             except (AssertionError, ValueError):
                 network = None
         self.cost = CostModel(machine, network=network, calibration=calibration,
-                              num_devices=self.num_devices)
+                              num_devices=self.num_devices,
+                              zero_dp_shard=zero_dp_shard)
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
         # propagate()/op_cost results per (op signature, view): structural
         # keys stay valid across graph copies and op lifetimes (an id()

@@ -45,6 +45,39 @@ def test_zero_dp_shard_matches_dense_numerics(mesh8):
             )
 
 
+def test_zero_dp_shard_shrinks_simulated_memory():
+    """The memory-feasibility model must credit the 1/replica optimizer
+    share, or the search rejects big-model DP strategies that ZeRO
+    execution actually fits in HBM."""
+    from flexflow_tpu.core.machine import MachineSpec, MachineView
+    from flexflow_tpu.search.simulator import Simulator
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 4096])
+    m.dense(x, 4096, name="big")
+    op = m.node_by_name("big").op
+    dp8 = MachineView(dim_degrees=(8, 1))
+    plain = Simulator(MachineSpec.tpu_v5e(8), num_devices=8)
+    zero = Simulator(MachineSpec.tpu_v5e(8), num_devices=8,
+                     zero_dp_shard=True)
+    m_plain = plain.cost.op_memory(op, dp8)
+    m_zero = zero.cost.op_memory(op, dp8)
+    assert m_zero < m_plain, (m_zero, m_plain)
+    # the saving is one optimizer share scaled by 7/8 of the weight
+    w = 4096 * 4096 * 4
+    assert abs((m_plain - m_zero) - w * 7 / 8) / w < 0.01
+
+    # an INDIVISIBLE weight (odd dims) cannot be sharded by execution's
+    # placement rule, so the model must NOT credit savings it won't get
+    m2 = ff.FFModel(ff.FFConfig(batch_size=8, num_devices=8,
+                                only_data_parallel=True))
+    x2 = m2.create_tensor([8, 4097])
+    m2.dense(x2, 4097, use_bias=False, name="odd")
+    op2 = m2.node_by_name("odd").op
+    assert zero.cost.op_memory(op2, dp8) == plain.cost.op_memory(op2, dp8)
+
+
 def test_zero_dp_shard_state_is_sharded(mesh8):
     m_z, _ = _run(zero=True)
     v = m_z.opt_state["v"]["fc1"]["kernel"]

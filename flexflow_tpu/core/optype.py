@@ -16,6 +16,7 @@ class OperatorType(enum.Enum):
     NOOP = "noop"
     INPUT = "input"
     WEIGHT = "weight"
+    CONSTANT = "constant"
 
     # ---- dense compute ops ----------------------------------------------
     CONV2D = "conv2d"

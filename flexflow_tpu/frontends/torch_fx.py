@@ -49,9 +49,15 @@ class OpRecord:
     attrs: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> str:
+        def _default(o):
+            if hasattr(o, "tolist"):  # ndarray constants stay unboxed
+                return o.tolist()     # in memory; lists only on disk
+            raise TypeError(f"unserializable attr {type(o).__name__}")
+
         return json.dumps(
             {"name": self.name, "kind": self.kind, "inputs": self.inputs,
-             "attrs": self.attrs}
+             "attrs": self.attrs},
+            default=_default,
         )
 
     @staticmethod
@@ -109,6 +115,7 @@ class _Tracer:
         self.records: List[OpRecord] = []
         self.env: Dict[str, str] = {}  # fx node name -> record output name
         self.literals: Dict[str, Any] = {}  # shape/int values traced as nodes
+        self.constants: Dict[str, Any] = {}  # node name -> folded torch.Tensor
         self.input_names: List[str] = []
         self.output_names: List[str] = []
 
@@ -118,7 +125,81 @@ class _Tracer:
         return name
 
     def ref(self, arg) -> str:
+        if arg.name not in self.env and arg.name in self.constants:
+            # a folded constant flowing into a real graph op: materialize
+            # it as a ConstantOp record on first use
+            val = self.constants[arg.name]
+            import numpy as np
+
+            arr = val.detach().cpu().numpy() if hasattr(val, "detach") else np.asarray(val)
+            self.env[arg.name] = self.emit(
+                "constant", arg.name, [],
+                value=arr, dtype=str(arr.dtype),
+            )
         return self.env[arg.name]
+
+    # -- constant folding -------------------------------------------------
+    def _resolve_const(self, a):
+        """(value, ok): resolve an fx arg to a concrete python/torch
+        value if it is a folded constant, a traced literal, or a plain
+        literal (recursing into tuples/lists/slices).  ok=False means
+        the arg depends on real graph tensors."""
+        fx = self.torch.fx
+        if isinstance(a, fx.Node):
+            if a.name in self.constants:
+                return self.constants[a.name], True
+            if a.name in self.literals:
+                return self.literals[a.name], True
+            return None, False
+        if isinstance(a, (tuple, list)):
+            vals = []
+            for x in a:
+                v, ok = self._resolve_const(x)
+                if not ok:
+                    return None, False
+                vals.append(v)
+            return type(a)(vals), True
+        if isinstance(a, slice):
+            parts = []
+            for x in (a.start, a.stop, a.step):
+                v, ok = self._resolve_const(x)
+                if not ok:
+                    return None, False
+                parts.append(v)
+            return slice(*parts), True
+        return a, True
+
+    def _try_fold(self, node) -> bool:
+        """Execute a node whose inputs are all constants/literals (the
+        imported model's mask-construction and position-id chains —
+        transformers BERT builds its extended attention mask from
+        ones/eq/sub/finfo/masked_fill on traced shapes).  Stores a
+        tensor result in ``constants``, anything else in ``literals``."""
+        torch = self.torch
+        for a in list(node.args) + list(node.kwargs.values()):
+            _, ok = self._resolve_const(a)
+            if not ok:
+                return False
+        args = []
+        for a in node.args:
+            v, _ = self._resolve_const(a)
+            args.append(v)
+        kwargs = {}
+        for k, a in node.kwargs.items():
+            v, _ = self._resolve_const(a)
+            kwargs[k] = v
+        try:
+            if node.op == "call_method":
+                out = getattr(args[0], node.target)(*args[1:], **kwargs)
+            else:
+                out = node.target(*args, **kwargs)
+        except Exception:
+            return False
+        if isinstance(out, torch.Tensor):
+            self.constants[node.name] = out
+        else:
+            self.literals[node.name] = out
+        return True
 
     def run(self) -> List[OpRecord]:
         for node in self.gm.graph.nodes:
@@ -135,7 +216,10 @@ class _Tracer:
             return node.name
         if node.op == "output":
             args = node.args[0]
-            outs = args if isinstance(args, (tuple, list)) else (args,)
+            if isinstance(args, dict):  # HF ModelOutput-style dict
+                outs = tuple(args.values())
+            else:
+                outs = args if isinstance(args, (tuple, list)) else (args,)
             self.output_names = [self.ref(a) for a in outs]
             return None
         if node.op == "call_module":
@@ -144,10 +228,30 @@ class _Tracer:
         if node.op in ("call_function", "call_method"):
             return self.visit_function(node)
         if node.op == "get_attr":
+            # module buffers (position_ids, token_type_ids, ...) are
+            # compile-time constants of the imported graph
+            import operator as _op
+
+            try:
+                val = _op.attrgetter(node.target)(self.gm)
+            except AttributeError:
+                val = None
+            if isinstance(val, self.torch.nn.Parameter):
+                # a TRAINABLE tensor used functionally (F.linear(x,
+                # self.weight), custom scales): baking it in as a frozen
+                # constant would silently stop it training
+                raise NotImplementedError(
+                    f"get_attr parameter {node.target!r}: functionally-used "
+                    "nn.Parameters are not importable; wrap them in a "
+                    "supported layer module"
+                )
+            if isinstance(val, self.torch.Tensor):
+                self.constants[node.name] = val  # non-trainable buffer
+                return None
             raise NotImplementedError(
-                f"get_attr node {node.target!r}: free tensor attributes are "
-                "not importable; register them as module buffers/parameters "
-                "of a supported layer"
+                f"get_attr node {node.target!r}: free non-tensor attributes "
+                "are not importable; register them as module buffers/"
+                "parameters of a supported layer"
             )
         raise NotImplementedError(f"fx node op {node.op!r}")
 
@@ -219,6 +323,128 @@ class _Tracer:
                 return self.emit(kind, name, x)
         raise NotImplementedError(f"unsupported torch module {type(mod).__name__}")
 
+    def _sdpa(self, node) -> str:
+        """torch.nn.functional.scaled_dot_product_attention, decomposed
+        into the PCG's own vocabulary (transpose / batch_matmul /
+        scalar_multiply / softmax / dropout) — the reference's frontend
+        has no sdpa path at all (its MHA is the fused cuDNN op only);
+        on TPU the decomposition is exactly what XLA fuses well."""
+        import math
+
+        name = node.name
+        q, k, v = node.args[:3]
+        # positional tail follows torch's signature
+        # (q, k, v, attn_mask, dropout_p, is_causal, *, scale)
+        pos = {i + 3: a for i, a in enumerate(node.args[3:])}
+        kwargs = dict(node.kwargs)
+
+        def arg(key, pos_idx, default):
+            raw = kwargs.get(key, pos.get(pos_idx, default))
+            val, ok = self._resolve_const(raw)
+            if not ok:
+                raise NotImplementedError(
+                    f"sdpa with tensor-dependent {key} is not importable"
+                )
+            return val
+
+        mask = arg("attn_mask", 3, None)
+        dropout_p = float(arg("dropout_p", 4, 0.0) or 0.0)
+        is_causal = bool(arg("is_causal", 5, False))
+        scale = arg("scale", 6, None)
+        if is_causal:
+            raise NotImplementedError(
+                "sdpa(is_causal=True) import is not supported; build causal "
+                "attention with FFModel.multihead_attention(causal=True)"
+            )
+        if mask is not None and float(abs(mask).max()) != 0.0:
+            raise NotImplementedError(
+                "sdpa with a non-trivial attn_mask is not supported (trace "
+                "with input_names=['input_ids'] so the all-ones mask "
+                "constant-folds to zeros)"
+            )
+        q_shape = _tensor_shape(q)
+        rank = len(q_shape)
+        dh = q_shape[-1]
+        if scale is None:
+            scale = 1.0 / math.sqrt(dh)
+        perm = list(range(rank))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        kt = self.emit("transpose", f"{name}_kt", [self.ref(k)], perm=perm)
+        scores = self.emit("batch_matmul", f"{name}_scores",
+                           [self.ref(q), kt])
+        scaled = self.emit("scalar_multiply", f"{name}_scaled", [scores],
+                           scalar=float(scale))
+        probs = self.emit("softmax", f"{name}_probs", [scaled], axis=-1)
+        if dropout_p > 0.0:
+            probs = self.emit("dropout", f"{name}_dropout", [probs],
+                              rate=dropout_p)
+        return self.emit("batch_matmul", name, [probs, self.ref(v)])
+
+    def _tensor_getitem(self, node, src, idx) -> str:
+        """Graph-tensor subscripts: integer indexing realized as
+        split + select (+ final reshape to drop the indexed dims and
+        insert None dims); full slices pass through."""
+        in_shape = _tensor_shape(src)
+        idx_t = idx if isinstance(idx, tuple) else (idx,)
+        cur = self.ref(src)
+        out_shape: List[int] = []
+        d = 0  # current dim in the (possibly split) source tensor
+        squeeze = False
+        for it in idx_t:
+            it_v, ok = self._resolve_const(it)
+            if not ok:
+                raise NotImplementedError("tensor-dependent subscript index")
+            if it_v is None:
+                out_shape.append(1)
+                squeeze = True
+                continue
+            if isinstance(it_v, slice):
+                dim = in_shape[d]
+                s0 = 0 if it_v.start is None else int(it_v.start)
+                s1 = dim if it_v.stop is None else int(it_v.stop)
+                if s0 < 0:
+                    s0 += dim
+                if s1 < 0:
+                    s1 += dim
+                s0, s1 = max(0, min(s0, dim)), max(0, min(s1, dim))
+                if s1 <= s0:
+                    raise NotImplementedError(f"empty tensor slice [{s0}:{s1}]")
+                if it_v.step not in (None, 1):
+                    raise NotImplementedError("strided tensor slicing")
+                if s0 == 0 and s1 == in_shape[d]:
+                    out_shape.append(in_shape[d])
+                    d += 1
+                    continue
+                sizes = [s for s in (s0, s1 - s0, in_shape[d] - s1) if s > 0]
+                part_idx = 1 if s0 > 0 else 0
+                sp = self.emit("split", f"{node.name}_split{d}", [cur],
+                               sizes=sizes, axis=d)
+                cur = self.emit("getitem", f"{node.name}_part{d}", [sp],
+                                index=part_idx)
+                out_shape.append(s1 - s0)
+                d += 1
+                continue
+            if isinstance(it_v, int):
+                i = it_v % in_shape[d]
+                if in_shape[d] > 1:
+                    sizes = [s for s in (i, 1, in_shape[d] - i - 1) if s > 0]
+                    part_idx = 1 if i > 0 else 0
+                    sp = self.emit("split", f"{node.name}_split{d}", [cur],
+                                   sizes=sizes, axis=d)
+                    cur = self.emit("getitem", f"{node.name}_part{d}", [sp],
+                                    index=part_idx)
+                squeeze = True
+                d += 1
+                continue
+            raise NotImplementedError(f"unsupported subscript element {it_v!r}")
+        out_shape.extend(in_shape[d:])
+        target = _tensor_shape(node)
+        if squeeze or (target is not None and list(target) != out_shape):
+            cur = self.emit("reshape", node.name + "_sq", [cur],
+                            shape=[int(s) for s in (target or out_shape)])
+        self.env[node.name] = cur
+        return cur
+
     # mapping of simple unary call_function/method targets
     _UNARY = {
         "relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh", "gelu": "gelu",
@@ -240,17 +466,60 @@ class _Tracer:
         fname = target if isinstance(target, str) else getattr(target, "__name__", str(target))
         fname = fname.rstrip("_")  # in-place variants (relu_, add_) fold to pure
 
-        if fname == "getattr" and len(node.args) == 2 and node.args[1] == "shape":
-            self.literals[node.name] = _tensor_shape(node.args[0])
+        if fname == "getattr" and len(node.args) == 2:
+            attr = node.args[1]
+            if attr == "shape":
+                self.literals[node.name] = _tensor_shape(node.args[0])
+                return None
+            # dtype/device queries on real graph tensors fold to the
+            # traced metadata (constants are handled by _try_fold below)
+            src = node.args[0]
+            if (
+                attr in ("dtype", "device")
+                and hasattr(src, "meta")
+                and src.name not in self.constants
+            ):
+                tm = src.meta.get("tensor_meta")
+                if attr == "dtype" and tm is not None:
+                    self.literals[node.name] = tm.dtype
+                    return None
+                if attr == "device":
+                    self.literals[node.name] = self.torch.device("cpu")
+                    return None
+        if fname in ("size", "dim") and node.args and hasattr(node.args[0], "meta") \
+                and node.args[0].name not in self.constants \
+                and node.args[0].name not in self.literals:
+            shape = _tensor_shape(node.args[0])
+            if shape is not None:
+                if fname == "dim":
+                    self.literals[node.name] = len(shape)
+                elif len(node.args) > 1:
+                    self.literals[node.name] = shape[_norm_dim(node.args[1], len(shape))]
+                else:
+                    self.literals[node.name] = self.torch.Size(shape)
+                return None
+        if fname in ("_assert", "_assert_async"):
+            cond, ok = self._resolve_const(node.args[0])
+            if ok and bool(cond):
+                return None
+            raise NotImplementedError("data-dependent torch._assert")
+        # whole-node constant folding: the imported model's mask and
+        # position-id chains (ones/eq/sub/finfo/masked_fill/expand/to on
+        # traced shapes and buffers) execute at import time
+        if self._try_fold(node):
             return None
         if target is operator.getitem or fname == "getitem":
             src, idx = node.args
             if hasattr(src, "name") and src.name in self.literals:
-                self.literals[node.name] = self.literals[src.name][idx]
+                idx_v, ok = self._resolve_const(idx)
+                assert ok, "literal getitem with graph-tensor index"
+                self.literals[node.name] = self.literals[src.name][idx_v]
                 return None
             if isinstance(idx, int):  # select one output of a multi-output op
                 return self.emit("getitem", name, [self.ref(src)], index=idx)
-            raise NotImplementedError("tensor slicing via getitem is not supported")
+            return self._tensor_getitem(node, src, idx)
+        if fname == "scaled_dot_product_attention":
+            return self._sdpa(node)
 
         def _lit(a):  # resolve traced ints (e.g. x.shape[0]) to values
             if hasattr(a, "name") and a.name in self.literals:
@@ -494,6 +763,12 @@ class PyTorchModel:
             return ff.split(x[0], a["sizes"], axis=a["axis"], name=rec.name)
         if k == "getitem":
             return x[0][a["index"]]
+        if k == "constant":
+            import numpy as np
+
+            return ff.create_constant(
+                np.asarray(a["value"], dtype=a["dtype"]), name=rec.name
+            )
         if k == "reshape":
             shape = [s if s != -1 else -1 for s in a["shape"]]
             return ff.reshape(x[0], shape, name=rec.name)

@@ -95,6 +95,28 @@ class FFModel:
         self._input_tensors.append(t)
         return t
 
+    def create_constant(self, value, dtype=None, name=None) -> Tensor:
+        """Compile-time constant tensor (baked into the program; XLA
+        folds it).  Serves imported frontend graphs whose buffers —
+        position ids, token-type ids — are constants, a case the
+        reference routes through host-initialized Legion regions."""
+        import numpy as np
+
+        arr = np.asarray(value)
+        if dtype is not None:
+            from flexflow_tpu.core.ptensor import DataType
+
+            arr = arr.astype(DataType.from_any(dtype).to_numpy())
+        name = self._fresh_name("constant", name)
+        dt = str(arr.dtype)
+        t = Tensor(list(arr.shape), dt, name=name)
+        op = O.ConstantOp(
+            name, ParallelTensorShape.make(t.sizes, t.dtype), value=arr
+        )
+        node = self.graph.new_node(op)
+        self._producer[t.guid] = (node, 0)
+        return t
+
     # ---- layers (reference: model.h layer-method block) ----------------
     def dense(self, input: Tensor, out_dim: int, activation=None, use_bias=True,
               kernel_initializer=None, bias_initializer=None, name=None) -> Tensor:

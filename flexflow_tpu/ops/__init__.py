@@ -10,7 +10,7 @@ from flexflow_tpu.ops.base import (
     WeightSpec,
     register_op,
 )
-from flexflow_tpu.ops.inout import InputOp, NoOp
+from flexflow_tpu.ops.inout import ConstantOp, InputOp, NoOp
 from flexflow_tpu.ops.elementwise import ElementBinaryOp, ElementUnaryOp
 from flexflow_tpu.ops.linear import LinearOp
 from flexflow_tpu.ops.shape_ops import (
@@ -37,6 +37,7 @@ __all__ = [
     "ShardAnnot",
     "WeightSpec",
     "register_op",
+    "ConstantOp",
     "InputOp",
     "NoOp",
     "ElementBinaryOp",

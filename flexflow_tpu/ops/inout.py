@@ -56,6 +56,60 @@ class InputOp(Operator):
 
 
 @register_op
+class ConstantOp(Operator):
+    """Compile-time constant tensor — e.g. position ids an imported
+    frontend graph carries as a module buffer (transformers BERT traces
+    `embeddings.position_ids` as get_attr).  The reference has no
+    direct analogue (constants live in Legion regions initialized
+    host-side); here the value is baked into the program and XLA
+    constant-folds around it."""
+
+    op_type = OperatorType.CONSTANT
+
+    def __init__(self, name, shape: ParallelTensorShape, value=None):
+        import numpy as np
+
+        self._shape = shape.drop_parallelism()
+        self._value = np.asarray(value)
+        # attrs keep a hashable fingerprint, not the payload: signatures
+        # and strategy export stay small
+        import hashlib
+
+        digest = hashlib.sha1(self._value.tobytes()).hexdigest()[:16]
+        super().__init__(name, [], value_digest=digest)
+
+    @property
+    def value(self):
+        return self._value
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self._shape,)
+
+    def forward(self, ctx, inputs, weights):
+        import jax.numpy as jnp
+
+        return [jnp.asarray(self._value)]
+
+    def signature(self) -> Tuple:
+        return (
+            self.op_type.value,
+            self._shape.sizes,
+            self._shape.dtype.value,
+            self.attrs["value_digest"],
+        )
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        return OpSharding(
+            inputs=(),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return ()
+
+
+@register_op
 class NoOp(Operator):
     op_type = OperatorType.NOOP
 

@@ -167,6 +167,99 @@ def test_torch_batchnorm_eval_parity():
     np.testing.assert_allclose(np.asarray(y[0]), ref, rtol=1e-3, atol=1e-3)
 
 
+def test_torch_sdpa_positional_args_and_negative_slice_parity():
+    """sdpa traced with POSITIONAL (attn_mask, dropout_p, is_causal)
+    must not silently drop them, and `x[:, :-1]` negative-bound slices
+    must import as the right split."""
+    import torch.nn.functional as F
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Linear(16, 16)
+
+        def forward(self, x):          # x: [B, S, 16]
+            b, s, h = x.shape
+            q = x.view(b, s, 2, 8).transpose(1, 2)
+            y = F.scaled_dot_product_attention(q, q, q, None, 0.0, False)
+            y = y.transpose(1, 2).reshape(b, s, h)
+            y = self.proj(y)
+            return y[:, :-1]           # drop the last position
+
+    m = Net()
+    m.eval()
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 6, 16)).astype(np.float32)
+    model, y = _import_and_run(m, [x], [(4, 6, 16)])
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x)).numpy()
+    assert np.asarray(y[0]).shape == ref.shape == (4, 5, 16)
+    np.testing.assert_allclose(np.asarray(y[0]), ref, rtol=1e-4, atol=1e-5)
+
+    # positional is_causal=True must fail LOUDLY, not import wrong
+    class Causal(nn.Module):
+        def forward(self, x):
+            b, s, h = x.shape
+            q = x.view(b, s, 2, 8).transpose(1, 2)
+            return F.scaled_dot_product_attention(q, q, q, None, 0.0, True)
+
+    cm = Causal()
+    with pytest.raises(NotImplementedError, match="is_causal"):
+        cfg = ff.FFConfig(batch_size=4, num_devices=1, only_data_parallel=True)
+        mm = ff.FFModel(cfg)
+        t = mm.create_tensor([4, 6, 16])
+        PyTorchModel(cm, example_inputs=[torch.from_numpy(x)]).torch_to_ff(mm, [t])
+
+
+def test_huggingface_bert_import_parity_and_training():
+    """Import a real transformers BertModel through torch.fx (the
+    reference's frontend traces its own mt5/bert_proxy graphs,
+    python/flexflow/torch/model.py; it has no sdpa or constant-folding
+    path at all).  Covers: HF symbolic trace, buffer constants
+    (position_ids), mask-chain constant folding, sdpa decomposition,
+    CLS-token slicing, weight transfer — forward parity to ~1e-6, then
+    a fit() step training the imported graph."""
+    transformers = pytest.importorskip("transformers")
+    from transformers.utils import fx as hf_fx
+
+    cfg = transformers.BertConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, vocab_size=128, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    tm = transformers.BertModel(cfg)
+    tm.eval()
+    gm = hf_fx.symbolic_trace(tm, input_names=["input_ids"])
+    B, S = 4, 8
+    ex = torch.randint(0, 128, (B, S))
+
+    fcfg = ff.FFConfig(batch_size=B, num_devices=1, only_data_parallel=True,
+                       compute_dtype="float32")
+    m = ff.FFModel(fcfg)
+    x = m.create_tensor([B, S], dtype="int32")
+    outs = PyTorchModel(gm, example_inputs=[ex]).torch_to_ff(m, [x])
+    assert [tuple(o.sizes) for o in outs] == [(B, S, 32), (B, 32)]
+    m.compile(loss_type="mean_squared_error", metrics=[])
+    assert transfer_torch_weights(tm, m) >= 29
+
+    with torch.no_grad():
+        to = tm(input_ids=ex)
+        refs = {
+            (B, S, 32): to.last_hidden_state.numpy(),
+            (B, 32): to.pooler_output.numpy(),
+        }
+    fwd = m.compiled.forward_fn()
+    got = np.asarray(fwd(m.params, m.state, [ex.numpy().astype(np.int32)]))
+    np.testing.assert_allclose(got, refs[got.shape], rtol=1e-4, atol=1e-5)
+
+    # the imported graph must also TRAIN end-to-end
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (64, S)).astype(np.int32)
+    tgt = rng.normal(size=(64,) + got.shape[1:]).astype(np.float32)
+    hist = m.fit(x=ids, y=tgt, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
+
+
 def test_onnx_importer_gated():
     try:
         import onnx  # noqa: F401

@@ -116,12 +116,14 @@ class _Tracer:
         self.env: Dict[str, str] = {}  # fx node name -> record output name
         self.literals: Dict[str, Any] = {}  # shape/int values traced as nodes
         self.constants: Dict[str, Any] = {}  # node name -> folded torch.Tensor
+        self.kinds: Dict[str, str] = {}  # record name -> record kind
         self.input_names: List[str] = []
         self.output_names: List[str] = []
 
     # -- helpers ----------------------------------------------------------
     def emit(self, kind: str, name: str, inputs: List[str], **attrs) -> str:
         self.records.append(OpRecord(name, kind, inputs, attrs))
+        self.kinds[name] = kind
         return name
 
     def ref(self, arg) -> str:
@@ -356,12 +358,17 @@ class _Tracer:
                 "sdpa(is_causal=True) import is not supported; build causal "
                 "attention with FFModel.multihead_attention(causal=True)"
             )
-        if mask is not None and float(abs(mask).max()) != 0.0:
-            raise NotImplementedError(
-                "sdpa with a non-trivial attn_mask is not supported (trace "
-                "with input_names=['input_ids'] so the all-ones mask "
-                "constant-folds to zeros)"
-            )
+        if mask is not None:
+            if mask.dtype == self.torch.bool:
+                trivial = bool(mask.all())  # all-True = keep everything
+            else:
+                trivial = float(mask.abs().max()) == 0.0  # additive zeros
+            if not trivial:
+                raise NotImplementedError(
+                    "sdpa with a non-trivial attn_mask is not supported "
+                    "(trace with input_names=['input_ids'] so the all-ones "
+                    "mask constant-folds to a no-op)"
+                )
         q_shape = _tensor_shape(q)
         rank = len(q_shape)
         dh = q_shape[-1]
@@ -515,7 +522,11 @@ class _Tracer:
                 assert ok, "literal getitem with graph-tensor index"
                 self.literals[node.name] = self.literals[src.name][idx_v]
                 return None
-            if isinstance(idx, int):  # select one output of a multi-output op
+            if isinstance(idx, int) and self.kinds.get(
+                self.env.get(getattr(src, "name", ""), "")
+            ) == "split":
+                # select one output of the only multi-output op (split/
+                # chunk); x[0] on a PLAIN tensor is real dim-0 indexing
                 return self.emit("getitem", name, [self.ref(src)], index=idx)
             return self._tensor_getitem(node, src, idx)
         if fname == "scaled_dot_product_attention":

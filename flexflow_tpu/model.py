@@ -100,12 +100,8 @@ class FFModel:
         folds it).  Serves imported frontend graphs whose buffers —
         position ids, token-type ids — are constants, a case the
         reference routes through host-initialized Legion regions."""
-        import numpy as np
-
         arr = np.asarray(value)
         if dtype is not None:
-            from flexflow_tpu.core.ptensor import DataType
-
             arr = arr.astype(DataType.from_any(dtype).to_numpy())
         name = self._fresh_name("constant", name)
         dt = str(arr.dtype)

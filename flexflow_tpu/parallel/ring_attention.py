@@ -12,6 +12,10 @@ Implemented at the shard_map level (XLA-level blockwise attention per
 step; the Pallas flash kernel accelerates the inner block on TPU).
 Causal masking is handled per (q-shard, kv-shard) pair: full blocks
 below the diagonal, masked diagonal blocks, skipped blocks above.
+Causal rings default to the ZIGZAG schedule (device i holds sequence
+chunks i and 2n-1-i), which removes the contiguous layout's straggler
+— every device computes exactly two half-chunk attentions per ring
+step, ~2x faster causal long-context than the naive ring.
 """
 
 from __future__ import annotations
@@ -62,11 +66,21 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     batch_axes: Tuple[str, ...] = (),
+    schedule: str = "auto",
 ) -> jax.Array:
     """Global-view ring attention: q/k/v [B, S, H, D] (self-attention:
     Sk == Sq) sharded on dim 1 over ``seq_axis`` of ``mesh`` (and
     optionally on dim 0 over ``batch_axes``); returns [B, S, H, D] with
-    the same sharding.  Composable under jit (uses shard_map internally)."""
+    the same sharding.  Composable under jit (uses shard_map internally).
+
+    ``schedule``: "contiguous" | "zigzag" | "auto".  With contiguous
+    shards, causal masking is load-IMBALANCED: at ring step s only
+    devices i >= s have below-diagonal work, so the last device
+    computes a full block every step and skipping buys no wall time.
+    "zigzag" re-orders the sequence so device i holds chunks
+    (i, 2n-1-i) of a 2n-chunking — every device then does exactly two
+    half-blocks per step (~2x faster causal rings).  "auto" picks
+    zigzag for causal multi-device rings when the length divides."""
     from jax import shard_map
 
     if scale is None:
@@ -86,6 +100,21 @@ def ring_attention(
         from flexflow_tpu.kernels.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    b_spec = None
+    if batch_axes:
+        b_spec = batch_axes[0] if len(batch_axes) == 1 else tuple(batch_axes)
+    spec = P(b_spec, axes, None, None)
+
+    assert schedule in ("auto", "contiguous", "zigzag"), schedule
+    if schedule == "auto":
+        schedule = (
+            "zigzag" if causal and q.shape[1] % (2 * n) == 0 else "contiguous"
+        )
+    if schedule == "zigzag":
+        assert causal, "zigzag scheduling only applies to causal attention"
+        assert q.shape[1] % (2 * n) == 0, (q.shape, n)
+        return _zigzag_ring(q, k, v, mesh, axes, n, scale, spec)
 
     s_local = q.shape[1] // n
 
@@ -144,11 +173,104 @@ def ring_attention(
         out = acc / jnp.maximum(l, 1e-30)
         return out.transpose(0, 2, 1, 3).astype(q_l.dtype)  # [B, S/n, H, D]
 
-    b_spec = None
-    if batch_axes:
-        b_spec = batch_axes[0] if len(batch_axes) == 1 else tuple(batch_axes)
-    spec = P(b_spec, axes, None, None)
     return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)
+
+
+def _zigzag_ring(q, k, v, mesh, axes, n, scale, spec):
+    """Load-balanced causal ring: the sequence is viewed as 2n chunks
+    and device i holds chunks (i, 2n-1-i).  With global chunk ids, the
+    four (q-half, kv-half) sub-blocks per ring step resolve so that
+    EVERY device computes exactly two half-chunk attentions per step
+    (one diagonal extra on the resident step) — the contiguous
+    schedule's straggler (last device below-diagonal at every step)
+    disappears.  The zigzag permutation is applied on the global view
+    (one gather in, one gather out; XLA lowers them to collectives over
+    the sharded seq dim)."""
+    from jax import shard_map
+
+    B, S = q.shape[0], q.shape[1]
+    s2 = S // (2 * n)
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    inv = [0] * (2 * n)
+    for pos, c in enumerate(order):
+        inv[c] = pos
+
+    def _reorder(x, idxs):
+        xs = x.reshape((B, 2 * n, s2) + x.shape[2:])
+        return xs[:, jnp.asarray(idxs)].reshape(x.shape)
+
+    qz, kz, vz = (_reorder(x, order) for x in (q, k, v))
+
+    def local_fn(q_l, k_l, v_l):
+        idx = jax.lax.axis_index(axes)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        b, _, h, d = q_l.shape
+        q0, q1 = q_l[:, :s2], q_l[:, s2:]  # global chunks idx, 2n-1-idx
+
+        zero = (
+            jnp.zeros((b, h, s2, d), jnp.float32),
+            jnp.full((b, h, s2, 1), -1e30, jnp.float32),
+            jnp.zeros((b, h, s2, 1), jnp.float32),
+        )
+
+        def att(qc, kc, vc, diag):
+            return _block_attn(qc, kc, vc, scale, 1 if diag else 0, 0, 0)
+
+        # resident step (kv chunks == own chunks): early half attends
+        # its diagonal; late half attends the early chunk fully plus its
+        # own diagonal
+        acc0 = _merge(*zero, *att(q0, k_l[:, :s2], v_l[:, :s2], True))
+        acc1 = _merge(
+            *att(q1, k_l[:, :s2], v_l[:, :s2], False),
+            *att(q1, k_l[:, s2:], v_l[:, s2:], True),
+        )
+
+        def step(carry, _):
+            k_cur, v_cur, a0, a1, src = carry
+            k_cur = jax.lax.ppermute(k_cur, axes, perm)
+            v_cur = jax.lax.ppermute(v_cur, axes, perm)
+            src = (src - 1) % n  # device whose chunks we now hold
+            k0, k1 = k_cur[:, :s2], k_cur[:, s2:]
+            v0, v1 = v_cur[:, :s2], v_cur[:, s2:]
+
+            def before(_):
+                # src < idx: early q attends src's early chunk; late q
+                # attends it too (always below diagonal)
+                return (
+                    att(q0, k0, v0, False),
+                    att(q1, k0, v0, False),
+                )
+
+            def after(_):
+                # src > idx: early q sees nothing; late q (chunk
+                # 2n-1-idx) attends BOTH of src's chunks (idx < src and
+                # 2n-1-idx > 2n-1-src)
+                t = _merge(*att(q1, k0, v0, False), *att(q1, k1, v1, False))
+                return (zero, t)
+
+            p0, p1 = jax.lax.cond(src < idx, before, after, None)
+            a0 = _merge(*a0, *p0)
+            a1 = _merge(*a1, *p1)
+            return (k_cur, v_cur, a0, a1, src), None
+
+        (_, _, acc0, acc1, _), _ = jax.lax.scan(
+            step, (k_l, v_l, acc0, acc1, idx), None, length=n - 1
+        )
+
+        def fin(t):
+            acc, m, l = t
+            out = acc / jnp.maximum(l, 1e-30)
+            return out.transpose(0, 2, 1, 3).astype(q_l.dtype)
+
+        return jnp.concatenate([fin(acc0), fin(acc1)], axis=1)
+
+    out = shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(qz, kz, vz)
+    return _reorder(out, inv)

@@ -358,6 +358,28 @@ def test_mha_flash_dispatch_heuristic():
         fa.flash_attention = orig
 
 
+def test_ring_attention_zigzag_matches_contiguous(mesh8):
+    """The zigzag schedule (device i holds chunks i and 2n-1-i — the
+    load-balanced causal ring; every device does exactly two half-chunk
+    attentions per step instead of the contiguous schedule's
+    full-block straggler) must be numerically identical to the
+    contiguous schedule and to the reference attention."""
+    from flexflow_tpu.parallel.ring_attention import ring_attention
+
+    q, k, v = qkv(B=2, S=64, H=4, D=16)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ref = _xla_attention(q, k, v, True, scale)
+    zig = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh8, ("x0", "x1"), causal=True, schedule="zigzag"))(q, k, v)
+    cont = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh8, ("x0", "x1"), causal=True,
+        schedule="contiguous"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(zig), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(zig), np.asarray(cont),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_ring_attention_multi_axis_grad_matches(mesh8):
     """Backward through the product ring (shard_map autodiff transposes
     the multi-axis ppermute) matches the reference attention's grads."""

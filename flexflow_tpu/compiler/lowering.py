@@ -393,6 +393,11 @@ class CompiledModel:
 
     def _raw_step(self, params, opt_state, state, rng, inputs, labels):
         optimizer = self.optimizer
+        ga = max(1, getattr(self.config, "grad_accum_steps", 1))
+        if ga > 1:
+            return self._raw_step_accum(
+                params, opt_state, state, rng, inputs, labels, ga
+            )
 
         def loss_fn(p):
             logits, new_state = self.apply(p, state, inputs, rng, train=True)
@@ -407,6 +412,58 @@ class CompiledModel:
             new_params, new_opt_state
         )
         m = compute_metrics(self.metric_types, self.loss_type, logits, labels)
+        return new_params, new_opt_state, new_state, loss, m
+
+    def _raw_step_accum(self, params, opt_state, state, rng, inputs, labels, ga):
+        """Gradient accumulation: the batch is processed as ``ga``
+        microbatches inside a lax.scan, grads averaged, ONE optimizer
+        update — activation memory scales with batch/ga while the
+        effective batch stays the full batch: the loss is the mean of
+        equal-sized microbatch means and metrics are per-batch SUMS
+        (compute_metrics semantics), so they add across the disjoint
+        microbatches.  The reference has no analogue — its
+        per-iteration batch is bounded by what fits.  Together with
+        config.remat this is the second memory lever."""
+        B = labels.shape[0]
+        assert B % ga == 0, (
+            f"batch {B} must divide by grad_accum_steps {ga}"
+        )
+
+        def resh(x):
+            return x.reshape((ga, B // ga) + x.shape[1:])
+
+        keys = jax.random.split(rng, ga)
+
+        def loss_fn(p, s, inp, lab, key):
+            logits, new_state = self.apply(p, s, list(inp), key, train=True)
+            loss = self._loss_from(logits, lab, new_state)
+            return loss, (logits, new_state)
+
+        gzero = jax.tree.map(jnp.zeros_like, params)
+
+        def body(carry, xs):
+            s, gacc = carry
+            key, inp, lab = xs
+            (loss, (logits, new_s)), g = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, s, inp, lab, key)
+            gacc = jax.tree.map(jnp.add, gacc, g)
+            m = compute_metrics(self.metric_types, self.loss_type, logits, lab)
+            return (new_s, gacc), (loss, m)
+
+        (new_state, gsum), (losses, ms) = jax.lax.scan(
+            body, (state, gzero),
+            (keys, tuple(resh(x) for x in inputs), resh(labels)),
+        )
+        grads = jax.tree.map(lambda g: g / ga, gsum)
+        new_params, new_opt_state = self.optimizer.apply(
+            params, grads, opt_state
+        )
+        new_params, new_opt_state = self._constrain_update(
+            new_params, new_opt_state
+        )
+        loss = jnp.mean(losses)
+        m = jax.tree.map(lambda x: jnp.sum(x, axis=0), ms)
         return new_params, new_opt_state, new_state, loss, m
 
     def _build_train_step(self):

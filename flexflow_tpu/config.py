@@ -71,6 +71,10 @@ class FFConfig:
     # execution
     profiling: bool = False
     perform_fusion: bool = True
+    grad_accum_steps: int = 1  # >1: each optimizer step processes the
+    # batch as this many microbatches inside a lax.scan, averaging
+    # grads — full effective batch at batch/N activation memory
+    # (reference has no analogue; with remat, the second memory lever)
     trace_steps: int = 1  # >1: fit() runs this many optimizer steps per
     # compiled call (lax.scan over stacked batches) — the XLA-native
     # analogue of the reference's Legion iteration tracing
@@ -138,6 +142,8 @@ class FFConfig:
         p.add_argument("--taskgraph", dest="export_taskgraph", type=str, default=None)
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--trace-steps", dest="trace_steps", type=int, default=1)
+        p.add_argument("--grad-accum-steps", dest="grad_accum_steps",
+                       type=int, default=1)
         p.add_argument("--remat", action="store_true")
         p.add_argument("--zero-dp-shard", dest="zero_dp_shard",
                        action="store_true")
@@ -166,6 +172,7 @@ class FFConfig:
             machine_model_file=args.machine_model_file,
             profiling=args.profiling,
             trace_steps=args.trace_steps,
+            grad_accum_steps=args.grad_accum_steps,
             remat=args.remat,
             zero_dp_shard=args.zero_dp_shard,
             seed=args.seed,

@@ -186,30 +186,51 @@ def _zigzag_ring(q, k, v, mesh, axes, n, scale, spec):
     EVERY device computes exactly two half-chunk attentions per step
     (one diagonal extra on the resident step) — the contiguous
     schedule's straggler (last device below-diagonal at every step)
-    disappears.  The zigzag permutation is applied on the global view
-    (one gather in, one gather out; XLA lowers them to collectives over
-    the sharded seq dim)."""
+    disappears.
+
+    The contiguous->zigzag exchange happens INSIDE shard_map as two
+    half-chunk ppermutes each way (device i's contiguous chunks
+    (2i, 2i+1) route to their zigzag owners; bijective per half since
+    even chunks map to even-or-mirrored targets).  Each q/k/v/out
+    tensor moves at most one half-chunk per device — a fraction of one
+    ring rotation — and per-chip memory stays O(S/n), which a global
+    gather could not guarantee (GSPMD may materialize it as an
+    all-gather)."""
     from jax import shard_map
 
-    B, S = q.shape[0], q.shape[1]
+    S = q.shape[1]
     s2 = S // (2 * n)
-    order = []
-    for i in range(n):
-        order += [i, 2 * n - 1 - i]
-    inv = [0] * (2 * n)
-    for pos, c in enumerate(order):
-        inv[c] = pos
 
-    def _reorder(x, idxs):
-        xs = x.reshape((B, 2 * n, s2) + x.shape[2:])
-        return xs[:, jnp.asarray(idxs)].reshape(x.shape)
+    def _fwd_owner(c):  # zigzag owner device of global chunk c
+        return c if c < n else 2 * n - 1 - c
 
-    qz, kz, vz = (_reorder(x, order) for x in (q, k, v))
+    # ppermute A carries each device's EARLY contiguous half (chunk 2i),
+    # B the LATE half (chunk 2i+1); both maps are bijections
+    perm_a = [(i, _fwd_owner(2 * i)) for i in range(n)]
+    perm_b = [(i, _fwd_owner(2 * i + 1)) for i in range(n)]
+    perm_a_inv = [(d, s) for s, d in perm_a]
+    perm_b_inv = [(d, s) for s, d in perm_b]
+    # chunk id delivered via A to each destination device
+    recv_a = [0] * n
+    for src, dst in perm_a:
+        recv_a[dst] = 2 * src
 
     def local_fn(q_l, k_l, v_l):
         idx = jax.lax.axis_index(axes)
         perm = [(i, (i + 1) % n) for i in range(n)]
         b, _, h, d = q_l.shape
+        # True where the A-delivered chunk is this device's EARLY
+        # zigzag chunk (global id == idx); else A carried the late one
+        a_is_early = jnp.take(jnp.asarray(recv_a), idx) == idx
+
+        def to_zig(x):
+            ra = jax.lax.ppermute(x[:, :s2], axes, perm_a)
+            rb = jax.lax.ppermute(x[:, s2:], axes, perm_b)
+            early = jnp.where(a_is_early, ra, rb)
+            late = jnp.where(a_is_early, rb, ra)
+            return jnp.concatenate([early, late], axis=1)
+
+        q_l, k_l, v_l = to_zig(q_l), to_zig(k_l), to_zig(v_l)
         q0, q1 = q_l[:, :s2], q_l[:, s2:]  # global chunks idx, 2n-1-idx
 
         zero = (
@@ -267,10 +288,16 @@ def _zigzag_ring(q, k, v, mesh, axes, n, scale, spec):
             out = acc / jnp.maximum(l, 1e-30)
             return out.transpose(0, 2, 1, 3).astype(q_l.dtype)
 
-        return jnp.concatenate([fin(acc0), fin(acc1)], axis=1)
+        out = jnp.concatenate([fin(acc0), fin(acc1)], axis=1)
+        # inverse exchange: return each zigzag half along the route it
+        # arrived by; receivers get their contiguous (early, late) halves
+        oa = jnp.where(a_is_early, out[:, :s2], out[:, s2:])
+        ob = jnp.where(a_is_early, out[:, s2:], out[:, :s2])
+        e = jax.lax.ppermute(oa, axes, perm_a_inv)
+        l_ = jax.lax.ppermute(ob, axes, perm_b_inv)
+        return jnp.concatenate([e, l_], axis=1)
 
-    out = shard_map(
+    return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
-    )(qz, kz, vz)
-    return _reorder(out, inv)
+    )(q, k, v)

@@ -138,6 +138,40 @@ def test_train_steps_matches_sequential():
                 rtol=1e-5, atol=1e-6)
 
 
+def test_grad_accum_matches_full_batch():
+    """config.grad_accum_steps: microbatched grads averaged into ONE
+    update must match the full-batch step's numerics exactly (same
+    effective batch, 1/N activation memory)."""
+    def run(ga):
+        cfg = ff.FFConfig(batch_size=32, epochs=4, num_devices=8,
+                          only_data_parallel=True, compute_dtype="float32",
+                          seed=5, grad_accum_steps=ga)
+        model = ff.FFModel(cfg)
+        x = model.create_tensor([32, 16])
+        t = model.dense(x, 32, activation="relu")
+        t = model.dense(t, 4)
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+                      loss_type="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        data_x, data_y = make_blobs(n=128)
+        hist = model.fit(x=data_x, y=data_y, shuffle=False, verbose=False)
+        return hist, model
+
+    h1, m1 = run(1)
+    h4, m4 = run(4)
+    assert h4[-1]["accuracy"] > 0.9, h4[-1]
+    for a, b in zip(h1, h4):
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
+        # metrics are per-batch SUMS — microbatching must not rescale
+        # the accumulated sample count
+        assert a.get("samples") == b.get("samples"), (a, b)
+    for op, ws in m1.params.items():
+        for w, arr in ws.items():
+            np.testing.assert_allclose(
+                np.asarray(arr), np.asarray(m4.params[op][w]),
+                rtol=1e-5, atol=1e-6)
+
+
 def test_fit_with_trace_steps_matches_metrics():
     """fit() with config.trace_steps>1 (scanned multi-step, Legion-trace
     analogue) must reach the same training quality as single-step fit

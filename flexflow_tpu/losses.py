@@ -48,15 +48,35 @@ def _match_shape(labels: jax.Array, logits: jax.Array) -> jax.Array:
     return labels.astype(jnp.float32)
 
 
+def sparse_targets(labels, logits):
+    """(int targets, per_position) for the sparse-CCE family — the ONE
+    shape-dispatch rule, shared with metrics.compute_metrics.
+    Per-position when the labels match ALL leading dims of 3D+ logits
+    (causal LM: logits [B,S,V], labels [B,S] or [B,S,1]);
+    classification-style first-label otherwise (the reference's
+    sparse-CCE semantics, loss_functions.h:26-63)."""
+    lab = labels.astype(jnp.int32)
+    if lab.ndim == logits.ndim and lab.shape[-1] == 1:
+        lab = lab.reshape(lab.shape[:-1])  # trailing singleton class dim
+    if logits.ndim > 2 and lab.shape == logits.shape[:-1]:
+        return lab, True
+    return lab.reshape(lab.shape[0], -1)[:, 0], False
+
+
 def compute_loss(loss_type: LossType, logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Scalar loss. ``logits`` are the final op's output (pre-softmax for
     the CCE losses, matching the reference where Softmax output feeds a
     fused log-softmax CCE backward)."""
     loss_type = LossType.from_any(loss_type)
     if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
-        labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+        lab, per_pos = sparse_targets(labels, logits)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        if per_pos:
+            # per-position labels (causal LM: logits [B,S,V], labels
+            # [B,S]) — token-level NLL averaged over all positions
+            nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)
+            return jnp.mean(nll)
+        nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)[:, 0]
         return jnp.mean(nll)
     if loss_type is LossType.CATEGORICAL_CROSSENTROPY:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

@@ -17,7 +17,7 @@ from typing import Dict, List
 import jax
 import jax.numpy as jnp
 
-from flexflow_tpu.losses import LossType
+from flexflow_tpu.losses import LossType, sparse_targets
 
 
 class MetricsType(enum.Enum):
@@ -50,16 +50,32 @@ def compute_metrics(
         if m is MetricsType.ACCURACY:
             pred = jnp.argmax(logits32, axis=-1)
             if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
-                tgt = labels.reshape(n, -1)[:, 0].astype(jnp.int32)
+                tgt, per_pos = sparse_targets(labels, logits)
+                if per_pos:
+                    # per-position labels (causal LM): credit each
+                    # sample its fraction of correct tokens, so
+                    # train_correct/train_all stays a [0,1] accuracy
+                    correct = (pred == tgt).astype(jnp.float32)
+                    out["train_correct"] = jnp.sum(
+                        jnp.mean(correct.reshape(n, -1), axis=-1)
+                    )
+                else:
+                    out["train_correct"] = jnp.sum(
+                        (pred == tgt).astype(jnp.float32)
+                    )
             else:
                 tgt = jnp.argmax(labels32, axis=-1)
-            out["train_correct"] = jnp.sum((pred == tgt).astype(jnp.float32))
+                out["train_correct"] = jnp.sum((pred == tgt).astype(jnp.float32))
         elif m is MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
-            tgt = labels.reshape(n, -1)[:, 0].astype(jnp.int32)
+            tgt, per_pos = sparse_targets(labels, logits)
             logp = jax.nn.log_softmax(logits32, axis=-1)
-            out["sparse_cce_loss"] = -jnp.sum(
-                jnp.take_along_axis(logp, tgt[:, None], axis=-1)
-            )
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            if per_pos:  # mean over positions, summed over batch
+                out["sparse_cce_loss"] = jnp.sum(
+                    jnp.mean(nll.reshape(n, -1), axis=-1)
+                )
+            else:
+                out["sparse_cce_loss"] = jnp.sum(nll)
         elif m is MetricsType.CATEGORICAL_CROSSENTROPY:
             logp = jax.nn.log_softmax(logits32, axis=-1)
             out["cce_loss"] = -jnp.sum(labels32 * logp)

@@ -65,3 +65,34 @@ def build_bert(config: FFConfig, vocab: int = 30522, num_layers: int = 12,
     t = model.dense(t, hidden, activation="tanh", name="pooler")
     t = model.dense(t, num_classes, name="classifier")
     return model
+
+
+def build_gpt(config: FFConfig, vocab: int = 32000, num_layers: int = 12,
+              hidden: int = 768, num_heads: int = 12, ff_dim: int = 3072,
+              seq_len: int = 1024, dropout: float = 0.0):
+    """GPT-style causal language model: token + learned positional
+    embeddings, post-LN causal encoder stack (the zoo's shared
+    encoder_layer), untied vocab head;
+    trains with per-token sparse CCE on shifted targets.  Beyond the
+    reference zoo (its Transformer example is a non-causal MSE proxy,
+    transformer.cc:112-211); the causal MHA takes the flash/ring
+    attention paths, so the seq dim is partitionable for long-context
+    training (zigzag ring — parallel/ring_attention.py)."""
+    model = FFModel(config)
+    b = config.batch_size
+    ids = model.create_tensor([b, seq_len], dtype="int32", name="input_ids")
+    t = model.embedding(ids, vocab, hidden, aggr="none", name="tok_embed")
+    import numpy as np
+
+    pos = model.create_constant(
+        np.arange(seq_len, dtype=np.int32)[None, :].repeat(b, axis=0),
+        name="positions",
+    )
+    p = model.embedding(pos, seq_len, hidden, aggr="none", name="pos_embed")
+    t = model.add(t, p, name="embed_sum")
+    for i in range(num_layers):
+        t = encoder_layer(model, t, hidden, num_heads, ff_dim, f"layer{i}",
+                          dropout=dropout, layer_norm=True, causal=True)
+    t = model.layer_norm(t, name="final_ln")
+    t = model.dense(t, vocab, use_bias=False, name="lm_head")
+    return model

@@ -24,6 +24,7 @@ def _zoo():
         build_alexnet_cifar10,
         build_candle_uno,
         build_dlrm,
+        build_gpt,
         build_inception_v3,
         build_mlp_unify,
         build_moe,
@@ -48,6 +49,11 @@ def _zoo():
                 cfg, num_layers=12, hidden=512, num_heads=8, ff_dim=2048,
                 seq_len=256),
             batch=64, loss="mean_squared_error"),
+        "gpt": dict(
+            build=lambda cfg: build_gpt(
+                cfg, vocab=32000, num_layers=12, hidden=768, num_heads=12,
+                ff_dim=3072, seq_len=512),
+            batch=8, loss="sparse_categorical_crossentropy"),
         "dlrm": dict(
             # reference default is 8x 1M-row tables; 4x 1M keeps the f32
             # weight+grad+Adam footprint inside one chip's HBM

@@ -33,11 +33,28 @@ def synthetic_inputs(model: ff.FFModel, num_samples: int, seed: int = 0) -> List
     return out
 
 
+def lm_sequence_data(num_samples: int, seq_len: int, vocab: int, seed: int = 0):
+    """(x, y) for next-token training on the deterministic rule
+    token[j] = (token[j-1] * 3 + 1) mod vocab — learnable by a causal
+    model; shared by examples/gpt.py and the zoo test so the asserted
+    rule and the demonstrated rule cannot drift apart."""
+    rng = np.random.default_rng(seed)
+    x = np.empty((num_samples, seq_len), np.int32)
+    x[:, 0] = rng.integers(0, vocab, num_samples)
+    for j in range(1, seq_len):
+        x[:, j] = (x[:, j - 1] * 3 + 1) % vocab
+    return x, np.roll(x, -1, axis=1)
+
+
 def synthetic_labels(model: ff.FFModel, num_samples: int, loss: str, seed: int = 1):
     rng = np.random.default_rng(seed)
     sink = model.graph.sinks()[-1]
     out_shape = sink.op.output_shapes[0].sizes
     if loss == "sparse_categorical_crossentropy":
+        if len(out_shape) > 2:  # per-position logits (causal LM)
+            return rng.integers(
+                0, out_shape[-1], (num_samples,) + tuple(out_shape[1:-1])
+            ).astype(np.int32)
         return rng.integers(0, out_shape[-1], num_samples).astype(np.int32)
     return rng.normal(size=(num_samples,) + tuple(out_shape[1:])).astype(np.float32)
 

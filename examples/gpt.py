@@ -40,15 +40,10 @@ def main():
         metrics=["accuracy", "sparse_categorical_crossentropy"],
     )
 
-    rng = np.random.default_rng(config.seed)
+    from examples.common import lm_sequence_data
+
     n = config.batch_size * 8
-    # synthetic LM data: next token = (token * 3 + 1) mod vocab, a
-    # deterministic rule a causal model can learn
-    x = np.empty((n, seq), np.int32)
-    x[:, 0] = rng.integers(0, vocab, n)
-    for j in range(1, seq):
-        x[:, j] = (x[:, j - 1] * 3 + 1) % vocab
-    y = np.roll(x, -1, axis=1)  # shifted targets
+    x, y = lm_sequence_data(n, seq, vocab, seed=config.seed)
     model.fit(x=x, y=y, epochs=config.epochs)
 
 

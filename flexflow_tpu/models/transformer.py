@@ -9,6 +9,8 @@ parallelism — the reference cannot split MHA's seq dim, SURVEY.md §5)."""
 
 from __future__ import annotations
 
+import numpy as np
+
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.model import FFModel
 
@@ -33,7 +35,8 @@ def encoder_layer(model, t, hidden, num_heads, ff_dim, name, dropout=0.1,
 
 def build_transformer(config: FFConfig, num_layers: int = 12, hidden: int = 512,
                       num_heads: int = 8, ff_dim: int = 2048, seq_len: int = 512,
-                      dropout: float = 0.0, layer_norm: bool = False):
+                      dropout: float = 0.0, layer_norm: bool = False,
+                      causal: bool = False):
     """The reference Transformer example: raw float inputs [B, S, H],
     per-position dense head back to hidden (transformer.cc:112-211 uses
     no embedding/LN — dense proxies)."""
@@ -43,7 +46,8 @@ def build_transformer(config: FFConfig, num_layers: int = 12, hidden: int = 512,
     t = x
     for i in range(num_layers):
         t = encoder_layer(model, t, hidden, num_heads, ff_dim, f"layer{i}",
-                          dropout=dropout, layer_norm=layer_norm)
+                          dropout=dropout, layer_norm=layer_norm,
+                          causal=causal)
     t = model.dense(t, hidden, name="head")
     return model
 
@@ -82,8 +86,6 @@ def build_gpt(config: FFConfig, vocab: int = 32000, num_layers: int = 12,
     b = config.batch_size
     ids = model.create_tensor([b, seq_len], dtype="int32", name="input_ids")
     t = model.embedding(ids, vocab, hidden, aggr="none", name="tok_embed")
-    import numpy as np
-
     pos = model.create_constant(
         np.arange(seq_len, dtype=np.int32)[None, :].repeat(b, axis=0),
         name="positions",

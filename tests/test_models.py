@@ -67,19 +67,15 @@ def test_gpt_tiny_learns_and_is_causal():
     input position cannot change earlier logits."""
     from flexflow_tpu.models import build_gpt
 
-    rng = np.random.default_rng(4)
     vocab, seq = 64, 16
     model = build_gpt(tiny_cfg(), vocab=vocab, num_layers=2, hidden=32,
                       num_heads=4, ff_dim=64, seq_len=seq)
     model.compile(optimizer=ff.AdamOptimizer(alpha=3e-3),
                   loss_type="sparse_categorical_crossentropy",
                   metrics=["accuracy", "sparse_categorical_crossentropy"])
-    n = 64
-    x = np.empty((n, seq), np.int32)
-    x[:, 0] = rng.integers(0, vocab, n)
-    for j in range(1, seq):
-        x[:, j] = (x[:, j - 1] * 3 + 1) % vocab
-    y = np.roll(x, -1, axis=1)
+    from examples.common import lm_sequence_data
+
+    x, y = lm_sequence_data(64, seq, vocab, seed=4)
     hist = model.fit(x=x, y=y, epochs=8, verbose=False)
     assert hist[-1]["loss"] < hist[0]["loss"] * 0.5, (
         hist[0]["loss"], hist[-1]["loss"])

@@ -12,9 +12,8 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import numpy as np
-
 import flexflow_tpu as ff
+from examples.common import lm_sequence_data
 from flexflow_tpu.models import build_gpt
 
 
@@ -39,8 +38,6 @@ def main():
         loss_type="sparse_categorical_crossentropy",
         metrics=["accuracy", "sparse_categorical_crossentropy"],
     )
-
-    from examples.common import lm_sequence_data
 
     n = config.batch_size * 8
     x, y = lm_sequence_data(n, seq, vocab, seed=config.seed)

@@ -105,13 +105,66 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 use_orbax: Optional[bool] = None):
+                 use_orbax: Optional[bool] = None, async_save: bool = False):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         if use_orbax and not _HAS_ORBAX:
             raise ValueError("use_orbax=True but orbax-checkpoint is not installed")
         self.use_orbax = _HAS_ORBAX if use_orbax is None else use_orbax
+        # async_save: save() blocks only for the device->host copy (the
+        # training step may DONATE the device buffers right after) and
+        # persists to disk in a background thread — training overlaps
+        # serialization + IO.  wait() (or the next save/restore) joins.
+        self.async_save = async_save
+        # single-slot box shared with the finalizer — the finalizer must
+        # not capture self, or the weakref never fires
+        self._pending_box: list = [None]
+        self._executor = None
+        if async_save:
+            import weakref
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-save"
+            )
+            # a dropped manager (or interpreter exit) must not lose a
+            # write error silently: join the pending future and raise
+            # in whoever finalizes
+            self._finalizer = weakref.finalize(
+                self, CheckpointManager._drain, self._executor,
+                self._pending_box,
+            )
         os.makedirs(self.directory, exist_ok=True)
+
+    @staticmethod
+    def _drain(executor, pending_box):
+        fut, pending_box[0] = pending_box[0], None
+        try:
+            if fut is not None:
+                fut.result()
+        finally:
+            executor.shutdown(wait=True)
+
+    def wait(self) -> None:
+        """Block until the in-flight async save (if any) is durable on
+        disk; re-raises any persistence error in the caller."""
+        fut, self._pending_box[0] = self._pending_box[0], None
+        if fut is not None:
+            fut.result()
+
+    def close(self) -> None:
+        """Join the in-flight save and shut the writer thread down;
+        surfaces any persistence error.  Also runs at finalization."""
+        self.wait()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._finalizer.detach()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # ------------------------------------------------------------------
     def all_steps(self) -> List[int]:
@@ -160,6 +213,22 @@ class CheckpointManager:
         manifest["rng_counter"] = int(getattr(model, "_rng_counter", 0))
 
         path = self._step_dir(step)
+        if not self.async_save:
+            self._write_snapshot(path, arrays, manifest)
+            return path
+        self.wait()  # one in-flight save at a time; surfaces prior errors
+        # REAL copies NOW — the caller's next train step donates the
+        # device buffers (lowering jits with donate_argnums), and on the
+        # CPU backend np.asarray of a jax array is a zero-copy VIEW of
+        # exactly that donated memory; copy=True is what makes handing
+        # the arrays to the background thread safe
+        arrays = {k: np.array(v, copy=True) for k, v in arrays.items()}
+        self._pending_box[0] = self._executor.submit(
+            self._write_snapshot, path, arrays, manifest
+        )
+        return path
+
+    def _write_snapshot(self, path: str, arrays, manifest) -> None:
         tmp = path + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
@@ -175,11 +244,11 @@ class CheckpointManager:
             shutil.rmtree(path)
         os.rename(tmp, path)
         self._gc()
-        return path
 
     def restore(self, model, step: Optional[int] = None) -> int:
         """Load a snapshot into a compiled FFModel; returns the step."""
         assert model.compiled is not None, "compile() before restore"
+        self.wait()  # an in-flight async save must land first
         if step is None:
             step = self.latest_step()
             if step is None:

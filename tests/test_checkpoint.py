@@ -60,6 +60,32 @@ def test_save_restore_roundtrip(tmp_path, use_orbax):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
+def test_async_save_overlaps_and_roundtrips(tmp_path):
+    """async_save=True: save() returns before the snapshot is on disk
+    (host copy only — donation-safe), training continues meanwhile, and
+    wait()/restore() join the background write.  The restored state
+    must equal the state AT SAVE TIME, not the later-trained state."""
+    m = _make_model()
+    _train_a_bit(m, steps=2)
+    saved_params = {op: {w: np.asarray(a) for w, a in ws.items()}
+                    for op, ws in m.params.items()}
+    mgr = CheckpointManager(str(tmp_path), async_save=True, use_orbax=False)
+    mgr.save(7, m)
+    _train_a_bit(m, steps=2, seed=9)  # train OVER the in-flight save
+    mgr.wait()
+    assert mgr.all_steps() == [7]
+    m2 = _make_model(seed=1)
+    step = mgr.restore(m2)
+    assert step == 7
+    for op, ws in saved_params.items():
+        for w, a in ws.items():
+            np.testing.assert_array_equal(a, np.asarray(m2.params[op][w]))
+    # a second async save joins the first and supersedes it
+    mgr.save(8, m)
+    mgr.wait()
+    assert mgr.latest_step() == 8
+
+
 def test_resume_training_continues(tmp_path):
     m = _make_model()
     x, y = _train_a_bit(m, steps=2)

@@ -1,0 +1,64 @@
+"""Example-script smoke tier: every runnable script in examples/
+executes end-to-end at CI size in a fresh process (role of the
+reference's tests/multi_gpu_tests.sh, which runs its ~30 example
+scripts with --only-data-parallel — success = trains without crash).
+
+Builders are unit-tested in test_models.py; this tier catches what
+those cannot — rot in the scripts themselves (imports, arg parsing,
+run_example glue).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (script, extra argv).  Scripts sized for CPU internally; batch/epochs
+# kept minimal here.  Excluded: inception (220-node graph takes minutes
+# to compile on a 1-core CI host; covered by
+# test_models.test_inception_builds and the search-scale gate) and
+# pytorch_bert (HF trace + import covered directly by
+# test_frontends.test_huggingface_bert_import_parity_and_training).
+_SCRIPTS = [
+    ("alexnet.py", ["-b", "8", "-e", "1"]),
+    ("mlp_unify.py", ["-b", "16", "-e", "1"]),
+    ("transformer.py", ["-b", "4", "-e", "1"]),
+    ("gpt.py", ["-b", "4", "-e", "1"]),
+    ("dlrm.py", ["-b", "8", "-e", "1"]),
+    ("xdl.py", ["-b", "8", "-e", "1"]),
+    ("candle_uno.py", ["-b", "8", "-e", "1"]),
+    ("moe.py", ["-b", "8", "-e", "1"]),
+    ("keras_mnist_mlp.py", ["-b", "16", "-e", "1"]),
+    ("pytorch_import.py", ["-b", "8", "-e", "1"]),
+    ("resnet.py", ["-b", "4", "-e", "1"]),
+]
+
+_BOOT = (
+    "import jax; "
+    "jax.config.update('jax_platforms', 'cpu'); "
+    "jax.config.update('jax_num_cpu_devices', 8); "
+    "import runpy, sys; "
+    "runpy.run_path(sys.argv[1], run_name='__main__')"
+)
+
+
+@pytest.mark.parametrize("script,argv", _SCRIPTS,
+                         ids=[s for s, _ in _SCRIPTS])
+def test_example_script_runs(script, argv):
+    path = os.path.join(_REPO, "examples", script)
+    proc = subprocess.run(
+        [sys.executable, "-c", _BOOT, path, *argv,
+         "--only-data-parallel"],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "PYTHONPATH": _REPO},
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )

@@ -11,16 +11,56 @@ chip is running at better than 40% of bf16 MXU peak.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 
+def _probe_backend(timeout_s: float = 120.0):
+    """Fail fast if the accelerator is unreachable.  A wedged device
+    tunnel hangs backend INITIALIZATION (jax.devices()) or the first
+    computation forever (observed: a remote-compile failure left the
+    relay claiming forever) — a bench that hangs records nothing; a
+    loud early exit records the cause.  Returns jax.devices()."""
+    import threading
+
+    done = threading.Event()
+    out = []
+
+    def _try():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            devs = jax.devices()
+            x = jnp.ones((64, 64))
+            (x @ x).block_until_ready()
+            out.append(devs)
+        except Exception as e:  # pragma: no cover
+            out.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_try, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        print(
+            f"# bench: accelerator backend unresponsive after "
+            f"{timeout_s:.0f}s — device tunnel down?",
+            file=sys.stderr,
+        )
+        os._exit(3)  # the hung init/compile thread cannot be joined
+    if isinstance(out[0], Exception):
+        raise out[0]
+    return out[0]
+
+
 def main():
     import jax
 
-    devices = jax.devices()
+    devices = _probe_backend()
     on_tpu = devices[0].platform == "tpu" or "TPU" in str(devices[0])
     # sized for a single v5e chip; shrink on CPU so CI-style runs finish
     if on_tpu:

@@ -41,14 +41,21 @@ _BOOT = (
     "jax.config.update('jax_platforms', 'cpu'); "
     "jax.config.update('jax_num_cpu_devices', 8); "
     "import runpy, sys; "
-    "runpy.run_path(sys.argv[1], run_name='__main__')"
+    "sys.argv = sys.argv[1:]; "  # the script must see ITS OWN argv
+    "runpy.run_path(sys.argv[0], run_name='__main__')"
 )
 
 
 @pytest.mark.parametrize("script,argv", _SCRIPTS,
                          ids=[s for s, _ in _SCRIPTS])
 def test_example_script_runs(script, argv):
+    if script == "pytorch_import.py":
+        pytest.importorskip("torch")
     path = os.path.join(_REPO, "examples", script)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_REPO, env.get("PYTHONPATH")) if p
+    )
     proc = subprocess.run(
         [sys.executable, "-c", _BOOT, path, *argv,
          "--only-data-parallel"],
@@ -56,7 +63,7 @@ def test_example_script_runs(script, argv):
         capture_output=True,
         text=True,
         timeout=600,
-        env={**os.environ, "PYTHONPATH": _REPO},
+        env=env,
     )
     assert proc.returncode == 0, (
         f"{script} failed\nstdout:\n{proc.stdout[-2000:]}\n"

@@ -65,6 +65,10 @@ class FFConfig:
     export_strategy_computation_graph_file: Optional[str] = None
     export_strategy_task_graph_file: Optional[str] = None  # simulated
     # schedule dot export (reference: config.h:142, simulator.cc:1008)
+    comp_mode: str = "training"  # "training" | "inference" — set by
+    # compile(comp_mode=...); inference searches rank strategies by
+    # forward latency with no weight sync (reference:
+    # COMP_MODE_INFERENCE, config.h:47-50) and fit() refuses to run
     # numerics
     compute_dtype: str = "bfloat16"  # matmul dtype on TPU
     param_dtype: str = "float32"

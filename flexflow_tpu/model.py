@@ -400,6 +400,11 @@ class FFModel:
         ffconst.h:148)."""
         from flexflow_tpu.compiler.lowering import CompiledModel, data_parallel_strategy
 
+        if comp_mode not in ("training", "inference"):
+            raise ValueError(
+                f"comp_mode must be 'training' or 'inference', got {comp_mode!r}"
+            )
+        self.config.comp_mode = comp_mode
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
@@ -460,11 +465,9 @@ class FFModel:
         if self.config.export_strategy_task_graph_file:
             from flexflow_tpu.search.simulator import Simulator
 
-            # search_devices, not num_devices: the strategy's views were
-            # sized for the (possibly overridden) search machine
-            Simulator(
-                self.config.machine_spec, num_devices=self.config.search_devices
-            ).export_task_graph_dot(
+            # for_config: search_devices + comp_mode/zero flags match
+            # what the search itself costed
+            Simulator.for_config(self.config).export_task_graph_dot(
                 self.graph, strategy, self.config.export_strategy_task_graph_file
             )
 
@@ -562,6 +565,12 @@ class FFModel:
         from flexflow_tpu.runtime.dataloader import SingleDataLoader
 
         assert self.compiled is not None, "call compile() first"
+        if self.config.comp_mode == "inference":
+            raise RuntimeError(
+                "model was compiled with comp_mode='inference' (forward-"
+                "only strategy search, reference COMP_MODE_INFERENCE) — "
+                "recompile with comp_mode='training' to fit()"
+            )
         ckpt_mgr = None
         start_epoch = 0
         if checkpoint_dir is not None:

@@ -500,7 +500,9 @@ class SearchHelper:
         ns, index = built
         assign = [0] * ns.num_nodes
         free_idx = [index[n.guid] for n in free]
-        cost, best = ns.brute_force(free_idx, assign)
+        cost, best = ns.brute_force(
+            free_idx, assign, include_update=not self.sim.inference
+        )
         if not math.isfinite(cost):
             return (math.inf, {})
         strategy = {
@@ -561,7 +563,9 @@ class SearchHelper:
             else:
                 is_free[i] = True
                 assign[i] = len(node_views[guid]) - 1  # default view
-        cost, best = ns.greedy(is_free, counts, assign)
+        cost, best = ns.greedy(
+            is_free, counts, assign, include_update=not self.sim.inference
+        )
         strategy = {
             guid: node_views[guid][best[i]] for guid, i in index.items()
         }

@@ -420,8 +420,7 @@ def optimize_strategy(
                 log.log(f"{len(calibration)} measured records")
             if config.calibration_file:
                 calibration.save(config.calibration_file)
-    sim = Simulator(config.machine_spec, num_devices=n, calibration=calibration,
-                    zero_dp_shard=config.zero_dp_shard)
+    sim = Simulator.for_config(config, calibration=calibration)
     helper = SearchHelper(sim, n)
 
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
@@ -466,9 +465,7 @@ def optimize_strategy(
                     )
                     if config.calibration_file:
                         calibration.save(config.calibration_file)
-                    sim2 = Simulator(config.machine_spec, num_devices=n,
-                                     calibration=calibration,
-                                     zero_dp_shard=config.zero_dp_shard)
+                    sim2 = Simulator.for_config(config, calibration=calibration)
                     best_cost = sim2.simulate(graph, best_strategy)
                     c2 = sim2.simulate(g2, s2)
             if c2 < best_cost and s2:
@@ -496,8 +493,7 @@ def mcmc_optimize(
     from flexflow_tpu.search.views import candidate_views
 
     n = config.search_devices
-    sim = Simulator(config.machine_spec, num_devices=n,
-                    zero_dp_shard=config.zero_dp_shard)
+    sim = Simulator.for_config(config)
     rng = random.Random(seed)
     nodes = graph.topo_order()
 

@@ -68,6 +68,9 @@ class CostModel:
     # credit the 1/replica optimizer share or the search rejects
     # strategies that actually fit
     zero_dp_shard: bool = False
+    # inference compile (reference COMP_MODE_INFERENCE): no grads, no
+    # optimizer state — op_memory counts weights + activations only
+    inference: bool = False
 
     # ---- slice topology --------------------------------------------------
     def _slot_axes(self, slot_degrees: Tuple[int, ...]):
@@ -442,6 +445,9 @@ class CostModel:
             for d in annot.degrees:
                 n //= max(d, 1)
             w = n * ws.dtype.itemsize
+            if self.inference:
+                mem += w  # weights only: no grad, no optimizer state
+                continue
             opt = w  # one optimizer-state share (weight + grad + opt)
             if self.zero_dp_shard:
                 # mirror execution exactly (lowering._zero_augmented):
@@ -471,5 +477,6 @@ class CostModel:
             n = shape.num_elements
             for d in annot.degrees:
                 n //= max(d, 1)
-            mem += n * shape.dtype.itemsize * 2  # fwd + grad
+            mem += n * shape.dtype.itemsize * (1 if self.inference else 2)
+            # fwd activation (+ its grad when training)
         return mem

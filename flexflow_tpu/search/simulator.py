@@ -29,7 +29,8 @@ from flexflow_tpu.search.machine_model import CostModel
 class Simulator:
     def __init__(self, machine: MachineSpec, num_devices: Optional[int] = None,
                  use_network_model: bool = True, calibration=None,
-                 placement_overlap: bool = False, zero_dp_shard: bool = False):
+                 placement_overlap: bool = False, zero_dp_shard: bool = False,
+                 inference: bool = False):
         self.machine = machine
         self.num_devices = num_devices or machine.num_devices
         # placement_overlap=True credits inter-op COMPUTE overlap for
@@ -44,6 +45,11 @@ class Simulator:
         # (weight syncs over distinct device groups) IS real and stays
         # on view-level device sets in both modes.
         self.placement_overlap = placement_overlap
+        # inference=True: simulate() defaults to forward-only costs with
+        # no weight sync (the reference's COMP_MODE_INFERENCE,
+        # config.h:47-50 / FFModel::compile comp_mode arg) — the search
+        # then ranks strategies by inference latency
+        self.inference = inference
         self._all_devices = frozenset(range(self.num_devices))
         network = None
         if use_network_model:
@@ -55,7 +61,8 @@ class Simulator:
                 network = None
         self.cost = CostModel(machine, network=network, calibration=calibration,
                               num_devices=self.num_devices,
-                              zero_dp_shard=zero_dp_shard)
+                              zero_dp_shard=zero_dp_shard,
+                              inference=inference)
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
         # propagate()/op_cost results per (op signature, view): structural
         # keys stay valid across graph copies and op lifetimes (an id()
@@ -81,6 +88,21 @@ class Simulator:
             hit = frozenset((start + i) % self.num_devices for i in range(n))
             self._device_sets[key] = hit
         return hit
+
+    @classmethod
+    def for_config(cls, config, calibration=None, **kw):
+        """Simulator matching an FFConfig's search settings — the ONE
+        place every config-derived flag is threaded, so a new flag
+        cannot miss a construction site (driver search, MCMC, strategy
+        task-graph export)."""
+        return cls(
+            config.machine_spec,
+            num_devices=config.search_devices,
+            calibration=calibration,
+            zero_dp_shard=config.zero_dp_shard,
+            inference=config.comp_mode == "inference",
+            **kw,
+        )
 
     # ------------------------------------------------------------------
     def _node_costs(self, node, mv) -> Tuple[float, float, float, float]:
@@ -112,13 +134,17 @@ class Simulator:
         self,
         graph: Graph,
         strategy: Dict[int, MachineView],
-        include_update: bool = True,
+        include_update: Optional[bool] = None,
         schedule: Optional[list] = None,
     ) -> float:
-        """Seconds per training iteration under the strategy.  Pass a
+        """Seconds per training iteration under the strategy (or per
+        inference when the simulator was built with inference=True —
+        ``include_update`` defaults to the simulator's mode).  Pass a
         list as ``schedule`` to receive per-task placement records
         ``(op_name, start_s, finish_s, device_ids)`` — the simulated
         task graph (reference: simulator.cc:1008-1058 dot export)."""
+        if include_update is None:
+            include_update = not self.inference
         ready: Dict[Tuple[int, int], float] = {}  # (guid, out_idx) -> time
         device_avail: Dict[int, float] = {d: 0.0 for d in range(self.num_devices)}
         # per-device COMM timelines for weight-grad allreduces

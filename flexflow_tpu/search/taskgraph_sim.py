@@ -43,7 +43,9 @@ class LogicalTaskGraphSimulator(Simulator):
         return flows
 
     def simulate(self, graph: Graph, strategy: Dict[int, MachineView],
-                 include_update: bool = True, schedule=None) -> float:
+                 include_update=None, schedule=None) -> float:
+        if include_update is None:
+            include_update = not self.inference
         if self.cost.network is None:
             # no topology to pool flows on — fall back to the event sim
             return super().simulate(graph, strategy, include_update, schedule)

@@ -131,3 +131,46 @@ def test_argv_taskgraph_flag():
     cfg = ff.FFConfig.parse_args(["--taskgraph", "/tmp/x.dot", "-b", "64"])
     assert cfg.export_strategy_task_graph_file == "/tmp/x.dot"
     assert cfg.batch_size == 64
+
+
+def test_inference_comp_mode_forward_only():
+    """compile(comp_mode='inference') — the reference's
+    COMP_MODE_INFERENCE (config.h:47-50): the search ranks strategies
+    by forward latency with NO weight sync, evaluate/forward work, and
+    fit() refuses loudly."""
+    import numpy as np
+    import pytest
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.machine import MachineSpec, MachineView
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+
+    cfg = ff.FFConfig(batch_size=16, num_devices=8, only_data_parallel=False,
+                      compute_dtype="float32", search_budget=4)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([16, 32])
+    t = m.dense(x, 64, activation="relu")
+    m.dense(t, 4)
+    m.compile(comp_mode="inference",
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    xd = rng.normal(size=(32, 32)).astype(np.float32)
+    yd = rng.integers(0, 4, 32).astype(np.int32)
+    rep = m.evaluate(x=xd, y=yd)
+    assert "accuracy" in rep
+    with pytest.raises(RuntimeError, match="inference"):
+        m.fit(x=xd, y=yd, verbose=False)
+
+    # simulator: inference mode costs forward-only, no grad sync
+    m2 = ff.FFModel(ff.FFConfig(batch_size=8, num_devices=8,
+                                only_data_parallel=True))
+    x2 = m2.create_tensor([8, 1024])
+    m2.dense(x2, 1024)
+    g = m2.graph
+    dp = data_parallel_strategy(g, 8)
+    spec = MachineSpec.tpu_v5e(8)
+    c_train = Simulator(spec, num_devices=8).simulate(g, dp)
+    c_inf = Simulator(spec, num_devices=8, inference=True).simulate(g, dp)
+    assert c_inf < c_train * 0.6, (c_inf, c_train)

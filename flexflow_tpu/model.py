@@ -542,6 +542,7 @@ class FFModel:
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True,
             callbacks: Sequence = (), recompile_state=None,
+            validation_data=None,
             checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
             resume: bool = False):
         """Training loop (reference: flexflow_cffi.py:1832 fit).
@@ -554,6 +555,12 @@ class FFModel:
         once per iteration (reference: recompile_on_condition,
         model.cc:2273); its alter() may mutate op attrs, after which the
         model re-lowers with params/state carried over.
+
+        ``validation_data=(vx, vy)`` — evaluated after each epoch;
+        ``val_*`` keys join the epoch logs/history so callbacks can
+        monitor them (keras semantics; the reference's keras frontend
+        verifies metrics only on the training set, callbacks.py
+        VerifyMetrics).
 
         ``checkpoint_dir`` — snapshot the full training state (params,
         optimizer state, rng counter) every ``checkpoint_every`` epochs;
@@ -571,6 +578,28 @@ class FFModel:
                 "only strategy search, reference COMP_MODE_INFERENCE) — "
                 "recompile with comp_mode='training' to fit()"
             )
+        if validation_data is not None:
+            # fail BEFORE training, not after a wasted epoch
+            if not isinstance(validation_data, (tuple, list)) or len(
+                validation_data
+            ) != 2:
+                raise ValueError(
+                    "validation_data must be an (x, y) pair "
+                    "(sample weights are not supported)"
+                )
+            _vy = np.asarray(validation_data[1])
+            _bs = batch_size or self.config.batch_size
+            if len(_vy) < _bs:
+                raise ValueError(
+                    f"validation set ({len(_vy)} samples) is smaller than "
+                    f"batch_size ({_bs}) — evaluate() runs full batches "
+                    "only, so no validation metric could ever be computed"
+                )
+            if len(_vy) % _bs:
+                print(
+                    f"# warning: validation tail of {len(_vy) % _bs} samples "
+                    f"(< batch_size {_bs}) is dropped each epoch"
+                )
         ckpt_mgr = None
         start_epoch = 0
         if checkpoint_dir is not None:
@@ -693,6 +722,18 @@ class FFModel:
                 print(f"epoch {epoch}: loss={float(loss):.4f} {metrics}")
             logs = metrics.report()
             logs["loss"] = float(loss)
+            if validation_data is not None:
+                vx, vy = validation_data
+                val = self.evaluate(x=vx, y=vy, batch_size=batch_size)
+                for k, v in val.items():
+                    if k != "samples":
+                        logs[f"val_{k}"] = v
+                if verbose:
+                    parts = " ".join(
+                        f"{k}: {v:.4f}" for k, v in logs.items()
+                        if k.startswith("val_")
+                    )
+                    print(f"  validation: {parts}")
             history.append(logs)
             for cb in callbacks:
                 if cb.on_epoch_end(epoch, logs) is False:
@@ -730,10 +771,18 @@ class FFModel:
             batch_size, shuffle=False,
         )
         metrics = PerfMetrics()
+        total_loss, batches = 0.0, 0
         for inputs, labels in loader:
-            _, m = self.compiled.eval_step(self.params, self.state, inputs, labels)
+            loss, m = self.compiled.eval_step(
+                self.params, self.state, inputs, labels
+            )
+            total_loss += float(loss)
+            batches += 1
             metrics.update(m)
-        return metrics.report()
+        rep = metrics.report()
+        if batches:  # equal-sized batches: mean of batch means is exact
+            rep["loss"] = total_loss / batches
+        return rep
 
     # ------------------------------------------------------------------
     def get_weight(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:

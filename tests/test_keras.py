@@ -159,3 +159,59 @@ def test_embedding_sequential():
     y = (x.sum(axis=1) % 2).astype(np.int32)
     hist = model.fit(x, y, epochs=3, verbose=False)
     assert "accuracy" in hist[-1]
+
+
+def test_fit_validation_data_and_early_stopping_on_val():
+    """fit(validation_data=...) evaluates each epoch, joins val_* into
+    the history, and EarlyStopping can monitor val_loss (keras
+    semantics; the reference verifies metrics on the training set
+    only)."""
+    import numpy as np
+
+    from flexflow_tpu import keras
+
+    rng = np.random.default_rng(0)
+    xtr = rng.normal(size=(64, 16)).astype(np.float32)
+    ytr = rng.integers(0, 4, 64).astype(np.int32)
+    xva = rng.normal(size=(32, 16)).astype(np.float32)
+    yva = rng.integers(0, 4, 32).astype(np.int32)
+    model = keras.Sequential([
+        keras.layers.Dense(32, activation="relu", input_shape=(16,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(
+        xtr, ytr, epochs=3, batch_size=16, verbose=False,
+        validation_data=(xva, yva),
+        callbacks=[keras.callbacks.EarlyStopping(monitor="val_loss",
+                                                 patience=1)],
+    )
+    assert all("val_accuracy" in h and "val_loss" in h for h in hist)
+    assert "val_sparse" not in "".join(hist[0])  # only compiled metrics
+
+
+def test_fit_validation_data_validated_up_front():
+    """A malformed or too-small validation set must fail BEFORE the
+    first epoch trains, not after."""
+    import numpy as np
+    import pytest
+
+    from flexflow_tpu import keras
+
+    rng = np.random.default_rng(0)
+    xtr = rng.normal(size=(32, 16)).astype(np.float32)
+    ytr = rng.integers(0, 4, 32).astype(np.int32)
+    model = keras.Sequential([
+        keras.layers.Dense(8, activation="relu", input_shape=(16,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    with pytest.raises(ValueError, match="pair"):
+        model.fit(xtr, ytr, epochs=1, batch_size=16, verbose=False,
+                  validation_data=(xtr, ytr, ytr))
+    with pytest.raises(ValueError, match="smaller than"):
+        model.fit(xtr, ytr, epochs=1, batch_size=16, verbose=False,
+                  validation_data=(xtr[:4], ytr[:4]))

@@ -118,10 +118,7 @@ def train_step_row(seq, hidden, heads, layers):
                       compute_dtype="bfloat16")
     model = build_transformer(cfg, num_layers=layers, hidden=hidden,
                               num_heads=heads, ff_dim=4 * hidden,
-                              seq_len=seq, layer_norm=True)
-    for n in model.graph.nodes.values():
-        if "causal" in getattr(n.op, "attrs", {}):
-            n.op.attrs["causal"] = True
+                              seq_len=seq, layer_norm=True, causal=True)
     model.compile(optimizer=ff.AdamOptimizer(alpha=1e-4),
                   loss_type="mean_squared_error", metrics=[])
     rng = np.random.default_rng(0)

@@ -90,6 +90,28 @@ class MultiHeadAttentionOp(Operator):
     def head_dim(self) -> int:
         return self.attrs["embed_dim"] // self.attrs["num_heads"]
 
+    def ring_comm_bytes(self, mv) -> Tuple[float, int, int]:
+        """(forward wire bytes per device, ring size, view slot the
+        ring rides) when the view splits the SEQUENCE dim — execution
+        then runs ring attention (parallel/ring_attention.py): the K
+        and V shards make n-1 ppermute hops each in the forward (the
+        backward re-rotates them; the cost model doubles it).  Charged
+        so sequence parallelism is not ranked as free compute-splitting
+        (the compute roofline alone would say it is).
+
+        Zero for cross-attention (Sk != Sq — propagate keeps K/V whole
+        and execution takes the non-ring path) and the bytes shrink by
+        the head-parallel replica degree (each device rotates only its
+        own heads' K/V columns)."""
+        q, k = self.input_shapes[0], self.input_shapes[1]
+        n = mv.dim_degrees[1] if len(mv.dim_degrees) > 1 else 1
+        if n <= 1 or k.sizes[1] != q.sizes[1]:
+            return 0.0, 1, 1
+        b_loc = q.sizes[0] / max(mv.dim_degrees[0], 1)
+        e = self.attrs["embed_dim"] / max(mv.replica_degree, 1)
+        shard = b_loc * (q.sizes[1] / n) * e * q.dtype.itemsize
+        return 2.0 * (n - 1) * shard, n, 1  # K and V, n-1 hops each
+
     def weight_specs(self) -> Sequence[WeightSpec]:
         a = self.attrs
         e, h = a["embed_dim"], a["num_heads"]

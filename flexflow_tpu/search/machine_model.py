@@ -196,6 +196,23 @@ class CostModel:
             # bwd ≈ 2x fwd FLOPs for matmul-family, ~1x for elementwise
             bwd_factor = 2.0 if op.flops() > 4 * op.output_shapes[0].num_elements else 1.0
             t += bwd_factor * fwd + OP_OVERHEAD_S
+        # ops whose sharded execution runs an internal collective (ring
+        # attention over a split seq dim) declare the wire bytes — a
+        # calibration measurement can't see them (probes run one chip).
+        # Priced via allgather(): identical neighbor-ring pattern
+        # ((n-1) hops of one shard), so the NetworkedMachineModel's
+        # contention routing applies when configured.
+        ring = getattr(op, "ring_comm_bytes", None)
+        if ring is not None:
+            nbytes, n, slot = ring(mv)
+            if nbytes > 0.0:
+                per_hop = nbytes / max(n - 1, 1)
+                spans = self._spans_dcn(
+                    tuple(mv.dim_degrees) + (mv.replica_degree,), [slot]
+                )
+                t += (2 if backward else 1) * self.allgather(
+                    per_hop, n, spans
+                )
         return t
 
     # ---- collectives -----------------------------------------------------

@@ -173,3 +173,28 @@ def test_search_still_beats_dp_on_two_slices_and_dcn_only_hurts():
     assert c_two >= c_one * 0.999, (c_one, c_two)
     dp_two = sim_two.simulate(m.graph, data_parallel_strategy(m.graph, 8))
     assert c_two <= dp_two * 1.001, (c_two, dp_two)
+
+
+def test_seq_parallel_mha_charges_ring_comm():
+    """A view splitting MHA's sequence dim executes as ring attention
+    (K/V shards make n-1 ppermute hops); the cost model must charge
+    that wire time — otherwise the search ranks sequence parallelism
+    as free compute-splitting and prefers it over batch splitting even
+    when the ring traffic dominates."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.machine import MachineSpec, MachineView
+    from flexflow_tpu.search.machine_model import CostModel
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 512, 256])
+    m.multihead_attention(x, x, x, embed_dim=256, num_heads=8, name="mha")
+    op = m.node_by_name("mha").op
+    cm = CostModel(MachineSpec.tpu_v5e(8), num_devices=8)
+    c_batch = cm.op_cost(op, MachineView(dim_degrees=(8, 1, 1)))
+    c_seq = cm.op_cost(op, MachineView(dim_degrees=(1, 8, 1)))
+    assert c_seq > c_batch * 1.5, (c_batch, c_seq)
+    # inference charges half the ring traffic (no backward re-rotation)
+    c_seq_fwd = cm.op_cost(op, MachineView(dim_degrees=(1, 8, 1)),
+                           backward=False)
+    assert c_seq_fwd < c_seq, (c_seq_fwd, c_seq)

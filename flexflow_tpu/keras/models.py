@@ -112,31 +112,9 @@ class _BaseModel:
         return self.ffmodel.evaluate(x=x, y=y, batch_size=batch_size)
 
     def predict(self, x, batch_size: Optional[int] = None):
-        """Forward pass over x in batches; one row out per row in (a
-        short tail batch is padded to batch_size and trimmed)."""
-        m = self.ffmodel
-        batch_size = batch_size or self.ffconfig.batch_size
-        xs = x if isinstance(x, (list, tuple)) else [x]
-        xs = [np.asarray(a) for a in xs]
-        n = xs[0].shape[0]
-        fwd = m.compiled.forward_fn()
-        outs = []
-        for i in range(0, n, batch_size):
-            batch = [a[i:i + batch_size] for a in xs]
-            got = batch[0].shape[0]
-            if got < batch_size:
-                batch = [
-                    np.concatenate(
-                        [b, np.repeat(b[-1:], batch_size - got, axis=0)], axis=0
-                    )
-                    for b in batch
-                ]
-            y = np.asarray(fwd(m.params, m.state, batch))
-            outs.append(y[:got])
-        if outs:
-            return np.concatenate(outs, axis=0)
-        out_tail = tuple(self._ff_outputs[0].sizes[1:])
-        return np.empty((0,) + out_tail, dtype=np.float32)
+        """Forward pass over x in batches; one row out per row in —
+        delegates to FFModel.predict (the single implementation)."""
+        return self.ffmodel.predict(x, batch_size=batch_size)
 
     # weight access (reference: get_weight_tensor/set_weight_tensor)
     def get_weights(self, layer_name: str) -> Dict[str, np.ndarray]:

@@ -784,6 +784,39 @@ class FFModel:
             rep["loss"] = total_loss / batches
         return rep
 
+    def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        """Batched forward pass: one output row per input row (a short
+        tail batch is padded to batch_size and trimmed — the compiled
+        program has static shapes).  The inference verb pairing with
+        compile(comp_mode='inference'); reference models predict via
+        their eval path only."""
+        assert self.compiled is not None, "call compile() first"
+        batch_size = batch_size or self.config.batch_size
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        xs = [np.asarray(a) for a in xs]
+        n = xs[0].shape[0]
+        fwd = self.compiled.forward_fn()
+        outs = []
+        for i in range(0, n, batch_size):
+            batch = [a[i:i + batch_size] for a in xs]
+            got = batch[0].shape[0]
+            if got < batch_size:
+                batch = [
+                    np.concatenate(
+                        [b, np.repeat(b[-1:], batch_size - got, axis=0)],
+                        axis=0,
+                    )
+                    for b in batch
+                ]
+            y = np.asarray(fwd(self.params, self.state, batch))
+            outs.append(y[:got])
+        if outs:
+            return np.concatenate(outs, axis=0)
+        sink = self.graph.sinks()[-1]
+        return np.empty(
+            (0,) + tuple(sink.op.output_shapes[0].sizes[1:]), np.float32
+        )
+
     # ------------------------------------------------------------------
     def get_weight(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
         """reference: ParallelTensorBase::get_tensor (parallel_tensor.h:157)."""

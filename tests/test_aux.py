@@ -159,7 +159,9 @@ def test_inference_comp_mode_forward_only():
     xd = rng.normal(size=(32, 32)).astype(np.float32)
     yd = rng.integers(0, 4, 32).astype(np.int32)
     rep = m.evaluate(x=xd, y=yd)
-    assert "accuracy" in rep
+    assert "accuracy" in rep and "loss" in rep
+    preds = m.predict(xd[:20])  # tail batch of 4 padded + trimmed
+    assert preds.shape == (20, 4)
     with pytest.raises(RuntimeError, match="inference"):
         m.fit(x=xd, y=yd, verbose=False)
 

@@ -85,7 +85,6 @@ class _BaseModel:
             outs = out if isinstance(out, list) else [out]
             for kt, t in zip(layer.outputs, outs):
                 env[kt.guid] = t
-        self._ff_outputs = [env[t.guid] for t in self._output_tensors()]
         self.ffmodel = model
         model.compile(optimizer=self._optimizer, loss_type=self._loss,
                       metrics=self._metrics, **ff_kwargs)

@@ -812,9 +812,9 @@ class FFModel:
             outs.append(y[:got])
         if outs:
             return np.concatenate(outs, axis=0)
-        sink = self.graph.sinks()[-1]
+        sink_shape = self.graph.sinks()[-1].op.output_shapes[0]
         return np.empty(
-            (0,) + tuple(sink.op.output_shapes[0].sizes[1:]), np.float32
+            (0,) + tuple(sink_shape.sizes[1:]), sink_shape.dtype.to_numpy()
         )
 
     # ------------------------------------------------------------------

@@ -91,3 +91,31 @@ def test_searched_strategy_trains_multibranch_e2e():
         "sparse_categorical_crossentropy"
     ], hist
     assert hist[-1]["accuracy"] > 0.7, hist[-1]
+
+
+def test_default_search_gpt_under_60s_and_splits_lm_head():
+    """The causal-LM PCG (embedding + causal MHA stack + a 32k-vocab
+    LM head) through the default joint search: completes inside the
+    deadline, never worse than pure DP, and the huge lm_head weight
+    (hidden x vocab — the largest tensor in the model) attracts a
+    non-pure-DP treatment (weight split or replica sharding) at small
+    batch, where its gradient allreduce dominates pure DP."""
+    from flexflow_tpu.models import build_gpt
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8)
+    model = build_gpt(cfg, vocab=32000, num_layers=4, hidden=512,
+                      num_heads=8, ff_dim=2048, seq_len=256)
+    g = model.graph
+    t0 = time.monotonic()
+    best_graph, strategy = optimize_strategy(g, cfg, return_graph=True)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, f"gpt search took {elapsed:.1f}s"
+    sim = Simulator(cfg.machine_spec, num_devices=8)
+    c_searched = sim.simulate(best_graph, strategy)
+    c_dp = sim.simulate(g, data_parallel_strategy(g, 8))
+    assert c_searched <= c_dp * 1.001, (c_searched, c_dp)
+    head = next(n for n in best_graph.topo_order() if "lm_head" in n.op.name)
+    hv = strategy[head.guid]
+    assert hv.replica_degree > 1 or any(
+        d > 1 for d in hv.dim_degrees[1:]
+    ), f"lm_head stayed pure-DP: {hv}"

@@ -542,7 +542,7 @@ class FFModel:
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True,
             callbacks: Sequence = (), recompile_state=None,
-            validation_data=None,
+            validation_data=None, validation_split: float = 0.0,
             checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
             resume: bool = False):
         """Training loop (reference: flexflow_cffi.py:1832 fit).
@@ -560,7 +560,9 @@ class FFModel:
         ``val_*`` keys join the epoch logs/history so callbacks can
         monitor them (keras semantics; the reference's keras frontend
         verifies metrics only on the training set, callbacks.py
-        VerifyMetrics).
+        VerifyMetrics).  ``validation_split=f`` holds out the LAST
+        fraction of (x, y) — taken before any shuffling, keras's exact
+        split formula — as validation_data; mutually exclusive with it.
 
         ``checkpoint_dir`` — snapshot the full training state (params,
         optimizer state, rng counter) every ``checkpoint_every`` epochs;
@@ -578,6 +580,30 @@ class FFModel:
                 "only strategy search, reference COMP_MODE_INFERENCE) — "
                 "recompile with comp_mode='training' to fit()"
             )
+        if validation_split:
+            # keras semantics: the LAST fraction of the data (before any
+            # shuffling) becomes the validation set
+            if validation_data is not None:
+                raise ValueError(
+                    "pass either validation_data or validation_split, not both"
+                )
+            if not 0.0 < validation_split < 1.0:
+                raise ValueError(f"validation_split={validation_split} not in (0, 1)")
+            xs_all = x if isinstance(x, (list, tuple)) else [x]
+            xs_all = [np.asarray(a) for a in xs_all]
+            y_all = np.asarray(y)
+            n_all = len(y_all)
+            cut = int(n_all * (1.0 - validation_split))  # keras's exact formula
+            if cut == n_all or cut == 0:
+                raise ValueError(
+                    f"validation_split={validation_split} of {n_all} samples "
+                    "leaves an empty train or validation set"
+                )
+            validation_data = ([a[cut:] for a in xs_all]
+                               if len(xs_all) > 1 else xs_all[0][cut:],
+                               y_all[cut:])
+            x = [a[:cut] for a in xs_all] if len(xs_all) > 1 else xs_all[0][:cut]
+            y = y_all[:cut]
         if validation_data is not None:
             # fail BEFORE training, not after a wasted epoch
             if not isinstance(validation_data, (tuple, list)) or len(

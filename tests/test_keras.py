@@ -215,3 +215,30 @@ def test_fit_validation_data_validated_up_front():
     with pytest.raises(ValueError, match="smaller than"):
         model.fit(xtr, ytr, epochs=1, batch_size=16, verbose=False,
                   validation_data=(xtr[:4], ytr[:4]))
+
+
+def test_fit_validation_split():
+    """validation_split=f holds out the LAST fraction (keras
+    semantics) and reports val_* like validation_data does."""
+    import numpy as np
+    import pytest
+
+    from flexflow_tpu import keras
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(80, 16)).astype(np.float32)
+    y = rng.integers(0, 4, 80).astype(np.int32)
+    model = keras.Sequential([
+        keras.layers.Dense(16, activation="relu", input_shape=(16,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(x, y, epochs=2, batch_size=16, verbose=False,
+                     validation_split=0.2)
+    assert all("val_loss" in h for h in hist)
+    # 80 * 0.2 = 16 held out -> 64 trained
+    assert hist[-1]["samples"] == 64
+    with pytest.raises(ValueError, match="not both"):
+        model.fit(x, y, epochs=1, batch_size=16, verbose=False,
+                  validation_split=0.2, validation_data=(x[:16], y[:16]))

@@ -31,6 +31,7 @@ Usage::
 from __future__ import annotations
 
 import json
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -171,13 +172,32 @@ class _Tracer:
             return slice(*parts), True
         return a, True
 
+    # Targets that must never constant-fold: executing them bakes ONE
+    # RNG draw (or an uninitialized buffer) into the imported program as
+    # a frozen constant.  Matched by name so tensor methods (normal_,
+    # uniform_, ...) are caught too.
+    _NONDETERMINISTIC = frozenset({
+        "rand", "randn", "randint", "randperm", "rand_like", "randn_like",
+        "randint_like", "normal", "bernoulli", "poisson", "multinomial",
+        "empty", "empty_like", "empty_strided", "new_empty",
+        "normal_", "uniform_", "random_", "bernoulli_", "exponential_",
+        "cauchy_", "log_normal_", "geometric_",
+        "dropout", "dropout_", "rrelu", "rrelu_",
+    })
+
     def _try_fold(self, node) -> bool:
         """Execute a node whose inputs are all constants/literals (the
         imported model's mask-construction and position-id chains —
         transformers BERT builds its extended attention mask from
         ones/eq/sub/finfo/masked_fill on traced shapes).  Stores a
-        tensor result in ``constants``, anything else in ``literals``."""
+        tensor result in ``constants``, anything else in ``literals``.
+        Non-deterministic targets are refused — folding them would
+        freeze a single RNG draw into the program."""
         torch = self.torch
+        tname = (node.target if isinstance(node.target, str)
+                 else getattr(node.target, "__name__", str(node.target)))
+        if tname in self._NONDETERMINISTIC:
+            return False
         for a in list(node.args) + list(node.kwargs.values()):
             _, ok = self._resolve_const(a)
             if not ok:
@@ -201,6 +221,9 @@ class _Tracer:
             self.constants[node.name] = out
         else:
             self.literals[node.name] = out
+        logging.getLogger(__name__).debug(
+            "folded %s (%s) -> %s", node.name, tname, type(out).__name__
+        )
         return True
 
     def run(self) -> List[OpRecord]:

@@ -58,8 +58,14 @@ def sparse_targets(labels, logits):
     lab = labels.astype(jnp.int32)
     if lab.ndim == logits.ndim and lab.shape[-1] == 1:
         lab = lab.reshape(lab.shape[:-1])  # trailing singleton class dim
-    if logits.ndim > 2 and lab.shape == logits.shape[:-1]:
-        return lab, True
+    if logits.ndim > 2:
+        if lab.shape == logits.shape[:-1]:
+            return lab, True
+        raise ValueError(
+            f"sparse labels {labels.shape} incompatible with logits "
+            f"{logits.shape}: per-position labels must match "
+            f"{logits.shape[:-1]} (optionally with a trailing singleton)"
+        )
     return lab.reshape(lab.shape[0], -1)[:, 0], False
 
 

@@ -838,10 +838,14 @@ class FFModel:
             outs.append(y[:got])
         if outs:
             return np.concatenate(outs, axis=0)
-        sink_shape = self.graph.sinks()[-1].op.output_shapes[0]
-        return np.empty(
-            (0,) + tuple(sink_shape.sizes[1:]), sink_shape.dtype.to_numpy()
-        )
+        import jax
+
+        zero_batch = [
+            jax.ShapeDtypeStruct((batch_size,) + a.shape[1:], a.dtype)
+            for a in xs
+        ]
+        spec = jax.eval_shape(fwd, self.params, self.state, zero_batch)
+        return np.empty((0,) + tuple(spec.shape[1:]), spec.dtype)
 
     # ------------------------------------------------------------------
     def get_weight(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:

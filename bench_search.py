@@ -153,7 +153,13 @@ def simulate_pair(name, spec, n_devices, calibration=None,
     }
 
 
-def _steady_step_seconds(model, xs, y, steps):
+def _steady_step_seconds(model, xs, y, steps, blocks: int = 5):
+    """Median-of-blocks step time: single-core hosts jitter 8-18%
+    between consecutive blocks (observed), which is larger than the
+    effects being measured — the median of several short blocks is
+    stable to ~2-3%."""
+    import statistics
+
     import jax
     import jax.random as jrandom
 
@@ -163,17 +169,20 @@ def _steady_step_seconds(model, xs, y, steps):
     ]
     labels = jax.device_put(y, compiled.batch_sharding())
     params, opt_state, state = model.params, model.opt_state, model.state
-    for i in range(2):  # compile + settle
+    for i in range(3):  # compile + settle
         params, opt_state, state, loss, _ = compiled.train_step(
             params, opt_state, state, jrandom.key(i), loader_inputs, labels)
     float(loss)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        params, opt_state, state, loss, _ = compiled.train_step(
-            params, opt_state, state, jrandom.key(100 + i), loader_inputs,
-            labels)
-    float(loss)
-    return (time.perf_counter() - t0) / steps
+    times = []
+    for b in range(blocks):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            params, opt_state, state, loss, _ = compiled.train_step(
+                params, opt_state, state, jrandom.key(100 + b * steps + i),
+                loader_inputs, labels)
+        float(loss)
+        times.append((time.perf_counter() - t0) / steps)
+    return statistics.median(times)
 
 
 def execute_pair(name, spec, n_devices, steps, calibration_file=None):
@@ -194,6 +203,7 @@ def execute_pair(name, spec, n_devices, steps, calibration_file=None):
     on_cpu = jax.devices()[0].platform == "cpu"
 
     results = {}
+    searched_is_dp = False
     for mode in ("dp", "searched"):
         # the osdi22ae contract runs searched-vs-DP on the SAME hardware,
         # with the search targeting that hardware — on a CPU mesh the
@@ -215,10 +225,17 @@ def execute_pair(name, spec, n_devices, steps, calibration_file=None):
             model.compile(loss_type=spec["loss"], metrics=[], strategy=strategy)
         else:
             model.compile(loss_type=spec["loss"], metrics=[])  # joint search
+            # did the search's champion-vs-DP floor keep plain DP?  Then
+            # both compiled programs are identical and the measured
+            # ratio is pure timing noise around 1.0 — record that.
+            searched_is_dp = (
+                model.strategy == data_parallel_strategy(model.graph, n_devices)
+            )
         xs = synthetic_inputs(model, cfg.batch_size)
         y = synthetic_labels(model, cfg.batch_size, spec["loss"])
         results[mode] = _steady_step_seconds(model, xs, y, steps)
     return {
+        "searched_is_dp": searched_is_dp,
         "exec_backend": jax.devices()[0].platform,
         "exec_devices": n_devices,
         # virtual devices share the host's physical cores: when cores <
@@ -378,6 +395,8 @@ def main():
     }
     won = sorted(k for k, r in exec_rows.items() if r > 1.0)
     lost = sorted(k for k, r in exec_rows.items() if r <= 1.0)
+    kept_dp = sorted(
+        k for k, v in report["models"].items() if v.get("searched_is_dp"))
     lines += [
         "",
         cal_note,
@@ -392,7 +411,13 @@ def main():
         "1.0 between runs.  "
         f"In this run the searched strategy won at execution for "
         f"{', '.join(won) or 'none'} and did not for "
-        f"{', '.join(lost) or 'none'}.  The contract number for "
+        f"{', '.join(lost) or 'none'}.  "
+        + (f"For {', '.join(kept_dp)} the search's champion-vs-DP floor "
+           "kept plain data parallelism (predicted win below the "
+           "uncertainty margin), so both executed programs are "
+           "IDENTICAL and the measured ratio is timing noise around "
+           "1.0.  " if kept_dp else "")
+        + "The contract number for "
         "compute-parallel strategies is the TPU-machine-model sim "
         "ratio, which the calibrated table makes falsifiable.",
     ]

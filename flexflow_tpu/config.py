@@ -49,6 +49,18 @@ class FFConfig:
     # search; <=0 disables.  The reference bounds work via --budget
     # alone (substitution.cc:2007); a hard deadline guarantees compile
     # latency at any model scale
+    enable_pipeline_search: bool = True  # compile's joint search also
+    # costs pp in {2,4,8} pipelined candidates for stacked-block graphs
+    # (search/pipeline_search.py) and lowers a winner automatically —
+    # the capability the reference stubs as OP_PIPELINE (ffconst.h:148)
+    search_improvement_margin: float = 0.03  # a searched strategy is
+    # accepted only when its simulated win over plain data parallelism
+    # exceeds this fraction — the simulator has finite fidelity, and a
+    # sub-margin "win" is noise that execution routinely loses to GSPMD
+    # resharding (measured: a 1.4% predicted BERT win executed 7-12%
+    # SLOWER than DP on the 8-device host mesh).  Within the margin the
+    # search returns uniform DP, whose lowering has zero resharding
+    # boundaries.
     substitution_json: Optional[str] = None
     calibration_file: Optional[str] = None  # persisted measured
     # per-(op, view) costs (search/calibration.py); the search loads it

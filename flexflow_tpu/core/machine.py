@@ -49,6 +49,11 @@ class MachineSpec:
     ici_torus: Tuple[int, ...] = ()  # physical torus shape, () = derive
     dcn_bandwidth: float = 3.125e9  # bytes/s per host (25 Gbps)
     dcn_latency: float = 10e-6
+    # fixed seconds per GSPMD reshard op beyond its byte costs (kernel
+    # launches, layout churn, fusion break).  ~launch-scale on TPU;
+    # dominant at small sizes on a serialized CPU host (measured ~2 ms
+    # per boundary for a 128 KB tensor — 20x the byte estimate)
+    reshard_overhead_s: float = 1e-6
     name: str = "tpu_v5e"
     # the jax platform this spec models ("tpu" or "cpu") — measured
     # calibration records are only coherent with a simulator whose
@@ -82,19 +87,25 @@ class MachineSpec:
         reference's --search-num-workers override, graph.cc:1535-1540).
 
         Measured on the CI-style host (often ONE physical core serving
-        all virtual devices): ~7e10 FLOP/s f32 matmul for the WHOLE
+        all virtual devices): ~1.4e11 FLOP/s f32 matmul for the WHOLE
         host, so per-device peak is host/num_devices — virtual devices
         serialize, parallel speedup on this "mesh" is zero and the
         model must say so or the search picks replication-heavy
-        strategies that execution loses.  An 8-way psum is ONE fused
-        XLA op: ~510 us fixed + ~4.6 GB/s ring bandwidth; spread the
-        fixed cost over the ring formula's 2(n-1) hops."""
+        strategies that execution loses.  Collectives serialize through
+        the same core, so the ring formula needs the EFFECTIVE
+        bandwidth that reproduces measured wall times: an 8-way psum
+        measures ~0.10 ms fixed + total-bytes/7.6e9 across 4KB-32MB
+        payloads, which the 2(n-1)/n-shard ring formula reproduces at
+        0.95e9 B/s with the fixed cost spread over 2(n-1) hops
+        (~7 us/hop).  Memory traffic (the reshard materialization term)
+        shares the core too: ~1.25e9 B/s per virtual device."""
         return MachineSpec(
             num_devices=num_devices,
-            peak_flops=7e10 / max(1, num_devices),
-            hbm_bandwidth=5e10,
-            ici_bandwidth=4.6e9,
-            ici_latency=3.6e-5,
+            peak_flops=1.4e11 / max(1, num_devices),
+            hbm_bandwidth=1.25e9,
+            ici_bandwidth=0.95e9,
+            ici_latency=7e-6,
+            reshard_overhead_s=1.5e-3,
             name="host_cpu",
             platform="cpu",
         )

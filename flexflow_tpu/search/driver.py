@@ -356,6 +356,34 @@ def _merge_split(
     return g, strategy
 
 
+def load_calibration(config: FFConfig):
+    """The CalibrationTable at config.calibration_file, or None.  The
+    platform-coherence check (measured records must come from the
+    backend the machine model describes) runs in optimize_strategy so
+    it can log; callers that need the coherent table directly use
+    coherent_calibration."""
+    if not config.calibration_file:
+        return None
+    import os
+
+    from flexflow_tpu.search.calibration import CalibrationTable
+
+    if not os.path.exists(config.calibration_file):
+        return None
+    return CalibrationTable.load(config.calibration_file)
+
+
+def coherent_calibration(config: FFConfig):
+    """load_calibration + the same platform-coherence rule the search
+    applies — so OTHER scorers (e.g. compile's pipeline proposal) rank
+    in the SAME cost currency as the search that just ran."""
+    calibration = load_calibration(config)
+    if calibration is not None and calibration.backend not in (
+            None, config.machine_spec.platform):
+        return None
+    return calibration
+
+
 def optimize_strategy(
     graph: Graph, config: FFConfig, return_graph: bool = False
 ) -> "Strategy | Tuple[Graph, Strategy]":
@@ -367,14 +395,7 @@ def optimize_strategy(
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     n = config.search_devices
-    calibration = None
-    if config.calibration_file:
-        import os
-
-        from flexflow_tpu.search.calibration import CalibrationTable
-
-        if os.path.exists(config.calibration_file):
-            calibration = CalibrationTable.load(config.calibration_file)
+    calibration = load_calibration(config)
     target = config.machine_spec.platform
     if calibration is not None and calibration.backend not in (None, target):
         # measured records are only coherent with a simulator whose
@@ -421,6 +442,7 @@ def optimize_strategy(
             if config.calibration_file:
                 calibration.save(config.calibration_file)
     sim = Simulator.for_config(config, calibration=calibration)
+    floor_sim = sim  # the sim the champion-vs-DP floor must score with
     helper = SearchHelper(sim, n)
 
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
@@ -466,6 +488,8 @@ def optimize_strategy(
                     if config.calibration_file:
                         calibration.save(config.calibration_file)
                     sim2 = Simulator.for_config(config, calibration=calibration)
+                    floor_sim = sim2  # sim's _node_costs cache predates
+                    # the new probes; the floor must not mix tables
                     best_cost = sim2.simulate(graph, best_strategy)
                     c2 = sim2.simulate(g2, s2)
             if c2 < best_cost and s2:
@@ -474,6 +498,25 @@ def optimize_strategy(
                     f" -> {c2 * 1e3:.4f} ms/iter"
                 )
                 best_cost, best_strategy, best_graph = c2, s2, g2
+
+    # Champion-vs-DP floor: the simulator's fidelity is finite, so a
+    # predicted win below the uncertainty margin is noise — and executing
+    # a mixed-view strategy for a noise-level win pays real GSPMD
+    # resharding that plain DP never pays.  DP is always in the search
+    # space, so this can only replace a sub-margin champion, never a
+    # genuine winner (the osdi22ae-class wins predict 1.2x-790x).
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+
+    dp_strategy = data_parallel_strategy(graph, n)
+    dp_cost = floor_sim.simulate(graph, dp_strategy)
+    margin = max(0.0, config.search_improvement_margin)
+    if math.isfinite(dp_cost) and best_cost > dp_cost * (1.0 - margin):
+        log.log(
+            f"searched win {(1.0 - best_cost / dp_cost) * 100:.2f}% is "
+            f"below the {margin * 100:.0f}% uncertainty margin: "
+            f"keeping plain data parallelism"
+        )
+        best_cost, best_strategy, best_graph = dp_cost, dp_strategy, graph
 
     if return_graph:
         return best_graph, best_strategy

@@ -184,7 +184,12 @@ class CostModel:
         if self.calibration is not None:
             fwd = self.calibration.get(op, mv)
         if fwd is None:
-            parts = max(1, mv.num_parts)
+            # replica groups do REDUNDANT work: only the partition count
+            # shrinks each device's share.  Dividing by num_parts (which
+            # includes replica_degree) priced an R8-replicated op at 1/8
+            # of its true per-device cost and made the search replicate
+            # compute that execution pays in full.
+            parts = max(1, mv.num_parts // max(1, mv.replica_degree))
             flops = op.flops() / parts
             bytes_ = op.bytes_accessed() / parts
             fwd = max(
@@ -196,6 +201,11 @@ class CostModel:
             # bwd ≈ 2x fwd FLOPs for matmul-family, ~1x for elementwise
             bwd_factor = 2.0 if op.flops() > 4 * op.output_shapes[0].num_elements else 1.0
             t += bwd_factor * fwd + OP_OVERHEAD_S
+            # training also pays the optimizer's elementwise update over
+            # the local weight shard (measured on the host mesh: the
+            # REPLICATED lm_head update dominated DP's real loss — a
+            # weight-sharded view divides this term by its shard count)
+            t += self.update_cost(op, mv)
         # ops whose sharded execution runs an internal collective (ring
         # attention over a split seq dim) declare the wire bytes — a
         # calibration measurement can't see them (probes run one chip).
@@ -345,6 +355,15 @@ class CostModel:
                 total / max(n_dst // src.replica, 1), src.replica, spans
             )
         shard_src = total / max(n_src // max(src.replica, 1), 1)
+        shard_dst = total / max(n_dst // max(dst.replica, 1), 1)
+        # every emitted reshard op materializes its result through HBM
+        # (write + read) and breaks XLA producer->consumer fusion —
+        # charged on top of the link bytes below.  Without this term the
+        # search trades noise-level compute wins for real boundary
+        # copies (measured on the host mesh: a 1.4% predicted win
+        # executed 7-12% slower).
+        mat = (2.0 * shard_dst / self.machine.hbm_bandwidth
+               + self.machine.reshard_overhead_s)
         n = max(n_src, n_dst)
         src_deg = 1
         for d in src.degrees:
@@ -357,7 +376,7 @@ class CostModel:
         ):
             # pure refinement (repartition): slicing is local when the
             # finer sharding nests in the coarser one
-            return OP_OVERHEAD_S
+            return mat + OP_OVERHEAD_S
         if dst_deg < src_deg and all(
             sd % dd == 0 for sd, dd in zip(src.degrees, dst.degrees)
         ):
@@ -371,7 +390,10 @@ class CostModel:
             spans = self._spans_dcn(
                 src_slots, shrink, {i: dst.degrees[i] for i in shrink},
             )
-            return self.allgather(shard_src, src_deg // max(dst_deg, 1), spans)
+            return (
+                self.allgather(shard_src, src_deg // max(dst_deg, 1), spans)
+                + mat + OP_OVERHEAD_S
+            )
         if src_deg == dst_deg and src.replica == dst.replica:
             # pure dim-to-dim migration at constant total degree (e.g.
             # [B/8, S] -> [B, S/8]): GSPMD emits a true all-to-all over
@@ -384,7 +406,7 @@ class CostModel:
                 src_slots, moved,
                 {i: math.gcd(src.degrees[i], dst.degrees[i]) for i in moved},
             )
-            return self.all_to_all(shard_src, n, spans)
+            return self.all_to_all(shard_src, n, spans) + mat + OP_OVERHEAD_S
         # mixed transition (degrees change AND migrate across dims, or
         # the replica factor changes): the SPMD partitioner's fallback
         # is "involuntary full rematerialization" — all-gather to
@@ -395,7 +417,11 @@ class CostModel:
         spans = self._spans_dcn(
             src_slots, [i for i, d in enumerate(src.degrees) if d > 1]
         )
-        return self.allgather(shard_src, src_deg, spans) + OP_OVERHEAD_S
+        # full remat: the replicated intermediate (the WHOLE tensor) is
+        # written and re-read on every device before the local re-slice
+        return (self.allgather(shard_src, src_deg, spans)
+                + 2.0 * total / self.machine.hbm_bandwidth
+                + self.machine.reshard_overhead_s + OP_OVERHEAD_S)
 
     def placement_move_cost(
         self, shape: ParallelTensorShape, src: Optional[ShardAnnot]
@@ -407,10 +433,20 @@ class CostModel:
         return shard / self.machine.ici_bandwidth + self.machine.ici_latency
 
     # ---- gradient synchronization ---------------------------------------
+    # optimizer-update memory passes per weight element: Adam reads
+    # (w, g, m, v) and writes (w, m, v) — ~7 sequential streams.  The
+    # constant matters less than the SCALING: each device updates its
+    # own weight SHARD, so sharding a weight divides its update traffic
+    # while replication repeats it on every holder (the host_cpu
+    # per-device bandwidth already encodes that holders share the core).
+    OPT_UPDATE_PASSES = 7.0
+
     def weight_sync_cost(self, op: Operator, mv: MachineView) -> float:
         """Per-iteration grad-allreduce for weights replicated across
         ``mv`` (reference: NCCL allreduce in optimizer, optimizer.cc:155-193;
-        here XLA's psum over the batch axes of the mesh)."""
+        here XLA's psum over the batch axes of the mesh).  The
+        optimizer's elementwise update is priced separately
+        (``update_cost``) on the compute timeline."""
         try:
             osh = op.propagate(mv)
         except AssertionError:
@@ -444,6 +480,31 @@ class CostModel:
             spans = self._spans_dcn(slot_degrees, active)
             total += self.allreduce(
                 shard_elems * ws.dtype.itemsize, annot.replica, spans
+            )
+        return total
+
+    def update_cost(self, op: Operator, mv: MachineView) -> float:
+        """Optimizer elementwise update over the local weight shard —
+        serial compute at the tail of the step (it needs the final
+        grads), so it belongs on the device timeline, unlike the
+        overlappable grad allreduce."""
+        if not op._weight_specs:
+            return 0.0
+        try:
+            osh = op.propagate(mv)
+        except AssertionError:
+            return math.inf
+        total = 0.0
+        for ws, annot in zip(op._weight_specs, osh.weights):
+            shard_elems = 1
+            for d in ws.shape:
+                shard_elems *= d
+            if annot is not None:
+                for d in annot.degrees:
+                    shard_elems //= max(d, 1)
+            total += (
+                self.OPT_UPDATE_PASSES * shard_elems * ws.dtype.itemsize
+                / self.machine.hbm_bandwidth
             )
         return total
 

@@ -172,6 +172,28 @@ class Simulator:
                 return math.inf
             shardings[node.guid] = (mv, osh)
 
+        # measured fusion-cluster overrides: when a producer+followers
+        # chain shares one view and the calibration table holds a fused
+        # measurement, scale every member's compute by the measured
+        # fused-over-lone ratio (lone probes are upper bounds; the
+        # cluster record is what XLA actually runs).  The optimizer
+        # update term is NOT scaled — fusion doesn't shrink it.
+        cluster_scale: Dict[int, Tuple[float, float]] = {}
+        cal = self.cost.calibration
+        if cal is not None and getattr(cal, "num_clusters", 0) > 0:
+            for members in self._cluster_chains(graph):
+                if any(m.guid not in shardings for m in members):
+                    continue
+                mv0 = shardings[members[0].guid][0]
+                if any(shardings[m.guid][0] != mv0 for m in members[1:]):
+                    continue
+                got = self._cluster_ratio(members, mv0)
+                if got is None:
+                    continue
+                r, upds = got
+                for m, upd in zip(members, upds):
+                    cluster_scale[m.guid] = (r, upd)
+
         end_time = 0.0
         end_comm = 0.0
         for node in topo:
@@ -196,12 +218,25 @@ class Simulator:
                     # when shardings agree (reference charges this via
                     # per-pair xfers, simulator.cc:599-731)
                     xfer += self.cost.placement_move_cost(shape, src_annot)
+                if include_update:
+                    # training pays every boundary twice: the activation
+                    # reshards/moves forward AND its gradient pays the
+                    # inverse transfer flowing back (GSPMD emits the
+                    # transposed collective in the backward program).
+                    # Applied AFTER the placement move so both engines
+                    # double the identical baked quantity.
+                    xfer *= 2.0
                 start = max(start, ready.get((e.src, e.src_idx), 0.0) + xfer)
             comm_devs = self.view_device_set(mv, use_start=self.placement_overlap)
             devs = comm_devs if self.placement_overlap else self._all_devices
             for d in devs:
                 start = max(start, device_avail[d])
             fwd, full, sync, m_bytes = self._node_costs(node, mv)
+            scale = cluster_scale.get(node.guid)
+            if scale is not None:
+                r, upd = scale
+                fwd = fwd * r
+                full = (full - upd) * r + upd
             for d in devs:
                 mem[d] += m_bytes
             dur = full if include_update else fwd
@@ -227,6 +262,49 @@ class Simulator:
         return max(end_time, end_comm)
 
     # ------------------------------------------------------------------
+    def _cluster_chains(self, graph: Graph):
+        """find_clusters(graph) as flat member lists, weakly cached —
+        simulate() runs thousands of times per search on the same
+        graphs."""
+        if not hasattr(self, "_cluster_graph_cache"):
+            import weakref
+
+            self._cluster_graph_cache = weakref.WeakKeyDictionary()
+            self._cluster_ratio_cache: Dict = {}
+        chains = self._cluster_graph_cache.get(graph)
+        if chains is None:
+            from flexflow_tpu.search.calibration import find_clusters
+
+            chains = [
+                [producer] + list(chain)
+                for producer, chain in find_clusters(graph)
+            ]
+            self._cluster_graph_cache[graph] = chains
+        return chains
+
+    def _cluster_ratio(self, members, mv):
+        """(fused/lone ratio, per-member update costs) for one chain at
+        one view, or None — cached per (chain signature, view)."""
+        cal = self.cost.calibration
+        key = cal.cluster_key([m.op for m in members], mv)
+        hit = self._cluster_ratio_cache.get(key, "miss")
+        if hit != "miss":
+            return hit
+        t = cal.get_cluster([m.op for m in members], mv)
+        result = None
+        if t is not None:
+            lone = sum(
+                self.cost.op_cost(m.op, mv, backward=False) for m in members
+            )
+            if lone > 0 and math.isfinite(lone):
+                result = (
+                    min(1.0, t / lone),
+                    tuple(self.cost.update_cost(m.op, mv) for m in members),
+                )
+        self._cluster_ratio_cache[key] = result
+        return result
+
+    # ------------------------------------------------------------------
     def build_native(self, graph: Graph, node_views: Dict[int, list]):
         """Digest (graph, candidate views) onto the native C++ engine
         (native/src/sim_engine.cpp).  Returns (NativeSimGraph,
@@ -235,7 +313,15 @@ class Simulator:
         ``node_views[guid]`` lists each node's registrable views in
         order; view indices in native assignments refer to these lists.
         Semantics match ``simulate`` exactly (tests assert equality).
+        Fusion-cluster overrides couple costs ACROSS nodes (the ratio
+        applies only when all chain members share a view), which the
+        native engine's independent per-node cost model cannot express
+        — with cluster records present we decline and callers use the
+        python engine, keeping the two engines' answers identical.
         """
+        cal = self.cost.calibration
+        if cal is not None and getattr(cal, "num_clusters", 0) > 0:
+            return None
         from flexflow_tpu import native
 
         if native.get_lib() is None:
@@ -283,6 +369,9 @@ class Simulator:
                             if e.dst_idx < len(d_osh.inputs) else None
                         )
                         x = self.cost.xfer_cost(shape, src_annot, dst_annot)
+                        # baked at 1x: both engines apply the 2x
+                        # training factor at simulate time, keyed on
+                        # include_update
                         if self.placement_overlap and (
                             src_views[svi].start_part
                             != dst_views[dvi].start_part

@@ -76,6 +76,11 @@ double simulate(SimGraph* g, const int32_t* assign, int include_update) {
       size_t n_dst = g->nodes[e.dst].size();
       double x = e.xfer[static_cast<size_t>(si) * n_dst + vi];
       if (x == kInf) return kInf;
+      // training pays every sharding boundary twice: the activation
+      // reshards forward and its gradient pays the inverse reshard
+      // (matrices are baked at 1x; python simulate applies the same
+      // factor so the two engines stay bit-identical)
+      if (include_update) x *= 2.0;
       double t = g->ready[e.src] + x;
       if (t > start) start = t;
     }

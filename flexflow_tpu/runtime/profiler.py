@@ -159,23 +159,34 @@ def measure_operator_cost(op, batch_inputs=None,
 
         return jax.jit(fn)
 
-    n1, n2 = 2, 2 + 5 * max(1, repeats)
-    j1, j2 = make(n1), make(n2)
-    for _ in range(max(1, warmup)):
-        float(j1(batch_inputs, weights))
-        float(j2(batch_inputs, weights))
-    diffs = []
-    for _ in range(max(1, repeats)):
-        t0 = time.perf_counter()
-        float(j1(batch_inputs, weights))
-        t1 = time.perf_counter()
-        float(j2(batch_inputs, weights))
-        diffs.append((time.perf_counter() - t1) - (t1 - t0))
-    per_iter = float(np.median(diffs)) / (n2 - n1)
-    if per_iter <= 0:
-        # the op is cheaper than timer noise: a clamped floor would be
-        # stored as a real measurement and mark the (op, view) as free,
-        # so the search would over-place work on it — decline and let
-        # the analytic roofline rank it instead
-        return None
+    def run_pair(n1, n2):
+        j1, j2 = make(n1), make(n2)
+        for _ in range(max(1, warmup)):
+            float(j1(batch_inputs, weights))
+            float(j2(batch_inputs, weights))
+        diffs = []
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            float(j1(batch_inputs, weights))
+            t1 = time.perf_counter()
+            float(j2(batch_inputs, weights))
+            diffs.append((time.perf_counter() - t1) - (t1 - t0))
+        return float(np.median(diffs)), n2 - n1
+
+    # Adaptive scan length: cheap ops (softmax, layernorm, pool, topk)
+    # run below timer noise at the base length, which used to leave
+    # them UNMEASURED (the round-3 calibration table had no record for
+    # any of them).  Scale the iteration-count difference until the
+    # measured delta is resolvable, then trust the per-iteration time.
+    span = 5 * max(1, repeats)
+    per_iter = None
+    for scale in (1, 16, 256):
+        delta, iters = run_pair(2, 2 + span * scale)
+        if delta > 2e-5:  # well above perf_counter noise
+            return delta / iters
+        if delta > 0:
+            per_iter = delta / iters
+    # never resolvable above noise: keep the best positive estimate, or
+    # decline (a clamped floor would mark the op free and the search
+    # would over-place work on it)
     return per_iter

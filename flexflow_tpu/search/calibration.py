@@ -36,6 +36,12 @@ class CalibrationTable:
 
     def __init__(self):
         self._t: Dict[Key, float] = {}
+        # fusion-CLUSTER measurements: a matmul-family producer plus its
+        # chain of single-consumer fusable followers, timed as ONE
+        # jitted block.  Lone-op probes are upper bounds under XLA
+        # fusion (module docstring); a cluster record is the ground
+        # truth for what the fused group actually costs.
+        self._clusters: Dict[Tuple, float] = {}
         self.backend: Optional[str] = None  # platform the probes ran on
 
     @staticmethod
@@ -52,6 +58,24 @@ class CalibrationTable:
     def put(self, op, mv: MachineView, seconds: float) -> None:
         self._t[self.key(op, mv)] = float(seconds)
 
+    @staticmethod
+    def cluster_key(ops, mv: MachineView) -> Tuple:
+        return (
+            tuple(repr(op.signature()) for op in ops),
+            tuple(mv.dim_degrees),
+            int(mv.replica_degree),
+        )
+
+    def get_cluster(self, ops, mv: MachineView) -> Optional[float]:
+        return self._clusters.get(self.cluster_key(ops, mv))
+
+    def put_cluster(self, ops, mv: MachineView, seconds: float) -> None:
+        self._clusters[self.cluster_key(ops, mv)] = float(seconds)
+
+    @property
+    def num_clusters(self) -> int:
+        return len(self._clusters)
+
     def __len__(self) -> int:
         return len(self._t)
 
@@ -67,9 +91,15 @@ class CalibrationTable:
             {"sig": k[0], "degrees": list(k[1]), "replica": k[2], "seconds": v}
             for k, v in sorted(self._t.items())
         ]
+        clusters = [
+            {"sigs": list(k[0]), "degrees": list(k[1]), "replica": k[2],
+             "seconds": v}
+            for k, v in sorted(self._clusters.items())
+        ]
         with open(path, "w") as f:
             json.dump(
-                {"version": 1, "backend": self.backend, "records": rows},
+                {"version": 1, "backend": self.backend, "records": rows,
+                 "clusters": clusters},
                 f, indent=1,
             )
 
@@ -83,6 +113,10 @@ class CalibrationTable:
             table._t[(r["sig"], tuple(r["degrees"]), int(r["replica"]))] = float(
                 r["seconds"]
             )
+        for r in data.get("clusters", []):
+            table._clusters[
+                (tuple(r["sigs"]), tuple(r["degrees"]), int(r["replica"]))
+            ] = float(r["seconds"])
         return table
 
 
@@ -134,6 +168,142 @@ def measure_op_view(
         return None
 
 
+class _ChainProbe:
+    """Adapter presenting a producer + fused-follower chain as one
+    op-like object to measure_operator_cost: forward() threads each
+    member's output into the next member's single input, weights are
+    namespaced per member.  This times the jitted FUSED block — the
+    thing XLA actually executes — instead of summing lone-op upper
+    bounds (reference measures per-op only, simulator.cc:515-554;
+    fusion-cluster probes are the TPU-specific refinement SURVEY §7
+    hard part (a) calls for)."""
+
+    def __init__(self, ops, oshs):
+        import dataclasses
+
+        self.ops = list(ops)
+        self.oshs = list(oshs)
+        self.name = "cluster:" + "+".join(op.name for op in self.ops)
+        self.input_shapes = self.ops[0].input_shapes
+        self._weight_specs = []
+        self._spec_owner = []  # parallel list: (member_idx, original name)
+        for i, op in enumerate(self.ops):
+            for ws, annot in zip(getattr(op, "_weight_specs", ()),
+                                 self.oshs[i].weights):
+                self._weight_specs.append(dataclasses.replace(
+                    ws, name=f"{i}.{ws.name}",
+                    shape=_shard_sizes(ws.shape, annot)))
+                self._spec_owner.append((i, ws.name))
+
+    def state_specs(self):
+        return ()
+
+    def forward(self, ctx, inputs, weights):
+        outs = list(inputs)
+        for i, op in enumerate(self.ops):
+            ws = {
+                orig: weights[f"{j}.{orig}"]
+                for j, orig in self._spec_owner
+                if j == i
+            }
+            outs = op.forward(ctx, outs if i == 0 else [outs[0]], ws)
+            outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        return outs
+
+
+# matmul-family producers whose follower chains XLA fuses
+_CLUSTER_HEADS = {"linear", "conv2d", "batch_matmul"}
+
+
+def _fusable(op) -> bool:
+    t = op.op_type
+    return (
+        t.is_elementwise_unary()
+        or t.value in ("softmax", "layernorm", "scalar_add", "scalar_sub",
+                       "scalar_mul", "scalar_true_div", "dropout")
+    )
+
+
+def find_clusters(graph: Graph):
+    """(producer_node, [follower_nodes...]) chains: producer is
+    matmul-family, each follower is the SOLE consumer of its
+    predecessor, single-input, and fusable.  Mirrors what XLA's
+    producer-consumer fusion will actually merge."""
+    out = []
+    for node in graph.topo_order():
+        if node.op.op_type.value not in _CLUSTER_HEADS:
+            continue
+        chain = []
+        cur = node
+        while True:
+            edges = graph.out_edges.get(cur.guid, [])
+            if len(edges) != 1:
+                break
+            nxt = graph.nodes[edges[0].dst]
+            if len(graph.in_edges.get(nxt.guid, [])) != 1:
+                break
+            if not _fusable(nxt.op):
+                break
+            chain.append(nxt)
+            cur = nxt
+        if chain:
+            out.append((node, chain))
+    return out
+
+
+def measure_cluster(producer, followers, mv: MachineView,
+                    repeats: int = 3) -> Optional[float]:
+    """Median seconds of one jitted forward of the fused chain at the
+    per-shard shapes ``mv`` induces.  None when any member rejects the
+    view or the chain cannot be probed."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.runtime.profiler import measure_operator_cost
+
+    ops = [producer.op] + [f.op for f in followers]
+    oshs = []
+    for op in ops:
+        try:
+            oshs.append(op.propagate(mv))
+        except AssertionError:
+            return None
+    try:
+        probe = _ChainProbe(ops, oshs)
+        inputs = [
+            jnp.zeros(_shard_sizes(s.sizes, a), s.dtype.to_numpy())
+            for s, a in zip(ops[0].input_shapes, oshs[0].inputs)
+        ]
+        return measure_operator_cost(probe, batch_inputs=inputs,
+                                     repeats=repeats)
+    except Exception:
+        return None
+
+
+def calibrate_clusters(
+    graph: Graph,
+    num_devices: int,
+    table: CalibrationTable,
+    time_budget_s: float = 60.0,
+    repeats: int = 3,
+) -> CalibrationTable:
+    """Measure every fusion cluster of ``graph`` at the producer's
+    candidate views (budget-bounded, resumable like calibrate_graph)."""
+    from flexflow_tpu.search.views import candidate_views
+
+    deadline = time.monotonic() + time_budget_s
+    for producer, chain in find_clusters(graph):
+        ops = [producer.op] + [c.op for c in chain]
+        for mv in candidate_views(producer.op, num_devices):
+            if table.get_cluster(ops, mv) is not None:
+                continue
+            if time.monotonic() > deadline:
+                return table
+            t = measure_cluster(producer, chain, mv, repeats=repeats)
+            if t is not None and math.isfinite(t) and t > 0:
+                table.put_cluster(ops, mv, t)
+    return table
+
+
 def calibrate_graph(
     graph: Graph,
     num_devices: int,
@@ -174,4 +344,10 @@ def calibrate_graph(
             t = measure_op_view(op, mv, repeats=repeats)
             if t is not None and math.isfinite(t) and t > 0:
                 table.put(op, mv, t)
+    # leftover budget goes to fusion-cluster probes (the refinement over
+    # lone-op upper bounds); per-op coverage keeps priority
+    remaining = deadline - time.monotonic()
+    if remaining > 1.0:
+        calibrate_clusters(graph, num_devices, table,
+                           time_budget_s=remaining, repeats=repeats)
     return table

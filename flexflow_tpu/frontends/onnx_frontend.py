@@ -10,9 +10,9 @@ model.py:74-287), re-designed for this framework:
 * graph initializers (weights baked into the ONNX file) are captured and
   can be copied into a compiled model with ``transfer_onnx_weights``.
 
-The ``onnx`` package is an optional dependency: constructing
-``ONNXModel`` without it raises ImportError; everything else in the
-package works without it.
+The ``onnx`` package is optional: when absent, the vendored minimal
+protobuf reader (onnx_minimal.py) parses the file instead, so real
+.onnx models import in any environment.
 """
 
 from __future__ import annotations
@@ -25,9 +25,21 @@ _NCHW_TO_NHWC = (0, 2, 3, 1)
 _NHWC_TO_NCHW = (0, 3, 1, 2)
 
 
-def _attrs(node) -> Dict[str, Any]:
-    from onnx import helper  # noqa: F401
+def _onnx_modules():
+    """(onnx-like module, numpy_helper) — the real package when
+    installed, the vendored wire-format reader otherwise."""
+    try:
+        import onnx
+        from onnx import numpy_helper
 
+        return onnx, numpy_helper
+    except ImportError:
+        from flexflow_tpu.frontends import onnx_minimal
+
+        return onnx_minimal, onnx_minimal.numpy_helper
+
+
+def _attrs(node) -> Dict[str, Any]:
     out = {}
     for a in node.attribute:
         if a.type == a.INT:
@@ -41,8 +53,7 @@ def _attrs(node) -> Dict[str, Any]:
         elif a.type == a.STRING:
             out[a.name] = a.s.decode()
         elif a.type == a.TENSOR:
-            from onnx import numpy_helper
-
+            _, numpy_helper = _onnx_modules()
             out[a.name] = numpy_helper.to_array(a.t)
     return out
 
@@ -51,16 +62,13 @@ class ONNXModel:
     """reference: python/flexflow/onnx/model.py ONNXModel."""
 
     def __init__(self, source):
-        try:
-            import onnx
-        except ImportError as e:  # pragma: no cover - env without onnx
-            raise ImportError(
-                "the 'onnx' package is required for ONNXModel (it is an "
-                "optional dependency of flexflow_tpu)"
-            ) from e
-        self.model = onnx.load(source) if isinstance(source, str) else source
-        from onnx import numpy_helper
-
+        onnx, numpy_helper = _onnx_modules()
+        if isinstance(source, str):
+            self.model = onnx.load(source)
+        elif isinstance(source, bytes):
+            self.model = onnx.load_model_from_string(source)
+        else:
+            self.model = source
         self.weights = {
             init.name: numpy_helper.to_array(init)
             for init in self.model.graph.initializer

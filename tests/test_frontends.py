@@ -261,14 +261,24 @@ def test_huggingface_bert_import_parity_and_training():
     assert np.isfinite(hist[-1]["loss"])
 
 
-def test_onnx_importer_gated():
-    try:
-        import onnx  # noqa: F401
-        has_onnx = True
-    except ImportError:
-        has_onnx = False
-    if not has_onnx:
-        from flexflow_tpu.frontends import ONNXModel
+def test_onnx_importer_works_without_onnx_package():
+    """With no ``onnx`` installed the vendored wire-format reader
+    (frontends/onnx_minimal.py) parses real .onnx bytes — the importer
+    is never dead code.  Full model coverage lives in test_onnx.py."""
+    from flexflow_tpu.frontends import ONNXModel
+    from flexflow_tpu.frontends.onnx_minimal import (
+        TensorProto,
+        helper,
+        numpy_helper,
+    )
 
-        with pytest.raises(ImportError):
-            ONNXModel("nonexistent.onnx")
+    w = np.ones((4, 3), np.float32)
+    g = helper.make_graph(
+        [helper.make_node("Gemm", ["x", "w"], ["y"], name="fc", transB=1)],
+        "g",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT, (2, 3))],
+        [helper.make_tensor_value_info("y", TensorProto.FLOAT, (2, 4))],
+        [numpy_helper.from_array(w, "w")],
+    )
+    om = ONNXModel(helper.make_model(g).serialize())
+    assert np.array_equal(om.weights["w"], w)

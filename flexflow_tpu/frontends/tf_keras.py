@@ -101,6 +101,13 @@ class TFKerasModel:
             act = (layer.activation.__name__
                    if layer.activation is not None else None)
             act = None if act == "linear" else act
+            if act == "gelu":
+                # tf.keras gelu defaults to the EXACT erf form; the
+                # framework's fused dense-gelu is the tanh approximation
+                # — emit a separate exact gelu for bit-parity
+                y = ff.dense(ins[0], layer.units, use_bias=layer.use_bias,
+                             name=name)
+                return ff.gelu(y, name=f"{name}.gelu", approximate=False)
             return ff.dense(ins[0], layer.units, activation=act,
                             use_bias=layer.use_bias, name=name)
         if isinstance(layer, L.Conv2D):
@@ -144,16 +151,44 @@ class TFKerasModel:
             return ff.embedding(ins[0], layer.input_dim, layer.output_dim,
                                 name=name)
         if isinstance(layer, L.Activation):
-            fn = getattr(ff, layer.activation.__name__, None)
+            act_name = layer.activation.__name__
+            if act_name == "gelu":
+                return ff.gelu(ins[0], name=name, approximate=False)
+            fn = getattr(ff, act_name, None)
             if fn is None:
-                raise NotImplementedError(
-                    f"activation {layer.activation.__name__!r}")
+                raise NotImplementedError(f"activation {act_name!r}")
             return fn(ins[0], name=name)
         if isinstance(layer, L.ReLU):
             return ff.relu(ins[0], name=name)
         if isinstance(layer, L.Softmax):
             axis = layer.axis if isinstance(layer.axis, int) else -1
             return ff.softmax(ins[0], axis=axis, name=name)
+        if isinstance(layer, L.MultiHeadAttention):
+            # tf call order is (query, VALUE, key); key defaults to value
+            q = ins[0]
+            v = ins[1] if len(ins) > 1 else ins[0]
+            k = ins[2] if len(ins) > 2 else v
+            heads = getattr(layer, "num_heads", None) or layer._num_heads
+            key_dim = getattr(layer, "key_dim", None) or layer._key_dim
+            value_dim = getattr(layer, "value_dim", None) or getattr(
+                layer, "_value_dim", None)
+            out_shape = getattr(layer, "_output_shape", None)
+            e_out = q.sizes[-1]
+            if out_shape is not None:
+                raise NotImplementedError(
+                    "MultiHeadAttention with output_shape= is not supported")
+            if value_dim not in (None, key_dim):
+                raise NotImplementedError(
+                    f"MultiHeadAttention with value_dim={value_dim} != "
+                    f"key_dim={key_dim}")
+            if heads * key_dim != e_out:
+                raise NotImplementedError(
+                    f"MultiHeadAttention needs num_heads*key_dim == "
+                    f"query dim ({heads}*{key_dim} != {e_out})")
+            return ff.multihead_attention(
+                q, k, v, embed_dim=e_out, num_heads=heads,
+                dropout=float(getattr(layer, "dropout", 0.0) or 0.0),
+                bias=getattr(layer, "_use_bias", True), name=name)
         if isinstance(layer, L.Concatenate):
             return ff.concat(list(ins), axis=layer.axis, name=name)
         if isinstance(layer, L.Add):
@@ -191,6 +226,16 @@ def transfer_tf_weights(tf_model, ffmodel) -> int:
         elif isinstance(layer, L.Embedding) and w:
             ffmodel.set_weight(name, "table", w[0])
             copied += 1
+        elif isinstance(layer, L.MultiHeadAttention) and w:
+            # tf builds query/key/value/output EinsumDense sublayers in
+            # that order; kernels are (in, H, dk) / (H, dk, out) —
+            # byte-identical to this framework's wq/wk/wv/wo layout
+            use_bias = getattr(layer, "_use_bias", True)
+            names = (["wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo"]
+                     if use_bias else ["wq", "wk", "wv", "wo"])
+            for nm, arr in zip(names, w):
+                ffmodel.set_weight(name, nm, arr)
+                copied += 1
         elif isinstance(layer, L.LayerNormalization) and len(w) == 2:
             ffmodel.set_weight(name, "gamma", w[0])
             ffmodel.set_weight(name, "beta", w[1])

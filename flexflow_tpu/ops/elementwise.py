@@ -60,9 +60,22 @@ class ElementUnaryOp(Operator):
 
     op_type = OperatorType.IDENTITY  # refined per-instance via attrs
 
-    def __init__(self, name, input_shapes, unary_type: OperatorType, scalar: float = 0.0):
+    def __init__(self, name, input_shapes, unary_type: OperatorType,
+                 scalar: float = 0.0, approximate: bool = True):
         self.op_type = unary_type
-        super().__init__(name, input_shapes, unary_type=unary_type.value, scalar=scalar)
+        # ``approximate`` only affects GELU: the tanh approximation is
+        # the TPU-friendly default, but imported models (tf.keras /
+        # torch both default to the exact erf form) need bit-parity
+        # with their source.  It joins the op SIGNATURE only for GELU —
+        # stamping it on every unary op would silently invalidate all
+        # persisted calibration records for them (signature() includes
+        # attrs).
+        extra = (
+            {"approximate": approximate}
+            if unary_type is OperatorType.GELU else {}
+        )
+        super().__init__(name, input_shapes, unary_type=unary_type.value,
+                         scalar=scalar, **extra)
 
     def infer(self) -> Sequence[ParallelTensorShape]:
         return (self.input_shapes[0],)
@@ -72,6 +85,9 @@ class ElementUnaryOp(Operator):
         x = inputs[0]
         if t in _SCALAR_FNS:
             return [_SCALAR_FNS[t](x, self.attrs["scalar"])]
+        if t is OperatorType.GELU:
+            return [jax.nn.gelu(x, approximate=bool(
+                self.attrs.get("approximate", True)))]
         return [_UNARY_FNS[t](x)]
 
     def splittable_output_dims(self) -> Tuple[int, ...]:

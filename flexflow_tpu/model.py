@@ -54,6 +54,7 @@ class FFModel:
         self._input_tensors: List[Tensor] = []
         self._name_counts: Dict[str, int] = {}
         self.compiled = None
+        self.strategy = None  # chosen parallelization, set by compile()
         self.params = None
         self.opt_state = None
         self.state = None
@@ -310,9 +311,11 @@ class FFModel:
         raise KeyError(name)
 
     # elementwise -------------------------------------------------------
-    def _unary(self, t: OperatorType, input: Tensor, name=None, scalar=0.0, base=None):
+    def _unary(self, t: OperatorType, input: Tensor, name=None, scalar=0.0,
+               base=None, approximate=True):
         op = O.ElementUnaryOp(self._fresh_name(base or t.value, name),
-                              [self._shape_of(input)], unary_type=t, scalar=scalar)
+                              [self._shape_of(input)], unary_type=t,
+                              scalar=scalar, approximate=approximate)
         return self._add_op(op, [input])[0]
 
     def _binary(self, t: OperatorType, a: Tensor, b: Tensor, name=None):
@@ -332,8 +335,11 @@ class FFModel:
     def elu(self, x, name=None):
         return self._unary(OperatorType.ELU, x, name)
 
-    def gelu(self, x, name=None):
-        return self._unary(OperatorType.GELU, x, name)
+    def gelu(self, x, name=None, approximate=True):
+        """tanh-approximate by default (the TPU-friendly form); pass
+        approximate=False for the exact erf GELU that tf.keras and
+        torch default to."""
+        return self._unary(OperatorType.GELU, x, name, approximate=approximate)
 
     def exp(self, x, name=None):
         return self._unary(OperatorType.EXP, x, name)
@@ -454,6 +460,48 @@ class FFModel:
                     self.graph, self.config, return_graph=True
                 )
                 self.graph = best_graph
+                # the search also costs pipelined candidates for
+                # stacked-block graphs (reference gap: OP_PIPELINE is an
+                # enum stub, ffconst.h:148) — a winning PipelineConfig
+                # is adopted exactly as if the user had passed it
+                if (
+                    pipeline is None
+                    and mesh is None
+                    and self.config.enable_pipeline_search
+                    and not self.config.zero_dp_shard
+                    and comp_mode == "training"
+                ):
+                    from flexflow_tpu.search.driver import (
+                        coherent_calibration,
+                    )
+                    from flexflow_tpu.search.pipeline_search import (
+                        propose_pipeline,
+                    )
+                    from flexflow_tpu.search.simulator import Simulator
+
+                    # same cost currency as the flat search that just
+                    # ran: measured calibration included when coherent
+                    sim = Simulator.for_config(
+                        self.config,
+                        calibration=coherent_calibration(self.config),
+                    )
+                    baseline = sim.simulate(self.graph, strategy)
+                    prop = propose_pipeline(
+                        self.graph, self.config, sim, baseline
+                    )
+                    if prop is not None and (
+                        self.config.num_devices % prop.num_stages == 0
+                        and self.config.batch_size % prop.num_microbatches
+                        == 0
+                    ):
+                        pipeline = prop
+                        strategy = data_parallel_strategy(
+                            self.graph,
+                            self.config.num_devices // pipeline.num_stages,
+                        )
+        # the chosen strategy is public state: tooling (bench_search,
+        # strategy introspection) reads it back after compile
+        self.strategy = strategy
         if self.config.export_strategy_file:
             from flexflow_tpu.search.strategy_io import export_strategy
 

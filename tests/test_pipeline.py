@@ -175,3 +175,62 @@ class TestPipelinedModel:
                 pipeline=PipelineConfig(num_stages=2, num_microbatches=4),
                 loss_type="sparse_categorical_crossentropy",
             )
+
+
+# ---------------------------------------------------------------------------
+# search-integrated pipeline (round 4): compile() proposes pp itself
+# ---------------------------------------------------------------------------
+
+
+def test_search_proposes_pipeline_on_memory_bound_model():
+    """The GPipe case, search-discovered: hidden dim 1021 is PRIME (no
+    tensor-parallel divisor <= 8) and the weights + optimizer state of
+    the full stack exceed the per-device HBM cap, so EVERY flat
+    strategy is memory-infeasible — only pipelining (each stage holds
+    1/S of the weights) fits.  compile() must find and lower it with
+    no pipeline= argument (reference gap: OP_PIPELINE is an enum stub,
+    ffconst.h:148; Unity approximates inter-op splits,
+    graph.cc:161-295)."""
+    import numpy as np
+
+    from flexflow_tpu.compiler.pipeline_lowering import PipelinedCompiledModel
+    from flexflow_tpu.core.machine import MachineSpec
+
+    n = 8
+    spec = MachineSpec(num_devices=n, devices_per_host=4, platform="cpu",
+                       hbm_capacity=48e6)
+    cfg = ff.FFConfig(batch_size=16, num_devices=n, compute_dtype="float32",
+                      machine_spec=spec)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([16, 1021])
+    for i in range(4):
+        t = m.dense(t, 1021, activation="relu", name=f"layer{i}_fc")
+    t = m.dense(t, 1021, name="head")  # epilogue: blocks need an external consumer
+    m.compile(loss_type="mean_squared_error", metrics=[])
+    assert isinstance(m.compiled, PipelinedCompiledModel)
+    assert m.compiled.pipeline.num_stages in (2, 4)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 1021)).astype(np.float32)
+    y = rng.normal(size=(64, 1021)).astype(np.float32) * 0.1
+    hist = m.fit(x=x, y=y, epochs=2, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_search_keeps_flat_lowering_on_single_host():
+    """Same model on a single-ICI-domain machine: DP sync rides ICI,
+    the pipeline bubble cannot pay for itself, compile stays flat."""
+    from flexflow_tpu.compiler.pipeline_lowering import PipelinedCompiledModel
+    from flexflow_tpu.core.machine import MachineSpec
+
+    n = 8
+    spec = MachineSpec.host_cpu(n)  # one host, serialized collectives
+    cfg = ff.FFConfig(batch_size=16, num_devices=n, compute_dtype="float32",
+                      machine_spec=spec)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([16, 128])
+    for i in range(4):
+        t = m.dense(t, 128, activation="relu", name=f"layer{i}_fc")
+    t = m.dense(t, 128, name="head")
+    m.compile(loss_type="mean_squared_error", metrics=[])
+    assert not isinstance(m.compiled, PipelinedCompiledModel)

@@ -64,3 +64,64 @@ def test_keras_mnist_reaches_accuracy():
     cb = keras.callbacks.VerifyMetrics(metric="accuracy", threshold=0.85)
     hist = model.fit(x_train, y_train, verbose=False, callbacks=[cb])
     assert hist[-1]["accuracy"] >= 0.85, hist[-1]
+
+
+def test_real_digits_accuracy():
+    """REAL-data accuracy regression with zero egress: sklearn's
+    bundled UCI digits (1797 genuine 8x8 scans) trained through the
+    normal compile path must reach >=90% held-out TEST accuracy — the
+    role of the reference's fetched-MNIST gate
+    (reference: tests/accuracy_tests.sh:10-14,
+    examples/python/keras/accuracy.py)."""
+    (xtr, ytr), (xte, yte) = datasets.digits.load_data()
+    assert len(xtr) + len(xte) == 1797  # the real dataset, not blobs
+    xtr = (xtr / 16.0).reshape(len(xtr), 64).astype(np.float32)
+    xte = (xte / 16.0).reshape(len(xte), 64).astype(np.float32)
+
+    cfg = ff.FFConfig(batch_size=32, epochs=20, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32",
+                      seed=3)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([32, 64], name="pix")
+    t = m.dense(x, 64, activation="relu", name="fc1")
+    t = m.dense(t, 10, name="fc2")
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x=xtr, y=ytr.astype(np.int32), verbose=False)
+    logs = m.evaluate(x=xte, y=yte.astype(np.int32))
+    assert logs["accuracy"] >= 0.90, logs
+
+
+def test_real_mnist_accuracy_when_cached():
+    """With a real mnist.npz present the keras gate must hit the
+    reference's threshold; without it the loader now WARNS loudly and
+    this test skips rather than 'passing' on blobs."""
+    import os
+    import warnings
+
+    from flexflow_tpu.keras.datasets import _data_dir
+
+    if not os.path.exists(os.path.join(_data_dir(), "mnist.npz")):
+        # also pin the honesty contract: the fallback must warn
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            datasets.mnist.load_data()
+        assert any("SYNTHETIC" in str(x.message) for x in w)
+        pytest.skip("no real mnist.npz cached (zero-egress environment)")
+
+    (xtr, ytr), (xte, yte) = datasets.mnist.load_data()
+    xtr = (xtr / 255.0).reshape(len(xtr), 784).astype(np.float32)
+    xte = (xte / 255.0).reshape(len(xte), 784).astype(np.float32)
+    cfg = ff.FFConfig(batch_size=64, epochs=3, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([64, 784], name="pix")
+    t = m.dense(x, 128, activation="relu", name="fc1")
+    t = m.dense(t, 10, name="fc2")
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x=xtr[:20000], y=ytr[:20000].astype(np.int32), verbose=False)
+    logs = m.evaluate(x=xte, y=yte.astype(np.int32))
+    assert logs["accuracy"] >= 0.90, logs

@@ -102,10 +102,20 @@ def _probe_backend(timeout_s: float = 120.0, attempts: int = 3,
             t = threading.Thread(target=_try, daemon=True)
             t.start()
             # subprocess said alive; in-process init can still wedge
-            if done.wait(timeout_s) and not isinstance(out[0], Exception):
+            if done.wait(timeout_s):
+                if isinstance(out[0], Exception):
+                    # deterministic in-process failure — surface it
+                    # loudly; a stale fallback would mask it forever
+                    print(
+                        f"# bench: in-process backend init raised: "
+                        f"{type(out[0]).__name__}: {out[0]}",
+                        file=sys.stderr,
+                    )
+                    sys.stderr.flush()
+                    os._exit(2)
                 return out[0]
             print(
-                f"# bench: in-process backend init failed/hung after a "
+                f"# bench: in-process backend init hung after a "
                 f"successful subprocess probe (attempt {attempt})",
                 file=sys.stderr,
             )
@@ -135,6 +145,8 @@ def _emit_last_good_or_die():
             f"measurement window from {rec.get('measured_at', 'unknown')}"
         )
         print(json.dumps(rec))
+        sys.stdout.flush()  # os._exit skips stdio flush — a piped stdout
+        # would otherwise drop the record and exit 0 with empty output
         os._exit(0)
     print(
         "# bench: accelerator unreachable and no last-good window "

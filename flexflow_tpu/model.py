@@ -519,7 +519,30 @@ class FFModel:
                 self.graph, strategy, self.config.export_strategy_task_graph_file
             )
 
-        if pipeline is not None:
+        from flexflow_tpu.compiler.placement_lowering import placeable
+
+        if pipeline is None and strategy and placeable(
+                self.graph, strategy, self.config):
+            # disjoint start_part device blocks that the placed lowering
+            # can express: EXECUTED inter-op placement (reference:
+            # mapper.cc:371-475 places ops on disjoint device sets and
+            # Legion runs them).  Multi-block strategies OUTSIDE its
+            # support (>2 blocks, multi-tensor cuts, grad accumulation)
+            # keep the historical behavior: offsets are inert and the
+            # single SPMD program replicates small-degree ops.
+            from flexflow_tpu.compiler.placement_lowering import (
+                PlacedCompiledModel,
+            )
+
+            self.compiled = PlacedCompiledModel(
+                self.graph,
+                strategy,
+                self.config,
+                LossType.from_any(loss_type),
+                list(metrics),
+                self.optimizer,
+            )
+        elif pipeline is not None:
             from flexflow_tpu.compiler.pipeline_lowering import PipelinedCompiledModel
 
             self.compiled = PipelinedCompiledModel(
@@ -739,6 +762,9 @@ class FFModel:
             and recompile_state is None
             and jax.process_count() == 1
             and loader.num_batches >= trace_n
+            # multi-mesh compositions (inter-op placement) have no
+            # single traced program — fall back to per-step calls
+            and getattr(self.compiled, "supports_trace", True)
         )
         for epoch in range(start_epoch, epochs):
             for cb in callbacks:

@@ -36,14 +36,16 @@ class Simulator:
         # placement_overlap=True credits inter-op COMPUTE overlap for
         # views on disjoint device blocks (start_part offsets — the
         # reference's mapper really places subgraphs on disjoint GPUs,
-        # mapper.cc:371-475).  The GSPMD lowering executes ONE SPMD
-        # program where a view with fewer parts than devices is
-        # REPLICATED, not placed — so the default (False) charges every
-        # op's compute against all devices, matching what actually runs
-        # (round-2 verdict weak #3: the simulator must not credit
-        # overlap the executor cannot express).  Comm-group overlap
-        # (weight syncs over distinct device groups) IS real and stays
-        # on view-level device sets in both modes.
+        # mapper.cc:371-475).  Since round 4 such strategies EXECUTE:
+        # two-block start_part strategies lower to per-submesh programs
+        # (compiler/placement_lowering.py) whose async dispatch overlaps
+        # segments across consecutive steps.  The default stays False
+        # because the DEFAULT lowering is one SPMD program where a view
+        # with fewer parts than devices is replicated, not placed —
+        # simulate with placement_overlap=True only when the strategy
+        # will go down the placed lowering.  Comm-group overlap (weight
+        # syncs over distinct device groups) IS real and stays on
+        # view-level device sets in both modes.
         self.placement_overlap = placement_overlap
         # inference=True: simulate() defaults to forward-only costs with
         # no weight sync (the reference's COMP_MODE_INFERENCE,

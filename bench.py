@@ -104,15 +104,20 @@ def _probe_backend(timeout_s: float = 120.0, attempts: int = 3,
             # subprocess said alive; in-process init can still wedge
             if done.wait(timeout_s):
                 if isinstance(out[0], Exception):
-                    # deterministic in-process failure — surface it
-                    # loudly; a stale fallback would mask it forever
+                    # the tunnel's documented failure mode is transient
+                    # RPC errors FOLLOWED by wedges — surface the error
+                    # and spend the remaining attempts before falling
+                    # back (the subprocess 'error' path above handles
+                    # deterministic env breakage with a hard exit)
                     print(
-                        f"# bench: in-process backend init raised: "
+                        f"# bench: in-process backend init raised "
+                        f"(attempt {attempt}/{attempts}): "
                         f"{type(out[0]).__name__}: {out[0]}",
                         file=sys.stderr,
                     )
-                    sys.stderr.flush()
-                    os._exit(2)
+                    if attempt < attempts:
+                        time.sleep(retry_wait_s)
+                    continue
                 return out[0]
             print(
                 f"# bench: in-process backend init hung after a "

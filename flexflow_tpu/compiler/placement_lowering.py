@@ -85,6 +85,10 @@ def placeable(graph: Graph, strategy: Dict[int, MachineView], config) -> bool:
         return False
     if getattr(config, "zero_dp_shard", False):
         return False
+    if jax.process_count() > 1:
+        # the host-composed multi-mesh step cannot device_put across
+        # processes; multihost keeps the historical single-SPMD lowering
+        return False
     blocks = placement_blocks(strategy)
     if len(blocks) != 2:
         return False  # 1 block = flat; >2 blocks = unsupported, inert

@@ -592,10 +592,26 @@ class FFModel:
                 pipeline=ctx["pipeline"], block_of=ctx["block_of"],
             )
         else:
-            self.compiled = CompiledModel(
-                self.graph, ctx["strategy"], self.config, ctx["loss_type"],
-                ctx["metrics"], self.optimizer, mesh=ctx.get("mesh"),
+            from flexflow_tpu.compiler.placement_lowering import (
+                PlacedCompiledModel,
+                placeable,
             )
+
+            if ctx["strategy"] and placeable(
+                    self.graph, ctx["strategy"], self.config):
+                # a placed model must RE-lower placed: flat re-lowering
+                # would silently drop the inter-op placement and carry
+                # submesh-committed params into a global-mesh program
+                self.compiled = PlacedCompiledModel(
+                    self.graph, ctx["strategy"], self.config,
+                    ctx["loss_type"], ctx["metrics"], self.optimizer,
+                )
+            else:
+                self.compiled = CompiledModel(
+                    self.graph, ctx["strategy"], self.config,
+                    ctx["loss_type"], ctx["metrics"], self.optimizer,
+                    mesh=ctx.get("mesh"),
+                )
         old_params, old_state, old_opt = self.params, self.state, self.opt_state
         self.params, self.state = self.compiled.init_params(self.config.seed)
         # shape-checked carry-over: an alter() that changes a weight's
@@ -700,14 +716,9 @@ class FFModel:
         ckpt_mgr = None
         start_epoch = 0
         if checkpoint_dir is not None:
-            if jax.process_count() > 1:
-                # every process would np.asarray globally-sharded params
-                # (raises on non-addressable shards) and race on the
-                # same step directory — loud unsupported-feature guard
-                raise NotImplementedError(
-                    "checkpoint_dir in fit() is single-host only; use an "
-                    "orbax multihost checkpointer for multi-process runs"
-                )
+            # multi-process runs go down CheckpointManager's coordinated
+            # orbax multihost path (every process calls save/restore on
+            # the same directory; orbax synchronizes the shard writes)
             from flexflow_tpu.runtime.checkpoint import CheckpointManager
 
             ckpt_mgr = CheckpointManager(checkpoint_dir)

@@ -189,15 +189,12 @@ class CheckpointManager:
         import jax
 
         if jax.process_count() > 1:
-            # every process would np.asarray globally-sharded params
-            # (raises on non-addressable shards) and race on the same
-            # step directory — loud unsupported-feature guard at the
-            # layer every entry point (fit checkpoint_dir, keras
-            # ModelCheckpoint, direct calls) goes through
-            raise NotImplementedError(
-                "CheckpointManager.save is single-host only; use an "
-                "orbax multihost checkpointer for multi-process runs"
-            )
+            # multihost: every process participates in ONE coordinated
+            # orbax save of the globally-sharded trees (each process
+            # writes its addressable shards; orbax barriers internally)
+            # — np.asarray of non-addressable shards would raise, and
+            # per-process npz writes would race on the step directory
+            return self._multihost_save(step, model)
         state_trees = {
             "params": model.params,
             "opt_state": model.opt_state,
@@ -228,6 +225,78 @@ class CheckpointManager:
         )
         return path
 
+    # ------------------------------------------------------------------
+    def _multihost_tree(self, model) -> Dict[str, Any]:
+        return {
+            "params": model.params,
+            "opt_state": model.opt_state,
+            "state": model.state,
+            "rng_counter": np.int64(getattr(model, "_rng_counter", 0)),
+        }
+
+    def _multihost_save(self, step: int, model) -> str:
+        """Coordinated multi-process snapshot via orbax StandardCheckpointer
+        (reference has no model checkpointing at all, SURVEY §5; the
+        multi-host story here mirrors its GASNet collective launch —
+        every process calls save on the SAME directory).  Synchronous:
+        the donation-safe async path needs per-host copies, which
+        multihost sharding makes orbax's job, not ours."""
+        import jax
+
+        import orbax.checkpoint as _ocp
+
+        path = self._step_dir(step)
+        if os.path.exists(path) and jax.process_index() == 0:
+            shutil.rmtree(path)
+        # all processes must observe the deletion before the collective
+        # save starts — without the barrier they race into the
+        # half-deleted directory
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_clear_{step}")
+        ckptr = _ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(path), self._multihost_tree(model))
+        ckptr.wait_until_finished()
+        if jax.process_index() == 0:
+            self._gc()
+        return path
+
+    def _multihost_restore(self, model, step: int) -> int:
+        import jax
+
+        import orbax.checkpoint as _ocp
+
+        path = self._step_dir(step)
+        tree = self._multihost_tree(model)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = getattr(model.compiled, "mesh", None)
+        repl = (NamedSharding(mesh, PartitionSpec())
+                if mesh is not None else None)
+
+        def to_abstract(a):
+            if isinstance(a, jax.Array):
+                sh = a.sharding
+                if (repl is not None and jax.process_count() > 1
+                        and len(sh.device_set) == 1):
+                    # per-process uncommitted scalars (optimizer step
+                    # counters) must come back GLOBAL-replicated, or the
+                    # restored array is committed to one device and the
+                    # next global-mesh jit rejects the argument mix
+                    sh = repl
+                return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+            return jax.ShapeDtypeStruct(
+                np.shape(a), np.asarray(a).dtype, sharding=repl)
+
+        abstract = jax.tree.map(to_abstract, tree)
+        ckptr = _ocp.StandardCheckpointer()
+        restored = ckptr.restore(os.path.abspath(path), abstract)
+        model.params = restored["params"]
+        model.opt_state = restored["opt_state"]
+        model.state = restored["state"]
+        model._rng_counter = int(restored["rng_counter"])
+        return step
+
     def _write_snapshot(self, path: str, arrays, manifest) -> None:
         tmp = path + ".tmp"
         if os.path.exists(tmp):
@@ -248,12 +317,19 @@ class CheckpointManager:
     def restore(self, model, step: Optional[int] = None) -> int:
         """Load a snapshot into a compiled FFModel; returns the step."""
         assert model.compiled is not None, "compile() before restore"
+        import jax
+
         self.wait()  # an in-flight async save must land first
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no checkpoints in {self.directory}")
         path = self._step_dir(step)
+        if jax.process_count() > 1 or not os.path.exists(
+                os.path.join(path, "manifest.json")):
+            # multihost snapshots are orbax directories (no manifest);
+            # they also restore fine single-process from a multihost run
+            return self._multihost_restore(model, step)
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
         if self.use_orbax and os.path.isdir(os.path.join(path, "tree")):

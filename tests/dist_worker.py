@@ -8,6 +8,7 @@ parent test to compare against the single-process run.
 import sys
 
 port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
 
 import jax  # noqa: E402
 
@@ -43,7 +44,25 @@ def main():
     centers = rng.normal(size=(4, 8)) * 3
     y = rng.integers(0, 4, 64)
     xs = (centers[y] + rng.normal(size=(64, 8))).astype(np.float32)
-    hist = model.fit(x=xs, y=y.astype(np.int32), verbose=False, shuffle=True)
+    if ckpt_dir is None:
+        hist = model.fit(x=xs, y=y.astype(np.int32), verbose=False,
+                         shuffle=True)
+    else:
+        # multihost checkpoint/resume through the coordinated orbax
+        # path: 2 epochs with snapshots, then a FRESH model resumes the
+        # third — must equal 3 straight epochs (exact state restore
+        # incl. rng counter and shuffle fast-forward)
+        model.fit(x=xs, y=y.astype(np.int32), verbose=False, shuffle=True,
+                  epochs=2, checkpoint_dir=ckpt_dir, checkpoint_every=1)
+        model2 = ff.FFModel(cfg)
+        x2 = model2.create_tensor([16, 8])
+        t2 = model2.dense(x2, 16, activation="relu", name="fc1")
+        t2 = model2.dense(t2, 4, name="fc2")
+        model2.compile(loss_type="sparse_categorical_crossentropy",
+                       metrics=["accuracy"], mesh=mesh)
+        hist = model2.fit(x=xs, y=y.astype(np.int32), verbose=False,
+                          shuffle=True, epochs=3, checkpoint_dir=ckpt_dir,
+                          resume=True)
     print(f"FINAL_LOSS {hist[-1]['loss']:.8f} ACC {hist[-1]['accuracy']:.6f}",
           flush=True)
 

@@ -34,6 +34,10 @@ _SCRIPTS = [
     ("keras_mnist_mlp.py", ["-b", "16", "-e", "1"]),
     ("pytorch_import.py", ["-b", "8", "-e", "1"]),
     ("resnet.py", ["-b", "4", "-e", "1"]),
+    ("onnx_import.py", ["-b", "16", "-e", "1"]),
+    ("placed_dlrm.py", ["-b", "32", "-e", "1"]),
+    ("tf_keras_import.py", ["-b", "8", "-e", "1"]),
+    ("digits_accuracy.py", ["-b", "32", "-e", "12"]),
 ]
 
 _BOOT = (

@@ -40,6 +40,14 @@ def _pads(padding: str, kernel, strides, in_hw) -> tuple:
     return tuple(out)
 
 
+def _act_name(layer):
+    """tf layer activation -> framework activation name (None when
+    linear) — one place for the idiom the Dense/Conv branches share."""
+    act = (layer.activation.__name__
+           if layer.activation is not None else None)
+    return None if act == "linear" else act
+
+
 class TFKerasModel:
     """Importer for a built tf.keras functional/Sequential model."""
 
@@ -98,9 +106,7 @@ class TFKerasModel:
 
         name = layer.name
         if isinstance(layer, L.Dense):
-            act = (layer.activation.__name__
-                   if layer.activation is not None else None)
-            act = None if act == "linear" else act
+            act = _act_name(layer)
             if act == "gelu":
                 # tf.keras gelu defaults to the EXACT erf form; the
                 # framework's fused dense-gelu is the tanh approximation
@@ -110,6 +116,22 @@ class TFKerasModel:
                 return ff.gelu(y, name=f"{name}.gelu", approximate=False)
             return ff.dense(ins[0], layer.units, activation=act,
                             use_bias=layer.use_bias, name=name)
+        if isinstance(layer, L.DepthwiseConv2D):
+            # depthwise = grouped conv with groups == in_channels and
+            # out = in * depth_multiplier (MobileNet-family blocks)
+            if layer.data_format == "channels_first":
+                raise NotImplementedError("channels_first DepthwiseConv2D")
+            if tuple(layer.dilation_rate) != (1, 1):
+                raise NotImplementedError("dilated DepthwiseConv2D")
+            c_in = ins[0].sizes[-1]
+            mult = layer.depth_multiplier
+            k = layer.kernel_size
+            s = layer.strides
+            ph, pw = _pads(layer.padding, k, s, ins[0].sizes[1:3])
+            act = _act_name(layer)
+            return ff.conv2d(ins[0], c_in * mult, k[0], k[1], s[0], s[1],
+                             ph, pw, activation=act, groups=c_in,
+                             use_bias=layer.use_bias, name=name)
         if isinstance(layer, L.Conv2D):
             if layer.data_format == "channels_first":
                 raise NotImplementedError("channels_first Conv2D")
@@ -118,9 +140,7 @@ class TFKerasModel:
             k = layer.kernel_size
             s = layer.strides
             ph, pw = _pads(layer.padding, k, s, ins[0].sizes[1:3])
-            act = (layer.activation.__name__
-                   if layer.activation is not None else None)
-            act = None if act == "linear" else act
+            act = _act_name(layer)
             return ff.conv2d(ins[0], layer.filters, k[0], k[1], s[0], s[1],
                              ph, pw, activation=act, groups=layer.groups,
                              use_bias=layer.use_bias, name=name)
@@ -133,6 +153,15 @@ class TFKerasModel:
                              pool_type=pt, name=name)
         if isinstance(layer, L.GlobalAveragePooling2D):
             return ff.mean(ins[0], dims=(1, 2), name=name)
+        if isinstance(layer, L.GlobalMaxPooling2D):
+            if getattr(layer, "data_format", "channels_last") == "channels_first":
+                raise NotImplementedError("channels_first GlobalMaxPooling2D")
+            h, w = ins[0].sizes[1:3]
+            t = ff.pool2d(ins[0], h, w, 1, 1, 0, 0, pool_type="max",
+                          name=name)
+            if getattr(layer, "keepdims", False):
+                return t  # already (N, 1, 1, C)
+            return ff.flat(t, name=f"{name}.squeeze")
         if isinstance(layer, L.Flatten):
             return ff.flat(ins[0], name=name)
         if isinstance(layer, L.Reshape):
@@ -217,7 +246,18 @@ def transfer_tf_weights(tf_model, ffmodel) -> int:
         if name not in ffmodel.params:
             continue
         w = layer.get_weights()
-        if isinstance(layer, (L.Dense, L.Conv2D)) and w:
+        if isinstance(layer, L.DepthwiseConv2D) and w:
+            # tf depthwise kernel (kh, kw, C, mult) -> grouped HWIO
+            # (kh, kw, 1, C*mult); C-major reshape matches the
+            # feature_group_count output-channel ordering
+            kh, kw, c, mult = w[0].shape
+            ffmodel.set_weight(name, "kernel", w[0].reshape(kh, kw, 1,
+                                                            c * mult))
+            copied += 1
+            if layer.use_bias and len(w) > 1:
+                ffmodel.set_weight(name, "bias", w[1])
+                copied += 1
+        elif isinstance(layer, (L.Dense, L.Conv2D)) and w:
             ffmodel.set_weight(name, "kernel", w[0])
             copied += 1
             if layer.use_bias and len(w) > 1:

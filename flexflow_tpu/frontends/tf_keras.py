@@ -48,6 +48,26 @@ def _act_name(layer):
     return None if act == "linear" else act
 
 
+# fused activations the conv ops support (ops/conv.py _ACT); gelu is
+# handled separately for exact-erf parity, everything else must fail
+# at IMPORT time, not as a KeyError mid-training
+_CONV_FUSED_ACTS = {None, "relu", "sigmoid", "tanh"}
+
+
+def _conv_act(ff, layer, emit_conv, name):
+    """Emit a conv-family layer honoring tf activation semantics:
+    fused when the op supports it, a separate EXACT gelu otherwise,
+    loud NotImplementedError for anything else."""
+    act = _act_name(layer)
+    if act == "gelu":
+        y = emit_conv(None)
+        return ff.gelu(y, name=f"{name}.gelu", approximate=False)
+    if act not in _CONV_FUSED_ACTS:
+        raise NotImplementedError(
+            f"{type(layer).__name__} activation {act!r} is not supported")
+    return emit_conv(act)
+
+
 class TFKerasModel:
     """Importer for a built tf.keras functional/Sequential model."""
 
@@ -128,10 +148,13 @@ class TFKerasModel:
             k = layer.kernel_size
             s = layer.strides
             ph, pw = _pads(layer.padding, k, s, ins[0].sizes[1:3])
-            act = _act_name(layer)
-            return ff.conv2d(ins[0], c_in * mult, k[0], k[1], s[0], s[1],
-                             ph, pw, activation=act, groups=c_in,
-                             use_bias=layer.use_bias, name=name)
+            return _conv_act(
+                ff, layer,
+                lambda act: ff.conv2d(
+                    ins[0], c_in * mult, k[0], k[1], s[0], s[1], ph, pw,
+                    activation=act, groups=c_in,
+                    use_bias=layer.use_bias, name=name),
+                name)
         if isinstance(layer, L.Conv2D):
             if layer.data_format == "channels_first":
                 raise NotImplementedError("channels_first Conv2D")
@@ -140,10 +163,13 @@ class TFKerasModel:
             k = layer.kernel_size
             s = layer.strides
             ph, pw = _pads(layer.padding, k, s, ins[0].sizes[1:3])
-            act = _act_name(layer)
-            return ff.conv2d(ins[0], layer.filters, k[0], k[1], s[0], s[1],
-                             ph, pw, activation=act, groups=layer.groups,
-                             use_bias=layer.use_bias, name=name)
+            return _conv_act(
+                ff, layer,
+                lambda act: ff.conv2d(
+                    ins[0], layer.filters, k[0], k[1], s[0], s[1], ph, pw,
+                    activation=act, groups=layer.groups,
+                    use_bias=layer.use_bias, name=name),
+                name)
         if isinstance(layer, (L.MaxPooling2D, L.AveragePooling2D)):
             k = layer.pool_size
             s = layer.strides or k
@@ -152,7 +178,9 @@ class TFKerasModel:
             return ff.pool2d(ins[0], k[0], k[1], s[0], s[1], ph, pw,
                              pool_type=pt, name=name)
         if isinstance(layer, L.GlobalAveragePooling2D):
-            return ff.mean(ins[0], dims=(1, 2), name=name)
+            return ff.mean(ins[0], dims=(1, 2),
+                           keepdims=getattr(layer, "keepdims", False),
+                           name=name)
         if isinstance(layer, L.GlobalMaxPooling2D):
             if getattr(layer, "data_format", "channels_last") == "channels_first":
                 raise NotImplementedError("channels_first GlobalMaxPooling2D")

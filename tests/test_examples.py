@@ -38,6 +38,8 @@ _SCRIPTS = [
     ("placed_dlrm.py", ["-b", "32", "-e", "1"]),
     ("tf_keras_import.py", ["-b", "8", "-e", "1"]),
     ("digits_accuracy.py", ["-b", "32", "-e", "12"]),
+    ("keras_cifar10_cnn.py", ["-b", "16", "-e", "1"]),
+    ("keras_reuters_mlp.py", ["-b", "16", "-e", "1"]),
 ]
 
 _BOOT = (

@@ -355,7 +355,9 @@ class ONNXModel:
         return ff.elu(env[node.input[0]], name=node.name or node.output[0])
 
     def handle_Gelu(self, ff, node, env, a):
-        return ff.gelu(env[node.input[0]], name=node.name or node.output[0])
+        # ONNX Gelu's spec default is approximate='none' (exact erf)
+        return ff.gelu(env[node.input[0]], name=node.name or node.output[0],
+                       approximate=a.get("approximate", "none") == "tanh")
 
     def handle_Exp(self, ff, node, env, a):
         return ff.exp(env[node.input[0]], name=node.name or node.output[0])

@@ -48,23 +48,16 @@ def _act_name(layer):
     return None if act == "linear" else act
 
 
-# fused activations the conv ops support (ops/conv.py _ACT); gelu is
-# handled separately for exact-erf parity, everything else must fail
-# at IMPORT time, not as a KeyError mid-training
-_CONV_FUSED_ACTS = {None, "relu", "sigmoid", "tanh"}
-
-
 def _conv_act(ff, layer, emit_conv, name):
-    """Emit a conv-family layer honoring tf activation semantics:
-    fused when the op supports it, a separate EXACT gelu otherwise,
-    loud NotImplementedError for anything else."""
+    """Emit a conv-family layer honoring tf activation semantics: a
+    separate EXACT-erf gelu (tf's default form; the fused one is the
+    tanh approximation), fused otherwise — ConvOp itself asserts the
+    fused activation is supported at BUILD time, so unsupported ones
+    fail loudly at import for every caller."""
     act = _act_name(layer)
     if act == "gelu":
         y = emit_conv(None)
         return ff.gelu(y, name=f"{name}.gelu", approximate=False)
-    if act not in _CONV_FUSED_ACTS:
-        raise NotImplementedError(
-            f"{type(layer).__name__} activation {act!r} is not supported")
     return emit_conv(act)
 
 
@@ -178,6 +171,10 @@ class TFKerasModel:
             return ff.pool2d(ins[0], k[0], k[1], s[0], s[1], ph, pw,
                              pool_type=pt, name=name)
         if isinstance(layer, L.GlobalAveragePooling2D):
+            if getattr(layer, "data_format",
+                       "channels_last") == "channels_first":
+                raise NotImplementedError(
+                    "channels_first GlobalAveragePooling2D")
             return ff.mean(ins[0], dims=(1, 2),
                            keepdims=getattr(layer, "keepdims", False),
                            name=name)

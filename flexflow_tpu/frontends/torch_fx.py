@@ -341,8 +341,13 @@ class _Tracer:
             raise NotImplementedError(
                 "nn.MultiheadAttention cannot be fx-traced generically; build "
                 "it with FFModel.multihead_attention")
+        if isinstance(mod, nn.GELU):
+            # nn.GELU(approximate='none') is torch's default: exact erf
+            return self.emit(
+                "gelu", name, x,
+                approximate=getattr(mod, "approximate", "none") == "tanh")
         for cls, kind in ((nn.ReLU, "relu"), (nn.Sigmoid, "sigmoid"),
-                          (nn.Tanh, "tanh"), (nn.GELU, "gelu"),
+                          (nn.Tanh, "tanh"),
                           (nn.ELU, "elu"), (nn.Identity, "identity")):
             if isinstance(mod, cls):
                 return self.emit(kind, name, x)
@@ -563,6 +568,13 @@ class _Tracer:
         is_tensor = lambda a: hasattr(a, "name") and a.name not in self.literals
         node_args = [_lit(a) for a in node.args]
         if fname in self._UNARY and len(node.args) >= 1:
+            if fname == "gelu":
+                # torch F.gelu defaults to the EXACT erf form
+                # (approximate='none'); only an explicit
+                # approximate='tanh' selects the tanh approximation
+                approx = node.kwargs.get("approximate", "none") == "tanh"
+                return self.emit("gelu", name, [self.ref(node.args[0])],
+                                 approximate=approx)
             return self.emit(self._UNARY[fname], name, [self.ref(node.args[0])])
         if fname in ("float", "to", "type_as", "type"):
             dtype = None
@@ -818,8 +830,12 @@ class PyTorchModel:
             return ff.pow(x[0], a["scalar"], name=rec.name)
         if k in ("scalar_add", "scalar_sub", "scalar_multiply", "scalar_true_divide"):
             return getattr(ff, k)(x[0], a["scalar"], name=rec.name)
+        if k == "gelu":
+            # exact erf unless the trace explicitly chose tanh
+            return ff.gelu(x[0], name=rec.name,
+                           approximate=bool(a.get("approximate", False)))
         if k in ("add", "subtract", "multiply", "divide", "max", "min",
-                 "relu", "sigmoid", "tanh", "gelu", "elu", "exp", "log",
+                 "relu", "sigmoid", "tanh", "elu", "exp", "log",
                  "rsqrt", "identity"):
             return getattr(ff, k)(*x, name=rec.name)
         raise NotImplementedError(f"record kind {k!r}")

@@ -72,6 +72,13 @@ class Conv2DOp(Operator):
         kernel_initializer: Initializer | None = None,
         bias_initializer: Initializer | None = None,
     ):
+        # validate at BUILD time (mirrors linear.py's assert): an
+        # unsupported fused activation must fail when the graph is
+        # constructed, not as a KeyError mid-training
+        assert activation in _ACT, (
+            f"conv2d activation {activation!r} not supported; "
+            f"one of {sorted(k for k in _ACT if k)}"
+        )
         self._kernel_init = kernel_initializer or DEFAULT_WEIGHT_INIT
         self._bias_init = bias_initializer or DEFAULT_BIAS_INIT
         super().__init__(

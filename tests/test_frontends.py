@@ -251,7 +251,7 @@ def test_huggingface_bert_import_parity_and_training():
         }
     fwd = m.compiled.forward_fn()
     got = np.asarray(fwd(m.params, m.state, [ex.numpy().astype(np.int32)]))
-    np.testing.assert_allclose(got, refs[got.shape], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got, refs[got.shape], rtol=1e-5, atol=1e-6)
 
     # the imported graph must also TRAIN end-to-end
     rng = np.random.default_rng(0)

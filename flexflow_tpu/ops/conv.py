@@ -41,6 +41,14 @@ _ACT = {
 }
 
 
+def _check_activation(op_name: str, activation) -> None:
+    if activation not in _ACT:
+        raise NotImplementedError(
+            f"{op_name} activation {activation!r} not supported; "
+            f"one of {sorted(k for k in _ACT if k)}"
+        )
+
+
 def _out_size(size: int, kernel: int, stride: int, pad: int) -> int:
     return (size + 2 * pad - kernel) // stride + 1
 
@@ -72,13 +80,11 @@ class Conv2DOp(Operator):
         kernel_initializer: Initializer | None = None,
         bias_initializer: Initializer | None = None,
     ):
-        # validate at BUILD time (mirrors linear.py's assert): an
-        # unsupported fused activation must fail when the graph is
-        # constructed, not as a KeyError mid-training
-        assert activation in _ACT, (
-            f"conv2d activation {activation!r} not supported; "
-            f"one of {sorted(k for k in _ACT if k)}"
-        )
+        # validate at BUILD time: an unsupported fused activation must
+        # fail when the graph is constructed, not as a KeyError
+        # mid-training — and survive `python -O` (a bare assert would
+        # not), with the exception type frontends advertise
+        _check_activation(type(self).__name__, activation)
         self._kernel_init = kernel_initializer or DEFAULT_WEIGHT_INIT
         self._bias_init = bias_initializer or DEFAULT_BIAS_INIT
         super().__init__(
@@ -189,7 +195,9 @@ class Pool2DOp(Operator):
         pool_type: str = "max",
         activation: str | None = None,
     ):
-        assert pool_type in ("max", "avg")
+        if pool_type not in ("max", "avg"):
+            raise NotImplementedError(f"pool_type {pool_type!r}")
+        _check_activation(type(self).__name__, activation)
         super().__init__(
             name,
             input_shapes,

@@ -114,3 +114,30 @@ def test_vertical_placement_rejects_bad_cuts():
     with pytest.raises(ValueError):
         m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
                   strategy=strat)
+
+
+def test_vertical_placement_survives_recompile():
+    """recompile() must re-lower a placed model AS placed — a flat
+    re-lowering would silently drop the placement and feed
+    submesh-committed params into a global-mesh program."""
+    cfg = ff.FFConfig(batch_size=B, num_devices=8, compute_dtype="float32")
+    m = _build(cfg)
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+              strategy=_placed_strategy(m))
+    assert isinstance(m.compiled, PlacedCompiledModel)
+    before = np.asarray(m.params["emb"]["table"])
+    m.recompile()
+    assert isinstance(m.compiled, PlacedCompiledModel)
+    # params carried over, still on segment A's device block
+    np.testing.assert_array_equal(np.asarray(m.params["emb"]["table"]),
+                                  before)
+    import jax
+
+    emb_devs = set(m.params["emb"]["table"].sharding.device_set)
+    assert emb_devs <= set(jax.devices()[:4])
+    # and the re-lowered model still trains
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, V, (32, S)).astype(np.int32)
+    y = (ids.sum(axis=1) % 4).astype(np.int32)
+    hist = m.fit(x=ids, y=y, epochs=1, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])

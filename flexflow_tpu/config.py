@@ -147,6 +147,16 @@ class FFConfig:
         p.add_argument("--search-num-workers", type=int, default=0)
         p.add_argument("--base-optimize-threshold", type=int, default=10)
         p.add_argument("--search-timeout", dest="search_timeout", type=float, default=45.0)
+        p.add_argument("--search-improvement-margin",
+                       dest="search_improvement_margin", type=float,
+                       default=0.03,
+                       help="minimum simulated win over plain DP before a "
+                            "searched strategy is accepted (champion-vs-DP "
+                            "floor)")
+        p.add_argument("--disable-pipeline-search",
+                       dest="disable_pipeline_search", action="store_true",
+                       help="compile() stops proposing pipelined lowerings "
+                            "for stacked-block graphs")
         p.add_argument("--substitution-json", type=str, default=None)
         p.add_argument("--calibration-file", type=str, default=None)
         p.add_argument("--calibrate", action="store_true")
@@ -178,6 +188,8 @@ class FFConfig:
             search_num_devices=search_devs,
             base_optimize_threshold=args.base_optimize_threshold,
             search_timeout_s=args.search_timeout,
+            search_improvement_margin=args.search_improvement_margin,
+            enable_pipeline_search=not args.disable_pipeline_search,
             substitution_json=args.substitution_json,
             calibration_file=args.calibration_file,
             calibrate=args.calibrate,

@@ -62,7 +62,13 @@ class LinearOp(Operator):
         bias_initializer: Initializer | None = None,
         param_dtype: str = "float32",
     ):
-        assert activation in _ACTIVATIONS, activation
+        if activation not in _ACTIVATIONS:
+            # same contract as conv/pool (_check_activation): fail at
+            # graph construction, survive python -O, one exception type
+            raise NotImplementedError(
+                f"LinearOp activation {activation!r} not supported; "
+                f"one of {sorted(k for k in _ACTIVATIONS if k)}"
+            )
         self._kernel_init = kernel_initializer or DEFAULT_WEIGHT_INIT
         self._bias_init = bias_initializer or DEFAULT_BIAS_INIT
         super().__init__(

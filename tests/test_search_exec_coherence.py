@@ -63,10 +63,21 @@ def _tiny_mlp(cfg):
     return build_mlp_unify(cfg, in_dim=512, hidden=(512, 512))
 
 
+def _tiny_dlrm(cfg):
+    """The flagship table-sharding phenomenon (dlrm.cc +
+    osdi22ae/dlrm.sh): DP pays the full-table gradient allreduce the
+    search avoids by sharding whole tables."""
+    from flexflow_tpu.models import build_dlrm
+
+    return build_dlrm(cfg, embedding_sizes=(50000,) * 4, embedding_dim=32,
+                      bot_mlp=(64, 32), top_mlp=(64, 1))
+
+
 CASES = {
     "bert": (_tiny_bert, "mean_squared_error"),
     "gpt": (_tiny_gpt, "sparse_categorical_crossentropy"),
     "mlp": (_tiny_mlp, "sparse_categorical_crossentropy"),
+    "dlrm": (_tiny_dlrm, "mean_squared_error"),
 }
 
 

@@ -42,6 +42,19 @@ from flexflow_tpu.parallel.mesh import (
 )
 
 
+def weight_fold_key(base_key, op_name: str, w_name: str):
+    """Per-weight init key derived from the weight's NAME, not its
+    position in the topo enumeration: initialization is then invariant
+    to how a strategy partitions the graph into programs (a placed
+    2-segment lowering and the flat lowering draw identical weights for
+    the same seed) and to graph rewrites that preserve op names."""
+    import zlib
+
+    return jax.random.fold_in(
+        base_key, np.uint32(zlib.crc32(f"{op_name}/{w_name}".encode()))
+    )
+
+
 def data_parallel_strategy(graph: Graph, degree: int) -> Dict[int, MachineView]:
     """Batch-dim partitioning for every op — the reference's
     --only-data-parallel path (graph.cc:1572-1597)."""
@@ -252,8 +265,8 @@ class CompiledModel:
 
         def _init(key):
             out = {}
-            for i, (op_name, w_name, shape, dtype, init, _) in enumerate(specs):
-                k = jax.random.fold_in(key, i)
+            for op_name, w_name, shape, dtype, init, _ in specs:
+                k = weight_fold_key(key, op_name, w_name)
                 out.setdefault(op_name, {})[w_name] = init.init(k, shape, dtype)
             return out
 

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flexflow_tpu.compiler.lowering import CompiledModel
+from flexflow_tpu.compiler.lowering import CompiledModel, weight_fold_key
 from flexflow_tpu.core.graph import Graph, Node
 from flexflow_tpu.ops.base import LoweringContext
 from flexflow_tpu.parallel.mesh import mesh_axis_sizes
@@ -342,8 +342,8 @@ class PipelinedCompiledModel(CompiledModel):
 
         def _init(key):
             out = {}
-            for i, (op_name, w_name, shape, dtype, init, _, stacked) in enumerate(specs):
-                k = jax.random.fold_in(key, i)
+            for op_name, w_name, shape, dtype, init, _, stacked in specs:
+                k = weight_fold_key(key, op_name, w_name)
                 if stacked:
                     w = jnp.stack(
                         [init.init(jax.random.fold_in(k, b), shape[1:], dtype)

@@ -305,8 +305,12 @@ class PlacedCompiledModel:
 
     # -- init ----------------------------------------------------------
     def init_params(self, seed: int = 0):
+        # same seed for both segments: the base lowering's name-keyed
+        # weight rng (weight_fold_key) makes initialization identical to
+        # the flat lowering's for the same model+seed — a strategy
+        # change must not silently change the training trajectory
         pa, sa = self._comp_a.init_params(seed)
-        pb, sb = self._comp_b.init_params(seed + 1)
+        pb, sb = self._comp_b.init_params(seed)
         return {**pa, **pb}, {**sa, **sb}
 
     def shard_opt_state(self, opt_state):

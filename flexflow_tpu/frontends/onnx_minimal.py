@@ -351,6 +351,14 @@ class numpy_helper:
         if t.data_type == TensorProto.INT64 and t.int64_data:
             return np.asarray(t.int64_data, np.int64).reshape(dims)
         if t.int32_data:
+            if t.data_type == TensorProto.FLOAT16:
+                # onnx.proto stores float16 in int32_data as raw bit
+                # patterns, not values: bits 15360 decode as 1.0
+                return (
+                    np.asarray(t.int32_data, np.uint16)
+                    .view(np.float16)
+                    .reshape(dims)
+                )
             return np.asarray(t.int32_data, np.int64).astype(dtype).reshape(dims)
         return np.zeros(dims, dtype)
 

@@ -521,8 +521,12 @@ class FFModel:
 
         from flexflow_tpu.compiler.placement_lowering import placeable
 
-        if pipeline is None and strategy and placeable(
+        if pipeline is None and mesh is None and strategy and placeable(
                 self.graph, strategy, self.config):
+            # mesh is None: a user-supplied mesh commits the whole graph
+            # to one submesh program, which a 2-block placed strategy
+            # cannot honor — fall through to the flat lowering (which
+            # respects mesh=) instead of silently ignoring it
             # disjoint start_part device blocks that the placed lowering
             # can express: EXECUTED inter-op placement (reference:
             # mapper.cc:371-475 places ops on disjoint device sets and
@@ -597,7 +601,7 @@ class FFModel:
                 placeable,
             )
 
-            if ctx["strategy"] and placeable(
+            if ctx.get("mesh") is None and ctx["strategy"] and placeable(
                     self.graph, ctx["strategy"], self.config):
                 # a placed model must RE-lower placed: flat re-lowering
                 # would silently drop the inter-op placement and carry

@@ -41,7 +41,7 @@ def _configure(lib) -> None:
                                      c_f64, p_i32, c_i32, p_i32, c_i32, c_i32]
     lib.ffn_sim_set_mem_cap.argtypes = [p_void, c_f64]
     lib.ffn_sim_set_default_view.argtypes = [p_void, c_i32, c_i32]
-    lib.ffn_sim_add_edge.argtypes = [p_void, c_i32, c_i32, p_f64]
+    lib.ffn_sim_add_edge.argtypes = [p_void, c_i32, c_i32, p_f64, c_i32]
     lib.ffn_sim_simulate.restype = c_f64
     lib.ffn_sim_simulate.argtypes = [p_void, p_i32, c_i32]
     lib.ffn_sim_brute_force.restype = c_f64
@@ -145,10 +145,12 @@ class NativeSimGraph:
     def set_default_view(self, node: int, view: int) -> None:
         self.lib.ffn_sim_set_default_view(self._g, node, view)
 
-    def add_edge(self, src: int, dst: int, xfer: np.ndarray) -> None:
+    def add_edge(self, src: int, dst: int, xfer: np.ndarray,
+                 has_grad: bool = True) -> None:
         x = np.ascontiguousarray(xfer, dtype=np.float64)
         self.lib.ffn_sim_add_edge(
-            self._g, src, dst, x.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+            self._g, src, dst,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), int(has_grad)
         )
 
     def simulate(self, assignment: Sequence[int], include_update=True) -> float:

@@ -146,6 +146,10 @@ class Operator:
     # must not be wrapped in jax.checkpoint (remat); set by every op
     # that mutates state, with or without state_specs
     writes_state: bool = False
+    # True for graph sources (inputs/constants) whose output edges carry
+    # no cotangent in training — the cost model charges such edges the
+    # forward reshard only, not the 2x fwd+bwd factor
+    is_gradient_free: bool = False
 
     def __init__(
         self,

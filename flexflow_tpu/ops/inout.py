@@ -25,6 +25,7 @@ class InputOp(Operator):
     the frontend Tensor so compile can bind feed arrays by position."""
 
     op_type = OperatorType.INPUT
+    is_gradient_free = True
 
     def __init__(self, name, shape: ParallelTensorShape, tensor_guid: int = -1):
         self._shape = shape.drop_parallelism()
@@ -65,6 +66,7 @@ class ConstantOp(Operator):
     constant-folds around it."""
 
     op_type = OperatorType.CONSTANT
+    is_gradient_free = True
 
     def __init__(self, name, shape: ParallelTensorShape, value=None):
         import numpy as np

@@ -328,7 +328,18 @@ class CheckpointManager:
         if jax.process_count() > 1 or not os.path.exists(
                 os.path.join(path, "manifest.json")):
             # multihost snapshots are orbax directories (no manifest);
-            # they also restore fine single-process from a multihost run
+            # they also restore fine single-process from a multihost run.
+            # Dispatch only on POSITIVE evidence of an orbax snapshot —
+            # a corrupt single-host snapshot or stray directory would
+            # otherwise surface as a confusing orbax internal error.
+            if jax.process_count() == 1 and not os.path.exists(
+                    os.path.join(path, "_CHECKPOINT_METADATA")):
+                raise ValueError(
+                    f"unrecognized snapshot at {path}: neither a "
+                    "manifest.json (single-host) nor an orbax "
+                    "_CHECKPOINT_METADATA (multihost) is present — the "
+                    "snapshot may be corrupt or from an interrupted save"
+                )
             return self._multihost_restore(model, step)
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)

@@ -220,13 +220,15 @@ class Simulator:
                     # when shardings agree (reference charges this via
                     # per-pair xfers, simulator.cc:599-731)
                     xfer += self.cost.placement_move_cost(shape, src_annot)
-                if include_update:
+                if include_update and not graph.nodes[e.src].op.is_gradient_free:
                     # training pays every boundary twice: the activation
                     # reshards/moves forward AND its gradient pays the
                     # inverse transfer flowing back (GSPMD emits the
                     # transposed collective in the backward program).
                     # Applied AFTER the placement move so both engines
-                    # double the identical baked quantity.
+                    # double the identical baked quantity.  Edges sourced
+                    # at inputs/constants carry no cotangent back, so
+                    # they pay the forward reshard only.
                     xfer *= 2.0
                 start = max(start, ready.get((e.src, e.src_idx), 0.0) + xfer)
             comm_devs = self.view_device_set(mv, use_start=self.placement_overlap)
@@ -382,8 +384,12 @@ class Simulator:
                             # cross-block movement charge
                             x += self.cost.placement_move_cost(shape, src_annot)
                         mat.append(x)
-                ns.add_edge(si, di, np.asarray(mat, dtype=np.float64).reshape(
-                    len(src_views), len(dst_views)))
+                ns.add_edge(
+                    si, di,
+                    np.asarray(mat, dtype=np.float64).reshape(
+                        len(src_views), len(dst_views)),
+                    has_grad=not graph.nodes[e.src].op.is_gradient_free,
+                )
         return ns, index
 
     # ------------------------------------------------------------------

@@ -35,6 +35,9 @@ struct View {
 struct Edge {
   int32_t src = 0;
   int32_t dst = 0;
+  // false when the source is an input/constant: no cotangent flows
+  // back, so training charges the forward reshard only (no 2x)
+  bool has_grad = true;
   // xfer[s * n_dst_views + d] for src view-choice s, dst view-choice d
   std::vector<double> xfer;
 };
@@ -79,8 +82,9 @@ double simulate(SimGraph* g, const int32_t* assign, int include_update) {
       // training pays every sharding boundary twice: the activation
       // reshards forward and its gradient pays the inverse reshard
       // (matrices are baked at 1x; python simulate applies the same
-      // factor so the two engines stay bit-identical)
-      if (include_update) x *= 2.0;
+      // factor so the two engines stay bit-identical); gradient-free
+      // source edges (inputs/constants) pay the forward reshard only
+      if (include_update && e.has_grad) x *= 2.0;
       double t = g->ready[e.src] + x;
       if (t > start) start = t;
     }
@@ -159,10 +163,11 @@ void ffn_sim_set_default_view(SimGraph* g, int32_t i, int32_t view) {
 
 // xfer: row-major [n_views(src)][n_views(dst)] matrix of seconds.
 void ffn_sim_add_edge(SimGraph* g, int32_t src, int32_t dst,
-                      const double* xfer) {
+                      const double* xfer, int32_t has_grad) {
   Edge e;
   e.src = src;
   e.dst = dst;
+  e.has_grad = has_grad != 0;
   e.xfer.assign(xfer, xfer + g->nodes[src].size() * g->nodes[dst].size());
   int32_t idx = static_cast<int32_t>(g->edges.size());
   g->edges.push_back(std::move(e));

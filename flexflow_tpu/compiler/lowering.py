@@ -172,6 +172,24 @@ class CompiledModel:
         train: bool,
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         """Forward through the PCG (global view). Returns (logits, new_state)."""
+        outs, new_state = self.apply_multi(
+            params, state, inputs, rng, train,
+            outputs=((self._sink.guid, 0),),
+        )
+        return outs[0], new_state
+
+    def apply_multi(
+        self,
+        params: Dict[str, Dict[str, jax.Array]],
+        state: Dict[str, jax.Array],
+        inputs: Sequence[jax.Array],
+        rng: Optional[jax.Array],
+        train: bool,
+        outputs: Sequence[Tuple[int, int]],
+    ) -> Tuple[Tuple[jax.Array, ...], Dict[str, jax.Array]]:
+        """Forward returning the requested ``(guid, output_idx)`` tensors
+        instead of the sink's — the placed lowering pulls every tensor
+        that crosses its segment boundary from one forward pass."""
         ctx = LoweringContext(
             compute_dtype=self.compute_dtype,
             train=train,
@@ -184,10 +202,16 @@ class CompiledModel:
         input_pos = {n.guid: i for i, n in enumerate(self._input_nodes)}
         for node in self._topo:
             self._run_node(node, ctx, values, params, inputs, input_pos)
-        logits = values[(self._sink.guid, 0)]
         new_state = dict(state)
         new_state.update(ctx.state_out)
-        return logits, new_state
+        return tuple(values[key] for key in outputs), new_state
+
+    def value_sharding(self, guid: int, idx: int = 0):
+        """NamedSharding of op ``guid``'s ``idx``-th output under this
+        program's mesh (boundary cotangents re-enter under it)."""
+        annot = self._shardings[guid].outputs[idx]
+        spec = annot_partition_spec(annot, self._slot_axes[guid])
+        return jax.sharding.NamedSharding(self.mesh, spec)
 
     def _run_node(self, node, ctx, values, params, inputs, input_pos):
         """Lower one PCG node into ``values`` (shared by the pipelined

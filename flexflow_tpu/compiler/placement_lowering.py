@@ -27,7 +27,11 @@ sets, consecutive fit() steps genuinely overlap across segments: while
 block B trains on step i's boundary, block A is already computing step
 i+1's forward — the inter-op parallelism the reference's mapper buys.
 
-Unsupported (loud): >2 device blocks, >1 crossing edge, gradient
+The cut may cross up to MAX_CROSSING_TENSORS distinct tensors (a
+multi-tower DLRM places every embedding tower in block A and the
+interaction + top MLP in block B; each tower output crosses).
+
+Unsupported (loud): >2 device blocks, >16 crossing tensors, gradient
 accumulation, zero_dp_shard, traced multi-step scans.
 """
 
@@ -74,13 +78,15 @@ def _cut(graph: Graph, strategy: Dict[int, MachineView]):
     return in_a, in_b, crossing, back
 
 
+MAX_CROSSING_TENSORS = 16
+
+
 def placeable(graph: Graph, strategy: Dict[int, MachineView], config) -> bool:
     """Can this strategy go down the placed lowering?  False keeps the
     HISTORICAL behavior for multi-block strategies outside its support
-    (>2 blocks, multi-tensor cuts, grad accumulation, ZeRO): offsets
-    stay inert and the single SPMD program replicates small-degree ops
-    — strategies that compiled before inter-op execution existed must
-    keep compiling."""
+    (>2 blocks, grad accumulation, ZeRO): offsets stay inert and the
+    single SPMD program replicates small-degree ops — strategies that
+    compiled before inter-op execution existed must keep compiling."""
     if getattr(config, "grad_accum_steps", 1) > 1:
         return False
     if getattr(config, "zero_dp_shard", False):
@@ -95,7 +101,12 @@ def placeable(graph: Graph, strategy: Dict[int, MachineView], config) -> bool:
     in_a, in_b, crossing, back = _cut(graph, strategy)
     if back or not in_a or not in_b:
         return False
-    return len({(e.src, e.src_idx) for e in crossing}) == 1
+    sinks = graph.sinks()
+    if not sinks or sinks[-1].guid not in {n.guid for n in in_b}:
+        # the loss is computed from B's sink; a cut whose second block
+        # does not own the graph sink has no loss program
+        return False
+    return 0 < len({(e.src, e.src_idx) for e in crossing}) <= MAX_CROSSING_TENSORS
 
 
 def _strip_start(mv: MachineView) -> MachineView:
@@ -142,13 +153,19 @@ class PlacedCompiledModel:
             raise NotImplementedError(
                 "inter-op placement requires a forward-only cut (edges "
                 "from the second block back into the first exist)")
-        boundary_srcs = {(e.src, e.src_idx) for e in crossing}
-        if len(boundary_srcs) != 1:
+        boundary_srcs = sorted({(e.src, e.src_idx) for e in crossing})
+        if not 0 < len(boundary_srcs) <= MAX_CROSSING_TENSORS:
             raise NotImplementedError(
-                f"inter-op placement needs exactly ONE tensor crossing "
-                f"the blocks, found {len(boundary_srcs)}")
-        (b_src, b_src_idx) = next(iter(boundary_srcs))
-        boundary_shape = graph.nodes[b_src].op.output_shapes[b_src_idx]
+                f"inter-op placement supports 1..{MAX_CROSSING_TENSORS} "
+                f"tensors crossing the blocks, found {len(boundary_srcs)}")
+        # ordered boundary tensors: every A-produced tensor B consumes
+        # (a multi-tower DLRM cut crosses one tensor per tower —
+        # reference: mapper.cc places the towers and the interaction on
+        # disjoint device sets the same way)
+        self._boundary_srcs = boundary_srcs
+        boundary_shapes = [
+            graph.nodes[s].op.output_shapes[i] for s, i in boundary_srcs
+        ]
 
         # ---- segment graphs -------------------------------------------
         graph_a = Graph()
@@ -161,13 +178,22 @@ class PlacedCompiledModel:
                                      e.src_idx, e.dst_idx)
 
         graph_b = Graph()
-        # the boundary enters B as a synthetic input; tensor_guid=-1
-        # sorts it FIRST in CompiledModel's stable input ordering
-        boundary_in = Node(
-            max(graph.nodes) + 1,
-            InputOp("placement_boundary", boundary_shape, tensor_guid=-1),
-        )
-        graph_b.add_node(boundary_in)
+        # each boundary enters B as a synthetic input; negative
+        # tensor_guids in boundary order sort them FIRST (and in order)
+        # in CompiledModel's stable input ordering
+        K = len(boundary_srcs)
+        boundary_ins = []
+        next_guid = max(graph.nodes) + 1
+        for bi, ((b_src, b_src_idx), shp) in enumerate(
+                zip(boundary_srcs, boundary_shapes)):
+            node = Node(
+                next_guid + bi,
+                InputOp(f"placement_boundary_{bi}", shp,
+                        tensor_guid=bi - K),
+            )
+            boundary_ins.append(node)
+            graph_b.add_node(node)
+        bmap = {key: n for key, n in zip(boundary_srcs, boundary_ins)}
         for n in in_b:
             graph_b.add_node(n)
         for guid in b_guids:
@@ -175,9 +201,9 @@ class PlacedCompiledModel:
                 if e.src in b_guids:
                     graph_b.add_edge(graph.nodes[e.src], graph.nodes[e.dst],
                                      e.src_idx, e.dst_idx)
-                elif (e.src, e.src_idx) == (b_src, b_src_idx):
-                    graph_b.add_edge(boundary_in, graph.nodes[e.dst],
-                                     0, e.dst_idx)
+                else:
+                    graph_b.add_edge(bmap[(e.src, e.src_idx)],
+                                     graph.nodes[e.dst], 0, e.dst_idx)
 
         # ---- per-segment strategies / meshes / compiled models --------
         strat_a = {
@@ -188,11 +214,6 @@ class PlacedCompiledModel:
             n.guid: _strip_start(strategy[n.guid])
             for n in in_b if strategy.get(n.guid) is not None
         }
-        # the boundary enters B under B's OWN mesh geometry: batch-dp
-        # over B's devices when divisible, replicated otherwise — the
-        # producer's view may not factor into an asymmetric B submesh
-        nd_bound = boundary_shape.ndim
-
         devices = jax.devices()[: config.num_devices]
         n_a = max(
             (strategy[n.guid].num_parts for n in in_a
@@ -211,11 +232,14 @@ class PlacedCompiledModel:
         mesh_a = build_mesh(devices[:n_a])
         mesh_b = build_mesh(devices[start_b:start_b + n_b])
 
-        if boundary_shape.sizes[0] % n_b == 0:
-            strat_b[boundary_in.guid] = MachineView.data_parallel(
-                nd_bound, n_b)
-        else:
-            strat_b[boundary_in.guid] = MachineView.trivial(nd_bound)
+        # each boundary enters B under B's OWN mesh geometry: batch-dp
+        # over B's devices when divisible, replicated otherwise — the
+        # producer's view may not factor into an asymmetric B submesh
+        for node, shp in zip(boundary_ins, boundary_shapes):
+            if shp.ndim and shp.sizes[0] % n_b == 0:
+                strat_b[node.guid] = MachineView.data_parallel(shp.ndim, n_b)
+            else:
+                strat_b[node.guid] = MachineView.trivial(shp.ndim)
 
         cfg_a = dataclasses.replace(config, num_devices=n_a)
         cfg_b = dataclasses.replace(config, num_devices=n_b)
@@ -241,6 +265,7 @@ class PlacedCompiledModel:
             local = [m.guid for m in comp._input_nodes].index(n.guid)
             self._input_map.append((seg, local))
         self._n_b_extra = sum(1 for seg, _ in self._input_map if seg == "b")
+        self._n_boundaries = K
 
         self._fwd_a = None
         self._step_b = None
@@ -300,8 +325,30 @@ class PlacedCompiledModel:
     def batch_sharding(self):
         return self._comp_b.batch_sharding()
 
-    def boundary_sharding(self):
-        return self._comp_b.input_sharding(0)
+    def boundary_shardings(self):
+        """B-side shardings of the crossing tensors, in boundary order.
+        Cached — this sits in the per-step host loop between the two
+        jitted programs."""
+        if getattr(self, "_boundary_shardings", None) is None:
+            self._boundary_shardings = [
+                self._comp_b.input_sharding(i)
+                for i in range(self._n_boundaries)
+            ]
+        return self._boundary_shardings
+
+    def _boundaries_to_b(self, boundaries):
+        return tuple(
+            jax.device_put(x, sh)
+            for x, sh in zip(boundaries, self.boundary_shardings())
+        )
+
+    def _cotangents_to_a(self, db):
+        """Each boundary cotangent re-enters A under the producing
+        tensor's own sharding on A's mesh."""
+        return tuple(
+            jax.device_put(g, self._comp_a.value_sharding(src, idx))
+            for g, (src, idx) in zip(db, self._boundary_srcs)
+        )
 
     # -- init ----------------------------------------------------------
     def init_params(self, seed: int = 0):
@@ -324,23 +371,26 @@ class PlacedCompiledModel:
         comp_a, comp_b = self._comp_a, self._comp_b
         optimizer = self.optimizer
 
+        boundary_srcs = self._boundary_srcs
+
         if self._fwd_a is None:
 
             @jax.jit
             def fwd_a(pa, sa, inputs_a, rng):
-                out, _ = comp_a.apply(pa, sa, inputs_a, rng, train=True)
-                return out
+                outs, _ = comp_a.apply_multi(
+                    pa, sa, inputs_a, rng, train=True, outputs=boundary_srcs)
+                return outs
 
             @jax.jit
-            def step_b(pb, ob, sb, boundary, inputs_b, labels, rng):
-                def loss_fn(p, bound):
+            def step_b(pb, ob, sb, boundaries, inputs_b, labels, rng):
+                def loss_fn(p, bounds):
                     logits, new_state = comp_b.apply(
-                        p, sb, [bound] + list(inputs_b), rng, train=True)
+                        p, sb, list(bounds) + list(inputs_b), rng, train=True)
                     loss = comp_b._loss_from(logits, labels, new_state)
                     return loss, (logits, new_state)
 
                 (loss, (logits, new_state)), (gb, db) = jax.value_and_grad(
-                    loss_fn, argnums=(0, 1), has_aux=True)(pb, boundary)
+                    loss_fn, argnums=(0, 1), has_aux=True)(pb, boundaries)
                 new_pb, new_ob = optimizer.apply(pb, gb, ob)
                 m = compute_metrics(
                     comp_b.metric_types, comp_b.loss_type, logits, labels)
@@ -349,9 +399,10 @@ class PlacedCompiledModel:
             @jax.jit
             def grad_a(pa, oa, sa, inputs_a, db, rng):
                 def f(p):
-                    out, new_state = comp_a.apply(
-                        p, sa, inputs_a, rng, train=True)
-                    return out, new_state
+                    outs, new_state = comp_a.apply_multi(
+                        p, sa, inputs_a, rng, train=True,
+                        outputs=boundary_srcs)
+                    return outs, new_state
 
                 _, vjp, new_state = jax.vjp(f, pa, has_aux=True)
                 (ga,) = vjp(db)
@@ -362,13 +413,14 @@ class PlacedCompiledModel:
         return self._fwd_a, self._step_b, self._grad_a
 
     def _bind_inputs(self, inputs):
+        K = self._n_boundaries
         ins_a = [None] * len(self._comp_a._input_nodes)
-        ins_b = [None] * max(len(self._comp_b._input_nodes) - 1, 0)
+        ins_b = [None] * max(len(self._comp_b._input_nodes) - K, 0)
         for (seg, local), x in zip(self._input_map, inputs):
             if seg == "a":
                 ins_a[local] = x
             else:
-                ins_b[local - 1] = x  # local 0 is the boundary
+                ins_b[local - K] = x  # locals 0..K-1 are the boundaries
         return ins_a, ins_b
 
     # -- steps ----------------------------------------------------------
@@ -380,12 +432,12 @@ class PlacedCompiledModel:
         ins_a, ins_b = self._bind_inputs(inputs)
         rng_a, rng_b = jax.random.split(rng)
 
-        boundary = fwd_a(pa, sa, ins_a, rng_a)
-        boundary_b = jax.device_put(boundary, self.boundary_sharding())
+        boundaries = fwd_a(pa, sa, ins_a, rng_a)
+        boundaries_b = self._boundaries_to_b(boundaries)
         new_pb, new_ob, new_sb, loss, m, db = step_b(
-            pb, ob, sb, boundary_b, ins_b, labels, rng_b)
-        # the cotangent crosses back under A's own sink sharding
-        db_a = jax.device_put(db, self._comp_a.batch_sharding())
+            pb, ob, sb, boundaries_b, ins_b, labels, rng_b)
+        # each cotangent crosses back under its producer's own sharding
+        db_a = self._cotangents_to_a(db)
         new_pa, new_oa, new_sa = grad_a(pa, oa, sa, ins_a, db_a, rng_a)
         return (
             {**new_pa, **new_pb},
@@ -400,11 +452,13 @@ class PlacedCompiledModel:
         per batch would pay Python per-op dispatch with no XLA fusion."""
         if self._eval_fwd_a is None:
             comp_a, comp_b = self._comp_a, self._comp_b
+            boundary_srcs = self._boundary_srcs
 
             @jax.jit
             def eval_fwd_a(pa, sa, ins):
-                out, _ = comp_a.apply(pa, sa, ins, None, train=False)
-                return out
+                outs, _ = comp_a.apply_multi(
+                    pa, sa, ins, None, train=False, outputs=boundary_srcs)
+                return outs
 
             @jax.jit
             def eval_fwd_b(pb, sb, ins):
@@ -419,9 +473,10 @@ class PlacedCompiledModel:
         pa, pb = self._split(params)
         sa, sb = self._split(state, state=True)
         ins_a, ins_b = self._bind_inputs(inputs)
-        out = eval_fwd_a(pa, sa, ins_a)
-        boundary_b = jax.device_put(out, self.boundary_sharding())
-        return self._comp_b.eval_step(pb, sb, [boundary_b] + ins_b, labels)
+        outs = eval_fwd_a(pa, sa, ins_a)
+        boundaries_b = self._boundaries_to_b(outs)
+        return self._comp_b.eval_step(
+            pb, sb, list(boundaries_b) + ins_b, labels)
 
     def forward_fn(self):
         eval_fwd_a, eval_fwd_b = self._eval_programs()
@@ -430,9 +485,9 @@ class PlacedCompiledModel:
             pa, pb = self._split(dict(params))
             sa, sb = self._split(dict(state), state=True)
             ins_a, ins_b = self._bind_inputs(list(inputs))
-            out = eval_fwd_a(pa, sa, ins_a)
-            boundary_b = jax.device_put(out, self.boundary_sharding())
-            return eval_fwd_b(pb, sb, [boundary_b] + ins_b)
+            outs = eval_fwd_a(pa, sa, ins_a)
+            boundaries_b = self._boundaries_to_b(outs)
+            return eval_fwd_b(pb, sb, list(boundaries_b) + ins_b)
 
         return fwd
 

@@ -53,6 +53,13 @@ class FFConfig:
     # costs pp in {2,4,8} pipelined candidates for stacked-block graphs
     # (search/pipeline_search.py) and lowers a winner automatically —
     # the capability the reference stubs as OP_PIPELINE (ffconst.h:148)
+    enable_placement_search: bool = True  # compile also costs 2-block
+    # inter-op placed candidates (search/placement_search.py) and lowers
+    # a margin-beating winner via the placed executor — the reference's
+    # VERTICAL resource splits + mapper placement (graph.cc:161-295,
+    # mapper.cc:371-475)
+    placement_search_max_nodes: int = 80  # placement cut enumeration is
+    # quadratic-ish in graph size; larger graphs skip the pass
     search_improvement_margin: float = 0.03  # a searched strategy is
     # accepted only when its simulated win over plain data parallelism
     # exceeds this fraction — the simulator has finite fidelity, and a

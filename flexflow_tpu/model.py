@@ -16,6 +16,7 @@ Differences by design (TPU-native):
 
 from __future__ import annotations
 
+import math as _math
 import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -55,6 +56,8 @@ class FFModel:
         self._name_counts: Dict[str, int] = {}
         self.compiled = None
         self.strategy = None  # chosen parallelization, set by compile()
+        self.pipeline_proposal = None  # staged-pipeline candidate for
+        # graphs the stacked executor can't run (StagedPipelineProposal)
         self.params = None
         self.opt_state = None
         self.state = None
@@ -467,7 +470,8 @@ class FFModel:
                 if (
                     pipeline is None
                     and mesh is None
-                    and self.config.enable_pipeline_search
+                    and (self.config.enable_pipeline_search
+                         or self.config.enable_placement_search)
                     and not self.config.zero_dp_shard
                     and comp_mode == "training"
                 ):
@@ -486,8 +490,11 @@ class FFModel:
                         calibration=coherent_calibration(self.config),
                     )
                     baseline = sim.simulate(self.graph, strategy)
-                    prop = propose_pipeline(
-                        self.graph, self.config, sim, baseline
+                    prop = (
+                        propose_pipeline(
+                            self.graph, self.config, sim, baseline
+                        )
+                        if self.config.enable_pipeline_search else None
                     )
                     if prop is not None and (
                         self.config.num_devices % prop.num_stages == 0
@@ -499,6 +506,52 @@ class FFModel:
                             self.graph,
                             self.config.num_devices // pipeline.num_stages,
                         )
+                    elif self.config.enable_placement_search:
+                        # no pipeline won: cost 2-block inter-op placed
+                        # candidates in the placed executor's schedule
+                        # (reference: VERTICAL splits + mapper placement,
+                        # graph.cc:161-295, mapper.cc:371-475); a
+                        # margin-beating placeable winner replaces the
+                        # flat strategy and lowers via the placed path
+                        from flexflow_tpu.search.placement_search import (
+                            propose_placement,
+                        )
+
+                        placed = propose_placement(
+                            self.graph, self.config, baseline,
+                            calibration=coherent_calibration(self.config),
+                        )
+                        if placed is not None:
+                            strategy = placed
+                        elif not _math.isfinite(baseline):
+                            # nothing executable fits: cost the GENERAL
+                            # staged-pipeline shape (any graph cut,
+                            # reference graph.cc:161-295) and surface it
+                            # — the stacked executor can't run it yet,
+                            # but the user should know pp would fit
+                            from flexflow_tpu.search.pipeline_search import (
+                                propose_pipeline_general,
+                            )
+
+                            self.pipeline_proposal = (
+                                propose_pipeline_general(
+                                    self.graph, self.config, sim, baseline
+                                )
+                            )
+                            if self.pipeline_proposal is not None:
+                                from flexflow_tpu.utils.logging import (
+                                    SEARCH_LOG,
+                                )
+
+                                p = self.pipeline_proposal
+                                SEARCH_LOG.log(
+                                    f"staged-pipeline candidate: S="
+                                    f"{p.num_stages} M="
+                                    f"{p.num_microbatches} modeled "
+                                    f"{p.cost * 1e3:.3f} ms/iter (flat "
+                                    f"is infeasible; not executable by "
+                                    f"the stacked-block lowering)"
+                                )
         # the chosen strategy is public state: tooling (bench_search,
         # strategy introspection) reads it back after compile
         self.strategy = strategy

@@ -424,12 +424,16 @@ class CostModel:
                 + self.machine.reshard_overhead_s + OP_OVERHEAD_S)
 
     def placement_move_cost(
-        self, shape: ParallelTensorShape, src: Optional[ShardAnnot]
+        self, shape: ParallelTensorShape, src: Optional[ShardAnnot],
+        spans_dcn: bool = False,
     ) -> float:
         """Cost of relocating a tensor between disjoint device blocks
-        (views with different start_part): each shard crosses ICI once."""
+        (views with different start_part): each shard crosses ICI once —
+        or DCN when the blocks live on different hosts/slices."""
         parts = max(1, src.num_parts) if src is not None else 1
         shard = shape.num_bytes / parts
+        if spans_dcn:
+            return shard / self.machine.dcn_bandwidth + self.machine.dcn_latency
         return shard / self.machine.ici_bandwidth + self.machine.ici_latency
 
     # ---- gradient synchronization ---------------------------------------

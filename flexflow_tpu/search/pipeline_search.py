@@ -28,11 +28,29 @@ activation hop per tick.
 
 from __future__ import annotations
 
+import dataclasses
 import math
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 from flexflow_tpu.core.graph import Graph
 from flexflow_tpu.core.machine import MachineView
+
+
+@dataclasses.dataclass
+class StagedPipelineProposal:
+    """A costed S-stage partition of an ARBITRARY PCG (reference: the
+    inter-op device splits of graph.cc:161-295 are general over any
+    graph cut; the stacked-block executor here is not).  ``executable``
+    is True only when the stacked-block lowering can run it — the
+    general shape is costed so the search can rank pp against flat/TP
+    for Inception/DLRM-shaped graphs, reported via strategy export and
+    tooling even when the executor cannot yet realize it."""
+
+    num_stages: int
+    num_microbatches: int
+    stage_guids: List[List[int]]  # topo-interval partition, stage order
+    cost: float                   # modeled seconds/iteration
+    executable: bool
 
 
 def _pick_microbatches(batch: int, stages: int, dp: int = 1) -> Optional[int]:
@@ -225,3 +243,167 @@ def propose_pipeline(graph: Graph, config, sim, baseline_cost: float):
         )
         return best[0]
     return None
+
+
+def _balanced_intervals(costs: List[float], stages: int) -> List[int]:
+    """Split ``costs`` into ``stages`` contiguous intervals minimizing
+    the max interval sum (classic linear-partition DP) — stage balance
+    decides the pipeline tick.  Returns the end index (exclusive) of
+    each interval."""
+    n = len(costs)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    INF = math.inf
+    # dp[s][i]: min over partitions of costs[:i] into s intervals of the
+    # max interval sum; cut[s][i]: position of the last cut
+    dp = [[INF] * (n + 1) for _ in range(stages + 1)]
+    cut = [[0] * (n + 1) for _ in range(stages + 1)]
+    dp[0][0] = 0.0
+    for s in range(1, stages + 1):
+        for i in range(s, n + 1):
+            for j in range(s - 1, i):
+                v = max(dp[s - 1][j], prefix[i] - prefix[j])
+                if v < dp[s][i]:
+                    dp[s][i] = v
+                    cut[s][i] = j
+    ends = []
+    i = n
+    for s in range(stages, 0, -1):
+        ends.append(i)
+        i = cut[s][i]
+    return ends[::-1]
+
+
+def propose_pipeline_general(graph: Graph, config, sim,
+                             baseline_cost: float
+                             ) -> Optional[StagedPipelineProposal]:
+    """Costed S-stage pipeline candidate for an ARBITRARY graph
+    (reference: inter-op splits are general over any cut,
+    graph.cc:161-295; the enum-stub OP_PIPELINE has no such limit).
+
+    The topo order is partitioned into S contiguous intervals balancing
+    full-step compute (every edge then crosses forward); cost model
+    mirrors propose_pipeline's collective-GPipe formula with the tick
+    set by the SLOWEST stage and the per-tick hop priced on the widest
+    adjacent-cut crossing:
+
+      T = (M + S - 1)/M · max_s C_s · S̄ …  — see inline terms
+
+    Returns the best finite-cost proposal (marked ``executable`` when
+    the graph also passes the stacked-block gates), or None."""
+    n = config.search_devices
+    batch = config.batch_size
+    cost = sim.cost
+    machine = cost.machine
+    topo = [node for node in graph.topo_order()]
+    best: Optional[StagedPipelineProposal] = None
+
+    for stages in (2, 4, 8):
+        if stages <= 1 or stages > n or n % stages:
+            continue
+        if len(topo) < stages:
+            continue
+        d = n // stages
+        m = _pick_microbatches(batch, stages, d)
+        if m is None:
+            continue
+
+        def dp_view(op, deg):
+            ndim = op.output_shapes[0].ndim
+            if ndim == 0:
+                return MachineView.trivial(0)
+            batch_dim = op.output_shapes[0].sizes[0]
+            if deg > 1 and batch_dim % deg:
+                return None
+            return MachineView.data_parallel(ndim, deg)
+
+        node_cost = {}
+        feasible = True
+        for node in topo:
+            v = dp_view(node.op, d)
+            if v is None:
+                feasible = False
+                break
+            node_cost[node.guid] = (
+                cost.op_cost(node.op, v, backward=True), v)
+        if not feasible:
+            continue
+        ends = _balanced_intervals(
+            [node_cost[nd.guid][0] for nd in topo], stages)
+        stage_of = {}
+        stage_guids: List[List[int]] = []
+        startp = 0
+        for si, e in enumerate(ends):
+            stage_guids.append([nd.guid for nd in topo[startp:e]])
+            for nd in topo[startp:e]:
+                stage_of[nd.guid] = si
+            startp = e
+        if any(not s for s in stage_guids):
+            continue
+
+        # per-stage compute/sync/update/memory
+        stage_comp = [0.0] * stages
+        stage_sync = [0.0] * stages
+        stage_upd = [0.0] * stages
+        stage_mem = [0.0] * stages
+        for node in topo:
+            si = stage_of[node.guid]
+            full, v = node_cost[node.guid]
+            upd = cost.update_cost(node.op, v)
+            stage_comp[si] += full - upd
+            stage_upd[si] += upd
+            stage_mem[si] += cost.op_memory(node.op, v)
+            for ws, annot in zip(node.op._weight_specs,
+                                 node.op.propagate(v).weights):
+                if annot is None or annot.replica <= 1:
+                    continue
+                nbytes = ws.dtype.itemsize
+                for s_ in ws.shape:
+                    nbytes *= s_
+                stage_sync[si] += cost.allreduce(
+                    nbytes, d, spans_dcn=d > machine.devices_per_host)
+        if max(stage_mem) > machine.hbm_capacity:
+            continue
+
+        # per-tick hop: widest adjacent-cut crossing (edges may skip
+        # stages; a k-stage skip pays k hops — charged as k unit hops)
+        hop_bytes = 0.0
+        for guid in graph.nodes:
+            for e in graph.out_edges[guid]:
+                span = stage_of[e.dst] - stage_of[e.src]
+                if span > 0:
+                    shape = graph.nodes[e.src].op.output_shapes[e.src_idx]
+                    hop_bytes = max(
+                        hop_bytes,
+                        span * shape.num_bytes / m / max(d, 1))
+        spans_dcn = n > machine.devices_per_host
+        if spans_dcn:
+            t_hop = hop_bytes / machine.dcn_bandwidth + machine.dcn_latency
+        else:
+            t_hop = hop_bytes / machine.ici_bandwidth + machine.ici_latency
+
+        # collective-GPipe: every tick runs all stages on one microbatch
+        # each; tick = slowest stage's per-microbatch time + hop; fwd
+        # and reversed bwd both pay the hop every tick
+        tick = max(stage_comp) / m
+        t_compute = (m + stages - 1) * tick
+        t_comm = 2.0 * (m + stages - 1) * t_hop
+        total = t_compute + t_comm + max(
+            s + u for s, u in zip(stage_sync, stage_upd))
+
+        if math.isfinite(total) and (best is None or total < best.cost):
+            executable = _applicable(graph, stages) is not None
+            best = StagedPipelineProposal(
+                num_stages=stages, num_microbatches=m,
+                stage_guids=stage_guids, cost=total,
+                executable=executable)
+
+    if best is None:
+        return None
+    margin = max(0.0, config.search_improvement_margin)
+    if math.isfinite(baseline_cost) and (
+            best.cost >= baseline_cost * (1.0 - margin)):
+        return None
+    return best

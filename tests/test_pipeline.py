@@ -234,3 +234,52 @@ def test_search_keeps_flat_lowering_on_single_host():
     t = m.dense(t, 128, name="head")
     m.compile(loss_type="mean_squared_error", metrics=[])
     assert not isinstance(m.compiled, PipelinedCompiledModel)
+
+
+def test_general_pipeline_costs_non_stacked_graph():
+    """Pipeline costing over an ARBITRARY graph cut (reference:
+    graph.cc:161-295 splits any graph): a heterogeneous MLP whose
+    layer widths all differ fails the stacked-block gates, but
+    propose_pipeline_general still produces a balanced staged
+    partition with a finite modeled cost — the memory-bound prime-width
+    regime where every flat strategy is infeasible."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.pipeline_search import (
+        _applicable,
+        propose_pipeline_general,
+    )
+    from flexflow_tpu.search.simulator import Simulator
+
+    n = 8
+    spec = MachineSpec(num_devices=n, devices_per_host=4, platform="cpu",
+                       hbm_capacity=40e6)
+    cfg = ff.FFConfig(batch_size=16, num_devices=n, compute_dtype="float32",
+                      machine_spec=spec)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([16, 1021])
+    # widths 1021, 1019, 1013, 1009: all prime (no TP divisor), all
+    # DIFFERENT (no stacked-block isomorphism)
+    for i, w in enumerate((1019, 1013, 1009, 1021)):
+        t = m.dense(t, w, activation="relu", name=f"layer{i}_fc")
+    t = m.dense(t, 1021, name="head")
+
+    for stages in (2, 4):
+        assert _applicable(m.graph, stages) is None  # truly non-stacked
+
+    g, strat = optimize_strategy(m.graph, cfg, return_graph=True)
+    sim = Simulator.for_config(cfg)
+    baseline = sim.simulate(g, strat)
+    prop = propose_pipeline_general(g, cfg, sim, baseline)
+    assert prop is not None, "no staged proposal for the pp-only regime"
+    assert prop.num_stages in (2, 4, 8)
+    assert not prop.executable
+    # the stages partition the whole graph, in topo order
+    seen = [gg for stage in prop.stage_guids for gg in stage]
+    assert sorted(seen) == sorted(g.nodes)
+    order = {node.guid: i for i, node in enumerate(g.topo_order())}
+    assert [order[gg] for gg in seen] == sorted(order[gg] for gg in seen)
+    assert np.isfinite(prop.cost)
+    # each stage holds 1/S of the weights; the modeled cost must beat
+    # the (infeasible) flat baseline by construction
+    assert prop.cost < baseline or not np.isfinite(baseline)

@@ -49,6 +49,23 @@ def _configure(lib) -> None:
     lib.ffn_sim_greedy.restype = c_f64
     lib.ffn_sim_greedy.argtypes = [p_void, p_u8, p_i32, p_i32, c_i32]
 
+    p_u64 = ctypes.POINTER(ctypes.c_uint64)
+    lib.ffn_dp_create.restype = p_void
+    lib.ffn_dp_create.argtypes = [c_i32, c_i32, c_f64, c_i32, c_i32, c_i32]
+    lib.ffn_dp_destroy.argtypes = [p_void]
+    lib.ffn_dp_add_view.argtypes = [p_void, c_i32, c_f64, c_f64, c_f64,
+                                    c_f64, c_i32, c_i32]
+    lib.ffn_dp_set_node_meta.argtypes = [p_void, p_i32, p_i32, p_i32]
+    lib.ffn_dp_set_budgets.argtypes = [p_void, p_i32, c_i32, p_i32, c_i32]
+    lib.ffn_dp_set_lists.argtypes = [p_void, p_i32, p_i32, c_i32, p_i32,
+                                     p_i32, c_i32, p_i32]
+    lib.ffn_dp_add_edge.argtypes = [p_void, c_i32, c_i32, c_i32, p_f64]
+    lib.ffn_dp_graph_cost.restype = c_f64
+    lib.ffn_dp_graph_cost.argtypes = [p_void, p_u64, p_i32, p_i32, c_i32,
+                                      c_i32, p_i32]
+    lib.ffn_dp_greedy_hits.restype = c_i32
+    lib.ffn_dp_greedy_hits.argtypes = [p_void]
+
     lib.ffn_graph_topo.restype = c_i32
     lib.ffn_graph_topo.argtypes = [c_i32, p_i32, c_i32, p_i32]
     lib.ffn_graph_bottlenecks.restype = c_i32
@@ -248,3 +265,87 @@ def gather_rows(src: np.ndarray, indices: np.ndarray,
         len(idx), row_bytes, n_threads,
     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# DP search engine (native graph_cost recursion)
+# ---------------------------------------------------------------------------
+
+
+class NativeDPGraph:
+    """A digested (graph, union candidate views) instance on the native
+    DP engine (native/src/dp_engine.cpp) — the full graph_cost
+    recursion runs in C++ over node bitmasks.  Node ids must be dense
+    0..n-1 in topological order."""
+
+    MAX_NODES = 256
+
+    def __init__(self, num_nodes: int, num_devices: int, mem_cap: float,
+                 include_update: bool, leaf_threshold: int = 4,
+                 max_tries: int = 2):
+        self.lib = get_lib()
+        assert self.lib is not None, "native library unavailable"
+        assert num_nodes <= self.MAX_NODES
+        self.num_nodes = num_nodes
+        self._g = self.lib.ffn_dp_create(
+            num_nodes, num_devices, float(mem_cap), int(include_update),
+            leaf_threshold, max_tries)
+        assert self._g, "ffn_dp_create failed"
+
+    def __del__(self):
+        if getattr(self, "_g", None):
+            self.lib.ffn_dp_destroy(self._g)
+            self._g = None
+
+    def add_view(self, node: int, fwd: float, full: float, sync: float,
+                 mem: float, parts: int, valid: bool) -> None:
+        self.lib.ffn_dp_add_view(self._g, node, float(fwd), float(full),
+                                 float(sync), float(mem), int(parts),
+                                 int(valid))
+
+    def set_node_meta(self, fixed_view, trivial_idx, guid_rank) -> None:
+        f = np.ascontiguousarray(fixed_view, dtype=np.int32)
+        t = np.ascontiguousarray(trivial_idx, dtype=np.int32)
+        g = np.ascontiguousarray(guid_rank, dtype=np.int32)
+        self.lib.ffn_dp_set_node_meta(self._g, _i32(f), _i32(t), _i32(g))
+
+    def set_budgets(self, budgets, cands) -> None:
+        b = np.ascontiguousarray(budgets, dtype=np.int32)
+        c = np.ascontiguousarray(cands, dtype=np.int32)
+        self.lib.ffn_dp_set_budgets(self._g, _i32(b), len(b), _i32(c), len(c))
+
+    def set_lists(self, cand_off, cand_idx, bview_off, bview_idx,
+                  default_idx) -> None:
+        co = np.ascontiguousarray(cand_off, dtype=np.int32)
+        ci = np.ascontiguousarray(cand_idx, dtype=np.int32)
+        bo = np.ascontiguousarray(bview_off, dtype=np.int32)
+        bi = np.ascontiguousarray(bview_idx, dtype=np.int32)
+        di = np.ascontiguousarray(default_idx, dtype=np.int32)
+        self.lib.ffn_dp_set_lists(self._g, _i32(co), _i32(ci), len(ci),
+                                  _i32(bo), _i32(bi), len(bi), _i32(di))
+
+    def add_edge(self, src: int, dst: int, has_grad: bool,
+                 xfer: np.ndarray) -> None:
+        x = np.ascontiguousarray(xfer, dtype=np.float64)
+        self.lib.ffn_dp_add_edge(
+            self._g, src, dst, int(has_grad),
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+
+    def graph_cost(self, node_indices: Sequence[int],
+                   fixed: Dict[int, int], budget: int):
+        """(cost, assign[num_nodes]) for the subgraph given by
+        ``node_indices`` with ``fixed`` {node: view_idx} pinned."""
+        mask = np.zeros(4, dtype=np.uint64)
+        for i in node_indices:
+            mask[i >> 6] |= np.uint64(1) << np.uint64(i & 63)
+        fn = np.ascontiguousarray(sorted(fixed), dtype=np.int32)
+        fv = np.ascontiguousarray([fixed[k] for k in sorted(fixed)],
+                                  dtype=np.int32)
+        out = np.full(self.num_nodes, -1, dtype=np.int32)
+        cost = self.lib.ffn_dp_graph_cost(
+            self._g, mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            _i32(fn), _i32(fv), len(fn), int(budget), _i32(out))
+        return cost, out
+
+    def greedy_hits(self) -> int:
+        return int(self.lib.ffn_dp_greedy_hits(self._g))

@@ -160,6 +160,221 @@ class SearchHelper:
         return fv
 
     # ------------------------------------------------------------------
+    # native DP engine (native/src/dp_engine.cpp): the ENTIRE graph_cost
+    # recursion in C++ for the default cost currency — the reference
+    # keeps this loop in C++ for the same reason (graph.cc:79-295).
+    # Eligibility: no placement-overlap credit (starts are cost-inert in
+    # the default currency — the planning mode stays Python), no
+    # calibration fusion clusters (strategy-dependent scaling), <=256
+    # nodes, and every pinned view must exist in the exported view sets.
+    def _native_dp_ctx(self, graph: Graph):
+        if self.sim.placement_overlap:
+            return None
+        cal = self.sim.cost.calibration
+        if cal is not None and getattr(cal, "num_clusters", 0) > 0:
+            return None
+        if graph.num_nodes > 256 or graph.num_nodes == 0:
+            return None
+        # staleness stamp: the digest bakes in the graph's structure and
+        # THIS helper's costing surface — a mutated graph (graph.hash()
+        # changes; Graph._invalidate clears its cache on mutation) or a
+        # different machine/device configuration must re-digest
+        stamp = (
+            graph.hash(), self.num_devices, id(self.sim.machine),
+            self.sim.machine.hbm_capacity, self.sim.inference,
+            self.leaf_threshold, self.max_bottleneck_tries,
+        )
+        cached = getattr(graph, "_ndp_ctx", None)
+        if cached == "ineligible":
+            return None  # hard override (tests force the Python path)
+        if cached is not None and cached[0] == stamp:
+            return cached[1]  # may be None (= ineligible)
+        from flexflow_tpu import native as _native
+
+        if _native.get_lib() is None:
+            graph._ndp_ctx = (stamp, None)
+            return None
+        try:
+            ctx = self._build_native_dp(graph)
+        except Exception:
+            ctx = None
+        graph._ndp_ctx = (stamp, ctx)
+        return ctx
+
+    def _build_native_dp(self, graph: Graph):
+        from flexflow_tpu import native as _native
+
+        sim = self.sim
+        topo = graph.topo_order()
+        n = len(topo)
+        index = {node.guid: i for i, node in enumerate(topo)}
+        guid_rank = {g: r for r, g in enumerate(sorted(graph.nodes))}
+
+        cands = sorted(self._budget_cands())
+        budgets = sorted(set(cands) | {self.num_devices})
+        nb = len(budgets)
+
+        views: List[List[MachineView]] = []      # union per node
+        view_key: List[Dict[Tuple, int]] = []    # (degrees, replica) -> idx
+        fixed_idx = [-1] * n
+        trivial_idx = [0] * n
+        cand_off = [0] * (n * nb + 1)
+        bview_off = [0] * (n * nb + 1)
+        cand_idx: List[int] = []
+        bview_idx: List[int] = []
+        default_idx = [0] * (n * nb)
+
+        def intern(i: int, mv: MachineView) -> int:
+            key = (mv.dim_degrees, mv.replica_degree)
+            hit = view_key[i].get(key)
+            if hit is None:
+                hit = len(views[i])
+                view_key[i][key] = hit
+                views[i].append(
+                    dataclasses.replace(mv, start_part=0)
+                    if mv.start_part else mv
+                )
+            return hit
+
+        for i, node in enumerate(topo):
+            views.append([])
+            view_key.append({})
+            nd = node.op.output_shapes[0].ndim
+            trivial_idx[i] = intern(i, MachineView.trivial(nd))
+            fv = node.op.fixed_machine_view()
+            if fv is not None:
+                fixed_idx[i] = intern(i, fv)
+            shape = node.op.output_shapes[0]
+            for bi, b in enumerate(budgets):
+                at = i * nb + bi
+                cl = [intern(i, v) for v in self._views(node, b)]
+                bl = [intern(i, v) for v in self._bviews(node, b)]
+                cand_idx.extend(cl)
+                bview_idx.extend(bl)
+                cand_off[at + 1] = len(cand_idx)
+                bview_off[at + 1] = len(bview_idx)
+                # _default_strategy's per-node dp view for this budget
+                mv = None
+                if nd and 0 in node.op.splittable_output_dims():
+                    d = b
+                    while d > 1 and shape.sizes[0] % d != 0:
+                        d //= 2
+                    if d > 1:
+                        mv = MachineView.data_parallel(nd, d)
+                default_idx[at] = (
+                    intern(i, mv) if mv is not None else trivial_idx[i]
+                )
+
+        ndp = _native.NativeDPGraph(
+            n, self.num_devices, sim.machine.hbm_capacity,
+            include_update=not sim.inference,
+            leaf_threshold=self.leaf_threshold,
+            max_tries=self.max_bottleneck_tries,
+        )
+        annots: List[List[Optional[object]]] = []
+        for i, node in enumerate(topo):
+            row = []
+            for mv in views[i]:
+                osh = sim._propagate(node, mv)
+                row.append(osh)
+                if osh is None:
+                    ndp.add_view(i, 0.0, 0.0, 0.0, 0.0, 1, False)
+                    continue
+                fwd, full, sync, m_bytes = sim._node_costs(node, mv)
+                ndp.add_view(i, fwd, full, sync, m_bytes,
+                             mv.num_parts, True)
+            annots.append(row)
+        ndp.set_node_meta(fixed_idx, trivial_idx,
+                          [guid_rank[node.guid] for node in topo])
+        ndp.set_budgets(budgets, cands)
+        ndp.set_lists(cand_off, cand_idx, bview_off, bview_idx, default_idx)
+
+        import numpy as _np
+
+        for guid in graph.nodes:
+            for e in graph.out_edges[guid]:
+                si, di = index[e.src], index[e.dst]
+                shape = graph.nodes[e.src].op.output_shapes[e.src_idx]
+                mat = _np.empty((len(views[si]), len(views[di])),
+                                dtype=_np.float64)
+                for svi in range(len(views[si])):
+                    s_osh = annots[si][svi]
+                    for dvi in range(len(views[di])):
+                        d_osh = annots[di][dvi]
+                        if s_osh is None or d_osh is None:
+                            mat[svi, dvi] = math.inf
+                            continue
+                        src_annot = (
+                            s_osh.outputs[e.src_idx]
+                            if e.src_idx < len(s_osh.outputs) else None
+                        )
+                        dst_annot = (
+                            d_osh.inputs[e.dst_idx]
+                            if e.dst_idx < len(d_osh.inputs) else None
+                        )
+                        mat[svi, dvi] = sim.cost.xfer_cost(
+                            shape, src_annot, dst_annot)
+                ndp.add_edge(
+                    si, di,
+                    not graph.nodes[e.src].op.is_gradient_free, mat)
+        ctx = {"ndp": ndp, "index": index, "views": views,
+               "view_key": view_key, "topo": topo, "budgets": set(budgets),
+               "greedy_seen": 0}
+        return ctx
+
+    def _budget_cands(self) -> List[int]:
+        """_sub_budgets' candidate sizes (shared with the native DP)."""
+        divs = [d for d in range(1, self.num_devices + 1)
+                if self.num_devices % d == 0]
+        cands = set(divs)
+        dph = getattr(self.sim.machine, "devices_per_host", 0)
+        if 1 < dph < self.num_devices:
+            cands.update(
+                k * dph for k in range(1, self.num_devices // dph + 1)
+            )
+        return sorted(cands)
+
+    def _native_graph_cost(self, graph: Graph, fixed: Strategy,
+                           budget: int) -> Optional[Tuple[float, Strategy]]:
+        ctx = self._native_dp_ctx(graph)
+        if ctx is None or budget not in ctx["budgets"]:
+            return None
+        index, view_key = ctx["index"], ctx["view_key"]
+        fixed_native: Dict[int, int] = {}
+        for g, v in fixed.items():
+            if g not in index:
+                continue
+            vi = view_key[index[g]].get((v.dim_degrees, v.replica_degree))
+            if vi is None:
+                return None  # pinned view outside the exported sets
+            fixed_native[index[g]] = vi
+        ndp = ctx["ndp"]
+        before = ndp.greedy_hits()
+        cost, assign = ndp.graph_cost(
+            list(index.values()), fixed_native, budget)
+        self.greedy_hits += ndp.greedy_hits() - before
+        strategy: Strategy = {}
+        for node in ctx["topo"]:
+            vi = int(assign[index[node.guid]])
+            if vi >= 0:
+                strategy[node.guid] = ctx["views"][index[node.guid]][vi]
+        # keep the caller's pinned views object-identical (start offsets
+        # on fixed boundary views are preserved even though they are
+        # cost-inert in this currency)
+        for g, v in fixed.items():
+            if g in strategy:
+                strategy[g] = v
+        # mirror the result into the Python memo: isomorphic graphs with
+        # different guids (repeated blocks seen through other Graph
+        # objects) then reuse it via canonical remapping exactly as the
+        # Python path would
+        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, 0)
+        if key not in self.memo:
+            self.memo[key] = (
+                float(cost), canonicalize_strategy(graph, strategy))
+        return float(cost), strategy
+
+    # ------------------------------------------------------------------
     def graph_cost(
         self,
         graph: Graph,
@@ -172,6 +387,10 @@ class SearchHelper:
         devices beginning at device ``start``."""
         fixed = fixed or {}
         budget = budget or self.num_devices
+        if start == 0:
+            native = self._native_graph_cost(graph, fixed, budget)
+            if native is not None:
+                return native
         # structural memo: keyed by graph hash + guid-free canonical
         # fixed views, so isomorphic segments with different guids
         # (repeated transformer layers, Inception blocks) share work.
@@ -207,6 +426,10 @@ class SearchHelper:
         graph.cc:1456-1526, exists for exactly this reason)."""
         fixed = fixed or {}
         budget = budget or self.num_devices
+        if start == 0:
+            native = self._native_graph_cost(graph, fixed, budget)
+            if native is not None:
+                return native[0]
         key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
         hit = self.memo.get(key)
         if hit is not None:

@@ -40,11 +40,12 @@ def test_default_search_12layer_bert_under_60s():
     assert c_searched <= c_dp * 1.001, (c_searched, c_dp)
 
 
-def test_default_search_inception_under_75s():
+def test_default_search_inception_under_15s():
     """Inception-v3 (220-node PCG, the branchiest zoo model) through the
-    default compile path.  The wall-clock deadline (search_timeout_s=45)
-    guarantees termination; the margin above it covers the baseline DP
-    pass and final materialization."""
+    default compile path.  The graph_cost recursion runs on the native
+    DP engine (native/src/dp_engine.cpp — the reference keeps this loop
+    in C++ for the same reason, graph.cc:79-295): the joint search that
+    took 75s in pure Python must now finish well inside 15s."""
     cfg = ff.FFConfig(batch_size=64, num_devices=8)
     model = build_inception_v3(cfg)
     g = model.graph
@@ -52,7 +53,7 @@ def test_default_search_inception_under_75s():
     t0 = time.monotonic()
     best_graph, strategy = optimize_strategy(g, cfg, return_graph=True)
     elapsed = time.monotonic() - t0
-    assert elapsed < 75.0, f"inception search took {elapsed:.1f}s"
+    assert elapsed < 15.0, f"inception search took {elapsed:.1f}s"
     sim = Simulator(cfg.machine_spec, num_devices=8)
     c_searched = sim.simulate(best_graph, strategy)
     c_dp = sim.simulate(g, data_parallel_strategy(g, 8))

@@ -188,9 +188,13 @@ def main():
         only_data_parallel=len(devices) == 1,
         compute_dtype=dtype,
     )
+    # bf16 activation stream on TPU: ops cast outputs back to the input
+    # tensor's dtype, so a bf16 input keeps every inter-op activation at
+    # 2 bytes (half the HBM traffic); matmuls still accumulate f32 and
+    # loss/metrics upcast internally
     model = build_transformer(
         cfg, num_layers=layers, hidden=hidden, num_heads=heads,
-        ff_dim=ff_dim, seq_len=seq,
+        ff_dim=ff_dim, seq_len=seq, dtype=dtype,
     )
     model.compile(
         optimizer=ff.AdamOptimizer(alpha=1e-4),
@@ -205,7 +209,11 @@ def main():
     # (flexflow_cffi.py:1867-1874), amortizing per-call dispatch (which
     # dominates through a remote-device tunnel)
     trace_n = 10 if on_tpu else steps
-    xs = rng.normal(size=(trace_n, batch, seq, hidden)).astype(np.float32)
+    import ml_dtypes
+
+    in_np = np.float32 if dtype == "float32" else np.dtype(
+        getattr(ml_dtypes, dtype))
+    xs = rng.normal(size=(trace_n, batch, seq, hidden)).astype(in_np)
     ys = rng.normal(size=(trace_n, batch, seq, hidden)).astype(np.float32)
     xs_d = jax.device_put(xs, model.compiled.stacked_input_sharding(0))
     ys_d = jax.device_put(ys, model.compiled.stacked_batch_sharding())

@@ -36,13 +36,18 @@ def encoder_layer(model, t, hidden, num_heads, ff_dim, name, dropout=0.1,
 def build_transformer(config: FFConfig, num_layers: int = 12, hidden: int = 512,
                       num_heads: int = 8, ff_dim: int = 2048, seq_len: int = 512,
                       dropout: float = 0.0, layer_norm: bool = False,
-                      causal: bool = False):
+                      causal: bool = False, dtype: str = "float32"):
     """The reference Transformer example: raw float inputs [B, S, H],
     per-position dense head back to hidden (transformer.cc:112-211 uses
-    no embedding/LN — dense proxies)."""
+    no embedding/LN — dense proxies).
+
+    ``dtype`` sets the activation-stream dtype: ops cast their outputs
+    back to their input dtype, so a "bfloat16" input tensor keeps every
+    inter-op activation at 2 bytes (half the HBM traffic of the default
+    float32 stream) while matmuls still accumulate in f32."""
     model = FFModel(config)
     b = config.batch_size
-    x = model.create_tensor([b, seq_len, hidden], name="tokens")
+    x = model.create_tensor([b, seq_len, hidden], dtype=dtype, name="tokens")
     t = x
     for i in range(num_layers):
         t = encoder_layer(model, t, hidden, num_heads, ff_dim, f"layer{i}",

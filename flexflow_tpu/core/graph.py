@@ -186,17 +186,19 @@ class Graph:
         """
         if self._hash_cache is not None:
             return self._hash_cache
+        # in-process tuple hashing (like node_hashes): every consumer
+        # (DP memo, driver segment cache, best-first seen-set) lives in
+        # this process, and the search hashes tens of thousands of
+        # rewritten graphs — blake2b-over-strings here was a measured
+        # 6s of the Inception search
         h: Dict[int, int] = {}
         for node in self.topo_order():
             sig = self._sig_repr(node)
             ins = sorted(
                 (h[e.src], e.src_idx, e.dst_idx) for e in self.in_edges[node.guid]
             )
-            payload = (sig + "|" + repr(ins)).encode()
-            h[node.guid] = int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
-        sinks = sorted(h[n.guid] for n in self.sinks())
-        payload = repr(sinks).encode()
-        out = int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
+            h[node.guid] = hash((sig, tuple(ins)))
+        out = hash(tuple(sorted(h[n.guid] for n in self.sinks())))
         self._hash_cache = out
         return out
 

@@ -55,6 +55,8 @@ def _configure(lib) -> None:
     lib.ffn_dp_destroy.argtypes = [p_void]
     lib.ffn_dp_add_view.argtypes = [p_void, c_i32, c_f64, c_f64, c_f64,
                                     c_f64, c_i32, c_i32]
+    lib.ffn_dp_set_views.argtypes = [p_void, p_i32, p_f64, p_f64, p_f64,
+                                     p_f64, p_i32, p_u8]
     lib.ffn_dp_set_node_meta.argtypes = [p_void, p_i32, p_i32, p_i32]
     lib.ffn_dp_set_budgets.argtypes = [p_void, p_i32, c_i32, p_i32, c_i32]
     lib.ffn_dp_set_lists.argtypes = [p_void, p_i32, p_i32, c_i32, p_i32,
@@ -302,6 +304,23 @@ class NativeDPGraph:
         self.lib.ffn_dp_add_view(self._g, node, float(fwd), float(full),
                                  float(sync), float(mem), int(parts),
                                  int(valid))
+
+    def set_views(self, node_off, fwd, full, sync, mem, parts,
+                  valid) -> None:
+        """Bulk per-node view upload; node_off is an n+1 prefix array
+        into the flat per-view arrays."""
+        off = np.ascontiguousarray(node_off, dtype=np.int32)
+        f = np.ascontiguousarray(fwd, dtype=np.float64)
+        u = np.ascontiguousarray(full, dtype=np.float64)
+        s = np.ascontiguousarray(sync, dtype=np.float64)
+        m = np.ascontiguousarray(mem, dtype=np.float64)
+        p = np.ascontiguousarray(parts, dtype=np.int32)
+        v = np.ascontiguousarray(valid, dtype=np.uint8)
+        pf = ctypes.POINTER(ctypes.c_double)
+        self.lib.ffn_dp_set_views(
+            self._g, _i32(off), f.ctypes.data_as(pf), u.ctypes.data_as(pf),
+            s.ctypes.data_as(pf), m.ctypes.data_as(pf), _i32(p),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
 
     def set_node_meta(self, fixed_view, trivial_idx, guid_rank) -> None:
         f = np.ascontiguousarray(fixed_view, dtype=np.int32)

@@ -77,6 +77,16 @@ class ShardAnnot:
     partial: bool = False
     idx: Tuple[int, ...] = ()
 
+    def __hash__(self):
+        # cached: ShardAnnots key the cost model's memo dicts and are
+        # hashed millions of times per search; the dataclass-generated
+        # hash rebuilds the field tuple every call
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash((self.degrees, self.replica, self.partial, self.idx))
+            object.__setattr__(self, "_hash", h)
+        return h
+
     def parallel_idx(self) -> Tuple[int, ...]:
         if self.idx:
             return self.idx

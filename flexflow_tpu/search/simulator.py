@@ -198,9 +198,19 @@ class Simulator:
 
         end_time = 0.0
         end_comm = 0.0
+        overlap = self.placement_overlap
+        # fast path: in the default (overlap=False) currency every op
+        # occupies ALL device timelines, so device availability is ONE
+        # scalar and per-device memory is the plain sum — identical math
+        # to the full per-device form (and to the native engines), at a
+        # fraction of the dict traffic.  The search calls this tens of
+        # thousands of times per compile.
+        scalar = not overlap and schedule is None
+        avail = 0.0
+        mem_total = 0.0
         for node in topo:
             mv, osh = shardings[node.guid]
-            start = 0.0
+            start = avail if scalar else 0.0
             # input readiness + edge xfer costs
             for e in graph.in_edges[node.guid]:
                 src_mv, src_osh = shardings[e.src]
@@ -214,7 +224,7 @@ class Simulator:
                 )
                 shape = graph.nodes[e.src].op.output_shapes[e.src_idx]
                 xfer = self.cost.xfer_cost(shape, src_annot, dst_annot)
-                if self.placement_overlap and src_mv.start_part != mv.start_part:
+                if overlap and src_mv.start_part != mv.start_part:
                     # producer and consumer live on different device
                     # blocks: every shard moves at least one hop even
                     # when shardings agree (reference charges this via
@@ -230,29 +240,40 @@ class Simulator:
                     # at inputs/constants carry no cotangent back, so
                     # they pay the forward reshard only.
                     xfer *= 2.0
-                start = max(start, ready.get((e.src, e.src_idx), 0.0) + xfer)
-            comm_devs = self.view_device_set(mv, use_start=self.placement_overlap)
-            devs = comm_devs if self.placement_overlap else self._all_devices
-            for d in devs:
-                start = max(start, device_avail[d])
+                t = ready.get((e.src, e.src_idx), 0.0) + xfer
+                if t > start:
+                    start = t
             fwd, full, sync, m_bytes = self._node_costs(node, mv)
             scale = cluster_scale.get(node.guid)
             if scale is not None:
                 r, upd = scale
                 fwd = fwd * r
                 full = (full - upd) * r + upd
-            for d in devs:
-                mem[d] += m_bytes
             dur = full if include_update else fwd
-            finish = start + dur
-            for d in devs:
-                device_avail[d] = finish
+            if scalar:
+                mem_total += m_bytes
+                finish = start + dur
+                avail = finish
+            else:
+                comm_devs = self.view_device_set(mv, use_start=overlap)
+                devs = comm_devs if overlap else self._all_devices
+                for d in devs:
+                    start = max(start, device_avail[d])
+                for d in devs:
+                    mem[d] += m_bytes
+                finish = start + dur
+                for d in devs:
+                    device_avail[d] = finish
+                if schedule is not None:
+                    schedule.append(
+                        (node.op.name, start, finish, tuple(sorted(devs))))
             for i in range(len(node.op.output_shapes)):
                 ready[(node.guid, i)] = finish
-            if schedule is not None:
-                schedule.append((node.op.name, start, finish, tuple(sorted(devs))))
-            end_time = max(end_time, finish)
+            if finish > end_time:
+                end_time = finish
             if include_update and sync > 0:
+                if scalar:
+                    comm_devs = self.view_device_set(mv, use_start=False)
                 s = finish
                 for d in comm_devs:
                     s = max(s, comm_avail[d])
@@ -261,7 +282,8 @@ class Simulator:
                     comm_avail[d] = f
                 end_comm = max(end_comm, f)
 
-        if max(mem.values()) > self.machine.hbm_capacity:
+        peak = mem_total if scalar else max(mem.values())
+        if peak > self.machine.hbm_capacity:
             return math.inf
         return max(end_time, end_comm)
 

@@ -805,6 +805,28 @@ void ffn_dp_add_view(DpCtx* c, int32_t node, double fwd, double full,
   c->views[node].push_back(v);
 }
 
+// bulk upload: node_off is an n+1 prefix array into the flat arrays
+// (per-view ctypes calls dominated the per-graph digest cost)
+void ffn_dp_set_views(DpCtx* c, const int32_t* node_off, const double* fwd,
+                      const double* full, const double* sync,
+                      const double* mem, const int32_t* parts,
+                      const uint8_t* valid) {
+  for (int i = 0; i < c->n; ++i) {
+    c->views[i].clear();
+    c->views[i].reserve(node_off[i + 1] - node_off[i]);
+    for (int32_t k = node_off[i]; k < node_off[i + 1]; ++k) {
+      DpView v;
+      v.fwd = fwd[k];
+      v.full = full[k];
+      v.sync = sync[k];
+      v.mem = mem[k];
+      v.parts = parts[k];
+      v.valid = valid[k] != 0;
+      c->views[i].push_back(v);
+    }
+  }
+}
+
 void ffn_dp_set_node_meta(DpCtx* c, const int32_t* fixed_view,
                           const int32_t* trivial_idx,
                           const int32_t* guid_rank) {

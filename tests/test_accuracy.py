@@ -125,3 +125,35 @@ def test_real_mnist_accuracy_when_cached():
     m.fit(x=xtr[:20000], y=ytr[:20000].astype(np.int32), verbose=False)
     logs = m.evaluate(x=xte, y=yte.astype(np.int32))
     assert logs["accuracy"] >= 0.90, logs
+
+
+def test_real_digits_cnn_accuracy():
+    """REAL pixels through the CONV path: a small Conv2D+pool CNN on
+    the bundled UCI digits (8x8 grayscale scans) must reach >=90%
+    held-out accuracy — the reference's CNN accuracy gate shape
+    (reference: tests/accuracy_tests.sh:10-14 trains CNNs on fetched
+    MNIST/CIFAR; zero-egress here, so the genuine offline 1797-scan
+    dataset plays that role)."""
+    (xtr, ytr), (xte, yte) = datasets.digits.load_data()
+    assert len(xtr) + len(xte) == 1797
+    xtr = (xtr / 16.0).reshape(len(xtr), 8, 8, 1).astype(np.float32)
+    xte = (xte / 16.0).reshape(len(xte), 8, 8, 1).astype(np.float32)
+
+    cfg = ff.FFConfig(batch_size=32, epochs=25, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32",
+                      seed=5)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([32, 8, 8, 1], name="pix")
+    t = m.conv2d(x, 16, 3, 3, padding_h=1, padding_w=1,
+                 activation="relu", name="c1")
+    t = m.pool2d(t, 2, 2, stride_h=2, stride_w=2, name="p1")
+    t = m.conv2d(t, 32, 3, 3, padding_h=1, padding_w=1,
+                 activation="relu", name="c2")
+    t = m.flat(t, name="flatten")
+    t = m.dense(t, 10, name="head")
+    m.compile(optimizer=ff.AdamOptimizer(alpha=1e-3),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x=xtr, y=ytr.astype(np.int32), verbose=False)
+    logs = m.evaluate(x=xte, y=yte.astype(np.int32))
+    assert logs["accuracy"] >= 0.90, logs

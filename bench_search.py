@@ -25,6 +25,14 @@ import argparse
 import json
 import time
 
+# The sync-bound transformer regime (osdi22ae/bert.sh scaled to the
+# CPU mesh): per-device batch 1, full hidden/ff widths — DP's weight
+# allreduce dominates and the searched TP strategy wins at EXECUTION.
+# Shared with tests/test_search_exec_coherence.py so the benchmark and
+# the CI gate measure the SAME program pair.
+SYNC_BOUND_BERT_KW = dict(num_layers=2, hidden=512, num_heads=4,
+                          ff_dim=2048, seq_len=16)
+
 
 def _model_specs():
     """Per-model configs mirror the osdi22ae scripts (bert.sh: batch 8,
@@ -52,10 +60,10 @@ def _model_specs():
             # dominates and the search's TP strategy wins at EXECUTION
             # (the osdi22ae/bert.sh regime; measured 3.7x on the CPU
             # mesh) — a narrowed exec model collapses to DP and the
-            # two-program comparison degenerates
+            # two-program comparison degenerates.  The coherence CI
+            # gates THE SAME spec (SYNC_BOUND_BERT_KW).
             exec_build=lambda cfg: build_transformer(
-                cfg, num_layers=2, hidden=512, num_heads=4, ff_dim=2048,
-                seq_len=16),
+                cfg, **SYNC_BOUND_BERT_KW),
             exec_batch=8,
         ),
         "gpt": dict(

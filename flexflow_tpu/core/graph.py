@@ -18,7 +18,6 @@ dominators/post-dominators, bottleneck (articulation) node finding
 
 from __future__ import annotations
 
-import hashlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
 

@@ -414,6 +414,8 @@ class FFModel:
                 f"comp_mode must be 'training' or 'inference', got {comp_mode!r}"
             )
         self.config.comp_mode = comp_mode
+        self.pipeline_proposal = None  # a stale proposal from an earlier
+        # compile must not hijack this one's lowering
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
@@ -526,9 +528,10 @@ class FFModel:
                         elif not _math.isfinite(baseline):
                             # nothing executable fits: cost the GENERAL
                             # staged-pipeline shape (any graph cut,
-                            # reference graph.cc:161-295) and surface it
-                            # — the stacked executor can't run it yet,
-                            # but the user should know pp would fit
+                            # reference graph.cc:161-295); a winning
+                            # proposal lowers via the heterogeneous
+                            # staged executor
+                            # (compiler/staged_pipeline_lowering.py)
                             from flexflow_tpu.search.pipeline_search import (
                                 propose_pipeline_general,
                             )
@@ -548,9 +551,8 @@ class FFModel:
                                     f"staged-pipeline candidate: S="
                                     f"{p.num_stages} M="
                                     f"{p.num_microbatches} modeled "
-                                    f"{p.cost * 1e3:.3f} ms/iter (flat "
-                                    f"is infeasible; not executable by "
-                                    f"the stacked-block lowering)"
+                                    f"{p.cost * 1e3:.3f} ms/iter "
+                                    f"(flat is infeasible)"
                                 )
         # the chosen strategy is public state: tooling (bench_search,
         # strategy introspection) reads it back after compile
@@ -612,6 +614,40 @@ class FFModel:
                 pipeline=pipeline,
                 block_of=block_of,
             )
+        elif (
+            self.pipeline_proposal is not None
+            and mesh is None
+            and comp_mode == "training"
+        ):
+            # (multi-process raises inside the constructor and falls
+            # back to flat via the except below)
+            # flat is infeasible and the general staged proposal won:
+            # lower it via the heterogeneous staged executor (GPipe over
+            # arbitrary graph cuts — compiler/staged_pipeline_lowering)
+            from flexflow_tpu.compiler.staged_pipeline_lowering import (
+                StagedPipelinedModel,
+            )
+
+            try:
+                self.compiled = StagedPipelinedModel(
+                    self.graph,
+                    self.pipeline_proposal.stage_guids,
+                    self.pipeline_proposal.num_microbatches,
+                    self.config,
+                    LossType.from_any(loss_type),
+                    list(metrics),
+                    self.optimizer,
+                )
+            except (NotImplementedError, ValueError):
+                # stateful stages etc.: keep the flat lowering (the
+                # proposal stays surfaced on self.pipeline_proposal)
+                self.compiled = None
+            if self.compiled is None:
+                self.compiled = CompiledModel(
+                    self.graph, strategy, self.config,
+                    LossType.from_any(loss_type), list(metrics),
+                    self.optimizer, mesh=mesh,
+                )
         else:
             self.compiled = CompiledModel(
                 self.graph,
@@ -622,10 +658,16 @@ class FFModel:
                 self.optimizer,
                 mesh=mesh,
             )
+        from flexflow_tpu.compiler.staged_pipeline_lowering import (
+            StagedPipelinedModel as _Staged,
+        )
+
         self._compile_ctx = dict(
             strategy=strategy, loss_type=LossType.from_any(loss_type),
             metrics=list(metrics), pipeline=pipeline, block_of=block_of,
             mesh=mesh,
+            staged=(self.pipeline_proposal
+                    if isinstance(self.compiled, _Staged) else None),
         )
         self.params, self.state = self.compiled.init_params(self.config.seed)
         self.opt_state = self.optimizer.init_state(self.params)
@@ -647,6 +689,19 @@ class FFModel:
                 self.graph, ctx["strategy"], self.config, ctx["loss_type"],
                 ctx["metrics"], self.optimizer,
                 pipeline=ctx["pipeline"], block_of=ctx["block_of"],
+            )
+        elif ctx.get("staged") is not None:
+            # a staged-pipelined model must RE-lower staged: the flat
+            # strategy it replaced was HBM-infeasible by construction
+            from flexflow_tpu.compiler.staged_pipeline_lowering import (
+                StagedPipelinedModel,
+            )
+
+            staged = ctx["staged"]
+            self.compiled = StagedPipelinedModel(
+                self.graph, staged.stage_guids, staged.num_microbatches,
+                self.config, ctx["loss_type"], ctx["metrics"],
+                self.optimizer,
             )
         else:
             from flexflow_tpu.compiler.placement_lowering import (

@@ -40,11 +40,11 @@ from flexflow_tpu.core.machine import MachineView
 class StagedPipelineProposal:
     """A costed S-stage partition of an ARBITRARY PCG (reference: the
     inter-op device splits of graph.cc:161-295 are general over any
-    graph cut; the stacked-block executor here is not).  ``executable``
-    is True only when the stacked-block lowering can run it — the
-    general shape is costed so the search can rank pp against flat/TP
-    for Inception/DLRM-shaped graphs, reported via strategy export and
-    tooling even when the executor cannot yet realize it."""
+    graph cut).  ``executable`` is True when the stacked-block scan
+    lowering can run it; the general heterogeneous shape executes via
+    the staged wavefront executor
+    (compiler/staged_pipeline_lowering.StagedPipelinedModel), which
+    compile() adopts when every flat strategy is infeasible."""
 
     num_stages: int
     num_microbatches: int

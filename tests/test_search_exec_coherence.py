@@ -63,12 +63,14 @@ def _sync_bound_bert(cfg):
     DP's weight-gradient allreduce dominates — the search's
     compute-parallel (TP) strategy must win at EXECUTION, not just in
     the simulator (round-4 verdict: no configuration had shown a
-    compute-parallel searched strategy beating DP when executed)."""
+    compute-parallel searched strategy beating DP when executed).
+    The spec is SHARED with bench_search.py's bert exec tier — the CI
+    gate and the benchmark must measure the same program pair."""
+    from bench_search import SYNC_BOUND_BERT_KW
+
     from flexflow_tpu.models import build_transformer
 
-    return build_transformer(
-        cfg, num_layers=2, hidden=512, num_heads=4, ff_dim=2048, seq_len=16
-    )
+    return build_transformer(cfg, **SYNC_BOUND_BERT_KW)
 
 
 def _tiny_mlp(cfg):

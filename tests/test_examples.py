@@ -36,6 +36,7 @@ _SCRIPTS = [
     ("resnet.py", ["-b", "4", "-e", "1"]),
     ("onnx_import.py", ["-b", "16", "-e", "1"]),
     ("placed_dlrm.py", ["-b", "32", "-e", "1"]),
+    ("staged_pipeline.py", ["-b", "16", "-e", "1"]),
     ("tf_keras_import.py", ["-b", "8", "-e", "1"]),
     ("digits_accuracy.py", ["-b", "32", "-e", "12"]),
     ("keras_cifar10_cnn.py", ["-b", "16", "-e", "1"]),

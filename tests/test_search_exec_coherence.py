@@ -36,7 +36,11 @@ from flexflow_tpu.core.machine import MachineSpec
 from flexflow_tpu.search.simulator import Simulator
 
 N_DEV = 8
-NOISE_FLOOR = 0.85
+# round-4 verdict weak #5: 0.85 tolerated a 15% executed loss.  Every
+# genuinely-different program pair currently wins >=1.8x executed
+# (BENCH_SEARCH.md), so the floor now only absorbs single-core timing
+# jitter, not modeling error.
+NOISE_FLOOR = 0.92
 BIG_WIN = 1.5
 
 

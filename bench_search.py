@@ -39,6 +39,8 @@ def _model_specs():
     budget 30; dlrm.sh/candle_uno.sh: budget 20; inception.sh: batch 64,
     budget 10)."""
     from flexflow_tpu.models import (
+        build_alexnet,
+        build_alexnet_cifar10,
         build_candle_uno,
         build_dlrm,
         build_gpt,
@@ -50,6 +52,14 @@ def _model_specs():
     )
 
     return {
+        "alexnet": dict(
+            # the 5th BASELINE.json target config (AlexNet/CIFAR-10):
+            # sim at full ImageNet size, exec at the native CIFAR size
+            build=lambda cfg: build_alexnet(cfg),
+            batch=64, budget=10, loss="sparse_categorical_crossentropy",
+            exec_build=lambda cfg: build_alexnet_cifar10(cfg),
+            exec_batch=16,
+        ),
         "bert": dict(
             build=lambda cfg: build_transformer(
                 cfg, num_layers=12, hidden=512, num_heads=8, ff_dim=2048,
@@ -275,7 +285,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--models",
-        default="bert,gpt,dlrm,candle_uno,inception,resnext50,xdl,mlp")
+        default="alexnet,bert,gpt,dlrm,candle_uno,inception,resnext50,"
+                "xdl,mlp")
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--cpu-mesh", action="store_true",

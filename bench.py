@@ -162,10 +162,44 @@ def _emit_last_good_or_die():
 
 
 def main():
+    """Orchestrator: probe, then run the ENTIRE measurement in a fresh
+    subprocess with a hard deadline — the tunnel's documented failure
+    mode can wedge MID-measurement, and a wedged interpreter can only
+    be abandoned, not recovered (round-4: two rc=3 tombstones).  The
+    subprocess prints the JSON record; on timeout/failure the parent
+    falls back to the last good window."""
     devices = _probe_backend()
     if devices is None:
         _emit_last_good_or_die()
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--measure"],
+            timeout=1500.0, text=True, capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("# bench: measurement subprocess exceeded its deadline "
+              "(tunnel wedged mid-run); falling back", file=sys.stderr)
+        _emit_last_good_or_die()
+    if r.returncode == 0 and r.stdout.strip():
+        sys.stderr.write(r.stderr)
+        print(r.stdout.strip().splitlines()[-1])
+        return
+    print(f"# bench: measurement subprocess failed rc={r.returncode}; "
+          f"stderr tail:", file=sys.stderr)
+    print(r.stderr[-2000:], file=sys.stderr)
+    _emit_last_good_or_die()
+
+
+def measure():
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon plugin's sitecustomize overrides the env var; only a
+        # pre-init jax.config update reliably forces CPU
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
 
     on_tpu = devices[0].platform == "tpu" or "TPU" in str(devices[0])
     # sized for a single v5e chip; shrink on CPU so CI-style runs finish
@@ -308,4 +342,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--measure" in sys.argv:
+        measure()
+    else:
+        main()

@@ -385,6 +385,15 @@ def main():
                             calibration, time_budget_s=120.0)
         calibrate_graph(_coverage_graph(), args.devices, calibration,
                         time_budget_s=60.0)
+        # the full MoE dispatch chain (group_by/aggregate/cache) probes
+        # from the zoo's MoE builder (reference: moe.cc self-reports
+        # throughput the same way the other examples do)
+        from flexflow_tpu.models import build_moe
+
+        calibrate_graph(
+            build_moe(ff.FFConfig(batch_size=32,
+                                  num_devices=args.devices)).graph,
+            args.devices, calibration, time_budget_s=60.0)
         calibration.save(args.calibration_file)
         print(f"# calibrated {len(calibration)} (op, view) records "
               f"on {jax.devices()[0].platform}")

@@ -296,6 +296,13 @@ def main():
     ap.add_argument("--calibrate", action="store_true",
                     help="measure per-(op,view) costs on the live backend "
                          "first (search/calibration.py) and rank with them")
+    ap.add_argument("--calibrate-only", action="store_true",
+                    help="save the calibration table and exit without "
+                         "touching the BENCH_SEARCH artifacts — the "
+                         "on-TPU half of the calibrate-on-TPU / "
+                         "execute-on-CPU-mesh split")
+    ap.add_argument("--calibrate-budget", type=float, default=120.0,
+                    help="per-model probe wall budget in seconds")
     ap.add_argument("--load-calibration", action="store_true",
                     help="rank with an existing --calibration-file (e.g. "
                          "measured earlier on the real TPU) instead of "
@@ -319,6 +326,8 @@ def main():
 
     specs = _model_specs()
     names = [n for n in args.models.split(",") if n in specs]
+    if args.calibrate_only:
+        args.calibrate = True
     calibration = None
     if args.load_calibration:
         from flexflow_tpu.search.calibration import CalibrationTable
@@ -382,9 +391,12 @@ def main():
             cfg = ff.FFConfig(batch_size=specs[n]["batch"],
                               num_devices=args.devices)
             calibrate_graph(specs[n]["build"](cfg).graph, args.devices,
-                            calibration, time_budget_s=120.0)
+                            calibration,
+                            time_budget_s=args.calibrate_budget)
+            print(f"# calibration after {n}: {len(calibration)} records, "
+                  f"{calibration.num_clusters} clusters")
         calibrate_graph(_coverage_graph(), args.devices, calibration,
-                        time_budget_s=60.0)
+                        time_budget_s=args.calibrate_budget / 2)
         # the full MoE dispatch chain (group_by/aggregate/cache) probes
         # from the zoo's MoE builder (reference: moe.cc self-reports
         # throughput the same way the other examples do)
@@ -393,10 +405,16 @@ def main():
         calibrate_graph(
             build_moe(ff.FFConfig(batch_size=32,
                                   num_devices=args.devices)).graph,
-            args.devices, calibration, time_budget_s=60.0)
+            args.devices, calibration,
+            time_budget_s=args.calibrate_budget / 2)
         calibration.save(args.calibration_file)
-        print(f"# calibrated {len(calibration)} (op, view) records "
+        print(f"# calibrated {len(calibration)} (op, view) records + "
+              f"{calibration.num_clusters} fusion clusters "
               f"on {jax.devices()[0].platform}")
+    if args.calibrate_only:
+        # applies to the --load-calibration combination too: the flag's
+        # contract is "never touch the BENCH_SEARCH artifacts"
+        return
 
     report = {"devices": args.devices,
               "calibrated": bool(calibration) and len(calibration) > 0,

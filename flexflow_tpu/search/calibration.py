@@ -211,6 +211,21 @@ class _ChainProbe:
         return outs
 
 
+def _any_cluster_unmeasured(table: CalibrationTable, clusters,
+                            num_devices: int) -> bool:
+    """True when some (cluster, producer-view) probe is not yet in the
+    table — the condition under which calibrate_graph reserves budget
+    for cluster probing."""
+    from flexflow_tpu.search.views import candidate_views
+
+    for producer, chain in clusters:
+        ops = [producer.op] + [c.op for c in chain]
+        for mv in candidate_views(producer.op, num_devices):
+            if table.get_cluster(ops, mv) is None:
+                return True
+    return False
+
+
 # matmul-family producers whose follower chains XLA fuses
 _CLUSTER_HEADS = {"linear", "conv2d", "batch_matmul"}
 
@@ -285,13 +300,16 @@ def calibrate_clusters(
     table: CalibrationTable,
     time_budget_s: float = 60.0,
     repeats: int = 3,
+    clusters=None,
 ) -> CalibrationTable:
     """Measure every fusion cluster of ``graph`` at the producer's
-    candidate views (budget-bounded, resumable like calibrate_graph)."""
+    candidate views (budget-bounded, resumable like calibrate_graph).
+    ``clusters`` accepts a precomputed find_clusters(graph) result."""
     from flexflow_tpu.search.views import candidate_views
 
     deadline = time.monotonic() + time_budget_s
-    for producer, chain in find_clusters(graph):
+    for producer, chain in (find_clusters(graph) if clusters is None
+                            else clusters):
         ops = [producer.op] + [c.op for c in chain]
         for mv in candidate_views(producer.op, num_devices):
             if table.get_cluster(ops, mv) is not None:
@@ -310,19 +328,32 @@ def calibrate_graph(
     table: Optional[CalibrationTable] = None,
     time_budget_s: float = 120.0,
     repeats: int = 3,
+    cluster_fraction: float = 0.25,
 ) -> CalibrationTable:
     """Fill ``table`` with measurements for every distinct
     (op signature, candidate view) in ``graph`` — the probe set the
     search will actually query (reference measures lazily mid-search,
     simulator.cc:515; measuring up front keeps the search itself pure).
     Budget-bounded: stops adding new probes when the wall budget is
-    spent (existing entries are never re-measured)."""
+    spent (existing entries are never re-measured).
+
+    Probe order is round-robin ACROSS op kinds, not topological: a
+    topo walk lets the most frequent kind eat the whole budget (the
+    round-3 table ended with 87 ``linear`` records and zero for
+    softmax/layernorm/pool — exactly the ops the flagship spends real
+    time in), whereas one-probe-per-kind-per-cycle leaves every kind
+    represented when the clock runs out.  ``cluster_fraction`` of the
+    budget is RESERVED for fusion-cluster probes when the graph has
+    any — leftover-only scheduling meant zero cluster records ever
+    got measured."""
     from flexflow_tpu.search.views import boundary_views, candidate_views
 
     # NOT `table or ...`: an empty CalibrationTable is falsy (__len__ == 0),
     # and the caller's table must be filled in place
     table = table if table is not None else CalibrationTable()
     deadline = time.monotonic() + time_budget_s
+    by_kind: Dict[str, list] = {}
+    queued = set()
     for node in graph.topo_order():
         op = node.op
         views = list(candidate_views(op, num_devices))
@@ -330,24 +361,46 @@ def calibrate_graph(
             if bv not in views:
                 views.append(bv)
         for mv in views:
-            if table.get(op, mv) is not None:
+            k = CalibrationTable.key(op, mv)
+            if k in queued or table._t.get(k) is not None:
                 continue
-            if time.monotonic() > deadline:
+            queued.add(k)
+            by_kind.setdefault(op.op_type.value, []).append((op, mv))
+    clusters = find_clusters(graph)
+    clusters_missing = _any_cluster_unmeasured(
+        table, clusters, num_devices)
+    op_deadline = deadline
+    if clusters_missing:
+        # reserve only when there is an unmeasured (cluster, view) probe
+        # to spend it on: a resumed run with full cluster coverage would
+        # otherwise stop op probing at 75% and return the rest unused
+        op_deadline -= cluster_fraction * time_budget_s
+    queues = [q for _, q in sorted(by_kind.items())]
+    spent = False
+    while queues and not spent:
+        for q in queues:
+            if not q:
+                continue
+            if time.monotonic() > op_deadline:
                 from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
                 log.log(
-                    f"calibration budget ({time_budget_s:.0f}s) spent at "
-                    f"node {node.op.name!r}: later (op, view) probes keep "
-                    f"the analytic roofline"
+                    f"calibration budget ({time_budget_s:.0f}s) spent with "
+                    f"{sum(len(x) for x in queues)} probes unmeasured: "
+                    f"those (op, view) pairs keep the analytic roofline"
                 )
-                return table
+                spent = True
+                break
+            op, mv = q.pop(0)
             t = measure_op_view(op, mv, repeats=repeats)
             if t is not None and math.isfinite(t) and t > 0:
                 table.put(op, mv, t)
-    # leftover budget goes to fusion-cluster probes (the refinement over
-    # lone-op upper bounds); per-op coverage keeps priority
+        queues = [q for q in queues if q]
+    # remaining budget (incl. the reserved fraction) goes to
+    # fusion-cluster probes — the refinement over lone-op upper bounds
     remaining = deadline - time.monotonic()
-    if remaining > 1.0:
+    if remaining > 1.0 and clusters_missing:
         calibrate_clusters(graph, num_devices, table,
-                           time_budget_s=remaining, repeats=repeats)
+                           time_budget_s=remaining, repeats=repeats,
+                           clusters=clusters)
     return table

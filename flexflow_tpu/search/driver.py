@@ -478,12 +478,19 @@ def optimize_strategy(
                 if deadline is not None:
                     budget = min(budget, max(0.0, deadline - time.monotonic()))
                 n_before = len(calibration)
+                ncl_before = calibration.num_clusters
                 if budget > 0:
                     calibrate_graph(g2, n, calibration, time_budget_s=budget)
-                if len(calibration) > n_before:
+                if (len(calibration) > n_before
+                        or calibration.num_clusters > ncl_before):
+                    # cluster-only growth counts: a rewrite with fully
+                    # pre-measured (op, view)s can still gain fusion-
+                    # chain records, which simulate() consults
                     log.log(
                         f"probed {len(calibration) - n_before} rewritten-"
-                        f"graph records; re-scoring on equal footing"
+                        f"graph records + "
+                        f"{calibration.num_clusters - ncl_before} clusters; "
+                        f"re-scoring on equal footing"
                     )
                     if config.calibration_file:
                         calibration.save(config.calibration_file)

@@ -278,3 +278,66 @@ def test_cluster_probe_and_simulator_override(tmp_path):
     cheap = Simulator(cfg.machine_spec, num_devices=8,
                       calibration=injected).simulate(m.graph, strat)
     assert cheap < base
+
+
+def test_cluster_reservation_only_when_unmeasured(monkeypatch):
+    """The 25% cluster-budget reservation must key on MISSING cluster
+    probes, not on mere cluster presence: a resumed run whose clusters
+    are fully measured would otherwise stop op probing at 75% of the
+    budget and return the reserved time unused.  Deterministic via a
+    fake clock + fake probes (each op probe 'costs' 10s), so the budget
+    arithmetic — not host speed — decides what gets measured."""
+    from flexflow_tpu.search import calibration as cal
+
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([64, 128])
+    t = m.dense(x, 256, name="fc")
+    t = m.gelu(t, name="act")
+    g = m.graph
+
+    clusters = cal.find_clusters(g)
+    assert clusters
+    clock = [0.0]
+    monkeypatch.setattr(cal.time, "monotonic", lambda: clock[0])
+
+    def fake_op_probe(op, mv, repeats=3, **kw):
+        clock[0] += 10.0
+        return 0.001
+
+    def fake_cluster_probe(producer, chain, mv, repeats=3):
+        clock[0] += 10.0
+        return 0.002
+
+    monkeypatch.setattr(cal, "measure_op_view", fake_op_probe)
+    monkeypatch.setattr(cal, "measure_cluster", fake_cluster_probe)
+
+    # learn the full queue size with an effectively unlimited budget
+    probe_all = cal.calibrate_graph(g, 8, CalibrationTable(),
+                                    time_budget_s=1e9)
+    n_ops, n_cl = len(probe_all), probe_all.num_clusters
+    # the budget arithmetic below only discriminates with >=6 queued op
+    # probes (0.75*n + 1 < n); guard the regime, not just non-emptiness
+    assert n_ops >= 6 and n_cl >= 1
+
+    # Case 1: clusters fully pre-measured -> NO reservation; a budget of
+    # exactly 10s/op must measure every queued op probe.  Under the
+    # keyed-on-presence regression op probing would stop at 75% of the
+    # budget and strand the rest (0.75*n + 1 < n for n > 4).
+    pre = CalibrationTable()
+    pre._clusters = dict(probe_all._clusters)
+    assert not cal._any_cluster_unmeasured(pre, clusters, 8)
+    clock[0] = 0.0
+    cal.calibrate_graph(g, 8, pre, time_budget_s=10.0 * n_ops + 5.0)
+    assert len(pre) == n_ops, (
+        f"full budget must reach all {n_ops} op probes when no cluster "
+        f"probe is missing; got {len(pre)}"
+    )
+
+    # Case 2: clusters unmeasured -> reservation applies; the same
+    # budget stops op probing early and spends the tail on clusters.
+    fresh = CalibrationTable()
+    clock[0] = 0.0
+    cal.calibrate_graph(g, 8, fresh, time_budget_s=10.0 * n_ops + 5.0)
+    assert len(fresh) < n_ops, "reservation should starve some op probes"
+    assert fresh.num_clusters >= 1, "reserved budget must reach clusters"

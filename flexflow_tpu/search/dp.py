@@ -168,14 +168,13 @@ class SearchHelper:
     # recursion in C++ for the default cost currency — the reference
     # keeps this loop in C++ for the same reason (graph.cc:79-295).
     # Eligibility: no placement-overlap credit (starts are cost-inert in
-    # the default currency — the planning mode stays Python), no
-    # calibration fusion clusters (strategy-dependent scaling), <=256
-    # nodes, and every pinned view must exist in the exported view sets.
+    # the default currency — the planning mode stays Python) and <=256
+    # nodes; every pinned view must exist in the exported view sets.
+    # Fusion-cluster ratios are per-(member, own-view) quantities
+    # (simulate()'s cluster_scale note) and bake into the exported rows
+    # — a cluster-bearing table no longer forces the python path.
     def _native_dp_ctx(self, graph: Graph):
         if self.sim.placement_overlap:
-            return None
-        cal = self.sim.cost.calibration
-        if cal is not None and getattr(cal, "num_clusters", 0) > 0:
             return None
         if graph.num_nodes > 256 or graph.num_nodes == 0:
             return None
@@ -187,9 +186,16 @@ class SearchHelper:
         # CostModel can be reallocated to a new one and validate a
         # stale digest; holding the reference prevents address reuse
         # outright
+        cal = self.sim.cost.calibration
         stamp = (
             graph.hash(), self.num_devices, self.sim.machine,
-            self.sim.cost, self.sim.cost.calibration,
+            self.sim.cost, cal,
+            # content fingerprint: the same table OBJECT mutated in
+            # place (driver's in-place recalibration pattern) must
+            # invalidate the ctx, or baked rows keep pre-mutation
+            # cluster scaling while the python engine sees new records
+            len(cal) if cal is not None else -1,
+            getattr(cal, "num_clusters", 0) if cal is not None else -1,
             self.sim.inference,
             self.leaf_threshold, self.max_bottleneck_tries,
         )
@@ -347,12 +353,28 @@ class SearchHelper:
         node_off = _np.zeros(n + 1, dtype=_np.int32)
         for i, d in enumerate(digests):
             node_off[i + 1] = node_off[i] + len(d["views"])
+        # digests are shared per op SIGNATURE across graphs; fusion-
+        # cluster scaling is graph-contextual (chain membership), so it
+        # adjusts a per-graph COPY of the rows here, never the cache
+        rows_list = [d["rows"] for d in digests]
+        membership = sim.cluster_membership(graph)
+        if membership:
+            for guid, cm in membership.items():
+                i = index[guid]
+                d = digests[i]
+                new = d["rows"].copy()
+                for vi, mv in enumerate(d["views"]):
+                    if not d["valid"][vi]:
+                        continue
+                    new[vi] = sim.cluster_scaled_costs(
+                        topo[i], mv, tuple(new[vi]), membership)
+                rows_list[i] = new
         ndp.set_views(
             node_off,
-            _np.concatenate([d["rows"][:, 0] for d in digests]),
-            _np.concatenate([d["rows"][:, 1] for d in digests]),
-            _np.concatenate([d["rows"][:, 2] for d in digests]),
-            _np.concatenate([d["rows"][:, 3] for d in digests]),
+            _np.concatenate([r[:, 0] for r in rows_list]),
+            _np.concatenate([r[:, 1] for r in rows_list]),
+            _np.concatenate([r[:, 2] for r in rows_list]),
+            _np.concatenate([r[:, 3] for r in rows_list]),
             _np.concatenate([d["parts"] for d in digests]),
             _np.concatenate([d["valid"] for d in digests]),
         )

@@ -175,26 +175,31 @@ class Simulator:
             shardings[node.guid] = (mv, osh)
 
         # measured fusion-cluster overrides: when a producer+followers
-        # chain shares one view and the calibration table holds a fused
-        # measurement, scale every member's compute by the measured
-        # fused-over-lone ratio (lone probes are upper bounds; the
-        # cluster record is what XLA actually runs).  The optimizer
-        # update term is NOT scaled — fusion doesn't shrink it.
+        # chain member's view has a fused measurement, scale the
+        # member's compute by the measured fused-over-lone ratio (lone
+        # probes are upper bounds; the cluster record is what XLA
+        # actually runs).  The ratio is keyed on EACH MEMBER'S OWN view
+        # — a pure per-(node, view) quantity both engines can bake,
+        # keeping native/python parity exact.  For the dominant case (a
+        # chain sharing one view, which resharding-inside-an-elementwise
+        # -chain xfer costs enforce) this equals the chain-uniform
+        # semantics; a member resharded away from its head keeps its
+        # own-view ratio even though XLA would break the fusion there —
+        # an accepted under-charge on strategies the xfer penalty
+        # already rules out.  The optimizer update term is NOT scaled —
+        # fusion doesn't shrink it.
         cluster_scale: Dict[int, Tuple[float, float]] = {}
         cal = self.cost.calibration
         if cal is not None and getattr(cal, "num_clusters", 0) > 0:
             for members in self._cluster_chains(graph):
                 if any(m.guid not in shardings for m in members):
                     continue
-                mv0 = shardings[members[0].guid][0]
-                if any(shardings[m.guid][0] != mv0 for m in members[1:]):
-                    continue
-                got = self._cluster_ratio(members, mv0)
-                if got is None:
-                    continue
-                r, upds = got
-                for m, upd in zip(members, upds):
-                    cluster_scale[m.guid] = (r, upd)
+                for pos, m in enumerate(members):
+                    got = self._cluster_ratio(members, shardings[m.guid][0])
+                    if got is None:
+                        continue
+                    r, upds = got
+                    cluster_scale[m.guid] = (r, upds[pos])
 
         end_time = 0.0
         end_comm = 0.0
@@ -330,6 +335,36 @@ class Simulator:
         self._cluster_ratio_cache[key] = result
         return result
 
+    def cluster_membership(self, graph: Graph):
+        """guid -> (chain members, position) for every fusion-cluster
+        member of ``graph``, or an empty dict without cluster records.
+        Nodes belong to at most one chain (heads are matmul-family,
+        followers elementwise — disjoint sets; followers extend down
+        sole-consumer links)."""
+        out: Dict[int, Tuple[list, int]] = {}
+        cal = self.cost.calibration
+        if cal is not None and getattr(cal, "num_clusters", 0) > 0:
+            for members in self._cluster_chains(graph):
+                for pos, m in enumerate(members):
+                    out[m.guid] = (members, pos)
+        return out
+
+    def cluster_scaled_costs(self, node, mv, costs, membership):
+        """Apply the per-member-own-view fusion-cluster ratio to one
+        (node, view) cost row ``(fwd, full, sync, mem)`` — the SAME
+        formula simulate() applies, so baked native rows stay parity-
+        exact with the python engine."""
+        cm = membership.get(node.guid)
+        if cm is None:
+            return costs
+        got = self._cluster_ratio(cm[0], mv)
+        if got is None:
+            return costs
+        r, upds = got
+        fwd, full, sync, m_bytes = costs
+        upd = upds[cm[1]]
+        return (fwd * r, (full - upd) * r + upd, sync, m_bytes)
+
     # ------------------------------------------------------------------
     def build_native(self, graph: Graph, node_views: Dict[int, list]):
         """Digest (graph, candidate views) onto the native C++ engine
@@ -338,22 +373,18 @@ class Simulator:
 
         ``node_views[guid]`` lists each node's registrable views in
         order; view indices in native assignments refer to these lists.
-        Semantics match ``simulate`` exactly (tests assert equality).
-        Fusion-cluster overrides couple costs ACROSS nodes (the ratio
-        applies only when all chain members share a view), which the
-        native engine's independent per-node cost model cannot express
-        — with cluster records present we decline and callers use the
-        python engine, keeping the two engines' answers identical.
+        Semantics match ``simulate`` exactly (tests assert equality);
+        fusion-cluster ratios are keyed per (member, own view) — a pure
+        per-(node, view) quantity — so they bake into the exported cost
+        rows (see simulate()'s cluster_scale note).
         """
-        cal = self.cost.calibration
-        if cal is not None and getattr(cal, "num_clusters", 0) > 0:
-            return None
         from flexflow_tpu import native
 
         if native.get_lib() is None:
             return None
         topo = graph.topo_order()
         index = {n.guid: i for i, n in enumerate(topo)}
+        membership = self.cluster_membership(graph)
         ns = native.NativeSimGraph(len(topo), self.num_devices)
         ns.set_mem_cap(self.machine.hbm_capacity)
         annots = {}  # (node_index, view_index) -> OpSharding | None
@@ -364,7 +395,8 @@ class Simulator:
                 if osh is None:
                     ns.add_view(i, 0.0, 0.0, 0.0, [], [], valid=False)
                     continue
-                fwd, full, sync, m_bytes = self._node_costs(node, mv)
+                fwd, full, sync, m_bytes = self.cluster_scaled_costs(
+                    node, mv, self._node_costs(node, mv), membership)
                 comm_devs = sorted(
                     self.view_device_set(mv, use_start=self.placement_overlap)
                 )

@@ -204,7 +204,8 @@ def test_native_dp_matches_python_dp():
             g = build(cfg).graph
         h_native = SearchHelper(Simulator.for_config(cfg), 8)
         c_native, s_native = h_native.graph_cost(g)
-        assert getattr(g, "_ndp_ctx", None) not in (None, "ineligible"), (
+        ctx = getattr(g, "_ndp_ctx", None)
+        assert ctx not in (None, "ineligible") and ctx[1] is not None, (
             f"{name}: native DP did not engage")
         g._ndp_ctx = "ineligible"  # force the Python path
         h_py = SearchHelper(Simulator.for_config(cfg), 8)
@@ -229,3 +230,73 @@ def test_native_dp_respects_fixed_views():
     cost, strat = h.graph_cost(g, fixed={node.guid: pin})
     assert strat[node.guid] == pin
     assert math.isfinite(cost)
+
+
+def test_native_simulate_matches_python_with_clusters():
+    """Fusion-cluster ratios are per-(member, own-view) quantities that
+    bake into the native cost rows — a cluster-bearing calibration
+    table must no longer force the python engine, and the two engines
+    must agree bit-for-bit on random (incl. non-uniform-chain)
+    assignments."""
+    from flexflow_tpu.search.calibration import CalibrationTable, find_clusters
+
+    g = build_model_graph()
+    chains = find_clusters(g)
+    assert chains, "model graph must contain a fusable chain"
+    producer, chain = chains[0]
+    ops = [producer.op] + [c.op for c in chain]
+
+    table = CalibrationTable()
+    table.backend = "cpu"
+    # inject fused measurements at a few of the producer's views: half
+    # the (arbitrary) lone-sum scale, so the ratio engages
+    for mv in candidate_views(producer.op, 8, max_views=8):
+        table.put_cluster(ops, mv, 1e-5)
+    sim = Simulator(MachineSpec(num_devices=8), calibration=table)
+
+    topo = g.topo_order()
+    node_views = {}
+    for node in topo:
+        views = candidate_views(node.op, 8, max_views=8)
+        if not views:
+            views = [node.op.fixed_machine_view()
+                     or MachineView.trivial(node.op.output_shapes[0].ndim)]
+        node_views[node.guid] = views
+    built = sim.build_native(g, node_views)
+    assert built is not None, (
+        "cluster-bearing table must not decline the native digest")
+    ns, index = built
+
+    rng = np.random.default_rng(7)
+    checked_scaled = False
+    for _ in range(60):
+        assign = {}
+        native_assign = [0] * len(topo)
+        for node in topo:
+            vi = int(rng.integers(0, len(node_views[node.guid])))
+            assign[node.guid] = node_views[node.guid][vi]
+            native_assign[index[node.guid]] = vi
+        if sim._cluster_ratio(
+                [producer] + list(chain), assign[producer.guid]) is not None:
+            checked_scaled = True
+        for include_update in (True, False):
+            py = sim.simulate(g, assign, include_update=include_update)
+            nat = ns.simulate(native_assign, include_update=include_update)
+            if math.isinf(py):
+                assert math.isinf(nat)
+            else:
+                assert abs(py - nat) <= 1e-12 + 1e-9 * abs(py), (py, nat)
+    assert checked_scaled, "no draw exercised a measured cluster view"
+
+    # the full native DP recursion must also engage and agree
+    h_native = SearchHelper(
+        Simulator(MachineSpec(num_devices=8), calibration=table), 8)
+    c_native, s_native = h_native.graph_cost(g)
+    ctx = getattr(g, "_ndp_ctx", None)
+    assert ctx not in (None, "ineligible") and ctx[1] is not None, (
+        "native DP must engage with a cluster-bearing table")
+    g._ndp_ctx = "ineligible"
+    h_py = SearchHelper(
+        Simulator(MachineSpec(num_devices=8), calibration=table), 8)
+    c_py, _ = h_py.graph_cost(g)
+    assert c_native == pytest.approx(c_py, rel=1e-9), (c_native, c_py)

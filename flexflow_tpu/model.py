@@ -169,13 +169,14 @@ class FFModel:
     def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
                             embed_dim: int, num_heads: int, kdim: int = 0,
                             vdim: int = 0, dropout: float = 0.0, bias: bool = False,
-                            causal: bool = False, kernel_initializer=None,
+                            causal: bool = False, sp_mode: str = "ring",
+                            kernel_initializer=None,
                             name=None) -> Tensor:
         op = O.MultiHeadAttentionOp(
             self._fresh_name("attention", name),
             [self._shape_of(query), self._shape_of(key), self._shape_of(value)],
             embed_dim=embed_dim, num_heads=num_heads, kdim=kdim, vdim=vdim,
-            dropout=dropout, use_bias=bias, causal=causal,
+            dropout=dropout, use_bias=bias, causal=causal, sp_mode=sp_mode,
             kernel_initializer=kernel_initializer)
         return self._add_op(op, [query, key, value])[0]
 

@@ -42,10 +42,20 @@ class MultiHeadAttentionOp(Operator):
     """query [B, Sq, E], key [B, Sk, E], value [B, Sk, E] -> [B, Sq, E].
 
     attrs: embed_dim, num_heads, kdim, vdim, dropout, use_bias, causal,
-    use_flash (prefer the Pallas kernel when on TPU).
+    use_flash (prefer the Pallas kernel when on TPU), sp_mode (which
+    sequence-parallel scheme serves a seq-sharded strategy: "ring" —
+    K/V rotation, parallel/ring_attention.py; "ulysses" — all-to-all
+    head exchange, parallel/ulysses.py, needs num_heads divisible by
+    the seq degree; "auto" — ulysses for non-causal divisible shapes
+    where its single exchange moves strictly fewer bytes than the
+    ring's n-1 K/V hops, ring otherwise incl. causal, whose zigzag
+    schedule overlaps comm with compute).
     """
 
     op_type = OperatorType.MULTIHEAD_ATTENTION
+    # sp_mode picks the multi-device SP scheme; a lone-chip probe never
+    # executes the collective, so records are shared across modes
+    _CALIBRATION_INERT_ATTRS = frozenset({"sp_mode"})
 
     def __init__(
         self,
@@ -59,11 +69,13 @@ class MultiHeadAttentionOp(Operator):
         use_bias: bool = False,
         causal: bool = False,
         use_flash: bool = True,
+        sp_mode: str = "ring",
         kernel_initializer: Initializer | None = None,
     ):
         kdim = kdim or embed_dim
         vdim = vdim or embed_dim
         assert embed_dim % num_heads == 0
+        assert sp_mode in ("ring", "ulysses", "auto"), sp_mode
         self._kernel_init = kernel_initializer or DEFAULT_WEIGHT_INIT
         super().__init__(
             name,
@@ -76,7 +88,23 @@ class MultiHeadAttentionOp(Operator):
             use_bias=use_bias,
             causal=causal,
             use_flash=use_flash,
+            sp_mode=sp_mode,
         )
+
+    def _use_ulysses(self, n: int) -> bool:
+        """Whether a seq degree of ``n`` is served by the all-to-all
+        exchange instead of the ring (falls back to ring when the head
+        count does not divide)."""
+        a = self.attrs
+        mode = a.get("sp_mode", "ring")
+        if n <= 1 or a["num_heads"] % n != 0:
+            return False
+        if mode == "ulysses":
+            return True
+        # auto: non-causal rings have no zigzag overlap advantage and
+        # ulysses moves (n-1)/n of q/k/v/out once vs the ring's n-1
+        # full K/V hops — strictly fewer bytes for n >= 2
+        return mode == "auto" and not a["causal"]
 
     def infer(self) -> Sequence[ParallelTensorShape]:
         q = self.input_shapes[0]
@@ -91,18 +119,21 @@ class MultiHeadAttentionOp(Operator):
         return self.attrs["embed_dim"] // self.attrs["num_heads"]
 
     def ring_comm_bytes(self, mv) -> Tuple[float, int, int]:
-        """(forward wire bytes per device, ring size, view slot the
-        ring rides) when the view splits the SEQUENCE dim — execution
-        then runs ring attention (parallel/ring_attention.py): the K
-        and V shards make n-1 ppermute hops each in the forward (the
-        backward re-rotates them; the cost model doubles it).  Charged
-        so sequence parallelism is not ranked as free compute-splitting
-        (the compute roofline alone would say it is).
+        """(forward wire bytes per device, seq degree, view slot the
+        collective rides) when the view splits the SEQUENCE dim —
+        execution then runs the sequence-parallel scheme ``sp_mode``
+        selects: the ring rotates the K and V shards n-1 ppermute hops
+        each (parallel/ring_attention.py), the Ulysses exchange moves
+        (n-1)/n of each of q/k/v/out through one all-to-all pair
+        (parallel/ulysses.py).  The backward re-runs the collective;
+        the cost model doubles it.  Charged so sequence parallelism is
+        not ranked as free compute-splitting (the compute roofline
+        alone would say it is).
 
         Zero for cross-attention (Sk != Sq — propagate keeps K/V whole
         and execution takes the non-ring path) and the bytes shrink by
-        the head-parallel replica degree (each device rotates only its
-        own heads' K/V columns)."""
+        the head-parallel replica degree (each device moves only its
+        own heads' columns)."""
         q, k = self.input_shapes[0], self.input_shapes[1]
         n = mv.dim_degrees[1] if len(mv.dim_degrees) > 1 else 1
         if n <= 1 or k.sizes[1] != q.sizes[1]:
@@ -110,6 +141,9 @@ class MultiHeadAttentionOp(Operator):
         b_loc = q.sizes[0] / max(mv.dim_degrees[0], 1)
         e = self.attrs["embed_dim"] / max(mv.replica_degree, 1)
         shard = b_loc * (q.sizes[1] / n) * e * q.dtype.itemsize
+        if self._use_ulysses(n):
+            # q/k/v/out each move (n-1)/n of one local shard, once
+            return 4.0 * (n - 1) / n * shard, n, 1
         return 2.0 * (n - 1) * shard, n, 1  # K and V, n-1 hops each
 
     def weight_specs(self) -> Sequence[WeightSpec]:
@@ -190,6 +224,17 @@ class MultiHeadAttentionOp(Operator):
                 stacklevel=2,
             )
         if ring_ok:
+            n = 1
+            for ax in seq_axes:
+                n *= ctx.mesh.shape[ax]
+            if self._use_ulysses(n):
+                from flexflow_tpu.parallel.ulysses import ulysses_attention
+
+                return ulysses_attention(
+                    qh, kh, vh, ctx.mesh, tuple(seq_axes),
+                    causal=a["causal"], scale=scale,
+                    batch_axes=(ctx.slot_axes or {}).get(0, ()),
+                )
             from flexflow_tpu.parallel.ring_attention import ring_attention
 
             return ring_attention(

@@ -248,6 +248,11 @@ class Operator:
         return 1
 
     # ---- identity --------------------------------------------------------
+    # attrs that never change the lone-op kernel a single-chip probe
+    # measures (they select a multi-device execution scheme): excluded
+    # from calibration_signature so one probe record serves every mode
+    _CALIBRATION_INERT_ATTRS: frozenset = frozenset()
+
     def signature(self) -> Tuple:
         """Structural identity: two ops with equal signatures have equal
         shapes/costs/propagation.  Cached — Operator is immutable."""
@@ -261,6 +266,21 @@ class Operator:
             )
             self._sig_cache = sig
         return sig
+
+    def calibration_signature(self) -> Tuple:
+        """Probe-record identity: ``signature()`` minus the
+        _CALIBRATION_INERT_ATTRS — a single-chip measurement cannot
+        depend on them, so keying records by them would fragment the
+        table (e.g. three copies of every attention record, one per
+        sp_mode)."""
+        if not self._CALIBRATION_INERT_ATTRS:
+            return self.signature()
+        sig = self.signature()
+        attrs = tuple(
+            (k, v) for k, v in sig[3]
+            if k not in self._CALIBRATION_INERT_ATTRS
+        )
+        return sig[:3] + (attrs,)
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.name})"

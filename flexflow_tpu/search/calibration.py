@@ -43,11 +43,21 @@ class CalibrationTable:
         # truth for what the fused group actually costs.
         self._clusters: Dict[Tuple, float] = {}
         self.backend: Optional[str] = None  # platform the probes ran on
+        # bumped on EVERY put (including same-key overwrites): consumers
+        # with derived caches (simulator ratio cache, native DP digests)
+        # fingerprint this to notice in-place mutation — len() alone
+        # misses re-measurements of existing keys
+        self.version: int = 0
+
+    @staticmethod
+    def _sig(op) -> str:
+        getsig = getattr(op, "calibration_signature", None)
+        return repr(getsig() if getsig is not None else op.signature())
 
     @staticmethod
     def key(op, mv: MachineView) -> Key:
         return (
-            repr(op.signature()),
+            CalibrationTable._sig(op),
             tuple(mv.dim_degrees),
             int(mv.replica_degree),
         )
@@ -57,11 +67,12 @@ class CalibrationTable:
 
     def put(self, op, mv: MachineView, seconds: float) -> None:
         self._t[self.key(op, mv)] = float(seconds)
+        self.version += 1
 
     @staticmethod
     def cluster_key(ops, mv: MachineView) -> Tuple:
         return (
-            tuple(repr(op.signature()) for op in ops),
+            tuple(CalibrationTable._sig(op) for op in ops),
             tuple(mv.dim_degrees),
             int(mv.replica_degree),
         )
@@ -71,6 +82,7 @@ class CalibrationTable:
 
     def put_cluster(self, ops, mv: MachineView, seconds: float) -> None:
         self._clusters[self.cluster_key(ops, mv)] = float(seconds)
+        self.version += 1
 
     @property
     def num_clusters(self) -> int:
@@ -117,6 +129,7 @@ class CalibrationTable:
             table._clusters[
                 (tuple(r["sigs"]), tuple(r["degrees"]), int(r["replica"]))
             ] = float(r["seconds"])
+        table.version = len(table._t) + len(table._clusters)
         return table
 
 

@@ -191,11 +191,11 @@ class SearchHelper:
             graph.hash(), self.num_devices, self.sim.machine,
             self.sim.cost, cal,
             # content fingerprint: the same table OBJECT mutated in
-            # place (driver's in-place recalibration pattern) must
-            # invalidate the ctx, or baked rows keep pre-mutation
-            # cluster scaling while the python engine sees new records
-            len(cal) if cal is not None else -1,
-            getattr(cal, "num_clusters", 0) if cal is not None else -1,
+            # place (driver's in-place recalibration pattern, or a
+            # same-key re-measurement) must invalidate the ctx, or
+            # baked rows keep pre-mutation costs while the python
+            # engine sees the new records.  version bumps on EVERY put.
+            getattr(cal, "version", -1) if cal is not None else -1,
             self.sim.inference,
             self.leaf_threshold, self.max_bottleneck_tries,
         )
@@ -230,7 +230,12 @@ class SearchHelper:
         union candidate-view list, per-view (cost row, propagated
         sharding), per-budget candidate/boundary/default index lists,
         and the trivial/fixed view indices."""
-        sig = node.op.signature()
+        cal = self.sim.cost.calibration
+        # digest rows bake per-(op, view) calibration lookups, so the
+        # cache key carries the table's mutation counter — an in-place
+        # recalibration must re-bake, not reuse pre-mutation costs
+        sig = (node.op.signature(),
+               getattr(cal, "version", None) if cal is not None else None)
         hit = self._node_digest_cache.get(sig)
         if hit is not None:
             return hit

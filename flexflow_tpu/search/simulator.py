@@ -315,8 +315,16 @@ class Simulator:
 
     def _cluster_ratio(self, members, mv):
         """(fused/lone ratio, per-member update costs) for one chain at
-        one view, or None — cached per (chain signature, view)."""
+        one view, or None — cached per (chain signature, view).  The
+        cache drops wholesale when the table mutates (version bump):
+        a budget-bounded calibration RESUMED in place would otherwise
+        leave permanently-cached None results shadowing the new
+        records in both engines."""
         cal = self.cost.calibration
+        ver = getattr(cal, "version", None)
+        if getattr(self, "_cluster_cache_version", None) != ver:
+            self._cluster_ratio_cache = {}
+            self._cluster_cache_version = ver
         key = cal.cluster_key([m.op for m in members], mv)
         hit = self._cluster_ratio_cache.get(key, "miss")
         if hit != "miss":

@@ -41,6 +41,7 @@ _SCRIPTS = [
     ("digits_accuracy.py", ["-b", "32", "-e", "12"]),
     ("keras_cifar10_cnn.py", ["-b", "16", "-e", "1"]),
     ("keras_reuters_mlp.py", ["-b", "16", "-e", "1"]),
+    ("ulysses_sp.py", ["-b", "8", "-e", "1"]),
 ]
 
 _BOOT = (

@@ -505,3 +505,83 @@ def test_mha_sp_mode_ulysses_falls_back_when_heads_indivisible():
                               num_heads=3, sp_mode="ulysses")
     assert not op._use_ulysses(4)
     assert op._use_ulysses(3)
+
+
+@pytest.mark.parametrize("dt,tol", [(jnp.float32, 1e-5),
+                                    (jnp.bfloat16, 2e-2)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_xla_attention_compact_vjp_matches_autodiff(dt, tol, causal):
+    """_xla_attention's custom VJP (residuals: q/k/v + probs at
+    q.dtype, instead of autodiff's fp32 logits + fp32 probs) must match
+    the plain-autodiff einsum reference: exactly in fp32 (the residual
+    cast is the identity), to bf16 round-off under a bf16 stream."""
+    def ref(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * 0.25
+        if causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            m = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(m, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 32, 4, 16)), dt)
+               for _ in range(3))
+    o_ref = ref(q, k, v).astype(jnp.float32)
+    o_new = _xla_attention(q, k, v, causal, 0.25).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(o_new), np.asarray(o_ref),
+                               rtol=0, atol=1e-7)
+
+    for arg in range(3):
+        g_ref = jax.grad(
+            lambda *a: jnp.sum(ref(*a).astype(jnp.float32)), argnums=arg
+        )(q, k, v).astype(jnp.float32)
+        g_new = jax.grad(
+            lambda *a: jnp.sum(
+                _xla_attention(*a, causal, 0.25).astype(jnp.float32)),
+            argnums=arg,
+        )(q, k, v).astype(jnp.float32)
+        scale = max(float(jnp.max(jnp.abs(g_ref))), 1.0)
+        np.testing.assert_allclose(np.asarray(g_new) / scale,
+                                   np.asarray(g_ref) / scale,
+                                   rtol=0, atol=tol)
+
+    # the dropout branch stays on plain autodiff and still works
+    out_do = _xla_attention(q, k, v, causal, 0.25, dropout_rate=0.5,
+                            dropout_rng=jax.random.key(0))
+    assert out_do.shape == q.shape and bool(jnp.all(jnp.isfinite(
+        out_do.astype(jnp.float32))))
+
+
+def test_xla_attention_compact_vjp_fully_masked_rows():
+    """Causal cross-attention with Sq > Sk fully masks the first
+    Sq-Sk query rows; their q/k gradients must be zero exactly as the
+    where-mask VJP gives in plain autodiff (the saved probs for those
+    rows are uniform 1/Sk, NOT zero — the backward must re-zero them)."""
+    def ref(q, k, v):
+        sq, sk = q.shape[1], k.shape[1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * 0.25
+        m = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(m, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(2, 24, 4, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 16, 4, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 16, 4, 16)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(_xla_attention(q, k, v, True, 0.25)),
+        np.asarray(ref(q, k, v)), rtol=0, atol=1e-6)
+    for arg in range(3):
+        g_ref = jax.grad(lambda *a: jnp.sum(ref(*a)), argnums=arg)(q, k, v)
+        g_new = jax.grad(
+            lambda *a: jnp.sum(_xla_attention(*a, True, 0.25)),
+            argnums=arg)(q, k, v)
+        np.testing.assert_allclose(np.asarray(g_new), np.asarray(g_ref),
+                                   rtol=0, atol=1e-5)
+    # the fully-masked rows' q-grad is exactly zero
+    gq = jax.grad(lambda q: jnp.sum(_xla_attention(q, k, v, True, 0.25)))(q)
+    assert float(jnp.max(jnp.abs(gq[:, : 24 - 16]))) == 0.0

@@ -376,8 +376,9 @@ def _attn_core(q, k, v, causal, scale):
     removes the fp32 logits residual entirely; in fp32 mode the cast is
     the identity and the backward matches plain autodiff to round-off
     (same formula, fused differently).  Reverse-mode only, like the
-    Pallas kernel — jvp/jacfwd callers must use the dropout branch's
-    plain-autodiff path (custom_vjp forbids forward mode)."""
+    Pallas kernel (custom_vjp forbids forward mode) — jvp/jacfwd
+    callers set COMPACT_ATTENTION_VJP = False to get the plain-autodiff
+    einsum path back."""
     probs = _attn_logits_probs(q, k, causal, scale)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 
@@ -390,6 +391,27 @@ def _attn_core_fwd(q, k, v, causal, scale):
     return out, (q, k, v, probs)
 
 
+def _softmax_qk_grads(pf, gp, q, k, causal, scale):
+    """Shared backward tail: softmax VJP from saved fp32 probs ``pf``
+    and probs-cotangent ``gp``, then the q/k einsum grads.
+    PARTIALLY-masked entries have p == 0 exactly (exp underflow), so
+    their gradient vanishes without consulting the mask again;
+    FULLY-masked rows (i < sq-sk in causal cross-attention) softmax to
+    uniform 1/sk, not 0 — zero their logit grads the way the
+    where-mask VJP does in plain autodiff."""
+    gs = (pf * (gp - jnp.sum(pf * gp, axis=-1, keepdims=True))) * scale
+    if causal:
+        sq, sk = gs.shape[-2], gs.shape[-1]
+        if sq > sk:
+            rows = jnp.arange(sq)[:, None]
+            gs = jnp.where(rows < sq - sk, 0.0, gs)
+    gq = jnp.einsum("bhqk,bkhd->bqhd", gs.astype(q.dtype), k,
+                    preferred_element_type=jnp.float32).astype(q.dtype)
+    gk = jnp.einsum("bhqk,bqhd->bkhd", gs.astype(q.dtype), q,
+                    preferred_element_type=jnp.float32).astype(k.dtype)
+    return gq, gk
+
+
 def _attn_core_bwd(causal, scale, res, g):
     q, k, v, p = res
     pf = p.astype(jnp.float32)
@@ -397,39 +419,75 @@ def _attn_core_bwd(causal, scale, res, g):
                     preferred_element_type=jnp.float32).astype(v.dtype)
     gp = jnp.einsum("bqhd,bkhd->bhqk", g, v,
                     preferred_element_type=jnp.float32)
-    # softmax VJP from the saved probs: PARTIALLY-masked entries have
-    # p == 0 exactly (exp underflow), so their gradient vanishes
-    # without consulting the mask again
-    gs = (pf * (gp - jnp.sum(pf * gp, axis=-1, keepdims=True))) * scale
-    if causal:
-        sq, sk = gs.shape[-2], gs.shape[-1]
-        if sq > sk:
-            # FULLY-masked rows (i < sq-sk in causal cross-attention)
-            # softmax to uniform 1/sk, not 0 — zero their logit grads
-            # the way the where-mask VJP does in plain autodiff
-            rows = jnp.arange(sq)[:, None]
-            gs = jnp.where(rows < sq - sk, 0.0, gs)
-    gq = jnp.einsum("bhqk,bkhd->bqhd", gs.astype(q.dtype), k,
-                    preferred_element_type=jnp.float32).astype(q.dtype)
-    gk = jnp.einsum("bhqk,bqhd->bkhd", gs.astype(q.dtype), q,
-                    preferred_element_type=jnp.float32).astype(k.dtype)
+    gq, gk = _softmax_qk_grads(pf, gp, q, k, causal, scale)
     return gq, gk, gv
 
 
 _attn_core.defvjp(_attn_core_fwd, _attn_core_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _attn_core_dropout(q, k, v, mask, causal, scale, keep):
+    """Attention with post-softmax dropout, compact residuals: saves
+    (q, k, v, probs-at-q.dtype, bool mask) instead of autodiff's fp32
+    logits + fp32 probs + mask — the same residual diet as _attn_core
+    for the dropout-training regime (the reference's BERT workloads
+    train with attention dropout).  Reverse-mode only."""
+    # body mirrors _attn_core_dropout_fwd exactly (probs round to
+    # q.dtype BEFORE the keep-scaling) so primal and fwd agree bitwise
+    probs = _attn_logits_probs(q, k, causal, scale).astype(q.dtype)
+    dropped = jnp.where(mask, probs.astype(jnp.float32) / keep, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", dropped.astype(q.dtype), v)
+
+
+def _attn_core_dropout_fwd(q, k, v, mask, causal, scale, keep):
+    probs = _attn_logits_probs(q, k, causal, scale).astype(q.dtype)
+    dropped = jnp.where(mask, probs.astype(jnp.float32) / keep, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", dropped.astype(q.dtype), v)
+    return out, (q, k, v, probs, mask)
+
+
+def _attn_core_dropout_bwd(causal, scale, keep, res, g):
+    q, k, v, p, mask = res
+    pf = p.astype(jnp.float32)
+    dropped = jnp.where(mask, pf / keep, 0.0)
+    gv = jnp.einsum("bhqk,bqhd->bkhd", dropped.astype(q.dtype),
+                    g.astype(q.dtype),
+                    preferred_element_type=jnp.float32).astype(v.dtype)
+    g_dropped = jnp.einsum("bqhd,bkhd->bhqk", g, v,
+                           preferred_element_type=jnp.float32)
+    gp = jnp.where(mask, g_dropped / keep, 0.0)  # where-VJP of dropout
+    gq, gk = _softmax_qk_grads(pf, gp, q, k, causal, scale)
+    return gq, gk, gv, None
+
+
+_attn_core_dropout.defvjp(_attn_core_dropout_fwd, _attn_core_dropout_bwd)
+
+
+# escape hatch for forward-mode (jvp/jacfwd) callers: custom_vjp
+# forbids forward-mode autodiff, so setting this False routes
+# _xla_attention through plain-autodiff einsums (fat fp32 residuals,
+# full differentiability) — nothing in the training stack needs it
+COMPACT_ATTENTION_VJP = True
+
+
 def _xla_attention(q, k, v, causal, scale, dropout_rate=0.0, dropout_rng=None):
-    if not (dropout_rate > 0.0 and dropout_rng is not None):
+    dropout_active = dropout_rate > 0.0 and dropout_rng is not None
+    if not COMPACT_ATTENTION_VJP:
+        probs = _attn_logits_probs(q, k, causal, scale)
+        if dropout_active:
+            keep = 1.0 - dropout_rate
+            mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+            probs = jnp.where(mask, probs / keep, 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    if not dropout_active:
         return _attn_core(q, k, v, causal, float(scale))
-    # dropout keeps the plain-autodiff path: the mask belongs between
-    # softmax and the pv matmul, inside what the compact VJP treats as
-    # opaque
-    probs = _attn_logits_probs(q, k, causal, scale)
     keep = 1.0 - dropout_rate
-    mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
-    probs = jnp.where(mask, probs / keep, 0.0)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    b, sq, h, _ = q.shape
+    mask = jax.random.bernoulli(dropout_rng, keep,
+                                (b, h, sq, k.shape[1]))
+    return _attn_core_dropout(q, k, v, mask, causal, float(scale),
+                              float(keep))
 
 
 def _xla_attention_partial(q, k, v, causal, scale):

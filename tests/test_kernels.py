@@ -585,3 +585,50 @@ def test_xla_attention_compact_vjp_fully_masked_rows():
     # the fully-masked rows' q-grad is exactly zero
     gq = jax.grad(lambda q: jnp.sum(_xla_attention(q, k, v, True, 0.25)))(q)
     assert float(jnp.max(jnp.abs(gq[:, : 24 - 16]))) == 0.0
+
+
+@pytest.mark.parametrize("dt,tol", [(jnp.float32, 1e-5),
+                                    (jnp.bfloat16, 2e-2)])
+@pytest.mark.parametrize("causal,sq", [(False, 32), (True, 32), (True, 40)])
+def test_xla_attention_dropout_compact_vjp_matches_autodiff(dt, tol, causal,
+                                                            sq):
+    """The dropout branch's compact VJP (residuals: q/k/v + probs at
+    q.dtype + bool mask) must match plain autodiff of the same
+    mask-fixed computation — the BERT-family training regime."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(2, sq, 4, 16)), dt)
+    k = jnp.asarray(rng.normal(size=(2, 32, 4, 16)), dt)
+    v = jnp.asarray(rng.normal(size=(2, 32, 4, 16)), dt)
+    keep = 0.8
+    mask = jax.random.bernoulli(jax.random.key(9), keep, (2, 4, sq, 32))
+
+    def ref(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * 0.25
+        if causal:
+            sq_, sk_ = logits.shape[-2], logits.shape[-1]
+            cm = jnp.tril(jnp.ones((sq_, sk_), bool), k=sk_ - sq_)
+            logits = jnp.where(cm, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        d = jnp.where(mask, p.astype(jnp.float32) / keep, 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", d.astype(q.dtype), v)
+
+    from flexflow_tpu.kernels.flash_attention import _attn_core_dropout
+
+    o_ref = ref(q, k, v).astype(jnp.float32)
+    o_new = _attn_core_dropout(q, k, v, mask, causal, 0.25,
+                               keep).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(o_new), np.asarray(o_ref),
+                               rtol=0, atol=1e-6)
+    for arg in range(3):
+        g_ref = jax.grad(
+            lambda *a: jnp.sum(ref(*a).astype(jnp.float32)), argnums=arg
+        )(q, k, v).astype(jnp.float32)
+        g_new = jax.grad(
+            lambda *a: jnp.sum(_attn_core_dropout(
+                *a, mask, causal, 0.25, keep).astype(jnp.float32)),
+            argnums=arg)(q, k, v).astype(jnp.float32)
+        s = max(float(jnp.max(jnp.abs(g_ref))), 1.0)
+        np.testing.assert_allclose(np.asarray(g_new) / s,
+                                   np.asarray(g_ref) / s,
+                                   rtol=0, atol=tol)

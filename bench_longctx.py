@@ -86,8 +86,11 @@ def attention_rows(seqs, heads, head_dim, tokens):
 
         row = {"seq": s, "batch": b}
         row["flash_ms"] = round(_fence_timer(grad_of(fl_loss), q, k, v) * 1e3, 3)
-        # the materializing path needs B*H*S^2 fp32 logits twice (probs
-        # in backward as well); past the cliff it OOMs — record that
+        # the einsum path still materializes the [Sq,Sk] block per
+        # layer: fp32 scores transiently in the forward plus the
+        # compact VJP's probs-at-stream-dtype residual (the fp32
+        # logits+probs RESIDUALS are gone since the compact backward);
+        # past the cliff it OOMs — record that
         logits_gb = 2 * b * heads * s * s * 4 / 1e9
         if logits_gb <= 8.0:
             try:
@@ -192,9 +195,10 @@ def main():
         f"{train['step_ms']} ms/step ({train['tokens_per_s']} tokens/s) "
         f"on {backend}.",
         "",
-        "Multi-chip sequence parallelism (ring attention over the mesh "
-        "seq axis) is exercised by tests/test_parallel.py and "
-        "__graft_entry__.dryrun_multichip on the 8-device mesh.",
+        "Multi-chip sequence parallelism — ring attention over the mesh "
+        "seq axis, and the Ulysses all-to-all head exchange "
+        "(sp_mode=\"ulysses\") — is exercised by tests/test_kernels.py "
+        "and __graft_entry__.dryrun_multichip on the 8-device mesh.",
     ]
     with open("BENCH_LONGCTX.md", "w") as f:
         f.write("\n".join(lines) + "\n")

@@ -87,11 +87,11 @@ def attention_rows(seqs, heads, head_dim, tokens):
         row = {"seq": s, "batch": b}
         row["flash_ms"] = round(_fence_timer(grad_of(fl_loss), q, k, v) * 1e3, 3)
         # the einsum path still materializes the [Sq,Sk] block per
-        # layer: fp32 scores transiently in the forward plus the
-        # compact VJP's probs-at-stream-dtype residual (the fp32
-        # logits+probs RESIDUALS are gone since the compact backward);
-        # past the cliff it OOMs — record that
-        logits_gb = 2 * b * heads * s * s * 4 / 1e9
+        # layer: fp32 scores transiently in the forward (4 B/elt) plus
+        # the compact VJP's probs-at-stream-dtype residual (2 B/elt in
+        # bf16 — the fp32 logits+probs RESIDUALS are gone since the
+        # compact backward); past the cliff it OOMs — record that
+        logits_gb = b * heads * s * s * (4 + 2) / 1e9
         if logits_gb <= 8.0:
             try:
                 row["xla_ms"] = round(

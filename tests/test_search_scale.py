@@ -120,3 +120,46 @@ def test_default_search_gpt_under_60s_and_splits_lm_head():
     assert hv.replica_degree > 1 or any(
         d > 1 for d in hv.dim_degrees[1:]
     ), f"lm_head stayed pure-DP: {hv}"
+
+
+def test_calibrated_search_stays_native_fast():
+    """Regression gate: a CLUSTER-bearing calibration table must not
+    knock the search off the native DP engine (pre-fix, the committed
+    CALIBRATION.json's 17 cluster records forced the python path:
+    calibrated resnext50/inception searches took 66s/40s vs <1s
+    native).  Uses the committed on-chip table when present, a
+    synthetic cluster-bearing one otherwise."""
+    import os
+
+    import pytest
+
+    from flexflow_tpu import native as _native
+    from flexflow_tpu.search.calibration import CalibrationTable
+
+    if _native.get_lib() is None:
+        pytest.skip("native library not built (see tests/test_native.py)")
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "CALIBRATION.json")
+    if os.path.exists(path):
+        table = CalibrationTable.load(path)
+    else:  # synthesize: any cluster record triggers the old exclusion
+        table = CalibrationTable()
+        table._clusters[(("x",), (1,), 1)] = 1e-5
+    assert table.num_clusters > 0
+
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, search_budget=10)
+    m = build_inception_v3(cfg)
+    sim = Simulator(cfg.machine_spec, num_devices=8, calibration=table)
+    from flexflow_tpu.search.dp import SearchHelper
+
+    helper = SearchHelper(sim, 8)
+    t0 = time.monotonic()
+    cost, strategy = helper.graph_cost(m.graph)
+    elapsed = time.monotonic() - t0
+    ctx = getattr(m.graph, "_ndp_ctx", None)
+    assert ctx not in (None, "ineligible") and ctx[1] is not None, (
+        "cluster-bearing table must keep the native DP engaged")
+    assert np.isfinite(cost) and strategy
+    assert elapsed < 15.0, (
+        f"calibrated Inception graph_cost took {elapsed:.1f}s — the "
+        f"native engine should finish in seconds")

@@ -16,11 +16,13 @@ from flexflow_tpu.model import FFModel
 
 
 def encoder_layer(model, t, hidden, num_heads, ff_dim, name, dropout=0.1,
-                  layer_norm=True, causal=False):
-    """reference: transformer.cc create_attention_encoder"""
+                  layer_norm=True, causal=False, sp_mode="ring"):
+    """reference: transformer.cc create_attention_encoder.
+    ``sp_mode`` picks the sequence-parallel scheme serving seq-sharded
+    strategies (ops/attention.py: ring | ulysses | auto)."""
     a = model.multihead_attention(
         t, t, t, embed_dim=hidden, num_heads=num_heads, dropout=dropout,
-        causal=causal, name=f"{name}_mha",
+        causal=causal, sp_mode=sp_mode, name=f"{name}_mha",
     )
     t = model.add(a, t, name=f"{name}_res1")
     if layer_norm:
@@ -36,7 +38,8 @@ def encoder_layer(model, t, hidden, num_heads, ff_dim, name, dropout=0.1,
 def build_transformer(config: FFConfig, num_layers: int = 12, hidden: int = 512,
                       num_heads: int = 8, ff_dim: int = 2048, seq_len: int = 512,
                       dropout: float = 0.0, layer_norm: bool = False,
-                      causal: bool = False, dtype: str = "float32"):
+                      causal: bool = False, dtype: str = "float32",
+                      sp_mode: str = "ring"):
     """The reference Transformer example: raw float inputs [B, S, H],
     per-position dense head back to hidden (transformer.cc:112-211 uses
     no embedding/LN — dense proxies).
@@ -52,7 +55,7 @@ def build_transformer(config: FFConfig, num_layers: int = 12, hidden: int = 512,
     for i in range(num_layers):
         t = encoder_layer(model, t, hidden, num_heads, ff_dim, f"layer{i}",
                           dropout=dropout, layer_norm=layer_norm,
-                          causal=causal)
+                          causal=causal, sp_mode=sp_mode)
     t = model.dense(t, hidden, name="head")
     return model
 

@@ -224,6 +224,21 @@ class _ChainProbe:
         return outs
 
 
+def _drain_round_robin(queues, deadline, probe) -> bool:
+    """One probe per queue per cycle until every queue drains or the
+    deadline passes; mutates the queues in place.  Returns True when
+    the deadline cut probing short (callers may log what remains)."""
+    while queues:
+        for q in queues:
+            if not q:
+                continue
+            if time.monotonic() > deadline:
+                return True
+            probe(q.pop(0))
+        queues = [q for q in queues if q]
+    return False
+
+
 def _any_cluster_unmeasured(table: CalibrationTable, clusters,
                             num_devices: int) -> bool:
     """True when some (cluster, producer-view) probe is not yet in the
@@ -317,21 +332,36 @@ def calibrate_clusters(
 ) -> CalibrationTable:
     """Measure every fusion cluster of ``graph`` at the producer's
     candidate views (budget-bounded, resumable like calibrate_graph).
-    ``clusters`` accepts a precomputed find_clusters(graph) result."""
+    ``clusters`` accepts a precomputed find_clusters(graph) result.
+
+    Probe order is round-robin ACROSS clusters — like calibrate_graph's
+    op probes, a sequential walk would let the first chain's view
+    sweep eat a tight budget and leave later chains with no record."""
     from flexflow_tpu.search.views import candidate_views
 
     deadline = time.monotonic() + time_budget_s
+    queues = []
+    queued = set()  # dedup: N identical chains share one cluster_key
     for producer, chain in (find_clusters(graph) if clusters is None
                             else clusters):
         ops = [producer.op] + [c.op for c in chain]
+        q = []
         for mv in candidate_views(producer.op, num_devices):
-            if table.get_cluster(ops, mv) is not None:
+            key = CalibrationTable.cluster_key(ops, mv)
+            if key in queued or key in table._clusters:
                 continue
-            if time.monotonic() > deadline:
-                return table
-            t = measure_cluster(producer, chain, mv, repeats=repeats)
-            if t is not None and math.isfinite(t) and t > 0:
-                table.put_cluster(ops, mv, t)
+            queued.add(key)
+            q.append((producer, chain, ops, mv))
+        if q:
+            queues.append(q)
+
+    def probe(item):
+        producer, chain, ops, mv = item
+        t = measure_cluster(producer, chain, mv, repeats=repeats)
+        if t is not None and math.isfinite(t) and t > 0:
+            table.put_cluster(ops, mv, t)
+
+    _drain_round_robin(queues, deadline, probe)
     return table
 
 
@@ -389,26 +419,21 @@ def calibrate_graph(
         # otherwise stop op probing at 75% and return the rest unused
         op_deadline -= cluster_fraction * time_budget_s
     queues = [q for _, q in sorted(by_kind.items())]
-    spent = False
-    while queues and not spent:
-        for q in queues:
-            if not q:
-                continue
-            if time.monotonic() > op_deadline:
-                from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
-                log.log(
-                    f"calibration budget ({time_budget_s:.0f}s) spent with "
-                    f"{sum(len(x) for x in queues)} probes unmeasured: "
-                    f"those (op, view) pairs keep the analytic roofline"
-                )
-                spent = True
-                break
-            op, mv = q.pop(0)
-            t = measure_op_view(op, mv, repeats=repeats)
-            if t is not None and math.isfinite(t) and t > 0:
-                table.put(op, mv, t)
-        queues = [q for q in queues if q]
+    def probe(item):
+        op, mv = item
+        t = measure_op_view(op, mv, repeats=repeats)
+        if t is not None and math.isfinite(t) and t > 0:
+            table.put(op, mv, t)
+
+    if _drain_round_robin(queues, op_deadline, probe):
+        from flexflow_tpu.utils.logging import SEARCH_LOG as log
+
+        log.log(
+            f"calibration budget ({time_budget_s:.0f}s) spent with "
+            f"{sum(len(x) for x in queues)} probes unmeasured: "
+            f"those (op, view) pairs keep the analytic roofline"
+        )
     # remaining budget (incl. the reserved fraction) goes to
     # fusion-cluster probes — the refinement over lone-op upper bounds
     remaining = deadline - time.monotonic()

@@ -341,3 +341,29 @@ def test_cluster_reservation_only_when_unmeasured(monkeypatch):
     cal.calibrate_graph(g, 8, fresh, time_budget_s=10.0 * n_ops + 5.0)
     assert len(fresh) < n_ops, "reservation should starve some op probes"
     assert fresh.num_clusters >= 1, "reserved budget must reach clusters"
+
+
+def test_cluster_probe_dedup_across_identical_chains(monkeypatch):
+    """N identical chains share one cluster_key: the probe queue must
+    hold each (cluster_key, view) ONCE, not N times — a tight budget
+    would otherwise buy N copies of the same measurement."""
+    from flexflow_tpu.search import calibration as cal
+
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([64, 128])
+    for i in range(3):  # three IDENTICAL dense+gelu chains
+        t = m.dense(x, 32, name=f"fc{i}")
+        m.gelu(t, name=f"act{i}")
+
+    calls = []
+    monkeypatch.setattr(
+        cal, "measure_cluster",
+        lambda producer, chain, mv, repeats=3: calls.append(
+            cal.CalibrationTable.cluster_key(
+                [producer.op] + [c.op for c in chain], mv)) or 0.001)
+    table = CalibrationTable()
+    cal.calibrate_clusters(m.graph, 8, table, time_budget_s=1e9)
+    assert len(calls) == len(set(calls)), (
+        "identical chains must not be probed repeatedly")
+    assert table.num_clusters == len(set(calls))

@@ -216,6 +216,21 @@ def _steady_step_seconds(model, xs, y, steps, blocks: int = 5):
     return statistics.median(times)
 
 
+def _exec_cfg_kwargs(n_devices, on_cpu):
+    """The live-mesh execution recipe SHARED by execute_pair and the
+    sync-precision sweep, so the two 'executed' measurements in one
+    artifact can never diverge in methodology: on a CPU mesh rank with
+    the CPU machine model in float32; on the real accelerator keep the
+    TPU model and bfloat16."""
+    from flexflow_tpu.core.machine import MachineSpec
+
+    return dict(
+        num_devices=n_devices,
+        compute_dtype="float32" if on_cpu else "bfloat16",
+        machine_spec=MachineSpec.host_cpu(n_devices) if on_cpu else None,
+    )
+
+
 def execute_pair(name, spec, n_devices, steps, calibration_file=None):
     """Measure real per-step seconds for DP vs searched strategies on
     the live mesh.  Returns None when the model has no executable
@@ -229,7 +244,6 @@ def execute_pair(name, spec, n_devices, steps, calibration_file=None):
     import flexflow_tpu as ff
     from examples.common import synthetic_inputs, synthetic_labels
     from flexflow_tpu.compiler.lowering import data_parallel_strategy
-    from flexflow_tpu.core.machine import MachineSpec
 
     on_cpu = jax.devices()[0].platform == "cpu"
 
@@ -242,14 +256,12 @@ def execute_pair(name, spec, n_devices, steps, calibration_file=None):
         # (a TPU-optimal strategy can be a CPU pessimization); on the
         # real accelerator the search gets the calibration file too, so
         # the executed strategy is the one the calibrated sim ranked
-        cfg = ff.FFConfig(batch_size=spec["exec_batch"], num_devices=n_devices,
+        cfg = ff.FFConfig(batch_size=spec["exec_batch"],
                           search_budget=spec["budget"],
-                          compute_dtype="float32" if on_cpu else "bfloat16",
-                          machine_spec=(MachineSpec.host_cpu(n_devices)
-                                        if on_cpu else None),
                           calibration_file=(None if on_cpu
                                             else calibration_file),
-                          only_data_parallel=(mode == "dp"))
+                          only_data_parallel=(mode == "dp"),
+                          **_exec_cfg_kwargs(n_devices, on_cpu))
         model = spec["exec_build"](cfg)
         if mode == "dp":
             strategy = data_parallel_strategy(model.graph, n_devices)
@@ -279,6 +291,128 @@ def execute_pair(name, spec, n_devices, steps, calibration_file=None):
         "exec_searched_ms": round(results["searched"] * 1e3, 3),
         "exec_ratio": round(results["dp"] / results["searched"], 3),
     }
+
+
+def sync_precision_sweep(n_devices, steps, precisions):
+    """The --sync-precision sweep: gradient-sync wire precision as a
+    strategy dimension (comm/quantized.py, EQuARX arXiv:2506.17615) on
+    the sync-bound BERT config (SYNC_BOUND_BERT_KW — per-device batch
+    1, full widths, where DP's weight allreduce dominates).
+
+    Simulated: the DP strategy's weight-sync (allreduce) term and full
+    step cost under the TPU machine model, per precision.  Executed:
+    real CPU-mesh step time running the SAME per-weight-group map the
+    TPU pricing chooses — on a CPU mesh there is no fat wire to save,
+    so the executed ratio measures the quantize round-trip OVERHEAD
+    honestly (the win is the simulated number); the map is forced
+    because the CPU machine model itself declines to compress."""
+    import jax
+
+    import flexflow_tpu as ff
+    from examples.common import synthetic_inputs, synthetic_labels
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.search.sync_precision import choose_sync_precision
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    can_exec = len(jax.devices()) >= n_devices
+
+    sweep = {
+        "model": "bert",
+        "config": dict(SYNC_BOUND_BERT_KW),
+        "batch": 8,
+        "note": (
+            "simulated numbers price the wire win on the TPU machine "
+            "model; executed numbers run the TPU-chosen compression map "
+            "on the live mesh — on a CPU mesh that measures the "
+            "quantize round-trip overhead with no wire to save, so "
+            "exec_ratio <= 1.0 there is expected and honest"
+        ),
+        "rows": {},
+    }
+    from flexflow_tpu.models import build_transformer
+
+    for prec in precisions:
+        cfg = ff.FFConfig(batch_size=8, num_devices=n_devices,
+                          sync_precision=prec)
+        g = build_transformer(cfg, **SYNC_BOUND_BERT_KW).graph
+        sim = Simulator(cfg.machine_spec, num_devices=n_devices,
+                        sync_precision=prec)
+        dp = data_parallel_strategy(g, n_devices)
+        step_s = sim.simulate(g, dp)
+        sync_s = sum(
+            sim.cost.sync_cost(node.op, dp[node.guid])
+            for node in g.topo_order()
+        )
+        groups = choose_sync_precision(g, dp, sim.cost)
+        row = {
+            "sim_allreduce_ms": round(sync_s * 1e3, 4),
+            "sim_step_ms": round(step_s * 1e3, 4),
+            "compressed_groups": len(groups),
+        }
+        if can_exec:
+            cfg_x = ff.FFConfig(
+                batch_size=8, only_data_parallel=True,
+                **_exec_cfg_kwargs(n_devices, on_cpu))
+            m = build_transformer(cfg_x, **SYNC_BOUND_BERT_KW)
+            dp_x = data_parallel_strategy(m.graph, n_devices)
+            m.compile(loss_type="mean_squared_error", metrics=[],
+                      strategy=dp_x)
+            # force the TPU-chosen map (see docstring): the compiled
+            # step is lazily jitted, so setting the map here is enough
+            m.compiled.sync_precision = dict(
+                choose_sync_precision(m.graph, dp_x, sim.cost, mode=prec)
+            )
+            xs = synthetic_inputs(m, cfg_x.batch_size)
+            y = synthetic_labels(m, cfg_x.batch_size, "mean_squared_error")
+            row["exec_ms"] = round(
+                _steady_step_seconds(m, xs, y, steps) * 1e3, 3)
+            row["exec_backend"] = jax.devices()[0].platform
+        sweep["rows"][prec] = row
+        print(json.dumps({"sync_precision": prec, **row}))
+    base = sweep["rows"].get("fp32")
+    if base:
+        for prec, row in sweep["rows"].items():
+            if row.get("sim_allreduce_ms"):
+                row["sim_allreduce_ratio_vs_fp32"] = round(
+                    base["sim_allreduce_ms"] / row["sim_allreduce_ms"], 3)
+                row["sim_step_ratio_vs_fp32"] = round(
+                    base["sim_step_ms"] / row["sim_step_ms"], 3)
+            if row.get("exec_ms") and base.get("exec_ms"):
+                row["exec_ratio_vs_fp32"] = round(
+                    base["exec_ms"] / row["exec_ms"], 3)
+    return sweep
+
+
+def _sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## Sync-precision sweep (sync-bound BERT, SYNC_BOUND_BERT_KW)",
+        "",
+        "Gradient-sync wire precision as a searchable strategy dimension "
+        "(EQuARX-style quantized allreduce, comm/quantized.py).  "
+        "Simulated columns price the DP weight-allreduce term on the "
+        "TPU machine model; exec columns run the TPU-chosen "
+        "per-weight-group map for real on the live mesh.",
+        "",
+        "| precision | sim allreduce ms | sim step ms | sim allreduce "
+        "ratio | sim step ratio | exec ms | exec ratio | groups |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for prec, r in sweep["rows"].items():
+        lines.append(
+            f"| {prec} | {r.get('sim_allreduce_ms', '—')} | "
+            f"{r.get('sim_step_ms', '—')} | "
+            f"{r.get('sim_allreduce_ratio_vs_fp32', '—')} | "
+            f"{r.get('sim_step_ratio_vs_fp32', '—')} | "
+            f"{r.get('exec_ms', '—')} | "
+            f"{r.get('exec_ratio_vs_fp32', '—')} | "
+            f"{r.get('compressed_groups', '—')} |")
+    lines += [
+        "",
+        f"Honesty note: {sweep['note']}.",
+    ]
+    return lines
 
 
 def main():
@@ -314,6 +448,15 @@ def main():
                     help="artifact file prefix — point smoke runs at a "
                          "scratch prefix so they never overwrite the "
                          "committed full artifact")
+    ap.add_argument("--sync-precision", default="fp32,bf16,int8",
+                    help="comma list of gradient-sync wire precisions to "
+                         "sweep on the sync-bound BERT config (simulated "
+                         "allreduce term + executed step time per "
+                         "precision); empty disables the sweep")
+    ap.add_argument("--sync-sweep-only", action="store_true",
+                    help="run ONLY the sync-precision sweep and merge it "
+                         "into the existing artifact, leaving every "
+                         "model row untouched")
     args = ap.parse_args()
 
     import os
@@ -321,8 +464,48 @@ def main():
     import jax
 
     if args.cpu_mesh or os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.devices)
+        from flexflow_tpu.comm.compat import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+
+    sweep_precisions = [p for p in args.sync_precision.split(",") if p]
+    if args.sync_sweep_only:
+        if not sweep_precisions:
+            ap.error("--sync-sweep-only needs a non-empty --sync-precision "
+                     "list (empty means 'sweep disabled')")
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["sync_precision_sweep"] = sync_precision_sweep(
+            args.devices, args.steps, sweep_precisions)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous sweep section: everything from
+            # its marker to the next "## " heading (or EOF) — later
+            # sections survive the merge
+            marker = "\n## Sync-precision sweep"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_sweep_md_lines(report["sync_precision_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged sync-precision sweep into {path} / {md}")
+        return
 
     specs = _model_specs()
     names = [n for n in args.models.split(",") if n in specs]
@@ -442,6 +625,9 @@ def main():
     # as incoherent with the machine model)
     report["calibrated"] = any(
         r.get("sim_calibrated") for r in report["models"].values())
+    if sweep_precisions:
+        report["sync_precision_sweep"] = sync_precision_sweep(
+            args.devices, args.steps, sweep_precisions)
 
     with open(f"{args.out_prefix}.json", "w") as f:
         json.dump(report, f, indent=1)
@@ -505,6 +691,8 @@ def main():
         "compute-parallel strategies is the TPU-machine-model sim "
         "ratio, which the calibrated table makes falsifiable.",
     ]
+    if report.get("sync_precision_sweep"):
+        lines += _sweep_md_lines(report["sync_precision_sweep"])
     with open(f"{args.out_prefix}.md", "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"# wrote {args.out_prefix}.json / {args.out_prefix}.md")

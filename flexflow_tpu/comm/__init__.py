@@ -1,0 +1,40 @@
+"""Collective-communication subsystem.
+
+Two roles:
+
+* ``compat`` — the one place the shard_map API drift between jax
+  versions is absorbed (``jax.shard_map`` + ``check_vma`` on new jax,
+  ``jax.experimental.shard_map`` + ``check_rep`` on 0.4.x).  Every
+  explicit-SPMD lowering in the tree imports shard_map from here.
+* ``quantized`` — EQuARX-style compressed gradient collectives
+  (arXiv:2506.17615): per-chunk-scaled int8 (and bf16) quantize →
+  reduce-scatter → requantize → all-gather, with an exact-fp32 psum
+  fallback and an error-bound unit contract.  The search prices these
+  (search/machine_model.py ``allreduce(precision=...)``) and the
+  lowering executes them (compiler/lowering.py ``_sync_grads``).
+"""
+
+from flexflow_tpu.comm.compat import force_cpu_devices, shard_map
+from flexflow_tpu.comm.quantized import (
+    DEFAULT_CHUNK,
+    MIN_COMPRESS_ELEMS,
+    SYNC_PRECISIONS,
+    allreduce_error_bound,
+    dequantize_chunked,
+    quantize_chunked,
+    quantized_allreduce,
+    quantized_grad_sync,
+)
+
+__all__ = [
+    "DEFAULT_CHUNK",
+    "MIN_COMPRESS_ELEMS",
+    "SYNC_PRECISIONS",
+    "allreduce_error_bound",
+    "dequantize_chunked",
+    "force_cpu_devices",
+    "quantize_chunked",
+    "quantized_allreduce",
+    "quantized_grad_sync",
+    "shard_map",
+]

@@ -93,10 +93,16 @@ class CompiledModel:
         optimizer: Optional[Optimizer],
         mesh=None,
         label_dtype: str = "int32",
+        sync_precision: Optional[Dict[str, str]] = None,
     ):
         self.graph = graph
         self.strategy = strategy
         self.config = config
+        # op name -> bf16/int8: weight groups whose gradient sync runs
+        # through the compressed collective (comm/quantized.py); the
+        # search builds this map (search/sync_precision.py) and absent
+        # /empty means the historical bit-exact fp32 psum
+        self.sync_precision: Dict[str, str] = dict(sync_precision or {})
         self.loss_type = LossType.from_any(loss_type)
         self.metric_types = [MetricsType.from_any(m) for m in metric_types]
         self.optimizer = optimizer
@@ -421,6 +427,28 @@ class CompiledModel:
         return new_params, new_opt_state
 
     # ------------------------------------------------------------------
+    def _sync_grads(self, grads):
+        """Compressed gradient sync (EQuARX, comm/quantized.py) for the
+        weight groups ``self.sync_precision`` names: each group's grad
+        runs the quantized quantize → psum_scatter → requantize →
+        all_gather round trip over its replication axes inside the
+        jitted step.  With an empty map (or single device) this returns
+        ``grads`` untouched — the fp32 path stays bit-exact with the
+        historical lowering.  Composes with ZeRO-1: the round trip runs
+        before the optimizer update, so _constrain_update's
+        reduce-scatter/all-gather placement of the update is unchanged.
+        """
+        if not self.sync_precision or not self._multi_device:
+            return grads
+        shardings = getattr(self, "param_shardings", None)
+        if shardings is None:  # init_params not run yet — nothing to map
+            return grads
+        from flexflow_tpu.comm import quantized_grad_sync
+
+        return quantized_grad_sync(
+            grads, self.mesh, shardings, self.sync_precision
+        )
+
     def _loss_from(self, logits, labels, new_state):
         loss = compute_loss(self.loss_type, logits, labels)
         for k, v in new_state.items():
@@ -444,6 +472,7 @@ class CompiledModel:
         (loss, (logits, new_state)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params)
+        grads = self._sync_grads(grads)
         new_params, new_opt_state = optimizer.apply(params, grads, opt_state)
         new_params, new_opt_state = self._constrain_update(
             new_params, new_opt_state
@@ -493,6 +522,7 @@ class CompiledModel:
             (keys, tuple(resh(x) for x in inputs), resh(labels)),
         )
         grads = jax.tree.map(lambda g: g / ga, gsum)
+        grads = self._sync_grads(grads)
         new_params, new_opt_state = self.optimizer.apply(
             params, grads, opt_state
         )

@@ -105,6 +105,15 @@ class FFConfig:
     remat: bool = False  # rematerialize activations in backward
     # (jax.checkpoint) — trades FLOPs for HBM; the reference has no
     # equivalent (Legion keeps all activations resident)
+    sync_precision: str = "fp32"  # gradient-sync wire precision
+    # (comm/quantized.py, EQuARX arXiv:2506.17615): "fp32" keeps the
+    # historical bit-exact psum; "bf16"/"int8" request compressed
+    # collectives for every weight group the gradient-safety heuristic
+    # admits (search/sync_precision.py); "search" makes the precision a
+    # PER-WEIGHT-GROUP dimension of the strategy search — the cost
+    # model prices each group's sync at its cheapest admissible
+    # precision (wire bytes shrink, quantize overhead added) and the
+    # chosen map is executed by the lowering's _sync_grads
     zero_dp_shard: bool = False  # ZeRO-1 / weight-update sharding
     # (arXiv:2004.13336): shard optimizer state (and the update
     # compute) of replicated weights over the mesh axes they are
@@ -117,6 +126,11 @@ class FFConfig:
     iteration: IterationConfig = field(default_factory=IterationConfig)
 
     def __post_init__(self):
+        if self.sync_precision not in ("fp32", "bf16", "int8", "search"):
+            raise ValueError(
+                f"sync_precision must be fp32|bf16|int8|search, got "
+                f"{self.sync_precision!r}"
+            )
         if self.num_devices == 0:
             try:
                 import jax
@@ -180,6 +194,12 @@ class FFConfig:
         p.add_argument("--remat", action="store_true")
         p.add_argument("--zero-dp-shard", dest="zero_dp_shard",
                        action="store_true")
+        p.add_argument("--sync-precision", dest="sync_precision",
+                       choices=("fp32", "bf16", "int8", "search"),
+                       default="fp32",
+                       help="gradient-sync wire precision; 'search' "
+                            "lets the strategy search pick it per "
+                            "weight group")
         p.add_argument("--seed", type=int, default=0)
         args, _ = p.parse_known_args(argv)
         search_devs = args.search_num_workers * max(1, args.search_num_nodes or 1)
@@ -210,5 +230,6 @@ class FFConfig:
             grad_accum_steps=args.grad_accum_steps,
             remat=args.remat,
             zero_dp_shard=args.zero_dp_shard,
+            sync_precision=args.sync_precision,
             seed=args.seed,
         )

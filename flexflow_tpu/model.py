@@ -558,6 +558,29 @@ class FFModel:
         # the chosen strategy is public state: tooling (bench_search,
         # strategy introspection) reads it back after compile
         self.strategy = strategy
+        # sync-precision dimension of the strategy (EQuARX compressed
+        # gradient collectives): build the per-weight-group wire map
+        # with the SAME cost model the search ranked with, so execution
+        # runs exactly what the simulation priced.  Public state like
+        # the strategy itself (bench_search reads it back).
+        self.sync_precision_map: Dict[str, str] = {}
+        if (
+            comp_mode == "training"
+            and strategy
+            and getattr(self.config, "sync_precision", "fp32") != "fp32"
+        ):
+            from flexflow_tpu.search.driver import coherent_calibration
+            from flexflow_tpu.search.simulator import Simulator
+            from flexflow_tpu.search.sync_precision import (
+                choose_sync_precision,
+            )
+
+            _sync_sim = Simulator.for_config(
+                self.config, calibration=coherent_calibration(self.config)
+            )
+            self.sync_precision_map = choose_sync_precision(
+                self.graph, strategy, _sync_sim.cost
+            )
         if self.config.export_strategy_file:
             from flexflow_tpu.search.strategy_io import export_strategy
 
@@ -648,6 +671,7 @@ class FFModel:
                     self.graph, strategy, self.config,
                     LossType.from_any(loss_type), list(metrics),
                     self.optimizer, mesh=mesh,
+                    sync_precision=self.sync_precision_map,
                 )
         else:
             self.compiled = CompiledModel(
@@ -658,15 +682,32 @@ class FFModel:
                 list(metrics),
                 self.optimizer,
                 mesh=mesh,
+                sync_precision=self.sync_precision_map,
             )
         from flexflow_tpu.compiler.staged_pipeline_lowering import (
             StagedPipelinedModel as _Staged,
         )
 
+        if self.sync_precision_map and not getattr(
+                self.compiled, "sync_precision", None):
+            # placed/pipelined lowerings manage their own grad paths and
+            # do not run _sync_grads yet — say so rather than silently
+            # training at fp32 while the user expects compression
+            from flexflow_tpu.utils.logging import SEARCH_LOG
+
+            SEARCH_LOG.log(
+                f"sync_precision={self.config.sync_precision!r} chose "
+                f"{len(self.sync_precision_map)} compressed groups but "
+                f"this lowering ({type(self.compiled).__name__}) cannot "
+                f"execute them; gradients sync at fp32"
+            )
+            self.sync_precision_map = {}
+
         self._compile_ctx = dict(
             strategy=strategy, loss_type=LossType.from_any(loss_type),
             metrics=list(metrics), pipeline=pipeline, block_of=block_of,
             mesh=mesh,
+            sync_precision=dict(self.sync_precision_map),
             staged=(self.pipeline_proposal
                     if isinstance(self.compiled, _Staged) else None),
         )
@@ -724,6 +765,7 @@ class FFModel:
                     self.graph, ctx["strategy"], self.config,
                     ctx["loss_type"], ctx["metrics"], self.optimizer,
                     mesh=ctx.get("mesh"),
+                    sync_precision=ctx.get("sync_precision"),
                 )
         old_params, old_state, old_opt = self.params, self.state, self.opt_state
         self.params, self.state = self.compiled.init_params(self.config.seed)

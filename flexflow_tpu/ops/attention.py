@@ -102,9 +102,12 @@ class MultiHeadAttentionOp(Operator):
         if mode == "ulysses":
             return True
         # auto: non-causal rings have no zigzag overlap advantage and
-        # ulysses moves (n-1)/n of q/k/v/out once vs the ring's n-1
-        # full K/V hops — strictly fewer bytes for n >= 2
-        return mode == "auto" and not a["causal"]
+        # ulysses moves 4(n-1)/n local shards once vs the ring's
+        # 2(n-1) shards (K and V, n-1 hops each) — EQUAL bytes at
+        # n == 2 (4·1/2 vs 2·1), strictly fewer only for n >= 3.  At
+        # the tie the ring keeps its per-hop comm/compute overlap, so
+        # auto stays on the ring (ADVICE.md round 5).
+        return mode == "auto" and not a["causal"] and n >= 3
 
     def infer(self) -> Sequence[ParallelTensorShape]:
         q = self.input_shapes[0]

@@ -280,7 +280,10 @@ class Operator:
             (k, v) for k, v in sig[3]
             if k not in self._CALIBRATION_INERT_ATTRS
         )
-        return sig[:3] + (attrs,)
+        # sig[4:] preserves anything a subclass APPENDS to signature():
+        # truncating here would alias calibration records of ops that
+        # differ only in the appended components
+        return sig[:3] + (attrs,) + sig[4:]
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.name})"

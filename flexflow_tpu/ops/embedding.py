@@ -104,7 +104,7 @@ class EmbeddingOp(Operator):
         vocab_axes = (ctx.slot_axes or {}).get(REPLICA_SLOT, ())
         if not vocab_axes or ctx.mesh is None:
             return None
-        from jax import shard_map
+        from flexflow_tpu.comm.compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec
 
         from flexflow_tpu.parallel.mesh import annot_partition_spec
@@ -150,7 +150,6 @@ class EmbeddingOp(Operator):
             local, mesh=mesh,
             in_specs=(ids_spec, w_spec),
             out_specs=out_spec,
-            check_vma=False,
         )
         return [fn(ids, weights["table"])]
 

@@ -97,6 +97,12 @@ def pipeline_spmd(
     def local(params_l, x_l, const_l):
         # params_l leaves: [L/S, ...] — this stage's block slices.
         p = params_l
+        # NOTE jax 0.4.x: this axis_index lowers to a PartitionId the
+        # SPMD partitioner rejects when auto (dp/tp) axes are present —
+        # the pipelined TRAIN step therefore needs a newer jax.  Routing
+        # the index in as pp-sharded data fixes the forward but makes
+        # the scanned backward abort inside 0.4.x jaxlib, which is
+        # worse; keep the clean failure until the toolchain moves.
         s = jax.lax.axis_index(axis_name)
         zero = jnp.zeros(x_l.shape[1:], x_l.dtype)
         outbuf = jnp.zeros((M,) + x_l.shape[1:], x_l.dtype)
@@ -161,13 +167,14 @@ def pipeline_spmd(
         if x_const is not None
         else None
     )
-    return jax.shard_map(
+    from flexflow_tpu.comm.compat import shard_map
+
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, x_spec, const_specs),
         out_specs=x_spec,
         axis_names={axis_name},
-        check_vma=False,
     )(stage_params, x_microbatches, x_const)
 
 

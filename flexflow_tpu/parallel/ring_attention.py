@@ -81,7 +81,7 @@ def ring_attention(
     (i, 2n-1-i) of a 2n-chunking — every device then does exactly two
     half-blocks per step (~2x faster causal rings).  "auto" picks
     zigzag for causal multi-device rings when the length divides."""
-    from jax import shard_map
+    from flexflow_tpu.comm.compat import shard_map
 
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -175,7 +175,6 @@ def ring_attention(
 
     return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )(q, k, v)
 
 
@@ -196,7 +195,7 @@ def _zigzag_ring(q, k, v, mesh, axes, n, scale, spec):
     ring rotation — and per-chip memory stays O(S/n), which a global
     gather could not guarantee (GSPMD may materialize it as an
     all-gather)."""
-    from jax import shard_map
+    from flexflow_tpu.comm.compat import shard_map
 
     S = q.shape[1]
     s2 = S // (2 * n)
@@ -299,5 +298,4 @@ def _zigzag_ring(q, k, v, mesh, axes, n, scale, spec):
 
     return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )(q, k, v)

@@ -125,8 +125,11 @@ class SearchHelper:
         self.memo: Dict[Tuple, Tuple[float, Strategy]] = {}
         self._views_cache: Dict[Tuple, List[MachineView]] = {}
         # native-DP digests shared across every graph this helper
-        # searches (rewritten variants repeat the same op signatures)
+        # searches (rewritten variants repeat the same op signatures);
+        # cleared when the calibration table's version moves on
+        # (_node_digest), so stale generations never accumulate
         self._node_digest_cache: Dict[Tuple, dict] = {}
+        self._node_digest_version: object = None
         self._edge_matrix_cache: Dict[Tuple, object] = {}
         # diagnostic: how often the greedy fallback decided a subgraph —
         # zero on the model zoo (tests assert this; VERDICT r1 weak #2)
@@ -231,11 +234,16 @@ class SearchHelper:
         sharding), per-budget candidate/boundary/default index lists,
         and the trivial/fixed view indices."""
         cal = self.sim.cost.calibration
-        # digest rows bake per-(op, view) calibration lookups, so the
-        # cache key carries the table's mutation counter — an in-place
-        # recalibration must re-bake, not reuse pre-mutation costs
-        sig = (node.op.signature(),
-               getattr(cal, "version", None) if cal is not None else None)
+        # digest rows bake per-(op, view) calibration lookups, so an
+        # in-place recalibration must re-bake them.  The cache is
+        # CLEARED on a version change rather than keyed by it — a
+        # version-widened key retains every superseded generation of
+        # rows and grows without bound across calibration rounds
+        ver = getattr(cal, "version", None) if cal is not None else None
+        if self._node_digest_version != ver:
+            self._node_digest_cache.clear()
+            self._node_digest_version = ver
+        sig = node.op.signature()
         hit = self._node_digest_cache.get(sig)
         if hit is not None:
             return hit

@@ -47,6 +47,15 @@ from flexflow_tpu.parallel.mesh import (
 OP_OVERHEAD_S = 2e-6
 
 
+def _min_compress_elems() -> int:
+    """comm.quantized.MIN_COMPRESS_ELEMS, imported lazily: the comm
+    module pulls in jax, which this pure-python cost model otherwise
+    never needs."""
+    from flexflow_tpu.comm.quantized import MIN_COMPRESS_ELEMS
+
+    return MIN_COMPRESS_ELEMS
+
+
 @dataclass
 class CostModel:
     machine: MachineSpec
@@ -71,6 +80,13 @@ class CostModel:
     # inference compile (reference COMP_MODE_INFERENCE): no grads, no
     # optimizer state — op_memory counts weights + activations only
     inference: bool = False
+    # gradient-sync wire precision (FFConfig.sync_precision): fp32 |
+    # bf16 | int8 price every weight sync at that precision (safety
+    # heuristic permitting); "search" makes it a per-weight-group
+    # choice — sync_cost() returns the cheapest admissible precision's
+    # cost, so the DP trades e.g. TP (no sync) against DP + compressed
+    # sync with honest numbers (EQuARX, arXiv:2506.17615)
+    sync_precision: str = "fp32"
 
     # ---- slice topology --------------------------------------------------
     def _slot_axes(self, slot_degrees: Tuple[int, ...]):
@@ -225,6 +241,38 @@ class CostModel:
                 )
         return t
 
+    # ---- compressed collectives (EQuARX, arXiv:2506.17615) ---------------
+    # elements per int8 scale block (comm/quantized.py DEFAULT_CHUNK);
+    # each chunk ships one fp32 scale alongside its int8 payload
+    QUANT_CHUNK = 256
+    # HBM passes per quantize/dequantize endpoint (read fp32, write
+    # int8+scales, read back ≈ 3 streaming passes over the buffer)
+    QUANT_PASSES = 3.0
+
+    def _wire_scale(self, precision: Optional[str]) -> float:
+        """Wire bytes per fp32 byte under the sync precision."""
+        if precision == "bf16":
+            return 0.5
+        if precision == "int8":
+            return (1.0 + 4.0 / self.QUANT_CHUNK) / 4.0
+        return 1.0
+
+    def _quant_overhead(
+        self, nbytes: float, n: int, precision: Optional[str]
+    ) -> float:
+        """Per-device quantize/dequant seconds for one compressed
+        collective: the entry quantize runs over the full local buffer,
+        the mid requant (between reduce-scatter and all-gather) over
+        the 1/n reduced shard.  bf16 conversion is the same streaming
+        pattern at the same pass count (the VPU cast is free; the
+        traffic isn't)."""
+        if precision in (None, "fp32") or n <= 1:
+            return 0.0
+        return (
+            self.QUANT_PASSES * (nbytes + nbytes / n)
+            / self.machine.hbm_bandwidth
+        )
+
     # ---- collectives -----------------------------------------------------
     def _crosses(self, n: int, spans_dcn: Optional[bool]) -> bool:
         """Does an n-way collective ride DCN?  Axis-aware when the
@@ -245,43 +293,59 @@ class CostModel:
         return ici, dcn
 
     def allreduce(
-        self, nbytes: float, n: int, spans_dcn: Optional[bool] = None
+        self, nbytes: float, n: int, spans_dcn: Optional[bool] = None,
+        precision: Optional[str] = None,
     ) -> float:
+        """``precision`` (fp32|bf16|int8, default fp32) compresses the
+        wire bytes by _wire_scale and adds the per-device quantize
+        overhead — the EQuARX pricing the search uses to trade sync
+        precision against everything else."""
         if n <= 1:
             return 0.0
+        wire = nbytes * self._wire_scale(precision)
+        extra = self._quant_overhead(nbytes, n, precision)
         groups = self._net_groups(n)
         if groups is not None:
             t = self._net_cached(
-                "ar", n, nbytes,
-                lambda: max(self.network.ring_allreduce_time(g, nbytes)
+                "ar", n, wire,
+                lambda: max(self.network.ring_allreduce_time(g, wire)
                             for g in groups))
             if self._crosses(n, spans_dcn):
-                t += 2.0 * (n - 1) / n * nbytes / self.machine.dcn_bandwidth
-            return t
-        ici, dcn = self._link_time(2.0 * (n - 1) / n * nbytes, n, spans_dcn)
-        return ici + dcn + 2 * (n - 1) * self.machine.ici_latency
+                t += 2.0 * (n - 1) / n * wire / self.machine.dcn_bandwidth
+            return t + extra
+        ici, dcn = self._link_time(2.0 * (n - 1) / n * wire, n, spans_dcn)
+        return ici + dcn + 2 * (n - 1) * self.machine.ici_latency + extra
 
     def allgather(
-        self, nbytes_shard: float, n: int, spans_dcn: Optional[bool] = None
+        self, nbytes_shard: float, n: int, spans_dcn: Optional[bool] = None,
+        precision: Optional[str] = None,
     ) -> float:
         if n <= 1:
             return 0.0
+        wire = nbytes_shard * self._wire_scale(precision)
         groups = self._net_groups(n)
         if groups is not None:
             t = self._net_cached(
-                "ag", n, nbytes_shard,
-                lambda: max(self.network.allgather_time(g, nbytes_shard)
+                "ag", n, wire,
+                lambda: max(self.network.allgather_time(g, wire)
                             for g in groups))
             if self._crosses(n, spans_dcn):
-                t += (n - 1) * nbytes_shard / self.machine.dcn_bandwidth
+                t += (n - 1) * wire / self.machine.dcn_bandwidth
             return t
-        ici, dcn = self._link_time((n - 1) * nbytes_shard, n, spans_dcn)
+        ici, dcn = self._link_time((n - 1) * wire, n, spans_dcn)
         return ici + dcn + (n - 1) * self.machine.ici_latency
 
     def reducescatter(
-        self, nbytes: float, n: int, spans_dcn: Optional[bool] = None
+        self, nbytes: float, n: int, spans_dcn: Optional[bool] = None,
+        precision: Optional[str] = None,
     ) -> float:
-        return self.allgather(nbytes / max(n, 1), n, spans_dcn)
+        """One compressed phase plus the quantize passes (entry over
+        the full buffer, shard-side dequant) — the ZeRO-1 grad path;
+        the update's all-gather is priced separately."""
+        return (
+            self.allgather(nbytes / max(n, 1), n, spans_dcn, precision)
+            + self._quant_overhead(nbytes, n, precision)
+        )
 
     def all_to_all(
         self, nbytes_shard: float, n: int, spans_dcn: Optional[bool] = None
@@ -445,12 +509,14 @@ class CostModel:
     # per-device bandwidth already encodes that holders share the core).
     OPT_UPDATE_PASSES = 7.0
 
-    def weight_sync_cost(self, op: Operator, mv: MachineView) -> float:
+    def weight_sync_cost(
+        self, op: Operator, mv: MachineView, precision: str = "fp32"
+    ) -> float:
         """Per-iteration grad-allreduce for weights replicated across
         ``mv`` (reference: NCCL allreduce in optimizer, optimizer.cc:155-193;
-        here XLA's psum over the batch axes of the mesh).  The
-        optimizer's elementwise update is priced separately
-        (``update_cost``) on the compute timeline."""
+        here XLA's psum over the batch axes of the mesh), at the given
+        wire ``precision``.  The optimizer's elementwise update is
+        priced separately (``update_cost``) on the compute timeline."""
         try:
             osh = op.propagate(mv)
         except AssertionError:
@@ -482,10 +548,60 @@ class CostModel:
             if mv.replica_degree > 1 and REPLICA_SLOT not in weight_slots:
                 active.append(nslots)
             spans = self._spans_dcn(slot_degrees, active)
+            # sub-floor weights (bias/scale vectors) sync at fp32 even
+            # inside a compressed group — mirrors quantized_grad_sync's
+            # per-weight MIN_COMPRESS_ELEMS skip exactly
+            p = precision
+            if p != "fp32" and n < _min_compress_elems():
+                p = "fp32"
             total += self.allreduce(
-                shard_elems * ws.dtype.itemsize, annot.replica, spans
+                shard_elems * ws.dtype.itemsize, annot.replica, spans,
+                precision=p,
             )
         return total
+
+    # the search compresses a group's sync only where the allreduce
+    # actually DOMINATES: fp32 sync must exceed this fraction of the
+    # op's own compute+update time.  Where compute dominates, the sync
+    # hides behind it (async collectives — simulate()'s comm timeline),
+    # so quantization would trade gradient fidelity for nothing.
+    SYNC_DOMINANCE = 0.5
+
+    def sync_precision_choice(
+        self, op: Operator, mv: MachineView
+    ) -> Tuple[str, float]:
+        """(wire precision, sync seconds) this cost model prices for
+        one (op, view) — THE shared rule between the DP search (via
+        ``sync_cost``), the simulator, and the execution-side map
+        builder (search/sync_precision.py), so simulated strategies
+        price compressed sync exactly as the lowering will run it."""
+        base = self.weight_sync_cost(op, mv)
+        mode = self.sync_precision or "fp32"
+        if mode == "fp32" or base <= 0.0 or not math.isfinite(base):
+            return "fp32", base
+        from flexflow_tpu.search.sync_precision import grad_safe_to_compress
+
+        if not grad_safe_to_compress(op):
+            return "fp32", base
+        if mode == "search":
+            comp = self.op_cost(op, mv, backward=not self.inference)
+            if not math.isfinite(comp) or base < self.SYNC_DOMINANCE * comp:
+                return "fp32", base
+            candidates = ("bf16", "int8")
+        else:
+            candidates = (mode,)
+        best = ("fp32", base)
+        for p in candidates:
+            c = self.weight_sync_cost(op, mv, precision=p)
+            if c < best[1]:
+                best = (p, c)
+        return best
+
+    def sync_cost(self, op: Operator, mv: MachineView) -> float:
+        """weight_sync_cost at the precision the model's mode selects —
+        what the simulator and both DP engines put on the comm
+        timeline."""
+        return self.sync_precision_choice(op, mv)[1]
 
     def update_cost(self, op: Operator, mv: MachineView) -> float:
         """Optimizer elementwise update over the local weight shard —

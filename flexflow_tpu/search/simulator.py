@@ -30,7 +30,7 @@ class Simulator:
     def __init__(self, machine: MachineSpec, num_devices: Optional[int] = None,
                  use_network_model: bool = True, calibration=None,
                  placement_overlap: bool = False, zero_dp_shard: bool = False,
-                 inference: bool = False):
+                 inference: bool = False, sync_precision: str = "fp32"):
         self.machine = machine
         self.num_devices = num_devices or machine.num_devices
         # placement_overlap=True credits inter-op COMPUTE overlap for
@@ -64,7 +64,8 @@ class Simulator:
         self.cost = CostModel(machine, network=network, calibration=calibration,
                               num_devices=self.num_devices,
                               zero_dp_shard=zero_dp_shard,
-                              inference=inference)
+                              inference=inference,
+                              sync_precision=sync_precision)
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
         # propagate()/op_cost results per (op signature, view): structural
         # keys stay valid across graph copies and op lifetimes (an id()
@@ -103,6 +104,7 @@ class Simulator:
             calibration=calibration,
             zero_dp_shard=config.zero_dp_shard,
             inference=config.comp_mode == "inference",
+            sync_precision=getattr(config, "sync_precision", "fp32"),
             **kw,
         )
 
@@ -115,7 +117,10 @@ class Simulator:
         if hit is None:
             fwd = self.cost.op_cost(node.op, mv, backward=False)
             full = self.cost.op_cost(node.op, mv, backward=True)
-            sync = self.cost.weight_sync_cost(node.op, mv)
+            # sync at the precision the cost model's mode selects (per
+            # weight group under "search") — both DP engines consume
+            # this row, so compressed sync is priced consistently
+            sync = self.cost.sync_cost(node.op, mv)
             mem = self.cost.op_memory(node.op, mv)
             hit = (fwd, full, sync, mem)
             self._cost_cache[key] = hit

@@ -6,15 +6,22 @@ paths on a virtual CPU mesh — XLA compiles the same collectives, so
 sharding correctness transfers to real TPU slices.
 
 NOTE: in this environment jax is pre-imported at interpreter startup
-with the axon/TPU platform selected, so env vars are too late — we
-override via jax.config before any backend is initialized.
+with the axon/TPU platform selected, so env vars are too late — the
+platform/device-count override must run before any backend use, which
+import time guarantees.  The jax-version spelling drift (config option
+vs XLA flag) lives in flexflow_tpu.comm.compat.force_cpu_devices.
 """
 
-import jax
+import os
+import sys
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from flexflow_tpu.comm.compat import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
+
+import jax  # noqa: E402
 import pytest  # noqa: E402
 
 

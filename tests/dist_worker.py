@@ -10,15 +10,15 @@ import sys
 port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
 ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
 
-import jax  # noqa: E402
-
-jax.config.update("jax_num_cpu_devices", 2)
-jax.config.update("jax_platforms", "cpu")
-
 import os  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from flexflow_tpu.comm.compat import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(2)
+
+import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 import flexflow_tpu as ff  # noqa: E402

@@ -45,11 +45,12 @@ _SCRIPTS = [
 ]
 
 _BOOT = (
-    "import jax; "
-    "jax.config.update('jax_platforms', 'cpu'); "
-    "jax.config.update('jax_num_cpu_devices', 8); "
-    "import runpy, sys; "
-    "sys.argv = sys.argv[1:]; "  # the script must see ITS OWN argv
+    # version-drift handling lives in ONE place (comm/compat.py); the
+    # subprocess has the repo on PYTHONPATH, so the shared helper works
+    "from flexflow_tpu.comm.compat import force_cpu_devices\n"
+    "force_cpu_devices(8)\n"
+    "import runpy, sys\n"
+    "sys.argv = sys.argv[1:]\n"  # the script must see ITS OWN argv
     "runpy.run_path(sys.argv[0], run_name='__main__')"
 )
 

@@ -231,10 +231,16 @@ def _exec_cfg_kwargs(n_devices, on_cpu):
     )
 
 
-def execute_pair(name, spec, n_devices, steps, calibration_file=None):
+def execute_pair(name, spec, n_devices, steps, calibration_file=None,
+                 obs=False, out_prefix="BENCH_SEARCH",
+                 drift_threshold=0.5):
     """Measure real per-step seconds for DP vs searched strategies on
     the live mesh.  Returns None when the model has no executable
-    reduced config."""
+    reduced config.  With ``obs`` the unified telemetry rides along:
+    a per-strategy DriftReport (simulated prediction vs the measured
+    steady step, per phase) lands in the returned row, and the
+    searched strategy's PREDICTED timeline is written as
+    Perfetto-loadable Chrome-trace JSON."""
     if spec["exec_build"] is None:
         return None
     import os
@@ -248,6 +254,7 @@ def execute_pair(name, spec, n_devices, steps, calibration_file=None):
     on_cpu = jax.devices()[0].platform == "cpu"
 
     results = {}
+    programs = {}  # mode -> (graph, strategy, cfg, executor) for obs
     searched_is_dp = False
     for mode in ("dp", "searched"):
         # the osdi22ae contract runs searched-vs-DP on the SAME hardware,
@@ -277,7 +284,50 @@ def execute_pair(name, spec, n_devices, steps, calibration_file=None):
         xs = synthetic_inputs(model, cfg.batch_size)
         y = synthetic_labels(model, cfg.batch_size, spec["loss"])
         results[mode] = _steady_step_seconds(model, xs, y, steps)
+        if obs:
+            programs[mode] = (
+                model.graph,
+                model.strategy if mode == "searched" else strategy,
+                cfg, type(model.compiled).__name__,
+            )
+    obs_row = {}
+    if obs:
+        from flexflow_tpu.obs.drift import build_drift_report
+        from flexflow_tpu.search.driver import coherent_calibration
+        from flexflow_tpu.search.simulator import Simulator
+
+        drift = {}
+        for mode, (g, strat, cfg_m, executor) in programs.items():
+            # predict with the same table the search ranked with — a
+            # roofline prediction labeled "calibrated" would flag the
+            # calibration table stale for drift it never caused
+            cal = coherent_calibration(cfg_m)
+            sim = Simulator.for_config(cfg_m, calibration=cal)
+            bd = {}
+            schedule, comm = [], []
+            sim.simulate(g, strat, breakdown=bd, schedule=schedule,
+                         comm_schedule=comm)
+            rep = build_drift_report(
+                bd, measured_step_s=results[mode],
+                threshold=drift_threshold,
+                calibrated=cal is not None,
+            )
+            if rep is not None:
+                d = rep.to_dict()
+                d["executor"] = executor
+                drift[mode] = d
+            if mode == "searched":
+                trace_path = f"{out_prefix}_timeline_{name}.json"
+                sim.export_chrome_trace(
+                    g, strat, trace_path,
+                    label=f"predicted ({name}, searched)",
+                    schedule=schedule, comm_schedule=comm,
+                    total_s=bd.get("total_s"))
+                obs_row["predicted_timeline"] = trace_path
+        if drift:
+            obs_row["drift"] = drift
     return {
+        **obs_row,
         "searched_is_dp": searched_is_dp,
         "exec_backend": jax.devices()[0].platform,
         "exec_devices": n_devices,
@@ -457,6 +507,16 @@ def main():
                     help="run ONLY the sync-precision sweep and merge it "
                          "into the existing artifact, leaving every "
                          "model row untouched")
+    ap.add_argument("--obs", action="store_true",
+                    help="unified telemetry: JSONL event log "
+                         "(<prefix>_obs.jsonl), per-model "
+                         "predicted-timeline Chrome-trace JSON, a "
+                         "per-strategy DriftReport in every executed "
+                         "row, and an ffobs strategy-explanation "
+                         "report (<prefix>_report.md)")
+    ap.add_argument("--drift-threshold", type=float, default=0.5,
+                    help="predicted-vs-measured ratio beyond which a "
+                         "DriftReport flags staleness")
     args = ap.parse_args()
 
     import os
@@ -467,6 +527,20 @@ def main():
         from flexflow_tpu.comm.compat import force_cpu_devices
 
         force_cpu_devices(args.devices)
+
+    obs_log = None
+    if args.obs:
+        from flexflow_tpu.obs.events import BUS
+
+        obs_log = f"{args.out_prefix}_obs.jsonl"
+        # fresh log per run: the report renders THIS run's decisions.
+        # Close first — FLEXFLOW_TPU_OBS may have bound the bus to this
+        # very path at import, and removing a file an open sink holds
+        # would silently strand every later event on the unlinked inode
+        BUS.close()
+        if os.path.exists(obs_log):
+            os.remove(obs_log)
+        BUS.configure(obs_log)
 
     sweep_precisions = [p for p in args.sync_precision.split(",") if p]
     if args.sync_sweep_only:
@@ -613,7 +687,9 @@ def main():
         if can_exec:
             try:
                 ex = execute_pair(n, specs[n], args.devices, args.steps,
-                                  calibration_file=cal_file)
+                                  calibration_file=cal_file,
+                                  obs=args.obs, out_prefix=args.out_prefix,
+                                  drift_threshold=args.drift_threshold)
             except Exception as e:  # honest artifact: record the failure
                 ex = {"exec_error": f"{type(e).__name__}: {e}"}
             if ex:
@@ -696,6 +772,28 @@ def main():
     with open(f"{args.out_prefix}.md", "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"# wrote {args.out_prefix}.json / {args.out_prefix}.md")
+
+    if args.obs and obs_log and os.path.exists(obs_log):
+        # render the strategy-explanation report from this run's event
+        # log (tools/ffobs.py is stdlib-only, so the subprocess is fast)
+        import subprocess
+        import sys as _sys
+
+        from flexflow_tpu.obs.events import BUS
+
+        BUS.flush()
+        ffobs = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools", "ffobs.py")
+        proc = subprocess.run(
+            [_sys.executable, ffobs, "report", obs_log],
+            capture_output=True, text=True)
+        if proc.returncode == 0:
+            with open(f"{args.out_prefix}_report.md", "w") as f:
+                f.write(proc.stdout)
+            print(f"# wrote {args.out_prefix}_report.md (telemetry: "
+                  f"{obs_log})")
+        else:
+            print(f"# ffobs report failed: {proc.stderr.strip()}")
 
 
 if __name__ == "__main__":

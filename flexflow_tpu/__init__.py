@@ -46,6 +46,10 @@ _LAZY = {
     "device_trace": ("flexflow_tpu.runtime.profiler", "device_trace"),
     "measure_operator_cost": ("flexflow_tpu.runtime.profiler", "measure_operator_cost"),
     "RecursiveLogger": ("flexflow_tpu.utils.logging", "RecursiveLogger"),
+    # unified telemetry (flexflow_tpu/obs)
+    "OBS_BUS": ("flexflow_tpu.obs.events", "BUS"),
+    "METRICS": ("flexflow_tpu.obs.metrics", "METRICS"),
+    "DriftReport": ("flexflow_tpu.obs.drift", "DriftReport"),
 }
 
 __all__ = ["__version__", *_LAZY]

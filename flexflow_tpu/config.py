@@ -114,6 +114,18 @@ class FFConfig:
     # model prices each group's sync at its cheapest admissible
     # precision (wire bytes shrink, quantize overhead added) and the
     # chosen map is executed by the lowering's _sync_grads
+    # observability (flexflow_tpu/obs): unified telemetry
+    obs_log_file: Optional[str] = None  # JSONL structured-event sink
+    # (search-decision tracing, strategy tables, drift reports); also
+    # enabled process-wide via FLEXFLOW_TPU_OBS=<path>.  None (the
+    # default) keeps every emit to a single boolean check — near-zero
+    # overhead off.
+    obs_trace_file: Optional[str] = None  # compile() writes the
+    # PREDICTED task timeline here as Chrome-trace JSON (Perfetto-
+    # loadable), the artifact to view next to the real device_trace
+    drift_threshold: float = 0.5  # |measured/predicted - 1| above which
+    # the DriftReport flags the prediction stale (and, when a measured
+    # calibration table was consulted, the TABLE as stale)
     zero_dp_shard: bool = False  # ZeRO-1 / weight-update sharding
     # (arXiv:2004.13336): shard optimizer state (and the update
     # compute) of replicated weights over the mesh axes they are
@@ -200,6 +212,18 @@ class FFConfig:
                        help="gradient-sync wire precision; 'search' "
                             "lets the strategy search pick it per "
                             "weight group")
+        p.add_argument("--obs-log", dest="obs_log", type=str, default=None,
+                       help="JSONL structured-event telemetry sink "
+                            "(flexflow_tpu/obs; tools/ffobs.py renders it)")
+        p.add_argument("--obs-trace", dest="obs_trace", type=str,
+                       default=None,
+                       help="write the PREDICTED task timeline as "
+                            "Chrome-trace JSON at compile (Perfetto)")
+        p.add_argument("--drift-threshold", dest="drift_threshold",
+                       type=float, default=0.5,
+                       help="predicted-vs-measured step-time drift "
+                            "beyond which the DriftReport flags "
+                            "calibration staleness")
         p.add_argument("--seed", type=int, default=0)
         args, _ = p.parse_known_args(argv)
         search_devs = args.search_num_workers * max(1, args.search_num_nodes or 1)
@@ -231,5 +255,8 @@ class FFConfig:
             remat=args.remat,
             zero_dp_shard=args.zero_dp_shard,
             sync_precision=args.sync_precision,
+            obs_log_file=args.obs_log,
+            obs_trace_file=args.obs_trace,
+            drift_threshold=args.drift_threshold,
             seed=args.seed,
         )

@@ -415,6 +415,13 @@ class FFModel:
                 f"comp_mode must be 'training' or 'inference', got {comp_mode!r}"
             )
         self.config.comp_mode = comp_mode
+        if self.config.obs_log_file:
+            # FFConfig-gated unified telemetry (flexflow_tpu/obs): the
+            # search, compile, and fit paths below all emit through the
+            # same bus once it is armed
+            from flexflow_tpu.obs.events import BUS as _obs_bus
+
+            _obs_bus.configure(self.config.obs_log_file)
         self.pipeline_proposal = None  # a stale proposal from an earlier
         # compile must not hijack this one's lowering
         self.optimizer = optimizer or SGDOptimizer(
@@ -440,6 +447,7 @@ class FFModel:
                 "silently ignoring the flag would leave optimizer state "
                 "replicated while the user expects 1/N memory"
             )
+        searched_strategy = False  # did the joint search pick it?
         if strategy is None:
             if pipeline is not None:
                 # dp over the devices left after the pp axis is carved off
@@ -466,6 +474,7 @@ class FFModel:
                     self.graph, self.config, return_graph=True
                 )
                 self.graph = best_graph
+                searched_strategy = True
                 # the search also costs pipelined candidates for
                 # stacked-block graphs (reference gap: OP_PIPELINE is an
                 # enum stub, ffconst.h:148) — a winning PipelineConfig
@@ -581,10 +590,74 @@ class FFModel:
             self.sync_precision_map = choose_sync_precision(
                 self.graph, strategy, _sync_sim.cost
             )
+        # predicted step breakdown + strategy-explanation telemetry —
+        # the predicted half of the DriftReport fit() completes.  Only
+        # computed when something will consume it (profiling, the obs
+        # bus, a strategy/trace export): one extra simulate per compile
+        # is cheap but not free.
+        from flexflow_tpu.obs.events import BUS as _obs_bus
+
+        self.predicted_breakdown = None
+        self.drift_report = None
+        if (
+            strategy
+            and pipeline is None
+            and self.pipeline_proposal is None
+            and (
+                self.config.profiling
+                or _obs_bus.enabled
+                or self.config.export_strategy_file
+                or self.config.obs_trace_file
+            )
+        ):
+            from flexflow_tpu.search.driver import coherent_calibration
+            from flexflow_tpu.search.simulator import Simulator as _Sim
+
+            try:
+                _psim = _Sim.for_config(
+                    self.config, calibration=coherent_calibration(self.config)
+                )
+                bd: Dict = {}
+                _sched: list = []
+                _comm: list = []
+                _psim.simulate(self.graph, strategy, breakdown=bd,
+                               schedule=_sched, comm_schedule=_comm)
+                bd["calibrated"] = _psim.cost.calibration is not None
+                bd["machine"] = self.config.machine_spec.name
+                self.predicted_breakdown = bd
+                if _obs_bus.enabled:
+                    _obs_bus.emit(
+                        "strategy.table",
+                        rows=_psim.strategy_table_rows(
+                            self.graph, strategy,
+                            self.sync_precision_map,
+                        ),
+                        predicted_s=bd.get("total_s"),
+                        devices=self.config.search_devices,
+                        comp_mode=comp_mode,
+                        # searched=False marks forced-DP / imported /
+                        # caller-supplied strategies so report tooling
+                        # can prefer the joint-search table when both
+                        # were compiled in one run
+                        searched=searched_strategy,
+                    )
+                if self.config.obs_trace_file:
+                    _psim.export_chrome_trace(
+                        self.graph, strategy, self.config.obs_trace_file,
+                        schedule=_sched, comm_schedule=_comm,
+                        total_s=bd.get("total_s"))
+            except Exception:  # telemetry must never fail a compile
+                self.predicted_breakdown = None
         if self.config.export_strategy_file:
             from flexflow_tpu.search.strategy_io import export_strategy
 
-            export_strategy(self.config.export_strategy_file, self.graph, strategy)
+            export_strategy(
+                self.config.export_strategy_file, self.graph, strategy,
+                meta=(
+                    {"predicted": self.predicted_breakdown}
+                    if self.predicted_breakdown else None
+                ),
+            )
         if self.config.export_strategy_computation_graph_file:
             self.graph.write_dot(
                 self.config.export_strategy_computation_graph_file, strategy
@@ -946,6 +1019,7 @@ class FFModel:
                 rng = jax.random.key(self._rng_counter)
                 if profiler is not None:
                     profiler.start_step()
+                    profiler.start_phase("dispatch")
                 if kind == "stack":
                     (self.params, self.opt_state, self.state, losses, ms) = (
                         self.compiled.train_steps(
@@ -967,7 +1041,13 @@ class FFModel:
                     )
                     n_this = 1
                 if profiler is not None:
-                    float(loss)  # fence so the step time is real
+                    # host phases: enqueue (dispatch) vs device
+                    # completion (wait) — the measured side of the
+                    # DriftReport; the fence makes the step time real
+                    profiler.end_phase("dispatch")
+                    profiler.start_phase("wait")
+                    float(loss)
+                    profiler.end_phase("wait")
                     profiler.end_step()
                 if recompile_state is not None and recompile_state.check(self):
                     # drop the accumulator AND this step's metrics: the
@@ -1022,9 +1102,75 @@ class FFModel:
             if verbose:
                 print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
             self.last_throughput = thr
-        if profiler is not None and verbose:
-            print(f"PROFILE {profiler}")
+        if profiler is not None:
+            self._report_profile(profiler, verbose)
         return history
+
+    def _report_profile(self, profiler, verbose: bool) -> None:
+        """Step-profile reporting through the obs metrics registry +
+        event bus (replacing the ad-hoc ``print(f"PROFILE ...")``-only
+        path), plus the predicted-vs-measured DriftReport when
+        compile() recorded a prediction."""
+        from flexflow_tpu.obs.drift import build_drift_report
+        from flexflow_tpu.obs.events import BUS
+        from flexflow_tpu.obs.metrics import METRICS
+
+        s = profiler.summary()
+        if s.get("steps") and not s.get("includes_compile"):
+            # compile-contaminated stats stay out of the registry the
+            # same way the drift path declines them — a gauge has no
+            # honesty flag to carry the caveat
+            METRICS.gauge("fit.step_mean_s").set(s["mean_s"])
+            METRICS.gauge("fit.step_p95_s").set(s["p95_s"])
+            METRICS.counter("fit.steps").inc(int(s["steps"]))
+            hist = METRICS.histogram("fit.step_s")
+            for t in profiler.step_times[1:]:
+                hist.observe(t)
+        BUS.emit("profile.summary", **s)
+        if verbose:
+            print(f"PROFILE {profiler}")
+        pred = getattr(self, "predicted_breakdown", None)
+        if not pred or not s.get("steps") or s.get("includes_compile"):
+            # a compile-only measurement would compare apples to the
+            # compile step; decline rather than report fiction
+            return
+        report = build_drift_report(
+            pred,
+            measured_step_s=s["mean_s"],
+            measured_phases=profiler.phase_summary(),
+            threshold=self.config.drift_threshold,
+            calibrated=bool(pred.get("calibrated")),
+        )
+        if report is None:
+            return
+        self.drift_report = report
+        BUS.emit("drift.report", **report.to_dict())
+        METRICS.gauge("fit.drift_ratio").set(report.ratio)
+        if report.calibration_stale:
+            BUS.emit("calibration.staleness", ratio=report.ratio,
+                     threshold=report.threshold)
+            from flexflow_tpu.utils.logging import SEARCH_LOG
+
+            lo = 1.0 / (1.0 + report.threshold)
+            hi = 1.0 + report.threshold
+            SEARCH_LOG.log(
+                f"calibration staleness: measured step is "
+                f"{report.ratio:.2f}x the calibrated prediction, "
+                f"outside [{lo:.2f}x, {hi:.2f}x] — re-probe with "
+                f"--calibrate"
+            )
+        if verbose:
+            print(f"DRIFT {report}")
+        if self.config.export_strategy_file:
+            from flexflow_tpu.search.strategy_io import attach_meta
+
+            try:
+                attach_meta(self.config.export_strategy_file,
+                            drift=report.to_dict())
+            except (OSError, ValueError):
+                pass
+        BUS.flush()  # writes are block-buffered; a fit boundary is
+        # where tooling tails the log
 
     def evaluate(self, x=None, y=None, batch_size: Optional[int] = None):
         """reference: flexflow_cffi.py:1876 eval."""

@@ -1,0 +1,101 @@
+"""Predicted-vs-measured drift reporting.
+
+The entire search rests on ``Simulator.simulate``'s fidelity; a
+``DriftReport`` makes that falsifiable per run: the simulator's
+predicted step breakdown (``breakdown=`` dict from ``simulate``)
+against ``StepProfiler`` measurements, per phase.  Drift beyond
+``threshold`` flags the strategy as mispredicted — and, when the
+prediction consulted a measured CalibrationTable, flags the TABLE as
+stale (the ROADMAP's calibration-staleness follow-up needs exactly
+this signal).
+
+Phase semantics are honest about what is measurable: the executed
+step is ONE fused XLA program, so only the total step time has a
+measured counterpart; the predicted compute/sync split and the host
+``dispatch``/``wait`` phases are recorded single-sided (``ratio``
+None) rather than invented.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class DriftReport:
+    predicted_s: float
+    measured_s: float
+    ratio: float  # measured / predicted (>1: slower than predicted)
+    threshold: float
+    stale: bool
+    calibrated: bool = False
+    calibration_stale: bool = False
+    phases: Dict[str, dict] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "predicted_s": self.predicted_s,
+            "measured_s": self.measured_s,
+            "ratio": self.ratio,
+            "threshold": self.threshold,
+            "stale": self.stale,
+            "calibrated": self.calibrated,
+            "calibration_stale": self.calibration_stale,
+            "phases": self.phases,
+        }
+
+    def __str__(self) -> str:
+        flag = (" STALE-CALIBRATION" if self.calibration_stale
+                else " STALE" if self.stale else "")
+        return (
+            f"predicted={self.predicted_s * 1e3:.3f}ms "
+            f"measured={self.measured_s * 1e3:.3f}ms "
+            f"ratio={self.ratio:.2f}{flag}"
+        )
+
+
+def _phase(predicted_s: Optional[float], measured_s: Optional[float]) -> dict:
+    ratio = None
+    if (predicted_s and measured_s and predicted_s > 0
+            and math.isfinite(predicted_s)):
+        ratio = measured_s / predicted_s
+    return {"predicted_s": predicted_s, "measured_s": measured_s,
+            "ratio": ratio}
+
+
+def build_drift_report(
+    predicted: Dict[str, float],
+    measured_step_s: float,
+    measured_phases: Optional[Dict[str, dict]] = None,
+    threshold: float = 0.5,
+    calibrated: bool = False,
+) -> Optional[DriftReport]:
+    """``predicted`` is a ``Simulator.simulate(breakdown=...)`` dict
+    (``total_s``/``compute_end_s``/``comm_end_s``/...); ``measured_phases``
+    is ``StepProfiler.phase_summary()``.  None when there is nothing
+    comparable (no finite prediction or measurement)."""
+    total = predicted.get("total_s")
+    if (not total or not math.isfinite(total) or not measured_step_s
+            or not math.isfinite(measured_step_s)):
+        return None
+    ratio = measured_step_s / total
+    stale = ratio > 1.0 + threshold or ratio < 1.0 / (1.0 + threshold)
+    phases: Dict[str, dict] = {
+        "step": _phase(total, measured_step_s),
+        "compute": _phase(predicted.get("compute_end_s"), None),
+        "sync": _phase(predicted.get("comm_end_s"), None),
+    }
+    for name, stats in (measured_phases or {}).items():
+        phases[name] = _phase(None, stats.get("mean_s"))
+    return DriftReport(
+        predicted_s=float(total),
+        measured_s=float(measured_step_s),
+        ratio=float(ratio),
+        threshold=float(threshold),
+        stale=bool(stale),
+        calibrated=bool(calibrated),
+        calibration_stale=bool(stale and calibrated),
+        phases=phases,
+    )

@@ -23,11 +23,15 @@ import numpy as np
 
 
 class StepProfiler:
-    """Wall-clock per-step timing with compile-step exclusion."""
+    """Wall-clock per-step timing with compile-step exclusion, plus
+    named host-side phases within a step (``dispatch``/``wait`` in
+    model.fit) — the measured side of the obs DriftReport."""
 
     def __init__(self):
         self.step_times: List[float] = []
+        self.phase_times: Dict[str, List[float]] = {}
         self._t_last: Optional[float] = None
+        self._phase_t0: Dict[str, float] = {}
 
     def start_step(self) -> None:
         self._t_last = time.perf_counter()
@@ -37,9 +41,26 @@ class StepProfiler:
             self.step_times.append(time.perf_counter() - self._t_last)
             self._t_last = None
 
+    def start_phase(self, name: str) -> None:
+        self._phase_t0[name] = time.perf_counter()
+
+    def end_phase(self, name: str) -> None:
+        t0 = self._phase_t0.pop(name, None)
+        if t0 is not None:
+            self.phase_times.setdefault(name, []).append(
+                time.perf_counter() - t0)
+
     def summary(self, skip_first: int = 1) -> Dict[str, float]:
-        """Stats excluding the first (compile) steps."""
-        ts = np.asarray(self.step_times[skip_first:] or self.step_times)
+        """Stats excluding the first (compile) steps.  When every
+        recorded step WOULD be skipped the stats still cover all steps
+        but say so via ``includes_compile`` — silently folding the
+        compile step back in used to misreport single-step runs as
+        steady-state."""
+        kept = self.step_times[skip_first:]
+        includes_compile = (
+            not kept and bool(self.step_times) and skip_first > 0
+        )
+        ts = np.asarray(kept or self.step_times)
         if len(ts) == 0:
             return {"steps": 0}
         return {
@@ -48,7 +69,26 @@ class StepProfiler:
             "p50_s": float(np.percentile(ts, 50)),
             "p95_s": float(np.percentile(ts, 95)),
             "max_s": float(ts.max()),
+            "includes_compile": includes_compile,
         }
+
+    def phase_summary(self, skip_first: int = 1) -> Dict[str, Dict[str, float]]:
+        """Per-phase stats with the same compile-step exclusion (and
+        the same ``includes_compile`` honesty flag) as ``summary``."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, times in self.phase_times.items():
+            kept = times[skip_first:]
+            includes_compile = not kept and bool(times) and skip_first > 0
+            ts = np.asarray(kept or times)
+            if len(ts) == 0:
+                continue
+            out[name] = {
+                "count": len(ts),
+                "mean_s": float(ts.mean()),
+                "total_s": float(ts.sum()),
+                "includes_compile": includes_compile,
+            }
+        return out
 
     def __str__(self) -> str:
         s = self.summary()

@@ -30,8 +30,15 @@ from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.core.graph import Graph, Node
 from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.obs.events import BUS
+from flexflow_tpu.obs.metrics import METRICS
 from flexflow_tpu.search.simulator import Simulator
 from flexflow_tpu.search.views import boundary_views, candidate_views
+
+# cached metric handles (registry objects are stable across reset())
+_MEMO_HITS = METRICS.counter("dp.memo_hits")
+_MEMO_MISSES = METRICS.counter("dp.memo_misses")
+_NATIVE_HITS = METRICS.counter("dp.native_hits")
 
 Strategy = Dict[int, MachineView]
 
@@ -134,6 +141,11 @@ class SearchHelper:
         # diagnostic: how often the greedy fallback decided a subgraph —
         # zero on the model zoo (tests assert this; VERDICT r1 weak #2)
         self.greedy_hits = 0
+        # memo-cache effectiveness (mirrored into the global obs
+        # metrics registry; the driver emits them as dp.summary)
+        self.memo_hits = 0
+        self.memo_misses = 0
+        self.native_hits = 0
 
     # ------------------------------------------------------------------
     def _views(self, node: Node, budget: int, start: int = 0) -> List[MachineView]:
@@ -495,6 +507,8 @@ class SearchHelper:
         if start == 0:
             native = self._native_graph_cost(graph, fixed, budget)
             if native is not None:
+                self.native_hits += 1
+                _NATIVE_HITS.inc()
                 return native
         # structural memo: keyed by graph hash + guid-free canonical
         # fixed views, so isomorphic segments with different guids
@@ -513,8 +527,12 @@ class SearchHelper:
                     # not follow one isomorphism, so the cached cost may
                     # not match this strategy — ground it in the sim
                     cost = self.sim.simulate(graph, strategy)
+                self.memo_hits += 1
+                _MEMO_HITS.inc()
                 return cost, strategy
 
+        self.memo_misses += 1
+        _MEMO_MISSES.inc()
         cost, strategy = self._graph_cost_uncached(graph, fixed, budget, start)
         return self._finish(graph, key, cost, strategy, fixed, budget, start)
 
@@ -534,13 +552,19 @@ class SearchHelper:
         if start == 0:
             native = self._native_graph_cost(graph, fixed, budget)
             if native is not None:
+                self.native_hits += 1
+                _NATIVE_HITS.inc()
                 return native[0]
         key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
         hit = self.memo.get(key)
         if hit is not None:
             # the cached cost is achievable on any isomorphic graph, so
             # no reconstruction is needed for cost-only queries
+            self.memo_hits += 1
+            _MEMO_HITS.inc()
             return hit[0]
+        self.memo_misses += 1
+        _MEMO_MISSES.inc()
         cost, strategy = self._graph_cost_uncached(graph, fixed, budget, start)
         return self._finish(graph, key, cost, strategy, fixed, budget, start)[0]
 
@@ -635,6 +659,12 @@ class SearchHelper:
                     best_c, best_plan = total, (pre, post, f2, bn.guid, v)
         if best_plan is not None:
             pre, post, f2, bn_guid, v = best_plan
+            if BUS.enabled:
+                BUS.emit(
+                    "dp.split", op=graph.nodes[bn_guid].op.name,
+                    pre_nodes=pre.num_nodes, post_nodes=post.num_nodes,
+                    cost_s=best_c, budget=budget,
+                )
             _, s_pre = self.graph_cost(pre, f2, budget, start)
             _, s_post = self.graph_cost(post, f2, budget, start)
             s = dict(s_pre)

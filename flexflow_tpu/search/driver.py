@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Tuple
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.core.graph import Graph, Node
 from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.obs.events import BUS
 from flexflow_tpu.search.dp import SearchHelper, Strategy, canon_fixed_views
 from flexflow_tpu.search.simulator import Simulator
 from flexflow_tpu.search.substitution import generate_all_pcg_xfers
@@ -211,6 +212,12 @@ class _UnityOptimizer:
                 result = self.base_optimize(graph, fixed)
                 self._cache_store(key, graph, fixed, result)
                 return result
+            if BUS.enabled:
+                BUS.emit(
+                    "search.split", op=bn.op.name,
+                    pre_nodes=pre.num_nodes, post_nodes=post.num_nodes,
+                    boundary_views=len(self._boundary_views(bn)),
+                )
             best: Tuple[Optional[Graph], float, Strategy] = (None, math.inf, {})
             best_bound = math.inf
             for v in self._boundary_views(bn):
@@ -272,25 +279,51 @@ class _UnityOptimizer:
             if g is not graph:
                 # full DP for the popped candidate (tier 2)
                 cost, strat = helper.graph_cost(g, fixed)
+                if BUS.enabled:
+                    BUS.emit(
+                        "search.candidate", cost_s=cost, est_s=est,
+                        best_s=best_cost, improved=cost < best_cost,
+                        nodes=g.num_nodes,
+                    )
                 if cost < best_cost:
                     best_cost, best_strategy, best_graph = cost, strat, g
                 parent_s = strat
+            emit = BUS.enabled  # per-candidate events are chatty: one
+            # branch when telemetry is off, full accept/reject
+            # provenance when it is on
             for xf in self.xfers:
                 for m in xf.find_matches(g):
                     g2 = xf.apply(g, m)
                     if g2 is None:
+                        if emit:
+                            BUS.emit("search.substitution", xfer=xf.name,
+                                     action="invalid")
                         continue
                     # a rewrite must not consume a pinned boundary node
                     if any(p not in g2.nodes for p in pinned if p in g.nodes):
+                        if emit:
+                            BUS.emit("search.substitution", xfer=xf.name,
+                                     action="pinned")
                         continue
                     h = g2.hash()
                     if h in seen:
+                        if emit:
+                            BUS.emit("search.substitution", xfer=xf.name,
+                                     action="duplicate")
                         continue
                     seen.add(h)
                     e2 = self._estimate(g2, parent_s, fixed)
                     if e2 < config.search_alpha * best_cost:
                         counter += 1
                         heapq.heappush(heap, (e2, counter, g2, parent_s))
+                        if emit:
+                            BUS.emit("search.substitution", xfer=xf.name,
+                                     action="pushed", est_s=e2,
+                                     best_s=best_cost)
+                    elif emit:
+                        BUS.emit("search.substitution", xfer=xf.name,
+                                 action="pruned", est_s=e2,
+                                 best_s=best_cost)
                 if self._expired():
                     break
         return best_graph, best_cost, best_strategy
@@ -408,6 +441,8 @@ def optimize_strategy(
             f"ignoring calibration probed on {calibration.backend!r} "
             f"(machine model is {config.machine_spec.name!r})"
         )
+        BUS.emit("calibration.ignored", backend=calibration.backend,
+                 machine=config.machine_spec.name)
         calibration = None
     can_probe = False
     if config.calibrate:
@@ -445,9 +480,15 @@ def optimize_strategy(
     floor_sim = sim  # the sim the champion-vs-DP floor must score with
     helper = SearchHelper(sim, n)
 
+    BUS.emit(
+        "search.begin", nodes=graph.num_nodes, devices=n,
+        budget=config.search_budget, timeout_s=config.search_timeout_s,
+        calibrated=calibration is not None,
+    )
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
         best_cost, best_strategy = helper.graph_cost(graph)
         log.log(f"baseline DP-search cost: {best_cost * 1e3:.4f} ms/iter")
+    BUS.emit("search.baseline", cost_s=best_cost)
     best_graph = graph
 
     if return_graph and config.search_budget > 0:
@@ -517,13 +558,30 @@ def optimize_strategy(
     dp_strategy = data_parallel_strategy(graph, n)
     dp_cost = floor_sim.simulate(graph, dp_strategy)
     margin = max(0.0, config.search_improvement_margin)
-    if math.isfinite(dp_cost) and best_cost > dp_cost * (1.0 - margin):
+    kept_dp = math.isfinite(dp_cost) and best_cost > dp_cost * (1.0 - margin)
+    BUS.emit("search.floor", kept_dp=kept_dp, dp_cost_s=dp_cost,
+             searched_cost_s=best_cost, margin=margin)
+    if kept_dp:
         log.log(
             f"searched win {(1.0 - best_cost / dp_cost) * 100:.2f}% is "
             f"below the {margin * 100:.0f}% uncertainty margin: "
             f"keeping plain data parallelism"
         )
         best_cost, best_strategy, best_graph = dp_cost, dp_strategy, graph
+
+    if BUS.enabled:
+        BUS.emit(
+            "search.result", cost_s=best_cost,
+            rewritten=best_graph is not graph,
+            nodes=best_graph.num_nodes, kept_dp=kept_dp,
+            table=floor_sim.strategy_table_rows(best_graph, best_strategy),
+        )
+        BUS.emit(
+            "dp.summary", memo_hits=helper.memo_hits,
+            memo_misses=helper.memo_misses,
+            native_hits=helper.native_hits,
+            greedy_hits=helper.greedy_hits,
+        )
 
     if return_graph:
         return best_graph, best_strategy

@@ -143,13 +143,20 @@ class Simulator:
         strategy: Dict[int, MachineView],
         include_update: Optional[bool] = None,
         schedule: Optional[list] = None,
+        breakdown: Optional[dict] = None,
+        comm_schedule: Optional[list] = None,
     ) -> float:
         """Seconds per training iteration under the strategy (or per
         inference when the simulator was built with inference=True —
         ``include_update`` defaults to the simulator's mode).  Pass a
         list as ``schedule`` to receive per-task placement records
         ``(op_name, start_s, finish_s, device_ids)`` — the simulated
-        task graph (reference: simulator.cc:1008-1058 dot export)."""
+        task graph (reference: simulator.cc:1008-1058 dot export) —
+        and as ``comm_schedule`` the weight-sync collective records in
+        the same shape (the comm rows of the predicted timeline).
+        Pass a dict as ``breakdown`` to receive the predicted phase
+        split (compute/comm critical paths, total xfer/sync seconds,
+        peak memory) — the predicted side of the obs DriftReport."""
         if include_update is None:
             include_update = not self.inference
         ready: Dict[Tuple[int, int], float] = {}  # (guid, out_idx) -> time
@@ -208,6 +215,10 @@ class Simulator:
 
         end_time = 0.0
         end_comm = 0.0
+        track = breakdown is not None
+        xfer_total = 0.0
+        sync_total = 0.0
+        compute_total = 0.0
         overlap = self.placement_overlap
         # fast path: in the default (overlap=False) currency every op
         # occupies ALL device timelines, so device availability is ONE
@@ -250,6 +261,8 @@ class Simulator:
                     # at inputs/constants carry no cotangent back, so
                     # they pay the forward reshard only.
                     xfer *= 2.0
+                if track:
+                    xfer_total += xfer
                 t = ready.get((e.src, e.src_idx), 0.0) + xfer
                 if t > start:
                     start = t
@@ -260,6 +273,8 @@ class Simulator:
                 fwd = fwd * r
                 full = (full - upd) * r + upd
             dur = full if include_update else fwd
+            if track:
+                compute_total += dur
             if scalar:
                 mem_total += m_bytes
                 finish = start + dur
@@ -291,11 +306,31 @@ class Simulator:
                 for d in comm_devs:
                     comm_avail[d] = f
                 end_comm = max(end_comm, f)
+                if track:
+                    sync_total += sync
+                if comm_schedule is not None:
+                    comm_schedule.append(
+                        (f"{node.op.name}:sync", s, f,
+                         tuple(sorted(comm_devs))))
 
         peak = mem_total if scalar else max(mem.values())
-        if peak > self.machine.hbm_capacity:
+        total = max(end_time, end_comm)
+        oom = peak > self.machine.hbm_capacity
+        if track:
+            breakdown.update(
+                total_s=math.inf if oom else total,
+                compute_end_s=end_time,
+                comm_end_s=end_comm,
+                compute_total_s=compute_total,
+                xfer_total_s=xfer_total,
+                sync_total_s=sync_total,
+                peak_mem_bytes=peak,
+                num_devices=self.num_devices,
+                include_update=include_update,
+            )
+        if oom:
             return math.inf
-        return max(end_time, end_comm)
+        return total
 
     # ------------------------------------------------------------------
     def _cluster_chains(self, graph: Graph):
@@ -459,6 +494,47 @@ class Simulator:
                 )
         return ns, index
 
+    def node_cost_row(self, node, mv) -> Tuple[float, float, float, float]:
+        """Public per-(op, view) cost row ``(fwd_s, full_s, sync_s,
+        mem_bytes)`` — the strategy-explanation table (obs telemetry)
+        reads predicted costs through this."""
+        return self._node_costs(node, mv)
+
+    # ------------------------------------------------------------------
+    def export_chrome_trace(
+        self,
+        graph: Graph,
+        strategy: Dict[int, MachineView],
+        path: str,
+        include_update: Optional[bool] = None,
+        label: Optional[str] = None,
+        schedule: Optional[list] = None,
+        comm_schedule: Optional[list] = None,
+        total_s: Optional[float] = None,
+    ) -> float:
+        """Write the simulated schedule as Chrome-trace JSON loadable
+        in Perfetto/chrome://tracing — the PREDICTED timeline, viewable
+        next to the real ``runtime.profiler.device_trace`` capture.
+        Returns the simulated iteration seconds.  Callers that already
+        simulated (e.g. for a breakdown) pass their ``schedule``/
+        ``comm_schedule``/``total_s`` to skip the re-simulation."""
+        from flexflow_tpu.obs.trace import write_chrome_trace
+
+        if schedule is None:
+            schedule, comm_schedule = [], []
+            total_s = self.simulate(
+                graph, strategy, include_update=include_update,
+                schedule=schedule, comm_schedule=comm_schedule,
+            )
+        write_chrome_trace(
+            path, schedule, comm_schedule or [],
+            label=label or f"predicted ({type(self).__name__})",
+            meta={"simulated_step_s": total_s,
+                  "num_devices": self.num_devices,
+                  "machine": self.machine.name},
+        )
+        return total_s
+
     # ------------------------------------------------------------------
     def export_task_graph_dot(self, graph: Graph,
                               strategy: Dict[int, MachineView],
@@ -482,6 +558,48 @@ class Simulator:
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
         return cost
+
+    # ------------------------------------------------------------------
+    def strategy_table_rows(
+        self,
+        graph: Graph,
+        strategy: Dict[int, MachineView],
+        sync_precision_map: Optional[Dict[str, str]] = None,
+    ) -> list:
+        """Per-node strategy-explanation rows — op, chosen view, and
+        the predicted compute/sync/memory breakdown the search ranked
+        it by (plus the chosen gradient-sync wire precision for weight
+        groups).  Emitted as the ``strategy.table`` obs event and
+        rendered by ``tools/ffobs.py report``."""
+        rows = []
+        for node in graph.topo_order():
+            mv = strategy.get(node.guid)
+            if mv is None:
+                mv = node.op.fixed_machine_view() or MachineView.trivial(
+                    node.op.output_shapes[0].ndim
+                )
+            try:
+                fwd, full, sync, mem_b = self._node_costs(node, mv)
+            except Exception:  # never let telemetry break a compile
+                fwd = full = sync = mem_b = math.nan
+            row = {
+                "op": node.op.name,
+                "type": node.op.op_type.value,
+                "view": {
+                    "dims": list(mv.dim_degrees),
+                    "replica": mv.replica_degree,
+                    "start": mv.start_part,
+                },
+                "fwd_s": fwd,
+                "full_s": full,
+                "sync_s": sync,
+                "mem_bytes": mem_b,
+            }
+            if getattr(node.op, "_weight_specs", ()):
+                row["sync_precision"] = (sync_precision_map or {}).get(
+                    node.op.name, "fp32")
+            rows.append(row)
+        return rows
 
     # ------------------------------------------------------------------
     def peak_memory(self, graph: Graph, strategy: Dict[int, MachineView]) -> float:

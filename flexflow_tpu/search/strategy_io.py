@@ -4,18 +4,32 @@
 Format: JSON mapping op name -> {"dims": [...], "replica": r}.  Keyed
 by op NAME (stable across runs with deterministic name generation)
 rather than guid so strategies transfer between processes.
+
+A reserved ``"__meta__"`` entry (never a legal op name key for
+``import_strategy``, which only reads names present in the graph)
+carries run provenance: the simulator's predicted step breakdown at
+export time and — via ``attach_meta`` after training — the measured
+DriftReport, so a strategy file records both what the search promised
+and what execution delivered.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict
+from typing import Dict, Optional
 
 from flexflow_tpu.core.graph import Graph
 from flexflow_tpu.core.machine import MachineView
 
+META_KEY = "__meta__"
 
-def export_strategy(path: str, graph: Graph, strategy: Dict[int, MachineView]) -> None:
+
+def export_strategy(
+    path: str,
+    graph: Graph,
+    strategy: Dict[int, MachineView],
+    meta: Optional[dict] = None,
+) -> None:
     out = {}
     for guid, mv in strategy.items():
         node = graph.nodes.get(guid)
@@ -31,6 +45,8 @@ def export_strategy(path: str, graph: Graph, strategy: Dict[int, MachineView]) -
             "replica": mv.replica_degree,
             "start": mv.start_part,
         }
+    if meta:
+        out[META_KEY] = meta
     with open(path, "w") as f:
         json.dump(out, f, indent=2, sort_keys=True)
 
@@ -48,3 +64,23 @@ def import_strategy(path: str, graph: Graph) -> Dict[int, MachineView]:
                 start_part=d.get("start", 0),
             )
     return strategy
+
+
+def read_meta(path: str) -> dict:
+    """The ``__meta__`` provenance block of an exported strategy file
+    ({} when absent)."""
+    with open(path) as f:
+        return json.load(f).get(META_KEY, {})
+
+
+def attach_meta(path: str, **updates) -> dict:
+    """Merge ``updates`` into the strategy file's ``__meta__`` block in
+    place (model.fit persists the post-training DriftReport next to
+    the strategy this way).  Returns the merged block."""
+    with open(path) as f:
+        data = json.load(f)
+    meta = data.setdefault(META_KEY, {})
+    meta.update(updates)
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+    return meta

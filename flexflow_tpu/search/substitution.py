@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from flexflow_tpu.core.graph import Edge, Graph, Node
 from flexflow_tpu.core.optype import OperatorType
 from flexflow_tpu.core.ptensor import ParallelTensorShape
+from flexflow_tpu.obs.metrics import METRICS
 from flexflow_tpu.parallel.parallel_ops import (
     CombineOp,
     ReductionOp,
@@ -32,6 +33,12 @@ from flexflow_tpu.parallel.parallel_ops import (
 )
 
 Match = Node
+
+# obs telemetry: match-machinery volume (the per-candidate accept/
+# reject provenance is emitted by the driver, which owns the decision)
+_SCANS = METRICS.counter("substitution.find_matches_calls")
+_MATCHES = METRICS.counter("substitution.matches_found")
+_APPLIES = METRICS.counter("substitution.applies")
 
 
 @dataclass
@@ -43,9 +50,14 @@ class GraphXfer:
     apply_fn: Callable[[Graph, Node], Optional[Graph]]
 
     def find_matches(self, graph: Graph) -> List[Match]:
-        return [n for n in graph.topo_order() if self.matcher(graph, n)]
+        out = [n for n in graph.topo_order() if self.matcher(graph, n)]
+        _SCANS.inc()
+        if out:
+            _MATCHES.inc(len(out))
+        return out
 
     def apply(self, graph: Graph, match: Match) -> Optional[Graph]:
+        _APPLIES.inc()
         return self.apply_fn(graph, match)
 
 

@@ -43,12 +43,15 @@ class LogicalTaskGraphSimulator(Simulator):
         return flows
 
     def simulate(self, graph: Graph, strategy: Dict[int, MachineView],
-                 include_update=None, schedule=None) -> float:
+                 include_update=None, schedule=None, breakdown=None,
+                 comm_schedule=None) -> float:
         if include_update is None:
             include_update = not self.inference
         if self.cost.network is None:
             # no topology to pool flows on — fall back to the event sim
-            return super().simulate(graph, strategy, include_update, schedule)
+            return super().simulate(graph, strategy, include_update, schedule,
+                                    breakdown=breakdown,
+                                    comm_schedule=comm_schedule)
 
         topo = graph.topo_order()
         shardings = {}
@@ -123,4 +126,15 @@ class LogicalTaskGraphSimulator(Simulator):
                             n, t_bw * self.machine.ici_bandwidth))
 
         comm_time = self.cost.network.traffic_time(flows) if flows else 0.0
-        return max(compute_end, comm_time)
+        total = max(compute_end, comm_time)
+        if breakdown is not None:
+            # pooled-traffic currency: flows are joint, so there are no
+            # per-collective comm records (comm_schedule stays empty)
+            breakdown.update(
+                total_s=total,
+                compute_end_s=compute_end,
+                comm_end_s=comm_time,
+                num_devices=self.num_devices,
+                include_update=include_update,
+            )
+        return total

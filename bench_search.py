@@ -143,10 +143,10 @@ def _model_specs():
 
 
 def simulate_pair(name, spec, n_devices, calibration=None,
-                  calibration_file=None):
+                  calibration_file=None, cost_cache_file=None):
     import flexflow_tpu as ff
     from flexflow_tpu.compiler.lowering import data_parallel_strategy
-    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.driver import LAST_SEARCH_STATS, optimize_strategy
     from flexflow_tpu.search.simulator import Simulator
 
     cfg = ff.FFConfig(batch_size=spec["batch"], num_devices=n_devices,
@@ -154,7 +154,8 @@ def simulate_pair(name, spec, n_devices, calibration=None,
                       # the SEARCH must rank with the measured table too,
                       # or it optimizes the roofline and the calibrated
                       # re-simulation below exposes a bad pick
-                      calibration_file=calibration_file)
+                      calibration_file=calibration_file,
+                      cost_cache_file=cost_cache_file)
     model = spec["build"](cfg)
     g = model.graph
     if calibration is not None and (
@@ -169,8 +170,12 @@ def simulate_pair(name, spec, n_devices, calibration=None,
     t0 = time.monotonic()
     best_graph, strategy = optimize_strategy(g, cfg, return_graph=True)
     search_s = time.monotonic() - t0
+    stats = dict(LAST_SEARCH_STATS)
     c_se = Simulator(cfg.machine_spec, num_devices=n_devices,
                      calibration=calibration).simulate(best_graph, strategy)
+    d, f = stats.get("delta_sims", 0), stats.get("full_sims", 0)
+    rh = stats.get("cache_row_hits", 0)
+    rm = stats.get("cache_row_misses", 0)
     return {
         "nodes": g.num_nodes,
         # whether THIS model's sim numbers actually consulted measured
@@ -180,7 +185,19 @@ def simulate_pair(name, spec, n_devices, calibration=None,
         "sim_dp_ms": round(c_dp * 1e3, 4),
         "sim_searched_ms": round(c_se * 1e3, 4),
         "sim_ratio": round(c_dp / c_se, 3) if c_se > 0 else None,
-        "search_seconds": round(search_s, 1),
+        # split timing (was one conflated search_seconds): any
+        # compile-time calibration probing is reported separately
+        "search_seconds": round(stats.get("search_seconds", search_s), 2),
+        "calibration_seconds": round(stats.get("calibration_seconds", 0.0),
+                                     2),
+        # delta-simulation and persistent-cache effectiveness — the
+        # tracked trajectory numbers for search throughput
+        "delta_sims": d,
+        "full_sims": f,
+        "delta_hit_rate": round(d / (d + f), 3) if (d + f) else None,
+        "cost_cache_row_hit_rate": (
+            round(rh / (rh + rm), 3) if (rh + rm) else None),
+        "cost_cache_result_hit": bool(stats.get("result_cache_hit")),
     }
 
 
@@ -498,6 +515,18 @@ def main():
                     help="artifact file prefix — point smoke runs at a "
                          "scratch prefix so they never overwrite the "
                          "committed full artifact")
+    ap.add_argument("--sim-only", action="store_true",
+                    help="skip the executed-step tier even when enough "
+                         "devices are visible — the search-throughput "
+                         "measurement mode (cold vs warm cost cache)")
+    ap.add_argument("--cost-cache-file", default="COST_CACHE.json",
+                    help="persistent cost cache (search/cost_cache.py): "
+                         "per-(op, view) cost rows + finished search "
+                         "results keyed by graph digest x machine view x "
+                         "calibration signature; repeat sweeps start warm")
+    ap.add_argument("--no-cost-cache", action="store_true",
+                    help="bypass the persistent cost cache (cold-cache "
+                         "run)")
     ap.add_argument("--sync-precision", default="fp32,bf16,int8",
                     help="comma list of gradient-sync wire precisions to "
                          "sweep on the sync-bound BERT config (simulated "
@@ -586,6 +615,9 @@ def main():
     if args.calibrate_only:
         args.calibrate = True
     calibration = None
+    bench_cal = {}  # per-model seconds spent in the bench's own probe
+    # loop — reported as calibration_seconds, never folded into
+    # search_seconds (the satellite split)
     if args.load_calibration:
         from flexflow_tpu.search.calibration import CalibrationTable
 
@@ -647,9 +679,11 @@ def main():
         for n in names:
             cfg = ff.FFConfig(batch_size=specs[n]["batch"],
                               num_devices=args.devices)
+            t0 = time.monotonic()
             calibrate_graph(specs[n]["build"](cfg).graph, args.devices,
                             calibration,
                             time_budget_s=args.calibrate_budget)
+            bench_cal[n] = time.monotonic() - t0
             print(f"# calibration after {n}: {len(calibration)} records, "
                   f"{calibration.num_clusters} clusters")
         calibrate_graph(_coverage_graph(), args.devices, calibration,
@@ -673,17 +707,22 @@ def main():
         # contract is "never touch the BENCH_SEARCH artifacts"
         return
 
+    cost_cache = None if args.no_cost_cache else args.cost_cache_file
     report = {"devices": args.devices,
               "calibrated": bool(calibration) and len(calibration) > 0,
               "calibration_backend": getattr(calibration, "backend", None)
               if calibration else None,
               "backend": jax.devices()[0].platform,
+              "cost_cache": cost_cache,
               "models": {}}
-    can_exec = len(jax.devices()) >= args.devices
+    can_exec = len(jax.devices()) >= args.devices and not args.sim_only
     cal_file = args.calibration_file if calibration is not None else None
     for n in names:
         row = simulate_pair(n, specs[n], args.devices, calibration,
-                            calibration_file=cal_file)
+                            calibration_file=cal_file,
+                            cost_cache_file=cost_cache or "")
+        row["calibration_seconds"] = round(
+            row.get("calibration_seconds", 0.0) + bench_cal.get(n, 0.0), 2)
         if can_exec:
             try:
                 ex = execute_pair(n, specs[n], args.devices, args.steps,
@@ -718,16 +757,22 @@ def main():
         "see exec_scale).",
         "",
         "| model | nodes | sim DP ms | sim searched ms | sim ratio | "
-        "exec ratio | exec backend/scale | search s |",
-        "|---|---|---|---|---|---|---|---|",
+        "exec ratio | exec backend/scale | cal s | search s | "
+        "delta hit | cache |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for n, r in report["models"].items():
+        cache_cell = ("result" if r.get("cost_cache_result_hit")
+                      else (f"rows {r['cost_cache_row_hit_rate']:.0%}"
+                            if r.get("cost_cache_row_hit_rate") is not None
+                            else "—"))
         lines.append(
             f"| {n} | {r['nodes']} | {r['sim_dp_ms']} | "
             f"{r['sim_searched_ms']} | {r['sim_ratio']} | "
             f"{r.get('exec_ratio', '—')} | "
             f"{r.get('exec_backend', '—')}/{r.get('exec_scale', '—')} | "
-            f"{r['search_seconds']} |")
+            f"{r.get('calibration_seconds', 0.0)} | {r['search_seconds']} | "
+            f"{r.get('delta_hit_rate', '—')} | {cache_cell} |")
     cal_note = (
         f"Calibrated cost model: {report['calibrated']}"
         + (f" (probes measured on {report['calibration_backend']})."

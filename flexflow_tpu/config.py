@@ -126,6 +126,12 @@ class FFConfig:
     drift_threshold: float = 0.5  # |measured/predicted - 1| above which
     # the DriftReport flags the prediction stale (and, when a measured
     # calibration table was consulted, the TABLE as stale)
+    cost_cache_file: Optional[str] = None  # persistent cost cache
+    # (search/cost_cache.py): per-(op, view) cost rows + search results
+    # keyed by node digest x machine view x calibration signature,
+    # invalidated wholesale when the signature moves.  None falls back
+    # to $FLEXFLOW_TPU_COST_CACHE (path; "0"/empty disables); empty
+    # string "" disables outright (--no-cost-cache)
     zero_dp_shard: bool = False  # ZeRO-1 / weight-update sharding
     # (arXiv:2004.13336): shard optimizer state (and the update
     # compute) of replicated weights over the mesh axes they are
@@ -224,6 +230,15 @@ class FFConfig:
                        help="predicted-vs-measured step-time drift "
                             "beyond which the DriftReport flags "
                             "calibration staleness")
+        p.add_argument("--cost-cache-file", dest="cost_cache_file",
+                       type=str, default=None,
+                       help="persistent per-(op, view) cost-row + "
+                            "search-result cache (search/cost_cache.py); "
+                            "repeated searches start warm")
+        p.add_argument("--no-cost-cache", dest="no_cost_cache",
+                       action="store_true",
+                       help="bypass the persistent cost cache even when "
+                            "a file/env default is configured")
         p.add_argument("--seed", type=int, default=0)
         args, _ = p.parse_known_args(argv)
         search_devs = args.search_num_workers * max(1, args.search_num_nodes or 1)
@@ -258,5 +273,6 @@ class FFConfig:
             obs_log_file=args.obs_log,
             obs_trace_file=args.obs_trace,
             drift_threshold=args.drift_threshold,
+            cost_cache_file="" if args.no_cost_cache else args.cost_cache_file,
             seed=args.seed,
         )

@@ -19,12 +19,16 @@ dominators/post-dominators, bottleneck (articulation) node finding
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+from heapq import heapify, heappop, heappush
+from typing import Callable, Dict, FrozenSet, Iterable, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 
-@dataclass(frozen=True)
-class Edge:
-    """Tensor edge: output ``src_idx`` of ``src`` feeds input ``dst_idx`` of ``dst``."""
+class Edge(NamedTuple):
+    """Tensor edge: output ``src_idx`` of ``src`` feeds input ``dst_idx`` of ``dst``.
+
+    A NamedTuple, not a dataclass: substitution candidate generation
+    constructs hundreds of thousands per search, and the frozen-
+    dataclass ``object.__setattr__`` init was a measured hotspot."""
 
     src: int  # node guid
     dst: int  # node guid
@@ -61,6 +65,7 @@ class Graph:
         self._topo_cache: Optional[List[Node]] = None
         self._hash_cache: Optional[int] = None
         self._node_hash_cache: Optional[Dict[int, int]] = None
+        self._anc_hash_cache: Optional[Dict[int, int]] = None
 
     # ---- construction ----------------------------------------------------
     def new_node(self, op) -> Node:
@@ -73,6 +78,7 @@ class Graph:
         self._topo_cache = None
         self._hash_cache = None
         self._node_hash_cache = None
+        self._anc_hash_cache = None
 
     def add_node(self, node: Node) -> None:
         if node.guid in self.nodes:
@@ -101,13 +107,49 @@ class Graph:
         self.out_edges.pop(guid, None)
         self.nodes.pop(guid, None)
 
+    def __getstate__(self):
+        # pickle structure only: derived caches rebuild on demand, and
+        # delta annotations (_changed_vs parent weakref, touched sets)
+        # are meaningless outside the process that made them — the
+        # persistent search-result cache pickles rewritten graphs
+        return {
+            "nodes": self.nodes,
+            "in_edges": self.in_edges,
+            "out_edges": self.out_edges,
+            "_next_guid": self._next_guid,
+        }
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._topo_cache = None
+        self._hash_cache = None
+        self._node_hash_cache = None
+        self._anc_hash_cache = None
+
     def copy(self) -> "Graph":
         g = Graph()
         g._next_guid = self._next_guid
-        for guid, n in self.nodes.items():
-            g.nodes[guid] = n  # nodes are immutable (op descriptors shared)
-            g.in_edges[guid] = list(self.in_edges[guid])
-            g.out_edges[guid] = list(self.out_edges[guid])
+        # nodes are immutable (op descriptors shared); C-level copies —
+        # candidate generation clones the graph once per substitution
+        g.nodes = dict(self.nodes)
+        g.in_edges = {k: list(v) for k, v in self.in_edges.items()}
+        g.out_edges = {k: list(v) for k, v in self.out_edges.items()}
+        return g
+
+    def copy_cow(self) -> "Graph":
+        """Copy-on-write clone: edge LISTS are shared with the parent.
+        Callers must REPLACE a node's edge list to change it, never
+        mutate one in place (substitution._insert_before/_insert_after
+        follow this; remove_node does NOT — rewrites that delete nodes
+        take a full copy()).  Candidate generation applies thousands of
+        single-splice rewrites per search; sharing the untouched lists
+        is most of a copy's cost back, and lets delta consumers detect
+        unchanged nodes by list identity."""
+        g = Graph()
+        g._next_guid = self._next_guid
+        g.nodes = dict(self.nodes)
+        g.in_edges = dict(self.in_edges)
+        g.out_edges = dict(self.out_edges)
         return g
 
     # ---- queries ---------------------------------------------------------
@@ -147,18 +189,16 @@ class Graph:
         if self._topo_cache is not None:
             return self._topo_cache
         indeg = {g: len(self.in_edges[g]) for g in self.nodes}
-        ready = sorted(g for g, d in indeg.items() if d == 0)
+        ready = [g for g, d in indeg.items() if d == 0]
         order: List[Node] = []
-        import heapq
-
-        heapq.heapify(ready)
+        heapify(ready)
         while ready:
-            g = heapq.heappop(ready)
+            g = heappop(ready)
             order.append(self.nodes[g])
             for e in self.out_edges[g]:
                 indeg[e.dst] -= 1
                 if indeg[e.dst] == 0:
-                    heapq.heappush(ready, e.dst)
+                    heappush(ready, e.dst)
         if len(order) != len(self.nodes):
             raise ValueError("graph has a cycle")
         self._topo_cache = order
@@ -185,21 +225,82 @@ class Graph:
         """
         if self._hash_cache is not None:
             return self._hash_cache
-        # in-process tuple hashing (like node_hashes): every consumer
-        # (DP memo, driver segment cache, best-first seen-set) lives in
-        # this process, and the search hashes tens of thousands of
-        # rewritten graphs — blake2b-over-strings here was a measured
-        # 6s of the Inception search
-        h: Dict[int, int] = {}
-        for node in self.topo_order():
-            sig = self._sig_repr(node)
-            ins = sorted(
-                (h[e.src], e.src_idx, e.dst_idx) for e in self.in_edges[node.guid]
-            )
-            h[node.guid] = hash((sig, tuple(ins)))
+        h = self._anc_hash_cache or self._anc_hash_map()
         out = hash(tuple(sorted(h[n.guid] for n in self.sinks())))
         self._hash_cache = out
         return out
+
+    def _anc_hash_map(self) -> Dict[int, int]:
+        """Ancestor-refined per-node hashes (the forward half of
+        ``node_hashes``) — in-process tuple hashing: every consumer
+        (DP memo, driver segment cache, best-first seen-set) lives in
+        this process, and the search hashes tens of thousands of
+        rewritten graphs (blake2b-over-strings here was a measured 6s
+        of the Inception search).
+
+        Delta path: a substituted graph carries the changed-guid sets
+        its rewrite touched (substitution._finish_rewrite); when the
+        parent has PRIMED hashes (``prime_delta_hashes``, called on
+        best-first pop), the clean cone copies the parent's values —
+        the per-node hash is a pure function of sig + pred hashes, so
+        the copy is exact — and only the dirty cone pays the tuple
+        building.  The map is NOT cached here: storing a per-node dict
+        on all ~10^4 candidate graphs of a search was measured as 2s of
+        pure GC pressure on Inception."""
+        h: Dict[int, int] = {}
+        in_edges = self.in_edges
+        ph = None
+        cv = getattr(self, "_changed_vs", None)
+        if cv is not None:
+            parent = cv[0]()
+            if parent is not None:
+                ph = parent._anc_hash_cache
+        if ph is not None:
+            dirty = cv[1]
+            # start from the parent's map (C-level copy; stale entries
+            # for removed nodes are never read) and rewrite only the
+            # cone whose hash actually moved — `diff` tracks it
+            h = dict(ph)
+            diff: Set[int] = set()
+            for node in self.topo_order():
+                g = node.guid
+                el = in_edges[g]
+                if g not in dirty:
+                    for e in el:
+                        if e.src in diff:
+                            break
+                    else:
+                        continue  # parent's value stands
+                if len(el) == 1:  # the common case: skip the sort
+                    e = el[0]
+                    ins = ((h[e.src], e.src_idx, e.dst_idx),)
+                else:
+                    ins = tuple(sorted(
+                        (h[e.src], e.src_idx, e.dst_idx) for e in el))
+                v = hash((self._sig_repr(node), ins))
+                if v != h.get(g):
+                    diff.add(g)
+                    h[g] = v
+        else:
+            for node in self.topo_order():
+                el = in_edges[node.guid]
+                if len(el) == 1:
+                    e = el[0]
+                    ins = ((h[e.src], e.src_idx, e.dst_idx),)
+                else:
+                    ins = tuple(sorted(
+                        (h[e.src], e.src_idx, e.dst_idx) for e in el))
+                h[node.guid] = hash((self._sig_repr(node), ins))
+        return h
+
+    def prime_delta_hashes(self) -> Dict[int, int]:
+        """Retain this graph's ancestor-hash map so derived rewrites
+        hash incrementally.  Called for graphs that become substitution
+        PARENTS (best-first pops) — a bounded set, unlike the candidate
+        stream."""
+        if self._anc_hash_cache is None:
+            self._anc_hash_cache = self._anc_hash_map()
+        return self._anc_hash_cache
 
     def node_hashes(self) -> Dict[int, int]:
         """Bidirectional per-node structural hashes: combines each

@@ -1159,6 +1159,20 @@ class FFModel:
                 f"outside [{lo:.2f}x, {hi:.2f}x] — re-probe with "
                 f"--calibrate"
             )
+            # a stale table must also stop seeding future searches: mark
+            # the persistent cost cache, which then refuses to serve its
+            # rows/results until a recalibration rotates the signature
+            from flexflow_tpu.search.cost_cache import (
+                mark_calibration_stale,
+                resolve_cost_cache_path,
+            )
+
+            cache_path = resolve_cost_cache_path(self.config)
+            if cache_path and mark_calibration_stale(cache_path):
+                SEARCH_LOG.log(
+                    f"cost cache {cache_path} marked calibration-stale: "
+                    f"recalibrate or pass --no-cost-cache"
+                )
         if verbose:
             print(f"DRIFT {report}")
         if self.config.export_strategy_file:

@@ -354,9 +354,12 @@ class NativeDPGraph:
                    fixed: Dict[int, int], budget: int):
         """(cost, assign[num_nodes]) for the subgraph given by
         ``node_indices`` with ``fixed`` {node: view_idx} pinned."""
-        mask = np.zeros(4, dtype=np.uint64)
+        # python-int bit ops: numpy scalar shifts here were a measured
+        # per-call hotspot (this runs once per popped search candidate)
+        words = [0, 0, 0, 0]
         for i in node_indices:
-            mask[i >> 6] |= np.uint64(1) << np.uint64(i & 63)
+            words[i >> 6] |= 1 << (i & 63)
+        mask = np.array(words, dtype=np.uint64)
         fn = np.ascontiguousarray(sorted(fixed), dtype=np.int32)
         fv = np.ascontiguousarray([fixed[k] for k in sorted(fixed)],
                                   dtype=np.int32)

@@ -36,6 +36,8 @@ EVENT_KINDS = {
     "search.split": {"op", "pre_nodes", "post_nodes"},
     "search.floor": {"kept_dp", "dp_cost_s", "searched_cost_s"},
     "search.result": {"cost_s", "rewritten"},
+    "search.perf": {"search_seconds", "calibration_seconds", "full_sims",
+                    "delta_sims"},
     "search.log": {"msg"},
     # DP inner loop (search/dp.py)
     "dp.split": {"op", "pre_nodes", "post_nodes", "cost_s"},

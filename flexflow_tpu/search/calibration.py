@@ -257,14 +257,25 @@ def _any_cluster_unmeasured(table: CalibrationTable, clusters,
 # matmul-family producers whose follower chains XLA fuses
 _CLUSTER_HEADS = {"linear", "conv2d", "batch_matmul"}
 
+_FUSABLE_TYPES = None
+
 
 def _fusable(op) -> bool:
-    t = op.op_type
-    return (
-        t.is_elementwise_unary()
-        or t.value in ("softmax", "layernorm", "scalar_add", "scalar_sub",
-                       "scalar_mul", "scalar_true_div", "dropout")
-    )
+    # membership precomputed per OperatorType: this predicate runs per
+    # node in every cluster scan and per seed in the delta simulator's
+    # chain-dirty pass
+    global _FUSABLE_TYPES
+    if _FUSABLE_TYPES is None:
+        from flexflow_tpu.core.optype import OperatorType
+
+        _FUSABLE_TYPES = frozenset(
+            t for t in OperatorType
+            if t.is_elementwise_unary()
+            or t.value in ("softmax", "layernorm", "scalar_add",
+                           "scalar_sub", "scalar_mul", "scalar_true_div",
+                           "dropout")
+        )
+    return op.op_type in _FUSABLE_TYPES
 
 
 def find_clusters(graph: Graph):

@@ -35,6 +35,8 @@ Scaling disciplines (round-3; the reference's equivalents cited inline):
 
 from __future__ import annotations
 
+import contextlib
+import gc
 import heapq
 import math
 import random
@@ -49,6 +51,23 @@ from flexflow_tpu.search.dp import SearchHelper, Strategy, canon_fixed_views
 from flexflow_tpu.search.simulator import Simulator
 from flexflow_tpu.search.substitution import generate_all_pcg_xfers
 from flexflow_tpu.search.views import boundary_views
+
+
+@contextlib.contextmanager
+def _relaxed_gc():
+    """Raise the generational-GC thresholds for the duration of the
+    substitution loop: candidate generation churns through thousands of
+    acyclic container objects per second (graphs, snapshots, edge
+    lists) that refcounting frees promptly, and the default gen-0
+    cadence was a measured slice of search wall time.  Thresholds are
+    restored on exit; nothing is disabled, so genuine cycles still
+    collect."""
+    prev = gc.get_threshold()
+    gc.set_threshold(max(prev[0], 100_000), 1_000, 1_000)
+    try:
+        yield
+    finally:
+        gc.set_threshold(*prev)
 
 
 def _load_xfers(config: FFConfig, num_devices: int) -> list:
@@ -288,6 +307,16 @@ class _UnityOptimizer:
                 if cost < best_cost:
                     best_cost, best_strategy, best_graph = cost, strat, g
                 parent_s = strat
+            # arm the delta baseline on the popped parent: every child
+            # candidate's tier-1 estimate below is then an incremental
+            # re-cost of the substitution's dirty cone instead of a
+            # full O(nodes+edges) schedule derivation (the reference's
+            # SIMULATE_DELTA discipline, simulator.h).  Priming the
+            # parent's ancestor hashes makes the children's dedup
+            # hashing incremental the same way.
+            g.prime_delta_hashes()
+            self.helper.sim.set_baseline(
+                g, self._estimate_strategy(g, parent_s, fixed))
             emit = BUS.enabled  # per-candidate events are chatty: one
             # branch when telemetry is off, full accept/reject
             # provenance when it is on
@@ -326,11 +355,17 @@ class _UnityOptimizer:
                                  best_s=best_cost)
                 if self._expired():
                     break
+        self.helper.sim.clear_baseline()
         return best_graph, best_cost, best_strategy
 
-    def _estimate(self, graph: Graph, parent_s: Strategy, fixed: Strategy) -> float:
-        """Cheap candidate cost: parent strategy where guids survive,
-        default/fixed views for inserted nodes, one simulation."""
+    @staticmethod
+    def _estimate_strategy(graph: Graph, parent_s: Strategy,
+                           fixed: Strategy) -> Strategy:
+        """The estimate's view resolution — parent strategy where guids
+        survive, default/fixed views for inserted nodes.  ONE rule
+        shared by the estimate and its delta baseline, so an unchanged
+        node always resolves to the identical view object and the
+        dirty-set diff stays at the substitution's true footprint."""
         strat: Strategy = {}
         for guid, node in graph.nodes.items():
             v = fixed.get(guid) or parent_s.get(guid)
@@ -339,7 +374,32 @@ class _UnityOptimizer:
                     node.op.output_shapes[0].ndim
                 )
             strat[guid] = v
-        return self.helper.sim.simulate(graph, strat)
+        return strat
+
+    def _estimate(self, graph: Graph, parent_s: Strategy, fixed: Strategy) -> float:
+        """Cheap candidate cost: parent strategy where guids survive,
+        default/fixed views for inserted nodes, one simulation — served
+        as a delta re-cost of the substitution's dirty cone against the
+        popped parent's armed baseline (simulate_rewrite) whenever the
+        candidate carries its changed-guid sets; full simulation
+        otherwise."""
+        sim = self.helper.sim
+        fixed_get = fixed.get
+        parent_get = parent_s.get
+
+        def resolve(node):
+            v = fixed_get(node.guid) or parent_get(node.guid)
+            if v is None:
+                v = node.op.fixed_machine_view() or MachineView.trivial(
+                    node.op.output_shapes[0].ndim
+                )
+            return v
+
+        got = sim.simulate_rewrite(graph, resolve)
+        if got is not None:
+            return got
+        return sim.simulate(
+            graph, self._estimate_strategy(graph, parent_s, fixed))
 
 
 def _merge_split(
@@ -389,6 +449,37 @@ def _merge_split(
     return g, strategy
 
 
+# perf observability of the LAST optimize_strategy call in this
+# process: bench_search splits its per-model timing into calibration
+# vs search and records the delta/cache hit rates from here
+LAST_SEARCH_STATS: Dict[str, object] = {}
+
+
+def _serve_cached_search(cache, graph: Graph, config: FFConfig):
+    """Remap a cached search result onto the caller's graph.  The
+    digest key is guid-free (stable_graph_digest), so the stored
+    original-graph topo guid sequence is positionally isomorphic to
+    the caller's — original nodes map 1:1, rewrite-inserted nodes get
+    fresh guids (Graph.remap)."""
+    got = cache.get_search_result(graph, config)
+    if got is None:
+        return None
+    orig_topo, best_graph, strategy, cost = got
+    caller_topo = [n.guid for n in graph.topo_order()]
+    if len(orig_topo) != len(caller_topo):
+        return None
+    pos = dict(zip(orig_topo, caller_topo))
+    if best_graph is None:
+        # un-rewritten result: strategies transfer positionally onto
+        # the caller's (structurally identical) graph
+        strat2 = {pos[g]: v for g, v in strategy.items() if g in pos}
+        return graph, strat2, cost
+    mapping = {og: cg for og, cg in pos.items() if og in best_graph.nodes}
+    g2, full = best_graph.remap(mapping, fresh_start=graph._next_guid)
+    strat2 = {full[g]: v for g, v in strategy.items() if g in full}
+    return g2, strat2, cost
+
+
 def load_calibration(config: FFConfig):
     """The CalibrationTable at config.calibration_file, or None.  The
     platform-coherence check (measured records must come from the
@@ -427,6 +518,10 @@ def optimize_strategy(
     graph are explored (strategy-only mode, e.g. for export)."""
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
+    t_start = time.monotonic()
+    t_cal = 0.0  # seconds spent probing/persisting calibration — split
+    # out of the reported search time (bench satellite: the two were
+    # conflated in one search_seconds number)
     n = config.search_devices
     calibration = load_calibration(config)
     target = config.machine_spec.platform
@@ -470,9 +565,11 @@ def optimize_strategy(
                 f"calibrating (op, view) costs on the live backend "
                 f"(budget {config.calibration_budget_s:.0f}s)"
             ):
+                t0 = time.monotonic()
                 calibration = calibrate_graph(
                     graph, n, calibration,
                     time_budget_s=config.calibration_budget_s)
+                t_cal += time.monotonic() - t0
                 log.log(f"{len(calibration)} measured records")
             if config.calibration_file:
                 calibration.save(config.calibration_file)
@@ -485,11 +582,33 @@ def optimize_strategy(
         budget=config.search_budget, timeout_s=config.search_timeout_s,
         calibrated=calibration is not None,
     )
+
+    # persistent search-result cache: the search is a deterministic
+    # pure function of (graph structure, knobs, cost surface), so a
+    # warm cache serves the finished (graph, strategy) — bench sweeps,
+    # CI, and repeat compiles skip the whole search
+    cache = sim.cost_cache
+    if cache is not None and return_graph:
+        served = _serve_cached_search(cache, graph, config)
+        if served is not None:
+            best_graph, best_strategy, best_cost = served
+            log.log(
+                f"cost cache: served searched strategy "
+                f"({best_cost * 1e3:.4f} ms/iter) for {graph.num_nodes}-"
+                f"node graph — skipping the search"
+            )
+            _emit_search_done(
+                floor_sim, best_graph, graph, best_strategy, best_cost,
+                kept_dp=False, helper=helper, t_start=t_start,
+                t_cal=t_cal, result_cache_hit=True,
+            )
+            return best_graph, best_strategy
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
         best_cost, best_strategy = helper.graph_cost(graph)
         log.log(f"baseline DP-search cost: {best_cost * 1e3:.4f} ms/iter")
     BUS.emit("search.baseline", cost_s=best_cost)
     best_graph = graph
+    search_expired = False
 
     if return_graph and config.search_budget > 0:
         xfers = _load_xfers(config, n)
@@ -499,7 +618,7 @@ def optimize_strategy(
             else None
         )
         opt = _UnityOptimizer(helper, config, xfers, deadline=deadline)
-        with log.enter(f"unity outer loop: {len(xfers)} xfers"):
+        with _relaxed_gc(), log.enter(f"unity outer loop: {len(xfers)} xfers"):
             opt._score_edges(graph)
             g2, c2, s2 = opt.sequence_optimize(graph, {})
             if (c2 < best_cost and s2 and can_probe
@@ -521,7 +640,9 @@ def optimize_strategy(
                 n_before = len(calibration)
                 ncl_before = calibration.num_clusters
                 if budget > 0:
+                    t0 = time.monotonic()
                     calibrate_graph(g2, n, calibration, time_budget_s=budget)
+                    t_cal += time.monotonic() - t0
                 if (len(calibration) > n_before
                         or calibration.num_clusters > ncl_before):
                     # cluster-only growth counts: a rewrite with fully
@@ -546,6 +667,7 @@ def optimize_strategy(
                     f" -> {c2 * 1e3:.4f} ms/iter"
                 )
                 best_cost, best_strategy, best_graph = c2, s2, g2
+            search_expired = opt._expired()
 
     # Champion-vs-DP floor: the simulator's fidelity is finite, so a
     # predicted win below the uncertainty margin is noise — and executing
@@ -569,23 +691,72 @@ def optimize_strategy(
         )
         best_cost, best_strategy, best_graph = dp_cost, dp_strategy, graph
 
-    if BUS.enabled:
-        BUS.emit(
-            "search.result", cost_s=best_cost,
-            rewritten=best_graph is not graph,
-            nodes=best_graph.num_nodes, kept_dp=kept_dp,
-            table=floor_sim.strategy_table_rows(best_graph, best_strategy),
-        )
-        BUS.emit(
-            "dp.summary", memo_hits=helper.memo_hits,
-            memo_misses=helper.memo_misses,
-            native_hits=helper.native_hits,
-            greedy_hits=helper.greedy_hits,
-        )
+    # persist: cost rows accumulated this search + the finished result
+    # (only complete searches — a deadline-truncated result is not the
+    # pure function's value and must not be served forever)
+    cache = floor_sim.cost_cache
+    if cache is not None:
+        if return_graph and not search_expired and math.isfinite(best_cost):
+            payload = (
+                [nd.guid for nd in graph.topo_order()],
+                best_graph if best_graph is not graph else None,
+                dict(best_strategy),
+                best_cost,
+            )
+            cache.put_search_result(graph, config, payload, best_cost)
+        cache.save()
+
+    _emit_search_done(
+        floor_sim, best_graph, graph, best_strategy, best_cost,
+        kept_dp=kept_dp, helper=helper, t_start=t_start, t_cal=t_cal,
+        result_cache_hit=False,
+    )
 
     if return_graph:
         return best_graph, best_strategy
     return best_strategy
+
+
+def _emit_search_done(
+    floor_sim, best_graph, graph, best_strategy, best_cost, kept_dp,
+    helper, t_start, t_cal, result_cache_hit,
+) -> None:
+    """Search-completion telemetry: the final result/summary events
+    plus the search-perf roll-up (delta-vs-full simulation counts and
+    persistent-cache hit rates) that bench_search and ffobs report."""
+    sim = helper.sim
+    cache = floor_sim.cost_cache or sim.cost_cache
+    stats = {
+        "search_seconds": round(
+            max(0.0, time.monotonic() - t_start - t_cal), 3),
+        "calibration_seconds": round(t_cal, 3),
+        "full_sims": sim.full_sims + (
+            floor_sim.full_sims if floor_sim is not sim else 0),
+        "delta_sims": sim.delta_sims + (
+            floor_sim.delta_sims if floor_sim is not sim else 0),
+        "delta_bails": sim.delta_bails + (
+            floor_sim.delta_bails if floor_sim is not sim else 0),
+        "cache_row_hits": cache.row_hits if cache else 0,
+        "cache_row_misses": cache.row_misses if cache else 0,
+        "result_cache_hit": bool(result_cache_hit),
+    }
+    LAST_SEARCH_STATS.clear()
+    LAST_SEARCH_STATS.update(stats)
+    if not BUS.enabled:
+        return
+    BUS.emit(
+        "search.result", cost_s=best_cost,
+        rewritten=best_graph is not graph,
+        nodes=best_graph.num_nodes, kept_dp=kept_dp,
+        table=floor_sim.strategy_table_rows(best_graph, best_strategy),
+    )
+    BUS.emit(
+        "dp.summary", memo_hits=helper.memo_hits,
+        memo_misses=helper.memo_misses,
+        native_hits=helper.native_hits,
+        greedy_hits=helper.greedy_hits,
+    )
+    BUS.emit("search.perf", **stats)
 
 
 def mcmc_optimize(
@@ -610,6 +781,10 @@ def mcmc_optimize(
     current = dict(data_parallel_strategy(graph, n))
     cur_cost = sim.simulate(graph, current)
     best, best_cost = dict(current), cur_cost
+    # single-op rewrites on a fixed graph are the ideal delta-simulation
+    # case: each proposal perturbs one node (plus its consumers' edge
+    # xfers), so re-cost rides the armed baseline; re-arm on accept
+    sim.set_baseline(graph, current)
     for _ in range(iterations):
         node = rng.choice(nodes)
         if node.op.fixed_machine_view() is not None:
@@ -622,6 +797,7 @@ def mcmc_optimize(
         delta = c - cur_cost
         if delta <= 0 or rng.random() < math.exp(-delta / max(temperature * cur_cost, 1e-12)):
             cur_cost = c
+            sim.set_baseline(graph, current)
             if c < best_cost:
                 best, best_cost = dict(current), c
         else:

@@ -17,20 +17,89 @@ when they should.
 from __future__ import annotations
 
 import math
-from typing import Dict, FrozenSet, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 import numpy as np
 
 from flexflow_tpu.core.graph import Graph
 from flexflow_tpu.core.machine import MachineSpec, MachineView
+from flexflow_tpu.obs.metrics import METRICS
 from flexflow_tpu.search.machine_model import CostModel
+
+# module-cached metric handles (objects stay valid across METRICS.reset)
+_FULL_SIMS = METRICS.counter("sim.full")
+_DELTA_SIMS = METRICS.counter("sim.delta")
+_DELTA_BAILS = METRICS.counter("sim.delta_bails")
+
+
+def _delta_check_enabled() -> bool:
+    """FLEXFLOW_TPU_DELTA_CHECK=1: every delta-served simulate() result
+    is re-derived by the full path and asserted bit-identical — the
+    exact-equivalence contract as a runtime oracle (tests and debug
+    sessions flip it; the hot path reads a module flag)."""
+    import os
+
+    return os.environ.get("FLEXFLOW_TPU_DELTA_CHECK", "") not in ("", "0")
+
+
+DELTA_CHECK = _delta_check_enabled()
+
+# lazily built OperatorType sets mirroring calibration.find_clusters
+# membership (heads / fusable followers) — the hot _local_chain and
+# cluster-dirty paths must not pay per-call imports or string compares
+_HEAD_TYPES: Optional[frozenset] = None
+_FUSABLE_TYPES: Optional[frozenset] = None
+
+
+def _init_chain_types() -> None:
+    global _HEAD_TYPES, _FUSABLE_TYPES
+    if _HEAD_TYPES is not None:
+        return
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.calibration import _CLUSTER_HEADS, _fusable
+
+    class _Shim:
+        __slots__ = ("op_type",)
+
+        def __init__(self, t):
+            self.op_type = t
+
+    _FUSABLE_TYPES = frozenset(
+        t for t in OperatorType if _fusable(_Shim(t)))
+    _HEAD_TYPES = frozenset(
+        t for t in OperatorType if t.value in _CLUSTER_HEADS)
+
+
+class SimSnapshot:
+    """Baseline schedule of one ``(graph, strategy)`` simulation in the
+    default (scalar) cost currency — everything ``simulate`` derived
+    per node, stored so a *substituted* graph can be re-costed by
+    recomputing only the dirty cone (reference: simulator.h
+    ``SIMULATE_DELTA``, which re-simulates only the tasks a
+    substitution perturbed).
+
+    Per node (by guid): resolved view, propagated sharding, the
+    mode-selected cluster-scaled duration, sync/memory costs, the
+    per-in-edge xfer seconds (training doubling baked in), and the
+    baseline finish time.  Per topo position: the running scan state
+    (device avail, memory prefix sum, compute/comm horizons, per-device
+    comm timelines) so a delta walk can resume mid-schedule with
+    bit-identical floats."""
+
+    __slots__ = (
+        "graph", "include_update", "cal_version", "order", "views",
+        "ops", "annots", "in_list", "out_list", "rec", "finish",
+        "chain", "pre_avail", "pre_mem", "pre_end_time", "pre_end_comm",
+        "pre_comm", "total",
+    )
 
 
 class Simulator:
     def __init__(self, machine: MachineSpec, num_devices: Optional[int] = None,
                  use_network_model: bool = True, calibration=None,
                  placement_overlap: bool = False, zero_dp_shard: bool = False,
-                 inference: bool = False, sync_precision: str = "fp32"):
+                 inference: bool = False, sync_precision: str = "fp32",
+                 cost_cache=None):
         self.machine = machine
         self.num_devices = num_devices or machine.num_devices
         # placement_overlap=True credits inter-op COMPUTE overlap for
@@ -72,6 +141,17 @@ class Simulator:
         # key could be recycled after GC during a long search)
         self._prop_cache: Dict[Tuple, object] = {}
         self._cost_cache: Dict[Tuple, Tuple[float, float, float]] = {}
+        # optional persistent CostCache (search/cost_cache.py): misses
+        # of the in-memory row cache consult it before recomputing, so
+        # repeated searches across processes start warm
+        self.cost_cache = cost_cache
+        # delta-simulation baseline (SimSnapshot) + counters.  full_sims
+        # counts every full O(nodes+edges) schedule derivation (snapshot
+        # builds included); delta_sims the incremental re-costs.
+        self._baseline: Optional[SimSnapshot] = None
+        self.full_sims = 0
+        self.delta_sims = 0
+        self.delta_bails = 0
 
     # ------------------------------------------------------------------
     def view_device_set(self, mv: MachineView, use_start: bool = True) -> FrozenSet[int]:
@@ -97,8 +177,9 @@ class Simulator:
         """Simulator matching an FFConfig's search settings — the ONE
         place every config-derived flag is threaded, so a new flag
         cannot miss a construction site (driver search, MCMC, strategy
-        task-graph export)."""
-        return cls(
+        task-graph export).  Attaches the persistent cost cache when
+        the config enables one (cost_cache_file / env)."""
+        sim = cls(
             config.machine_spec,
             num_devices=config.search_devices,
             calibration=calibration,
@@ -107,6 +188,11 @@ class Simulator:
             sync_precision=getattr(config, "sync_precision", "fp32"),
             **kw,
         )
+        if sim.cost_cache is None:
+            from flexflow_tpu.search.cost_cache import load_for_simulator
+
+            load_for_simulator(config, sim)
+        return sim
 
     # ------------------------------------------------------------------
     def _node_costs(self, node, mv) -> Tuple[float, float, float, float]:
@@ -115,14 +201,21 @@ class Simulator:
         key = (node.op.signature(), (mv.dim_degrees, mv.replica_degree))
         hit = self._cost_cache.get(key)
         if hit is None:
-            fwd = self.cost.op_cost(node.op, mv, backward=False)
-            full = self.cost.op_cost(node.op, mv, backward=True)
-            # sync at the precision the cost model's mode selects (per
-            # weight group under "search") — both DP engines consume
-            # this row, so compressed sync is priced consistently
-            sync = self.cost.sync_cost(node.op, mv)
-            mem = self.cost.op_memory(node.op, mv)
-            hit = (fwd, full, sync, mem)
+            cc = self.cost_cache
+            if cc is not None:
+                hit = cc.get(node.op, mv)
+            if hit is None:
+                fwd = self.cost.op_cost(node.op, mv, backward=False)
+                full = self.cost.op_cost(node.op, mv, backward=True)
+                # sync at the precision the cost model's mode selects
+                # (per weight group under "search") — both DP engines
+                # consume this row, so compressed sync is priced
+                # consistently
+                sync = self.cost.sync_cost(node.op, mv)
+                mem = self.cost.op_memory(node.op, mv)
+                hit = (fwd, full, sync, mem)
+                if cc is not None:
+                    cc.put(node.op, mv, hit)
             self._cost_cache[key] = hit
         return hit
 
@@ -156,9 +249,51 @@ class Simulator:
         the same shape (the comm rows of the predicted timeline).
         Pass a dict as ``breakdown`` to receive the predicted phase
         split (compute/comm critical paths, total xfer/sync seconds,
-        peak memory) — the predicted side of the obs DriftReport."""
+        peak memory) — the predicted side of the obs DriftReport.
+
+        When a delta baseline is armed (``set_baseline``), calls in the
+        default scalar currency are served incrementally: only the
+        substituted nodes plus the downstream cone whose ready-times
+        shift are recomputed, with a bit-identical-to-full contract
+        (``_simulate_delta``; reference: simulator.h SIMULATE_DELTA)."""
         if include_update is None:
             include_update = not self.inference
+        snap = self._baseline
+        if (snap is not None and schedule is None and breakdown is None
+                and comm_schedule is None and not self.placement_overlap
+                and include_update == snap.include_update
+                and snap.cal_version == getattr(
+                    self.cost.calibration, "version", None)):
+            got = self._simulate_delta(snap, graph, strategy)
+            if got is not None:
+                self.delta_sims += 1
+                _DELTA_SIMS.inc()
+                if DELTA_CHECK:
+                    full = self._simulate_full(
+                        graph, strategy, include_update)
+                    assert got == full or (
+                        math.isnan(got) and math.isnan(full)
+                    ), (
+                        f"delta simulation diverged from full: "
+                        f"{got!r} != {full!r}"
+                    )
+                return got
+            self.delta_bails += 1
+            _DELTA_BAILS.inc()
+        return self._simulate_full(graph, strategy, include_update,
+                                   schedule, breakdown, comm_schedule)
+
+    def _simulate_full(
+        self,
+        graph: Graph,
+        strategy: Dict[int, MachineView],
+        include_update: bool,
+        schedule: Optional[list] = None,
+        breakdown: Optional[dict] = None,
+        comm_schedule: Optional[list] = None,
+    ) -> float:
+        self.full_sims += 1
+        _FULL_SIMS.inc()
         ready: Dict[Tuple[int, int], float] = {}  # (guid, out_idx) -> time
         device_avail: Dict[int, float] = {d: 0.0 for d in range(self.num_devices)}
         # per-device COMM timelines for weight-grad allreduces
@@ -327,10 +462,488 @@ class Simulator:
                 peak_mem_bytes=peak,
                 num_devices=self.num_devices,
                 include_update=include_update,
+                # per-collective records exist in this currency (the
+                # pooled-traffic LogicalTaskGraphSimulator sets True
+                # and leaves comm_schedule empty by design)
+                pooled_comm=False,
             )
         if oom:
             return math.inf
         return total
+
+    # ---- delta simulation (reference: simulator.h SIMULATE_DELTA) ----
+    def set_baseline(self, graph: Graph,
+                     strategy: Dict[int, MachineView],
+                     include_update: Optional[bool] = None) -> Optional[SimSnapshot]:
+        """Arm delta simulation: snapshot the baseline schedule of
+        ``(graph, strategy)`` so subsequent ``simulate`` calls on
+        substituted variants (or re-viewed strategies) are served
+        incrementally.  Returns the snapshot, or None (and disarms)
+        when the baseline is infeasible (invalid view / OOM)."""
+        snap = self._snapshot(graph, strategy, include_update)
+        self._baseline = snap
+        return snap
+
+    def clear_baseline(self) -> None:
+        self._baseline = None
+
+    def _resolve_view(self, node) -> MachineView:
+        mv = node.op.fixed_machine_view()
+        if mv is None:
+            mv = MachineView.trivial(node.op.output_shapes[0].ndim)
+        return mv
+
+    def _snapshot(self, graph: Graph, strategy: Dict[int, MachineView],
+                  include_update: Optional[bool] = None) -> Optional[SimSnapshot]:
+        """One full scalar-currency simulation, recording every derived
+        per-node quantity plus the per-position scan state.  The loop
+        MUST stay arithmetic-identical to ``_simulate_full``'s scalar
+        path — the delta contract (tests/test_search_delta.py) asserts
+        equality to the float."""
+        if include_update is None:
+            include_update = not self.inference
+        self.full_sims += 1
+        _FULL_SIMS.inc()
+        topo = graph.topo_order()
+        snap = SimSnapshot()
+        snap.graph = graph
+        snap.include_update = include_update
+        cal = self.cost.calibration
+        snap.cal_version = getattr(cal, "version", None)
+        views: Dict[int, MachineView] = {}
+        annots: Dict[int, object] = {}
+        shardings = {}
+        for node in topo:
+            mv = strategy.get(node.guid)
+            if mv is None:
+                mv = self._resolve_view(node)
+            osh = self._propagate(node, mv)
+            if osh is None:
+                return None
+            views[node.guid] = mv
+            annots[node.guid] = osh
+            shardings[node.guid] = (mv, osh)
+
+        cluster_scale: Dict[int, Tuple[float, float]] = {}
+        chain: Dict[int, Tuple[int, ...]] = {}
+        if cal is not None and getattr(cal, "num_clusters", 0) > 0:
+            for members in self._cluster_chains(graph):
+                mg = tuple(m.guid for m in members)
+                for pos, m in enumerate(members):
+                    chain[m.guid] = mg
+                    got = self._cluster_ratio(members, views[m.guid])
+                    if got is None:
+                        continue
+                    r, upds = got
+                    cluster_scale[m.guid] = (r, upds[pos])
+
+        n = len(topo)
+        order = [nd.guid for nd in topo]
+        # per-node record: (duration, sync_s, mem_bytes, comm_devs,
+        # ((src_guid, xfer_s), ...)) — ONE dict hit per clean node in
+        # the delta walk
+        rec: Dict[int, Tuple] = {}
+        finish_d: Dict[int, float] = {}
+        pre_avail: List[float] = [0.0] * (n + 1)
+        pre_mem: List[float] = [0.0] * (n + 1)
+        pre_end_time: List[float] = [0.0] * (n + 1)
+        pre_end_comm: List[float] = [0.0] * (n + 1)
+        pre_comm: List[Tuple[float, ...]] = [()] * (n + 1)
+
+        comm_avail = [0.0] * self.num_devices
+        comm_state = tuple(comm_avail)
+        avail = 0.0
+        mem_total = 0.0
+        end_time = 0.0
+        end_comm = 0.0
+        ready: Dict[int, float] = {}
+        for i, node in enumerate(topo):
+            guid = node.guid
+            pre_avail[i] = avail
+            pre_mem[i] = mem_total
+            pre_end_time[i] = end_time
+            pre_end_comm[i] = end_comm
+            pre_comm[i] = comm_state
+            mv = views[guid]
+            osh = annots[guid]
+            start = avail
+            edges = []
+            for e in graph.in_edges[guid]:
+                src_osh = annots[e.src]
+                src_annot = (
+                    src_osh.outputs[e.src_idx]
+                    if e.src_idx < len(src_osh.outputs) else None
+                )
+                dst_annot = (
+                    osh.inputs[e.dst_idx] if e.dst_idx < len(osh.inputs)
+                    else None
+                )
+                shape = graph.nodes[e.src].op.output_shapes[e.src_idx]
+                xfer = self.cost.xfer_cost(shape, src_annot, dst_annot)
+                if include_update and not graph.nodes[e.src].op.is_gradient_free:
+                    xfer *= 2.0
+                edges.append((e.src, xfer))
+                t = ready.get(e.src, 0.0) + xfer
+                if t > start:
+                    start = t
+            fwd, full, sync, m_bytes = self._node_costs(node, mv)
+            scale = cluster_scale.get(guid)
+            if scale is not None:
+                r, upd = scale
+                fwd = fwd * r
+                full = (full - upd) * r + upd
+            d = full if include_update else fwd
+            mem_total += m_bytes
+            finish = start + d
+            avail = finish
+            ready[guid] = finish
+            finish_d[guid] = finish
+            if finish > end_time:
+                end_time = finish
+            cd = None
+            if include_update and sync > 0:
+                cd = self.view_device_set(mv, use_start=False)
+                s = finish
+                for dev in cd:
+                    if comm_avail[dev] > s:
+                        s = comm_avail[dev]
+                f = s + sync
+                for dev in cd:
+                    comm_avail[dev] = f
+                comm_state = tuple(comm_avail)
+                if f > end_comm:
+                    end_comm = f
+            rec[guid] = (d, sync, m_bytes, cd, tuple(edges))
+        pre_avail[n] = avail
+        pre_mem[n] = mem_total
+        pre_end_time[n] = end_time
+        pre_end_comm[n] = end_comm
+        pre_comm[n] = comm_state
+
+        if mem_total > self.machine.hbm_capacity:
+            return None
+        snap.order = order
+        snap.views = views
+        snap.ops = {g: graph.nodes[g].op for g in order}
+        snap.annots = annots
+        snap.in_list = {g: graph.in_edges[g] for g in order}
+        snap.out_list = {g: graph.out_edges[g] for g in order}
+        snap.rec = rec
+        snap.finish = finish_d
+        snap.chain = chain
+        snap.pre_avail = pre_avail
+        snap.pre_mem = pre_mem
+        snap.pre_end_time = pre_end_time
+        snap.pre_end_comm = pre_end_comm
+        snap.pre_comm = pre_comm
+        snap.total = max(end_time, end_comm)
+        return snap
+
+    def _local_chain(self, graph: Graph, guid: int):
+        """The fusion-cluster chain of ``graph`` containing ``guid``
+        (same membership rule as calibration.find_clusters, derived
+        locally), or None.  Used by the delta path to detect chain
+        membership changes around substituted nodes without re-scanning
+        the whole graph."""
+        _init_chain_types()
+        node = graph.nodes.get(guid)
+        if node is None:
+            return None
+        cur = node
+        while cur.op.op_type not in _HEAD_TYPES:
+            if cur.op.op_type not in _FUSABLE_TYPES:
+                return None
+            ins = graph.in_edges[cur.guid]
+            if len(ins) != 1:
+                return None
+            pred = graph.nodes[ins[0].src]
+            if len(graph.out_edges[pred.guid]) != 1:
+                return None
+            cur = pred
+        members = [cur]
+        while True:
+            edges = graph.out_edges[members[-1].guid]
+            if len(edges) != 1:
+                break
+            nxt = graph.nodes[edges[0].dst]
+            if len(graph.in_edges[nxt.guid]) != 1:
+                break
+            if nxt.op.op_type not in _FUSABLE_TYPES:
+                break
+            members.append(nxt)
+        if len(members) < 2:
+            return None
+        return members if any(m.guid == guid for m in members) else None
+
+    def _mark_cluster_dirty(self, snap: SimSnapshot, graph: Graph,
+                            changed: set, cluster_seed) -> None:
+        """Fusion-cluster membership can shift around edge rewires even
+        for nodes whose own edges/views are untouched — mark every
+        member of any OLD or NEW chain through the perturbed region.
+        Only chain-typed seeds pay the local walk (substitution-inserted
+        parallel ops never form chains)."""
+        _init_chain_types()
+        chain = snap.chain
+        nodes = graph.nodes
+        for guid in list(changed | set(cluster_seed)):
+            old = chain.get(guid)
+            if old is not None:
+                changed.update(g for g in old if g in nodes)
+            node = nodes.get(guid)
+            if node is None:
+                continue
+            ot = node.op.op_type
+            if ot not in _HEAD_TYPES and ot not in _FUSABLE_TYPES:
+                continue
+            new = self._local_chain(graph, guid)
+            if new is not None:
+                changed.update(m.guid for m in new)
+
+    def _clusters_active(self) -> bool:
+        cal = self.cost.calibration
+        return cal is not None and getattr(cal, "num_clusters", 0) > 0
+
+    def simulate_rewrite(self, graph: Graph, resolve_view) -> Optional[float]:
+        """Tier-1 candidate estimate: delta re-cost of a substitution
+        candidate whose parent is the armed baseline, under the
+        caller's CONTRACT that every surviving node resolves to the
+        baseline's view (the estimate rule — driver._estimate_strategy)
+        and ``resolve_view(node)`` supplies the views of the touched
+        nodes.  Skips the per-node strategy dict and view diff the
+        generic ``simulate`` routing would pay.  None when no delta
+        applies (caller falls back to ``simulate``)."""
+        snap = self._baseline
+        if snap is None or self.placement_overlap:
+            return None
+        if snap.include_update != (not self.inference):
+            return None
+        cv = getattr(graph, "_changed_vs", None)
+        if cv is None or cv[0]() is not snap.graph:
+            return None
+        if snap.cal_version != getattr(self.cost.calibration, "version",
+                                       None):
+            return None
+        nodes = graph.nodes
+        changed = {g for g in cv[1] if g in nodes}
+        if self._clusters_active():
+            self._mark_cluster_dirty(snap, graph, changed, cv[2])
+        if len(changed) > max(8, len(nodes) // 2):
+            self.delta_bails += 1
+            _DELTA_BAILS.inc()
+            return None
+        got = self._delta_walk(snap, graph, changed, resolve_view)
+        self.delta_sims += 1
+        _DELTA_SIMS.inc()
+        if DELTA_CHECK:
+            strategy = {
+                guid: (resolve_view(node) if guid in changed
+                       else snap.views[guid])
+                for guid, node in nodes.items()
+            }
+            full = self._simulate_full(graph, strategy, snap.include_update)
+            assert got == full or (math.isnan(got) and math.isnan(full)), (
+                f"delta rewrite estimate diverged from full: "
+                f"{got!r} != {full!r}"
+            )
+        return got
+
+    def _delta_changed(self, snap: SimSnapshot, graph: Graph,
+                       strategy: Dict[int, MachineView]):
+        """Dirty-node set of ``graph`` vs the snapshot, or None when the
+        graphs diverge too much for a delta to pay (the caller then
+        full-simulates).  Seeded by the changed-guid sets GraphXfer
+        application attaches (``graph._changed_vs``); falls back to a
+        structural diff for graphs from other producers."""
+        nodes = graph.nodes
+        limit = max(8, len(nodes) // 4)
+        changed = set()
+        view_dirty = set()
+        cluster_seed = set()
+        if graph is not snap.graph:
+            cv = getattr(graph, "_changed_vs", None)
+            if cv is not None and cv[0]() is snap.graph:
+                changed.update(g for g in cv[1] if g in nodes)
+                cluster_seed.update(g for g in cv[2] if g in nodes)
+            else:
+                if abs(len(nodes) - len(snap.order)) > limit:
+                    return None
+                in_list = snap.in_list
+                out_list = snap.out_list
+                ops = snap.ops
+                for guid, node in nodes.items():
+                    base_in = in_list.get(guid)
+                    if base_in is None or node.op is not ops[guid]:
+                        changed.add(guid)
+                        view_dirty.add(guid)
+                        if len(changed) > limit:
+                            return None
+                        continue
+                    cur = graph.in_edges[guid]
+                    if cur is not base_in and cur != base_in:
+                        changed.add(guid)
+                        if len(changed) > limit:
+                            return None
+                    cur_out = graph.out_edges[guid]
+                    base_out = out_list[guid]
+                    if cur_out is not base_out and cur_out != base_out:
+                        cluster_seed.add(guid)
+        # view changes (re-viewed strategies on the same structure)
+        views = snap.views
+        for guid, node in nodes.items():
+            if guid in changed:
+                continue
+            mv = strategy.get(guid)
+            if mv is None:
+                mv = self._resolve_view(node)
+            base = views.get(guid)
+            if mv is not base and mv != base:
+                changed.add(guid)
+                view_dirty.add(guid)
+                if len(changed) > limit:
+                    return None
+        if not changed and not cluster_seed:
+            return changed
+        # a view-changed producer changes its consumers' edge xfers —
+        # one hop.  Pure edge rewires don't: a surviving node's output
+        # annot depends only on (op, view).
+        for guid in view_dirty:
+            for e in graph.out_edges.get(guid, ()):
+                changed.add(e.dst)
+        if self._clusters_active():
+            self._mark_cluster_dirty(snap, graph, changed, cluster_seed)
+        if len(changed) > limit:
+            return None
+        return changed
+
+    def _simulate_delta(self, snap: SimSnapshot, graph: Graph,
+                        strategy: Dict[int, MachineView]) -> Optional[float]:
+        """Incremental re-cost against the armed baseline: resume the
+        scalar scan at the first dirty topo position, reusing every
+        clean node's cached durations/xfers.  Returns None when a delta
+        does not apply (caller falls back to the full path).  The
+        result is bit-identical to ``_simulate_full`` on the same
+        inputs — same values, same arithmetic, same order."""
+        changed = self._delta_changed(snap, graph, strategy)
+        if changed is None:
+            return None
+
+        def resolve_view(node):
+            mv = strategy.get(node.guid)
+            if mv is None:
+                mv = self._resolve_view(node)
+            return mv
+
+        return self._delta_walk(snap, graph, changed, resolve_view)
+
+    def _delta_walk(self, snap: SimSnapshot, graph: Graph, changed,
+                    resolve_view) -> float:
+        """The scalar scan over ``graph`` with every clean node served
+        from the snapshot record — same values, same arithmetic, same
+        order as ``_simulate_full``, so the result is bit-identical."""
+        order = graph.topo_order()
+        base_order = snap.order
+        n = len(order)
+        # longest clean common prefix → resume state from the snapshot
+        k = 0
+        lim = min(n, len(base_order))
+        while k < lim:
+            g = order[k].guid
+            if g != base_order[k] or g in changed:
+                break
+            k += 1
+        if k == n and n == len(base_order):
+            return snap.total  # nothing dirty: the baseline cost stands
+        avail = snap.pre_avail[k]
+        mem_total = snap.pre_mem[k]
+        end_time = snap.pre_end_time[k]
+        end_comm = snap.pre_end_comm[k]
+        comm_avail = list(snap.pre_comm[k]) if k else [0.0] * self.num_devices
+        ready: Dict[int, float] = {}
+        ready_get = ready.get
+        base_finish = snap.finish
+        base_rec = snap.rec
+        new_annots: Dict[int, object] = {}
+        include_update = snap.include_update
+        clusters = self._clusters_active()
+        for i in range(k, n):
+            node = order[i]
+            guid = node.guid
+            if guid not in changed:
+                start = avail
+                dur, sync, m_bytes, comm_devs, edges = base_rec[guid]
+                for src, xfer in edges:
+                    t = ready_get(src)
+                    if t is None:
+                        t = base_finish.get(src, 0.0)
+                    t += xfer
+                    if t > start:
+                        start = t
+            else:
+                mv = resolve_view(node)
+                osh = self._propagate(node, mv)
+                if osh is None:
+                    return math.inf
+                new_annots[guid] = osh
+                start = avail
+                for e in graph.in_edges[guid]:
+                    src = e.src
+                    s_osh = new_annots.get(src)
+                    if s_osh is None:
+                        s_osh = snap.annots[src]
+                    src_annot = (
+                        s_osh.outputs[e.src_idx]
+                        if e.src_idx < len(s_osh.outputs) else None
+                    )
+                    dst_annot = (
+                        osh.inputs[e.dst_idx] if e.dst_idx < len(osh.inputs)
+                        else None
+                    )
+                    src_op = graph.nodes[src].op
+                    xfer = self.cost.xfer_cost(
+                        src_op.output_shapes[e.src_idx], src_annot, dst_annot)
+                    if include_update and not src_op.is_gradient_free:
+                        xfer *= 2.0
+                    t = ready_get(src)
+                    if t is None:
+                        t = base_finish.get(src, 0.0)
+                    t += xfer
+                    if t > start:
+                        start = t
+                fwd, full, sync, m_bytes = self._node_costs(node, mv)
+                if clusters:
+                    members = self._local_chain(graph, guid)
+                    if members is not None:
+                        got = self._cluster_ratio(members, mv)
+                        if got is not None:
+                            r, upds = got
+                            pos = next(
+                                j for j, m in enumerate(members)
+                                if m.guid == guid)
+                            upd = upds[pos]
+                            fwd = fwd * r
+                            full = (full - upd) * r + upd
+                dur = full if include_update else fwd
+                comm_devs = (self.view_device_set(mv, use_start=False)
+                             if include_update and sync > 0 else None)
+            mem_total += m_bytes
+            finish = start + dur
+            avail = finish
+            ready[guid] = finish
+            if finish > end_time:
+                end_time = finish
+            if comm_devs is not None:
+                s = finish
+                for dev in comm_devs:
+                    if comm_avail[dev] > s:
+                        s = comm_avail[dev]
+                f = s + sync
+                for dev in comm_devs:
+                    comm_avail[dev] = f
+                if f > end_comm:
+                    end_comm = f
+        if mem_total > self.machine.hbm_capacity:
+            return math.inf
+        return max(end_time, end_comm)
 
     # ------------------------------------------------------------------
     def _cluster_chains(self, graph: Graph):

@@ -18,6 +18,7 @@ reference: parallel_op.cc:25-58 join algebra) clean up searched graphs.
 
 from __future__ import annotations
 
+import weakref
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -41,6 +42,37 @@ _MATCHES = METRICS.counter("substitution.matches_found")
 _APPLIES = METRICS.counter("substitution.applies")
 
 
+def _mark(g: Graph, ins=(), outs=()) -> None:
+    """Record which guids a rewrite perturbed on the working graph:
+    ``ins`` = nodes whose in-edge list changed (every NEW node guid
+    must appear here), ``outs`` = nodes whose out-edge list changed.
+    Supersets are safe — the delta simulator only does extra work for
+    over-marked nodes, never returns a different float."""
+    touched = getattr(g, "_delta_touched", None)
+    if touched is None:
+        touched = (set(), set())
+        g._delta_touched = touched
+    touched[0].update(ins)
+    touched[1].update(outs)
+
+
+def _finish_rewrite(parent: Graph, g: Optional[Graph]) -> Optional[Graph]:
+    """Promote the working-graph touched sets into the changed-guid
+    annotation delta consumers read (``g._changed_vs`` = parent weakref
+    + changed-in/changed-out guid frozensets) — the dirty-frontier seed
+    the delta simulator and the delta graph hash both key on.  Rewrites
+    built outside this module (substitution_loader JSON rules) carry no
+    sets; consumers fall back to a structural diff."""
+    if g is None:
+        return None
+    touched = getattr(g, "_delta_touched", None)
+    if touched is not None:
+        g._changed_vs = (
+            weakref.ref(parent), frozenset(touched[0]), frozenset(touched[1])
+        )
+    return g
+
+
 @dataclass
 class GraphXfer:
     """A rewrite: match a node, produce a rewritten graph."""
@@ -58,57 +90,77 @@ class GraphXfer:
 
     def apply(self, graph: Graph, match: Match) -> Optional[Graph]:
         _APPLIES.inc()
-        return self.apply_fn(graph, match)
+        return _finish_rewrite(graph, self.apply_fn(graph, match))
 
 
 # ---------------------------------------------------------------------------
-def _insert_before(graph: Graph, node: Node, dst_idx: int, make_op) -> Optional[Graph]:
+# The two splice helpers are COPY-ON-WRITE: the clone shares every
+# untouched edge list with the parent and REPLACES (never mutates) the
+# few lists the splice changes.  Rewrites that DELETE nodes
+# (remove_node mutates neighbor lists in place) must keep using the
+# full graph.copy().
+
+
+def _insert_before(graph: Graph, node: Node, dst_idx: int, make_op,
+                   cow: bool = True) -> Optional[Graph]:
     """New graph with ``make_op(input_shape)`` spliced into the edge
-    feeding input ``dst_idx`` of ``node``."""
-    g = graph.copy()
-    edges = [e for e in g.in_edges[node.guid] if e.dst_idx == dst_idx]
+    feeding input ``dst_idx`` of ``node``.  Pass ``cow=False`` when the
+    caller will afterwards MUTATE the result in place (remove_node) —
+    in-place surgery on a COW clone would corrupt the shared parent."""
+    edges = [e for e in graph.in_edges[node.guid] if e.dst_idx == dst_idx]
     if not edges:
         return None
     e = edges[0]
-    src_shape = g.nodes[e.src].op.output_shapes[e.src_idx]
+    src_shape = graph.nodes[e.src].op.output_shapes[e.src_idx]
     new_op = make_op(src_shape)
     if new_op is None:
         return None
+    g = graph.copy_cow() if cow else graph.copy()
     mid = Node(g._next_guid, new_op)
     g._next_guid += 1
-    g.add_node(mid)
-    g.in_edges[node.guid].remove(e)
-    g.out_edges[e.src].remove(e)
     e1 = Edge(e.src, mid.guid, e.src_idx, 0)
     e2 = Edge(mid.guid, node.guid, 0, e.dst_idx)
-    g.out_edges[e.src].append(e1)
-    g.in_edges[mid.guid].append(e1)
-    g.out_edges[mid.guid].append(e2)
-    g.in_edges[node.guid].append(e2)
+    g.nodes[mid.guid] = mid
+    g.in_edges[mid.guid] = [e1]
+    g.out_edges[mid.guid] = [e2]
+    g.in_edges[node.guid] = [
+        x for x in g.in_edges[node.guid] if x is not e] + [e2]
+    g.out_edges[e.src] = [
+        x for x in g.out_edges[e.src] if x is not e] + [e1]
     g._invalidate()  # direct edge-list surgery bypasses add_edge
+    _mark(g, ins=(mid.guid, node.guid), outs=(e.src,))
     return g
 
 
-def _insert_after(graph: Graph, node: Node, out_idx: int, make_op) -> Optional[Graph]:
-    g = graph.copy()
+def _insert_after(graph: Graph, node: Node, out_idx: int, make_op,
+                  copy: bool = True) -> Optional[Graph]:
+    """``copy=False`` splices into ``graph`` itself — for two-step
+    rewrites whose first step already produced a fresh (COW) clone;
+    the discarded intermediate was pure overhead.  Either way the
+    surgery replaces edge lists, honoring the COW discipline."""
+    g = graph.copy_cow() if copy else graph
     shape = node.op.output_shapes[out_idx]
     new_op = make_op(shape)
     if new_op is None:
         return None
     mid = Node(g._next_guid, new_op)
     g._next_guid += 1
-    g.add_node(mid)
-    outs = [e for e in g.out_edges[node.guid] if e.src_idx == out_idx]
-    for e in outs:
-        g.out_edges[node.guid].remove(e)
-        g.in_edges[e.dst].remove(e)
-        ne = Edge(mid.guid, e.dst, 0, e.dst_idx)
-        g.out_edges[mid.guid].append(ne)
-        g.in_edges[e.dst].append(ne)
+    g.nodes[mid.guid] = mid
+    old_out = g.out_edges[node.guid]
+    outs = [e for e in old_out if e.src_idx == out_idx]
     e1 = Edge(node.guid, mid.guid, out_idx, 0)
-    g.out_edges[node.guid].append(e1)
-    g.in_edges[mid.guid].append(e1)
+    g.out_edges[node.guid] = [
+        e for e in old_out if e.src_idx != out_idx] + [e1]
+    mid_out = []
+    for e in outs:
+        ne = Edge(mid.guid, e.dst, 0, e.dst_idx)
+        mid_out.append(ne)
+        g.in_edges[e.dst] = [
+            x for x in g.in_edges[e.dst] if x is not e] + [ne]
+    g.in_edges[mid.guid] = [e1]
+    g.out_edges[mid.guid] = mid_out
     g._invalidate()
+    _mark(g, ins=[mid.guid] + [e.dst for e in outs], outs=(node.guid,))
     return g
 
 
@@ -118,6 +170,32 @@ _xfer_counter = [0]
 def _uname(base: str) -> str:
     _xfer_counter[0] += 1
     return f"{base}_x{_xfer_counter[0]}"
+
+
+_PROTO_CACHE: Dict[Tuple, object] = {}
+
+
+def _proto_op(cls, base: str, shape, **kw):
+    """Construct-or-clone a parallel-op descriptor.  Operator.__init__
+    re-derives output shapes and weight specs — two such constructions
+    per candidate across tens of thousands of candidates was a real
+    slice of the search — but every instance of (class, logical input
+    shape, attrs) is structurally identical except for its unique debug
+    name, so later instances clone a cached prototype and stamp a fresh
+    name.  Safe because operators are immutable descriptors (ops/base
+    docstring); the attrs dict is still copied per clone as insurance."""
+    key = (cls, shape.sizes, shape.dtype.value,
+           tuple(sorted(kw.items())))
+    proto = _PROTO_CACHE.get(key)
+    if proto is None:
+        proto = cls(_uname(base), [shape], **kw)
+        _PROTO_CACHE[key] = proto
+        return proto
+    clone = object.__new__(cls)
+    clone.__dict__.update(proto.__dict__)
+    clone.name = _uname(base)
+    clone.attrs = dict(proto.attrs)
+    return clone
 
 
 # ---------------------------------------------------------------------------
@@ -145,7 +223,8 @@ def make_partition_combine_xfer(
             graph,
             node,
             0,
-            lambda s: RepartitionOp(_uname("repartition"), [s], dim=dim, degree=degree)
+            lambda s: _proto_op(RepartitionOp, "repartition", s,
+                                dim=dim, degree=degree)
             if dim < s.ndim and s.sizes[dim] % degree == 0
             else None,
         )
@@ -155,7 +234,8 @@ def make_partition_combine_xfer(
             g,
             g.nodes[node.guid],
             0,
-            lambda s: CombineOp(_uname("combine"), [s], dim=dim, degree=1),
+            lambda s: _proto_op(CombineOp, "combine", s, dim=dim, degree=1),
+            copy=False,
         )
 
     return GraphXfer(
@@ -183,7 +263,7 @@ def make_replicate_reduce_xfer(op_type: OperatorType, degree: int) -> GraphXfer:
             graph,
             node,
             0,
-            lambda s: ReplicateOp(_uname("replicate"), [s], degree=degree),
+            lambda s: _proto_op(ReplicateOp, "replicate", s, degree=degree),
         )
         if g is None:
             return None
@@ -191,7 +271,8 @@ def make_replicate_reduce_xfer(op_type: OperatorType, degree: int) -> GraphXfer:
             g,
             g.nodes[node.guid],
             0,
-            lambda s: ReductionOp(_uname("reduction"), [s], degree=degree),
+            lambda s: _proto_op(ReductionOp, "reduction", s, degree=degree),
+            copy=False,
         )
 
     return GraphXfer(
@@ -229,6 +310,7 @@ def make_simplify_xfer() -> GraphXfer:
             g.out_edges[in_e.src].append(ne)
             g.in_edges[e.dst].append(ne)
         g._invalidate()
+        _mark(g, ins=[e.dst for e in out_edges], outs=(in_e.src,))
         return g
 
     return GraphXfer(
@@ -293,6 +375,8 @@ def make_linear_activation_fusion_xfer() -> GraphXfer:
             g.out_edges[nn.guid].append(ne)
             g.in_edges[e.dst].append(ne)
         g._invalidate()
+        _mark(g, ins=[nn.guid] + [e.dst for e in out_edges],
+              outs=[nn.guid] + [e.src for e in in_edges])
         return g
 
     return GraphXfer(
@@ -335,6 +419,7 @@ def make_parallel_chain_fusion_xfer() -> GraphXfer:
             g.out_edges[in_e.src].append(ne)
             g.in_edges[e.dst].append(ne)
         g._invalidate()
+        _mark(g, ins=[e.dst for e in out_edges], outs=(in_e.src,))
         return g
 
     return GraphXfer(
@@ -382,12 +467,15 @@ def make_combine_concat_sink_xfer() -> GraphXfer:
                 ne = Edge(up.src, oe.dst, up.src_idx, oe.dst_idx)
                 g.out_edges[up.src].append(ne)
                 g.in_edges[oe.dst].append(ne)
+            _mark(g, ins=[oe.dst for oe in out_edges], outs=(up.src,))
         g._invalidate()
         return _insert_after(
             g,
             g.nodes[node.guid],
             0,
-            lambda s: CombineOp(_uname("combine"), [s], dim=dim, degree=degree),
+            lambda s: _proto_op(CombineOp, "combine", s,
+                                dim=dim, degree=degree),
+            copy=False,
         )
 
     return GraphXfer(
@@ -438,9 +526,11 @@ def make_unary_hoist_partition_xfer() -> GraphXfer:
             graph,
             node,
             0,
-            lambda s: RepartitionOp(_uname("repartition"), [s], dim=dim, degree=degree)
+            lambda s: _proto_op(RepartitionOp, "repartition", s,
+                                dim=dim, degree=degree)
             if dim < s.ndim and s.sizes[dim] % degree == 0
             else None,
+            cow=False,  # the rep deletions below mutate in place
         )
         if g is None:
             return None
@@ -452,6 +542,7 @@ def make_unary_hoist_partition_xfer() -> GraphXfer:
                 ne = Edge(up.src, oe.dst, up.src_idx, oe.dst_idx)
                 g.out_edges[up.src].append(ne)
                 g.in_edges[oe.dst].append(ne)
+            _mark(g, ins=[oe.dst for oe in out_edges], outs=(up.src,))
         g._invalidate()
         return g
 
@@ -574,11 +665,13 @@ class BatchEmbeddingsXfer:
         g.out_edges[be.guid].append(e)
         g.in_edges[un.guid].append(e)
 
+        consumers = []
         for k, gu in enumerate(guids):
             for old in list(g.out_edges[gu]):
                 ne = Edge(un.guid, old.dst, k, old.dst_idx)
                 g.out_edges[un.guid].append(ne)
                 g.in_edges[old.dst].append(ne)
+                consumers.append(old.dst)
         for gu in guids:
             g.remove_node(gu)
         g._invalidate()
@@ -586,4 +679,7 @@ class BatchEmbeddingsXfer:
             g.topo_order()
         except ValueError:
             return None
-        return g
+        new = (stack.guid, be.guid, un.guid)
+        _mark(g, ins=list(new) + consumers,
+              outs=list(new) + [s for s, _ in id_srcs])
+        return _finish_rewrite(graph, g)

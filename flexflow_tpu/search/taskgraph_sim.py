@@ -129,12 +129,17 @@ class LogicalTaskGraphSimulator(Simulator):
         total = max(compute_end, comm_time)
         if breakdown is not None:
             # pooled-traffic currency: flows are joint, so there are no
-            # per-collective comm records (comm_schedule stays empty)
+            # per-collective comm records (comm_schedule stays empty BY
+            # DESIGN).  pooled_comm=True says so explicitly — ffobs /
+            # trace consumers must not read "no comm records" as "no
+            # communication" (the whole iteration's resharding + sync
+            # traffic is inside comm_end_s as one joint evaluation).
             breakdown.update(
                 total_s=total,
                 compute_end_s=compute_end,
                 comm_end_s=comm_time,
                 num_devices=self.num_devices,
                 include_update=include_update,
+                pooled_comm=True,
             )
         return total

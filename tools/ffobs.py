@@ -169,6 +169,26 @@ def render_report(events: List[dict], top: int = 10,
             f"native={d.get('native_hits', 0)}, "
             f"greedy-fallbacks={d.get('greedy_hits', 0)}"
         )
+    perf = [e for e in events if e.get("kind") == "search.perf"]
+    if perf:
+        p = perf[-1]
+        ds, fs = p.get("delta_sims", 0), p.get("full_sims", 0)
+        drate = ds / max(1, ds + fs)
+        rh = p.get("cache_row_hits", 0)
+        rm = p.get("cache_row_misses", 0)
+        line = (
+            f"Search perf: {p.get('search_seconds')}s search + "
+            f"{p.get('calibration_seconds')}s calibration; "
+            f"{len(cands)} candidates fully costed; simulations: "
+            f"{ds} delta / {fs} full ({drate:.0%} delta-served, "
+            f"{p.get('delta_bails', 0)} bails)"
+        )
+        if rh + rm:
+            line += (f"; cost-cache rows: {rh}/{rh + rm} hits "
+                     f"({rh / (rh + rm):.0%})")
+        if p.get("result_cache_hit"):
+            line += "; RESULT served from the persistent cost cache"
+        lines.append(line)
     lines.append("")
 
     # ---- strategy table ---------------------------------------------------

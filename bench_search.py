@@ -143,8 +143,10 @@ def _model_specs():
 
 
 def simulate_pair(name, spec, n_devices, calibration=None,
-                  calibration_file=None, cost_cache_file=None):
+                  calibration_file=None, cost_cache_file=None,
+                  verify=False):
     import flexflow_tpu as ff
+    from flexflow_tpu.analysis import CHECK_STATS
     from flexflow_tpu.compiler.lowering import data_parallel_strategy
     from flexflow_tpu.search.driver import LAST_SEARCH_STATS, optimize_strategy
     from flexflow_tpu.search.simulator import Simulator
@@ -167,10 +169,22 @@ def simulate_pair(name, spec, n_devices, calibration=None,
     sim = Simulator(cfg.machine_spec, num_devices=n_devices,
                     calibration=calibration)
     c_dp = sim.simulate(g, data_parallel_strategy(g, n_devices))
+    verify_before = dict(CHECK_STATS)
     t0 = time.monotonic()
     best_graph, strategy = optimize_strategy(g, cfg, return_graph=True)
     search_s = time.monotonic() - t0
     stats = dict(LAST_SEARCH_STATS)
+    verify_stats = None
+    if verify:
+        # per-model verifier overhead: wall seconds spent inside the
+        # invariant checker during THIS search (the measured cost of
+        # always-on checking, not a guess)
+        verify_stats = {
+            "verify_checks": int(
+                CHECK_STATS["checks"] - verify_before["checks"]),
+            "verify_seconds": round(
+                CHECK_STATS["seconds"] - verify_before["seconds"], 4),
+        }
     c_se = Simulator(cfg.machine_spec, num_devices=n_devices,
                      calibration=calibration).simulate(best_graph, strategy)
     d, f = stats.get("delta_sims", 0), stats.get("full_sims", 0)
@@ -198,6 +212,7 @@ def simulate_pair(name, spec, n_devices, calibration=None,
         "cost_cache_row_hit_rate": (
             round(rh / (rh + rm), 3) if (rh + rm) else None),
         "cost_cache_result_hit": bool(stats.get("result_cache_hit")),
+        **(verify_stats or {}),
     }
 
 
@@ -536,6 +551,12 @@ def main():
                     help="run ONLY the sync-precision sweep and merge it "
                          "into the existing artifact, leaving every "
                          "model row untouched")
+    ap.add_argument("--verify", action="store_true",
+                    help="arm the static-analysis verifier "
+                         "(flexflow_tpu/analysis, FLEXFLOW_TPU_VERIFY "
+                         "semantics) during the searches and record "
+                         "per-model verifier overhead "
+                         "(verify_checks/verify_seconds) in each row")
     ap.add_argument("--obs", action="store_true",
                     help="unified telemetry: JSONL event log "
                          "(<prefix>_obs.jsonl), per-model "
@@ -717,10 +738,15 @@ def main():
               "models": {}}
     can_exec = len(jax.devices()) >= args.devices and not args.sim_only
     cal_file = args.calibration_file if calibration is not None else None
+    if args.verify:
+        from flexflow_tpu.analysis import set_verify
+
+        set_verify(True)
     for n in names:
         row = simulate_pair(n, specs[n], args.devices, calibration,
                             calibration_file=cal_file,
-                            cost_cache_file=cost_cache or "")
+                            cost_cache_file=cost_cache or "",
+                            verify=args.verify)
         row["calibration_seconds"] = round(
             row.get("calibration_seconds", 0.0) + bench_cal.get(n, 0.0), 2)
         if can_exec:

@@ -81,6 +81,10 @@ class FFConfig:
     calibration_budget_s: float = 60.0  # wall bound on compile-time probes
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
+    import_strategy_partial: bool = False  # best-effort strategy import
+    # (--import-strategy-partial): downgrade the provenance checks
+    # (digest/coverage, STR2xx) to warnings and apply the views whose op
+    # names match — the historical behavior, now an explicit opt-in
     export_strategy_computation_graph_file: Optional[str] = None
     export_strategy_task_graph_file: Optional[str] = None  # simulated
     # schedule dot export (reference: config.h:142, simulator.cc:1008)
@@ -132,6 +136,13 @@ class FFConfig:
     # invalidated wholesale when the signature moves.  None falls back
     # to $FLEXFLOW_TPU_COST_CACHE (path; "0"/empty disables); empty
     # string "" disables outright (--no-cost-cache)
+    verify: bool = False  # static-analysis verification
+    # (flexflow_tpu/analysis, --verify, env FLEXFLOW_TPU_VERIFY=1):
+    # run the graph-invariant checker after EVERY GraphXfer.apply and
+    # check the compile-time graph before lowering.  The strategy/
+    # sharding legality lint in optimize_strategy is always on; this
+    # flag adds the per-rewrite structural proof (bench_search.py
+    # --verify measures its overhead).
     zero_dp_shard: bool = False  # ZeRO-1 / weight-update sharding
     # (arXiv:2004.13336): shard optimizer state (and the update
     # compute) of replicated weights over the mesh axes they are
@@ -203,6 +214,11 @@ class FFConfig:
                        type=float, default=60.0)
         p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
         p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
+        p.add_argument("--import-strategy-partial",
+                       dest="import_strategy_partial", action="store_true",
+                       help="apply a strategy file best-effort even when "
+                            "its graph digest/coverage does not match "
+                            "(provenance checks downgrade to warnings)")
         p.add_argument("--machine-model-file", type=str, default=None)
         p.add_argument("--taskgraph", dest="export_taskgraph", type=str, default=None)
         p.add_argument("--profiling", action="store_true")
@@ -239,6 +255,11 @@ class FFConfig:
                        action="store_true",
                        help="bypass the persistent cost cache even when "
                             "a file/env default is configured")
+        p.add_argument("--verify", action="store_true",
+                       help="static-analysis verification "
+                            "(flexflow_tpu/analysis): check graph "
+                            "invariants after every rewrite and the "
+                            "compile-time graph before lowering")
         p.add_argument("--seed", type=int, default=0)
         args, _ = p.parse_known_args(argv)
         search_devs = args.search_num_workers * max(1, args.search_num_nodes or 1)
@@ -262,6 +283,7 @@ class FFConfig:
             calibration_budget_s=args.calibration_budget,
             export_strategy_file=args.export_strategy,
             import_strategy_file=args.import_strategy,
+            import_strategy_partial=args.import_strategy_partial,
             export_strategy_task_graph_file=args.export_taskgraph,
             machine_model_file=args.machine_model_file,
             profiling=args.profiling,
@@ -274,5 +296,6 @@ class FFConfig:
             obs_trace_file=args.obs_trace,
             drift_threshold=args.drift_threshold,
             cost_cache_file="" if args.no_cost_cache else args.cost_cache_file,
+            verify=args.verify,
             seed=args.seed,
         )

@@ -525,6 +525,16 @@ class Graph:
                     g.in_edges[e.dst].append(e)
         return g
 
+    # ---- verification ----------------------------------------------------
+    def check(self, strict_shapes: bool = True) -> list:
+        """Well-formedness findings for this PCG ([] = sound) — the
+        static-analysis invariant pass (flexflow_tpu/analysis,
+        PCG0xx codes) as an instance method for interactive debugging.
+        Lazy import: the graph core stays dependency-free."""
+        from flexflow_tpu.analysis.invariants import check_graph
+
+        return check_graph(self, strict_shapes=strict_shapes)
+
     # ---- export ----------------------------------------------------------
     def to_dot(self, strategy: Optional[Dict[int, object]] = None) -> str:
         """Graphviz export (reference: substitution.cc:1790

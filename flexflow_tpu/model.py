@@ -415,6 +415,15 @@ class FFModel:
                 f"comp_mode must be 'training' or 'inference', got {comp_mode!r}"
             )
         self.config.comp_mode = comp_mode
+        if self.config.verify:
+            # prove the frontend-built graph well-formed before anything
+            # consumes it (flexflow_tpu/analysis).  The per-rewrite hook
+            # inside the search is armed by optimize_strategy's own
+            # scoped_verify — config.verify never becomes a sticky
+            # process-wide latch.
+            from flexflow_tpu.analysis import assert_graph_ok
+
+            assert_graph_ok(self.graph, context="at compile entry")
         if self.config.obs_log_file:
             # FFConfig-gated unified telemetry (flexflow_tpu/obs): the
             # search, compile, and fit paths below all emit through the
@@ -457,7 +466,43 @@ class FFModel:
             elif self.config.import_strategy_file:
                 from flexflow_tpu.search.strategy_io import import_strategy
 
-                strategy = import_strategy(self.config.import_strategy_file, self.graph)
+                # an imported strategy bypasses the search's always-on
+                # gate — provenance is checked by import_strategy and
+                # the views are linted below, so an illegal file fails
+                # at compile with a finding, not inside XLA
+                from flexflow_tpu.analysis import (
+                    AnalysisError,
+                    emit_findings,
+                    errors_only,
+                    lint_strategy,
+                )
+
+                try:
+                    strategy = import_strategy(
+                        self.config.import_strategy_file, self.graph,
+                        allow_partial=self.config.import_strategy_partial)
+                except AnalysisError as e:
+                    err = AnalysisError(
+                        f"{e}\n(hint: a strategy exported after a "
+                        f"REWRITING search is keyed to the rewritten "
+                        f"graph and cannot re-apply to a fresh frontend "
+                        f"build — use the persistent cost cache "
+                        f"(--cost-cache-file) for cross-process reuse of "
+                        f"rewritten searches, or "
+                        f"--import-strategy-partial / "
+                        f"FFConfig.import_strategy_partial for a "
+                        f"best-effort partial apply)")
+                    err.findings = list(e.findings)
+                    raise err from e
+
+                bad = errors_only(lint_strategy(
+                    self.graph, strategy, self.config.num_devices))
+                if bad:
+                    emit_findings(bad)
+                    raise AnalysisError(
+                        f"imported strategy "
+                        f"{self.config.import_strategy_file!r} is illegal "
+                        f"for this graph/mesh", bad)
             elif self.config.only_data_parallel:
                 strategy = data_parallel_strategy(self.graph, self.config.num_devices)
             else:

@@ -47,6 +47,10 @@ EVENT_KINDS = {
     "calibration.staleness": {"ratio", "threshold"},
     # compile-time strategy explanation (model.py)
     "strategy.table": {"rows"},
+    # static analysis (flexflow_tpu/analysis): one event per finding —
+    # "pass" is the producing pass (invariants/sharding/equivalence/
+    # strategy), "code" the stable finding code (PCG0xx/SHD1xx/…)
+    "analysis.finding": {"pass", "code"},
     # runtime (model.fit / runtime/profiler.py)
     "profile.summary": {"steps"},
     "drift.report": {"predicted_s", "measured_s", "ratio", "stale"},

@@ -324,6 +324,19 @@ class CostCache:
         _RESULT_HITS.inc()
         return payload
 
+    def drop_search_result(self, graph, config) -> bool:
+        """Evict the stored result for (graph, knobs) — the driver calls
+        this when a served payload fails the static-analysis gate
+        (corrupt pickle, illegal strategy), so a bad entry costs one
+        recompute instead of being served forever.  Returns True when an
+        entry was dropped."""
+        key = self.search_key(graph, config)
+        if key in self.results:
+            del self.results[key]
+            self._dirty = True
+            return True
+        return False
+
     def put_search_result(self, graph, config, payload,
                           cost: float) -> None:
         if self.stale or not math.isfinite(cost):

@@ -455,6 +455,17 @@ def _merge_split(
 LAST_SEARCH_STATS: Dict[str, object] = {}
 
 
+def _lint_findings(graph, strategy, num_devices):
+    """Error-level static-analysis findings for a search result: graph
+    well-formedness + strategy/sharding legality (flexflow_tpu/analysis).
+    The always-on gate of ``optimize_strategy`` — a few propagate calls
+    per node, negligible next to the search itself."""
+    from flexflow_tpu.analysis import check_graph, errors_only, lint_strategy
+
+    return errors_only(
+        check_graph(graph) + lint_strategy(graph, strategy, num_devices))
+
+
 def _serve_cached_search(cache, graph: Graph, config: FFConfig):
     """Remap a cached search result onto the caller's graph.  The
     digest key is guid-free (stable_graph_digest), so the stored
@@ -515,7 +526,22 @@ def optimize_strategy(
     default compile path — the joint Unity search runs: graph rewrites
     compete with view assignment and the best REWRITTEN graph is
     returned for lowering.  With False only strategies on the original
-    graph are explored (strategy-only mode, e.g. for export)."""
+    graph are explored (strategy-only mode, e.g. for export).
+
+    ``config.verify`` arms the post-rewrite invariant checker for THIS
+    search only (same checks as FLEXFLOW_TPU_VERIFY=1, scoped instead
+    of process-sticky)."""
+    if getattr(config, "verify", False):
+        from flexflow_tpu.analysis.invariants import scoped_verify
+
+        with scoped_verify(True):
+            return _optimize_strategy(graph, config, return_graph)
+    return _optimize_strategy(graph, config, return_graph)
+
+
+def _optimize_strategy(
+    graph: Graph, config: FFConfig, return_graph: bool = False
+) -> "Strategy | Tuple[Graph, Strategy]":
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     t_start = time.monotonic()
@@ -592,6 +618,23 @@ def optimize_strategy(
         served = _serve_cached_search(cache, graph, config)
         if served is not None:
             best_graph, best_strategy, best_cost = served
+            # gate the served result on the same static analysis the
+            # fresh search passes: a corrupt pickled graph or an
+            # illegal strategy must cost one recompute, not be reused
+            # forever (the PR-3 cache serves whole search results)
+            bad = _lint_findings(best_graph, best_strategy, n)
+            if bad:
+                from flexflow_tpu.analysis import emit_findings
+
+                emit_findings(bad)
+                log.log(
+                    f"cost cache: served search result FAILED the "
+                    f"static-analysis gate ({bad[0]}); dropping the "
+                    f"entry and searching fresh"
+                )
+                cache.drop_search_result(graph, config)
+                served = None
+        if served is not None:
             log.log(
                 f"cost cache: served searched strategy "
                 f"({best_cost * 1e3:.4f} ms/iter) for {graph.num_nodes}-"
@@ -691,12 +734,37 @@ def optimize_strategy(
         )
         best_cost, best_strategy, best_graph = dp_cost, dp_strategy, graph
 
+    # static-analysis gate (flexflow_tpu/analysis): the returned (graph,
+    # strategy) must pass graph invariants + the sharding legality lint
+    # BEFORE it is persisted or handed to the lowering.  A failure here
+    # is a search bug, not a user error — fail loudly instead of letting
+    # the cost cache serve a corrupt result forever.  Non-finite results
+    # (nothing feasible fits) are deliberately NOT fatal: compile's
+    # staged-pipeline fallback consumes them — findings are still
+    # emitted and logged so the drift is visible.
+    bad = _lint_findings(best_graph, best_strategy, n) if best_strategy \
+        else []
+    if bad:
+        from flexflow_tpu.analysis import AnalysisError, emit_findings
+
+        emit_findings(bad)
+        if math.isfinite(best_cost):
+            raise AnalysisError(
+                "optimize_strategy produced an illegal (graph, strategy) "
+                "pair", bad)
+        log.log(
+            f"static analysis: infeasible search result also fails the "
+            f"legality lint ({bad[0]}); returning it for the compile "
+            f"fallbacks, NOT persisting"
+        )
+
     # persist: cost rows accumulated this search + the finished result
     # (only complete searches — a deadline-truncated result is not the
     # pure function's value and must not be served forever)
     cache = floor_sim.cost_cache
     if cache is not None:
-        if return_graph and not search_expired and math.isfinite(best_cost):
+        if (return_graph and not search_expired and math.isfinite(best_cost)
+                and not bad):
             payload = (
                 [nd.guid for nd in graph.topo_order()],
                 best_graph if best_graph is not graph else None,

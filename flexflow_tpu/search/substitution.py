@@ -22,6 +22,7 @@ import weakref
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from flexflow_tpu.analysis import invariants as _invariants
 from flexflow_tpu.core.graph import Edge, Graph, Node
 from flexflow_tpu.core.optype import OperatorType
 from flexflow_tpu.core.ptensor import ParallelTensorShape
@@ -56,13 +57,21 @@ def _mark(g: Graph, ins=(), outs=()) -> None:
     touched[1].update(outs)
 
 
-def _finish_rewrite(parent: Graph, g: Optional[Graph]) -> Optional[Graph]:
+def _finish_rewrite(parent: Graph, g: Optional[Graph],
+                    name: Optional[str] = None) -> Optional[Graph]:
     """Promote the working-graph touched sets into the changed-guid
     annotation delta consumers read (``g._changed_vs`` = parent weakref
     + changed-in/changed-out guid frozensets) — the dirty-frontier seed
     the delta simulator and the delta graph hash both key on.  Rewrites
     built outside this module (substitution_loader JSON rules) carry no
-    sets; consumers fall back to a structural diff."""
+    sets; consumers fall back to a structural diff.
+
+    Under verification (``FLEXFLOW_TPU_VERIFY=1`` / ``--verify``) every
+    rewrite result passes the full graph-invariant check here — the ONE
+    chokepoint all ``GraphXfer.apply`` paths flow through — so a splice
+    that leaves a dangling edge, a doubly-fed slot, or a shape
+    disagreement with re-inference fails loudly at the rewrite, not
+    three layers later in a simulated cost."""
     if g is None:
         return None
     touched = getattr(g, "_delta_touched", None)
@@ -70,6 +79,9 @@ def _finish_rewrite(parent: Graph, g: Optional[Graph]) -> Optional[Graph]:
         g._changed_vs = (
             weakref.ref(parent), frozenset(touched[0]), frozenset(touched[1])
         )
+    if _invariants.verification_enabled():
+        _invariants.assert_graph_ok(
+            g, context=f"after rewrite {name or 'unnamed'!r}")
     return g
 
 
@@ -90,15 +102,50 @@ class GraphXfer:
 
     def apply(self, graph: Graph, match: Match) -> Optional[Graph]:
         _APPLIES.inc()
-        return _finish_rewrite(graph, self.apply_fn(graph, match))
+        return _finish_rewrite(graph, self.apply_fn(graph, match), self.name)
 
 
 # ---------------------------------------------------------------------------
-# The two splice helpers are COPY-ON-WRITE: the clone shares every
-# untouched edge list with the parent and REPLACES (never mutates) the
-# few lists the splice changes.  Rewrites that DELETE nodes
-# (remove_node mutates neighbor lists in place) must keep using the
-# full graph.copy().
+# The splice helpers below are the ONLY audited paths for raw edge-list
+# surgery: _insert_before/_insert_after splice a node into an edge
+# (COPY-ON-WRITE: the clone shares every untouched edge list with the
+# parent and REPLACES — never mutates — the few lists the splice
+# changes), and _bypass_node deletes a node and bridges its input to
+# every consumer (in-place; rewrites that delete must work on a full
+# graph.copy()).  Rewrites compose these instead of hand-rolling edge
+# lists, so the delta marks, cache invalidation, and the
+# no-consumer-reads-a-deleted-guid assertion live in one place — and
+# verification (_finish_rewrite) checks the composed result.
+
+
+def _bypass_node(g: Graph, guid: int) -> Optional[List[Edge]]:
+    """Checked delete-and-bridge splice: remove ``guid`` (a node with a
+    single meaningful input edge — the parallel-op/identity shape) and
+    reconnect its producer to every consumer, preserving consumer input
+    slots.  Returns the bridged edges, or None when the node is not
+    bypassable (no input edge) so the caller's apply can decline the
+    match instead of corrupting the graph.  MUTATES ``g`` in place:
+    callers must pass a full copy(), never a COW clone."""
+    in_list = g.in_edges.get(guid)
+    if not in_list:
+        return None
+    up = in_list[0]
+    out_edges = list(g.out_edges.get(guid, ()))
+    g.remove_node(guid)
+    bridged: List[Edge] = []
+    for e in out_edges:
+        # the audited contract of every delete-style rewrite: no
+        # surviving consumer may be left reading a deleted guid
+        assert e.dst in g.nodes, (
+            f"_bypass_node({guid}): consumer {e.dst} was already deleted"
+        )
+        ne = Edge(up.src, e.dst, up.src_idx, e.dst_idx)
+        g.out_edges[ne.src].append(ne)
+        g.in_edges[ne.dst].append(ne)
+        bridged.append(ne)
+    g._invalidate()
+    _mark(g, ins=[e.dst for e in out_edges], outs=(up.src,))
+    return bridged
 
 
 def _insert_before(graph: Graph, node: Node, dst_idx: int, make_op,
@@ -301,16 +348,13 @@ def make_simplify_xfer() -> GraphXfer:
     def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
         g = graph.copy()
         comb_guid = g.successors(node.guid)[0]
-        in_e = g.in_edges[node.guid][0]
-        out_edges = list(g.out_edges[comb_guid])
-        g.remove_node(node.guid)
-        g.remove_node(comb_guid)
-        for e in out_edges:
-            ne = Edge(in_e.src, e.dst, in_e.src_idx, e.dst_idx)
-            g.out_edges[in_e.src].append(ne)
-            g.in_edges[e.dst].append(ne)
-        g._invalidate()
-        _mark(g, ins=[e.dst for e in out_edges], outs=(in_e.src,))
+        # bypass the repartition (bridging its input to the combine),
+        # then the combine — two audited splices, same final edges as
+        # the old one-shot surgery
+        if _bypass_node(g, node.guid) is None:
+            return None
+        if _bypass_node(g, comb_guid) is None:
+            return None
         return g
 
     return GraphXfer(
@@ -411,15 +455,8 @@ def make_parallel_chain_fusion_xfer() -> GraphXfer:
 
     def apply_fn(graph: Graph, node: Node) -> Optional[Graph]:
         g = graph.copy()
-        in_e = g.in_edges[node.guid][0]
-        out_edges = list(g.out_edges[node.guid])
-        g.remove_node(node.guid)
-        for e in out_edges:
-            ne = Edge(in_e.src, e.dst, in_e.src_idx, e.dst_idx)
-            g.out_edges[in_e.src].append(ne)
-            g.in_edges[e.dst].append(ne)
-        g._invalidate()
-        _mark(g, ins=[e.dst for e in out_edges], outs=(in_e.src,))
+        if _bypass_node(g, node.guid) is None:
+            return None
         return g
 
     return GraphXfer(
@@ -460,15 +497,8 @@ def make_combine_concat_sink_xfer() -> GraphXfer:
             comb = g.nodes[e.src]
             dim = comb.op.attrs["dim"]
             degree = comb.op.attrs["degree"]
-            up = g.in_edges[comb.guid][0]
-            out_edges = list(g.out_edges[comb.guid])
-            g.remove_node(comb.guid)
-            for oe in out_edges:
-                ne = Edge(up.src, oe.dst, up.src_idx, oe.dst_idx)
-                g.out_edges[up.src].append(ne)
-                g.in_edges[oe.dst].append(ne)
-            _mark(g, ins=[oe.dst for oe in out_edges], outs=(up.src,))
-        g._invalidate()
+            if _bypass_node(g, comb.guid) is None:
+                return None
         return _insert_after(
             g,
             g.nodes[node.guid],
@@ -535,15 +565,8 @@ def make_unary_hoist_partition_xfer() -> GraphXfer:
         if g is None:
             return None
         for rep in reps:
-            up = g.in_edges[rep.guid][0]
-            out_edges = list(g.out_edges[rep.guid])
-            g.remove_node(rep.guid)
-            for oe in out_edges:
-                ne = Edge(up.src, oe.dst, up.src_idx, oe.dst_idx)
-                g.out_edges[up.src].append(ne)
-                g.in_edges[oe.dst].append(ne)
-            _mark(g, ins=[oe.dst for oe in out_edges], outs=(up.src,))
-        g._invalidate()
+            if _bypass_node(g, rep.guid) is None:
+                return None
         return g
 
     return GraphXfer(
@@ -682,4 +705,4 @@ class BatchEmbeddingsXfer:
         new = (stack.guid, be.guid, un.guid)
         _mark(g, ins=list(new) + consumers,
               outs=list(new) + [s for s, _ in id_srcs])
-        return _finish_rewrite(graph, g)
+        return _finish_rewrite(graph, g, self.name)

@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 import flexflow_tpu as ff
+from flexflow_tpu.analysis import assert_graph_ok
 from flexflow_tpu.compiler.lowering import data_parallel_strategy
 from flexflow_tpu.core.machine import MachineSpec, MachineView
 from flexflow_tpu.search.dp import SearchHelper
@@ -147,7 +148,7 @@ def test_substitutions_apply_and_cancel():
     g2 = part.apply(m.graph, matches[0])
     assert g2 is not None
     assert g2.num_nodes == m.graph.num_nodes + 2
-    g2.topo_order()  # still a DAG
+    assert_graph_ok(g2)  # full invariant pass, unconditional in tests
     cancel = next(x for x in xfers if x.name == "cancel_repartition_combine")
     # cancel only fires when combine directly follows repartition
     m3 = ff.FFModel(ff.FFConfig(num_devices=8))
@@ -159,7 +160,7 @@ def test_substitutions_apply_and_cancel():
     assert len(c_matches) == 1
     g3 = cancel.apply(m3.graph, c_matches[0])
     assert g3.num_nodes == m3.graph.num_nodes - 2
-    g3.topo_order()
+    assert_graph_ok(g3)
 
 
 def test_strategy_export_import_roundtrip(tmp_path):
@@ -302,6 +303,7 @@ def test_parallel_chain_fusion_xfer_unit():
     assert [mm.op.name for mm in matches] == ["r1"]
     g2 = xf.apply(m.graph, matches[0])
     assert g2.num_nodes == m.graph.num_nodes - 1
+    assert_graph_ok(g2)
     names = {n.op.name for n in g2.topo_order()}
     assert "r1" not in names and "r2" in names
     sim = Simulator(MachineSpec.tpu_v5e(8))
@@ -325,6 +327,7 @@ def test_combine_concat_sink_xfer_unit():
     g2 = xf.apply(m.graph, matches[0])
     # 3 combines removed, 1 inserted after the concat
     assert g2.num_nodes == m.graph.num_nodes - 2
+    assert_graph_ok(g2)
     combines = [n for n in g2.topo_order()
                 if n.op.op_type is OperatorType.COMBINE]
     assert len(combines) == 1
@@ -347,6 +350,7 @@ def test_unary_hoist_partition_xfer_unit():
     assert len(matches) == 1 and matches[0].op.name == "act"
     g2 = xf.apply(m.graph, matches[0])
     assert g2.num_nodes == m.graph.num_nodes - 2  # 3 removed, 1 added
+    assert_graph_ok(g2)
     reps = [n for n in g2.topo_order()
             if n.op.op_type is OperatorType.REPARTITION]
     assert len(reps) == 1
@@ -404,6 +408,7 @@ def test_linear_activation_fusion_xfer():
     assert len(matches) == 1 and matches[0].op.name == "fc"
     g2 = xf.apply(m.graph, matches[0])
     assert g2.num_nodes == m.graph.num_nodes - 1
+    assert_graph_ok(g2)
     fused = [n for n in g2.topo_order()
              if n.op.op_type is OperatorType.LINEAR
              and n.op.attrs.get("activation") == "relu"]

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""fflint — static-analysis linter for flexflow_tpu artifacts and the
+rewrite registry (flexflow_tpu/analysis as a CI-friendly CLI).
+
+Subcommands:
+
+  fflint strategy FILE...     lint exported strategy files (STR2xx):
+                              provenance digest present, views
+                              well-formed — stdlib-only, no jax
+  fflint cache FILE...        lint persistent cost-cache files (CCH4xx):
+                              schema/signature shape, row
+                              well-formedness, staleness — stdlib-only
+  fflint registry [--devices N]
+                              prove the substitution registry: graph
+                              invariants (PCG0xx) + numeric equivalence
+                              (EQV3xx) for every registered GraphXfer;
+                              imports the package (needs jax)
+  fflint all [--root DIR]     the CI entry point: lint every committed
+                              COST_CACHE*.json / *strategy*.json under
+                              DIR (default .) plus the full registry
+
+Exit codes: 0 clean, 1 findings, 2 usage/unreadable input.  Artifact
+subcommands never import jax, so they run anywhere the files land
+(same discipline as tools/ffobs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+from typing import List, Tuple
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+META_KEY = "__meta__"  # mirrors search/strategy_io.py (stdlib path)
+CACHE_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.SCHEMA_VERSION
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f), None
+    except OSError as e:
+        return None, f"unreadable: {e}"
+    except ValueError as e:
+        return None, f"not JSON: {e}"
+
+
+# ---------------------------------------------------------------------------
+# strategy files (stdlib)
+
+
+def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
+    """(severity, code, message) findings for one exported strategy
+    file.  Graph-side checks (digest match, coverage, view legality
+    against the op) need the graph and run at import time
+    (search/strategy_io.import_strategy) — this lints what a file alone
+    can prove."""
+    data, err = _load_json(path)
+    if err:
+        return [("error", "STR200", err)]
+    if not isinstance(data, dict):
+        return [("error", "STR200", "top level is not a JSON object")]
+    out: List[Tuple[str, str, str]] = []
+    meta = data.get(META_KEY)
+    if not isinstance(meta, dict) or not meta.get("graph_digest"):
+        # warn, matching import_strategy's severity for the same code:
+        # legacy pre-digest files import (with a warning), so they must
+        # not fail CI either
+        out.append((
+            "warn", "STR203",
+            "no __meta__.graph_digest — import cannot prove the file "
+            "matches its target graph (re-export with this tree)"))
+    views = {k: v for k, v in data.items() if k != META_KEY}
+    if not views:
+        out.append(("error", "STR202", "file names no ops at all"))
+    for name, v in sorted(views.items()):
+        if not isinstance(v, dict):
+            out.append(("error", "STR204", f"op {name!r}: entry is not an "
+                        "object"))
+            continue
+        dims = v.get("dims")
+        # an empty dims list is legal: a scalar-output op's trivial view
+        if (not isinstance(dims, list)
+                or any(not isinstance(d, int) or d < 1 for d in dims)):
+            out.append(("error", "STR204",
+                        f"op {name!r}: malformed dims {dims!r}"))
+        rep = v.get("replica", 1)
+        if not isinstance(rep, int) or rep < 1:
+            out.append(("error", "STR204",
+                        f"op {name!r}: malformed replica {rep!r}"))
+        start = v.get("start", 0)
+        if not isinstance(start, int) or start < 0:
+            out.append(("error", "STR204",
+                        f"op {name!r}: malformed start {start!r}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cost-cache files (stdlib)
+
+
+def lint_cache_file(path: str) -> List[Tuple[str, str, str]]:
+    data, err = _load_json(path)
+    if err:
+        return [("error", "CCH400", err)]
+    if not isinstance(data, dict):
+        return [("error", "CCH400", "top level is not a JSON object")]
+    out: List[Tuple[str, str, str]] = []
+    if data.get("schema") not in CACHE_SCHEMA_VERSIONS:
+        out.append(("error", "CCH401",
+                    f"unknown schema {data.get('schema')!r} (known: "
+                    f"{list(CACHE_SCHEMA_VERSIONS)})"))
+    sig = data.get("signature")
+    if (not isinstance(sig, str) or len(sig) != 16
+            or any(c not in "0123456789abcdef" for c in sig)):
+        out.append(("error", "CCH401",
+                    f"malformed cost-surface signature {sig!r} (expect 16 "
+                    "hex chars)"))
+    if data.get("calibration_stale"):
+        out.append(("warn", "CCH403",
+                    "calibration_stale is set: the cache refuses to serve "
+                    "until recalibration (drift gate, obs/drift.py)"))
+    rows = data.get("rows", [])
+    if not isinstance(rows, list):
+        return out + [("error", "CCH402", "rows is not a list")]
+    seen = set()
+    for i, r in enumerate(rows):
+        ok = (
+            isinstance(r, dict)
+            and isinstance(r.get("sig"), str)
+            and isinstance(r.get("degrees"), list)
+            and all(isinstance(d, int) and d >= 1 for d in r["degrees"])
+            and isinstance(r.get("replica"), int) and r["replica"] >= 1
+            and isinstance(r.get("row"), list) and len(r["row"]) == 4
+            and all(isinstance(x, (int, float)) and math.isfinite(x)
+                    and x >= 0 for x in r["row"])
+        )
+        if not ok:
+            out.append(("error", "CCH402", f"rows[{i}] malformed: "
+                        f"{str(r)[:120]}"))
+            continue
+        key = (r["sig"], tuple(r["degrees"]), r["replica"])
+        if key in seen:
+            out.append(("error", "CCH402",
+                        f"rows[{i}] duplicates key for degrees "
+                        f"{r['degrees']} replica {r['replica']}"))
+        seen.add(key)
+    sidecar = path + ".results.pkl"
+    if os.path.exists(sidecar) and os.path.getsize(sidecar) == 0:
+        out.append(("error", "CCH404", f"empty results sidecar {sidecar}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rewrite registry (imports flexflow_tpu — jax required)
+
+
+def lint_registry(num_devices: int) -> List[Tuple[str, str, str]]:
+    from flexflow_tpu.analysis.equivalence import verify_registry
+
+    return [(f.severity, f.code, f.message) for f in verify_registry(
+        num_devices=num_devices)]
+
+
+# ---------------------------------------------------------------------------
+
+
+def _report(path: str, findings: List[Tuple[str, str, str]]) -> int:
+    errors = 0
+    for sev, code, msg in findings:
+        print(f"{path}: {sev.upper()} [{code}] {msg}")
+        if sev == "error":
+            errors += 1
+    return errors
+
+
+def cmd_strategy(args) -> int:
+    errors = 0
+    for path in args.files:
+        errors += _report(path, lint_strategy_file(path))
+    print(f"fflint strategy: {len(args.files)} file(s), {errors} error(s)")
+    return 1 if errors else 0
+
+
+def cmd_cache(args) -> int:
+    errors = 0
+    for path in args.files:
+        errors += _report(path, lint_cache_file(path))
+    print(f"fflint cache: {len(args.files)} file(s), {errors} error(s)")
+    return 1 if errors else 0
+
+
+def cmd_registry(args) -> int:
+    findings = lint_registry(args.devices)
+    errors = _report("registry", findings)
+    print(f"fflint registry: {args.devices}-device rewrite registry, "
+          f"{errors} error(s)")
+    return 1 if errors else 0
+
+
+def cmd_all(args) -> int:
+    errors = 0
+    caches = sorted(glob.glob(
+        os.path.join(args.root, "**", "COST_CACHE*.json"), recursive=True))
+    strategies = sorted(
+        p for p in glob.glob(os.path.join(args.root, "**", "*.json"),
+                             recursive=True)
+        if "strategy" in os.path.basename(p).lower()
+    )
+    for path in caches:
+        errors += _report(path, lint_cache_file(path))
+    for path in strategies:
+        errors += _report(path, lint_strategy_file(path))
+    findings = lint_registry(args.devices)
+    errors += _report("registry", findings)
+    print(f"fflint all: {len(caches)} cache file(s), "
+          f"{len(strategies)} strategy file(s), registry @ "
+          f"{args.devices} devices — {errors} error(s)")
+    return 1 if errors else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fflint", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("strategy", help="lint exported strategy files")
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_strategy)
+    p = sub.add_parser("cache", help="lint persistent cost-cache files")
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_cache)
+    p = sub.add_parser("registry",
+                       help="numeric-equivalence proof of the rewrite "
+                            "registry (imports jax)")
+    p.add_argument("--devices", type=int, default=8)
+    p.set_defaults(fn=cmd_registry)
+    p = sub.add_parser("all", help="lint committed artifacts + registry")
+    p.add_argument("--root", default=".")
+    p.add_argument("--devices", type=int, default=8)
+    p.set_defaults(fn=cmd_all)
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code else 0
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

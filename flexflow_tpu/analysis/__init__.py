@@ -35,7 +35,7 @@ from flexflow_tpu.analysis.invariants import (
     set_verify,
     verification_enabled,
 )
-from flexflow_tpu.analysis.sharding import lint_strategy
+from flexflow_tpu.analysis.sharding import lint_strategy, lint_sync_schedule
 
 __all__ = [
     "AnalysisError",
@@ -50,4 +50,5 @@ __all__ = [
     "set_verify",
     "verification_enabled",
     "lint_strategy",
+    "lint_sync_schedule",
 ]

@@ -30,6 +30,25 @@ compile time — or worse, not discover at all:
   invariant split-boundary enumeration relies on —
   ``views.boundary_views`` pins one view to both segments)
 
+Gradient-sync SCHEDULE legality (``lint_sync_schedule`` — the
+searched, persisted comm plan of search/sync_schedule.py, gated
+always-on wherever a schedule is produced or imported):
+
+* **SHD120** structural sanity: bucket precision is a known wire
+  precision; every named op exists in the graph and carries weights
+* **SHD121** coverage: every weight group that actually syncs under the
+  strategy is covered EXACTLY once (no duplicates, no holes — an
+  uncovered group silently falls back to the exposed post-backward
+  monolithic path)
+* **SHD122** issue order respects grad readiness: buckets are ordered
+  by non-increasing earliest-member topo position — the backward
+  produces grads in reverse topo order, so a bucket issued before its
+  grads exist is a plan the executed step cannot honor
+* **SHD123** precision coherence: a compressed bucket's ops must be
+  gradient-safe to compress and agree with the sync-precision map
+  (search/sync_precision.py) — the two artifacts are built together
+  and must not contradict
+
 Pure host-side: no mesh construction, no XLA — safe to run inside
 ``optimize_strategy`` as an always-on gate.
 """
@@ -200,4 +219,130 @@ def lint_strategy(graph, strategy: Dict[int, object],
                             f"{len(annot.degrees)} but the producing edge "
                             f"carries a rank-{p_outs[e.src_idx].ndim} "
                             f"tensor", node=guid, op=name))
+    return findings
+
+
+def _s(code: str, message: str, **kw) -> Finding:
+    return Finding(code=code, pass_name="sync_schedule", message=message,
+                   **kw)
+
+
+def lint_sync_schedule(graph, strategy: Dict[int, object], schedule,
+                       precision_map: Optional[Dict[str, str]] = None,
+                       ) -> List[Finding]:
+    """Legality findings for a gradient-sync schedule against its
+    (graph, strategy) — SHD120-123 ([] = legal).  ``schedule`` is a
+    ``search.sync_schedule.SyncSchedule`` or any duck-typed bucket list
+    (objects with ``.name``/``.ops``/``.precision``)."""
+    # one source of truth for legal wire precisions: the schedule
+    # module is deliberately jax-free, so this stays pure host-side
+    from flexflow_tpu.search.sync_schedule import (
+        BUCKET_PRECISIONS as _BUCKET_PRECISIONS,
+    )
+
+    findings: List[Finding] = []
+    buckets = list(getattr(schedule, "buckets", schedule) or [])
+    if not buckets:
+        return [_s("SHD121", "schedule has no buckets")]
+
+    # which ops actually sync under this strategy (some propagated
+    # weight annot is replicated) — the coverage universe
+    pos: Dict[str, int] = {}
+    synced: Dict[str, bool] = {}
+    weighted: Dict[str, object] = {}
+    for i, node in enumerate(graph.topo_order()):
+        name = getattr(node.op, "name", None)
+        if name is None:
+            continue
+        pos[name] = i
+        if not getattr(node.op, "_weight_specs", ()):
+            continue
+        weighted[name] = node.op
+        mv = strategy.get(node.guid)
+        if mv is None and hasattr(node.op, "fixed_machine_view"):
+            mv = node.op.fixed_machine_view()
+        if mv is None:
+            continue
+        try:
+            osh = node.op.propagate(mv)
+        except Exception:
+            continue  # SHD105 owns that failure
+        synced[name] = any(
+            a is not None and a.replica > 1 for a in osh.weights)
+
+    seen: Dict[str, str] = {}  # op name -> bucket that claimed it
+    prev_min_pos: Optional[int] = None
+    prev_name: Optional[str] = None
+    pmap = precision_map or {}
+    for bucket in buckets:
+        bname = getattr(bucket, "name", "?")
+        prec = getattr(bucket, "precision", "fp32")
+        if prec not in _BUCKET_PRECISIONS:
+            findings.append(_s(
+                "SHD120",
+                f"bucket {bname!r} carries unknown precision {prec!r} "
+                f"(known: {list(_BUCKET_PRECISIONS)})"))
+        min_pos: Optional[int] = None
+        for op_name in getattr(bucket, "ops", ()):
+            if op_name not in pos:
+                findings.append(_s(
+                    "SHD120",
+                    f"bucket {bname!r} names op {op_name!r} the graph "
+                    f"does not have", op=op_name))
+                continue
+            if op_name not in weighted:
+                findings.append(_s(
+                    "SHD120",
+                    f"bucket {bname!r} names op {op_name!r}, which "
+                    f"carries no weights to sync", op=op_name))
+                continue
+            if op_name in seen:
+                findings.append(_s(
+                    "SHD121",
+                    f"op {op_name!r} is covered twice (buckets "
+                    f"{seen[op_name]!r} and {bname!r}) — its gradient "
+                    f"would sync twice", op=op_name))
+            seen[op_name] = bname
+            p = pos[op_name]
+            min_pos = p if min_pos is None else min(min_pos, p)
+            if prec != "fp32":
+                from flexflow_tpu.search.sync_precision import (
+                    grad_safe_to_compress,
+                )
+
+                mapped = pmap.get(op_name, "fp32")
+                if mapped != prec:
+                    findings.append(_s(
+                        "SHD123",
+                        f"bucket {bname!r} compresses {op_name!r} at "
+                        f"{prec} but the sync-precision map says "
+                        f"{mapped!r} — the two artifacts contradict",
+                        op=op_name))
+                elif not grad_safe_to_compress(weighted[op_name]):
+                    findings.append(_s(
+                        "SHD123",
+                        f"bucket {bname!r} compresses {op_name!r}, which "
+                        f"the gradient-safety heuristic excludes",
+                        op=op_name))
+        if min_pos is None:
+            continue
+        if prev_min_pos is not None and min_pos > prev_min_pos:
+            findings.append(_s(
+                "SHD122",
+                f"issue order violates grad readiness: bucket "
+                f"{prev_name!r} (earliest member at topo position "
+                f"{prev_min_pos}) issues BEFORE bucket {bname!r} "
+                f"(earliest member at {min_pos}), but the backward "
+                f"produces {bname!r}'s grads first — the serialized "
+                f"collective chain would stall a ready bucket behind "
+                f"one whose grads do not exist yet"))
+        prev_min_pos, prev_name = min_pos, bname
+    uncovered = sorted(
+        n for n, is_synced in synced.items() if is_synced and n not in seen)
+    if uncovered:
+        findings.append(_s(
+            "SHD121",
+            f"{len(uncovered)} synced weight group(s) uncovered (e.g. "
+            f"{uncovered[:4]}) — they would fall back to the exposed "
+            f"post-backward monolithic sync"))
     return findings

@@ -12,8 +12,14 @@ Two roles:
   fallback and an error-bound unit contract.  The search prices these
   (search/machine_model.py ``allreduce(precision=...)``) and the
   lowering executes them (compiler/lowering.py ``_sync_grads``).
+* ``bucketed`` — the searched gradient-sync SCHEDULE's executor
+  (search/sync_schedule.py): member grads of a bucket flatten into one
+  fused wire payload, buckets chain through ``optimization_barrier``
+  so collectives issue in backward grad-readiness order (overlap-aware
+  bucketed sync; GSPMD async collectives, arXiv:2105.04663).
 """
 
+from flexflow_tpu.comm.bucketed import bucketed_grad_sync
 from flexflow_tpu.comm.compat import force_cpu_devices, shard_map
 from flexflow_tpu.comm.quantized import (
     DEFAULT_CHUNK,
@@ -24,6 +30,7 @@ from flexflow_tpu.comm.quantized import (
     quantize_chunked,
     quantized_allreduce,
     quantized_grad_sync,
+    replication_axes,
 )
 
 __all__ = [
@@ -31,10 +38,12 @@ __all__ = [
     "MIN_COMPRESS_ELEMS",
     "SYNC_PRECISIONS",
     "allreduce_error_bound",
+    "bucketed_grad_sync",
     "dequantize_chunked",
     "force_cpu_devices",
     "quantize_chunked",
     "quantized_allreduce",
     "quantized_grad_sync",
+    "replication_axes",
     "shard_map",
 ]

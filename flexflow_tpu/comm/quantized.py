@@ -186,6 +186,27 @@ def allreduce_error_bound(
     return 1.05 * 2.0 * per_stage + 1e-12
 
 
+def replication_axes(sharding, mesh) -> Tuple[Tuple[str, ...], int]:
+    """The mesh axes a param's PartitionSpec does NOT consume (its
+    gradient is replicated — and psummed by GSPMD — across exactly
+    these), plus their total extent.  THE shared rule between the
+    per-group quantized sync below and the bucketed fused sync
+    (comm/bucketed.py)."""
+    used = set()
+    for entry in sharding.spec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            used.add(a)
+    rep = tuple(
+        a for a, s in mesh.shape.items() if a not in used and s > 1
+    )
+    n = 1
+    for a in rep:
+        n *= mesh.shape[a]
+    return rep, n
+
+
 def quantized_grad_sync(
     grads: Dict[str, Dict[str, jax.Array]],
     mesh,
@@ -221,20 +242,9 @@ def quantized_grad_sync(
             sh = param_shardings.get(op_name, {}).get(w_name)
             if sh is None:
                 continue
-            used = set()
-            for entry in sh.spec:
-                if entry is None:
-                    continue
-                for a in (entry if isinstance(entry, tuple) else (entry,)):
-                    used.add(a)
-            rep = tuple(
-                a for a, s in mesh.shape.items() if a not in used and s > 1
-            )
+            rep, n = replication_axes(sh, mesh)
             if not rep:
                 continue
-            n = 1
-            for a in rep:
-                n *= mesh.shape[a]
             sel.setdefault(op_name, {})[w_name] = g
             specs.setdefault(op_name, {})[w_name] = sh.spec
             plan.setdefault(op_name, {})[w_name] = (rep, prec, n)

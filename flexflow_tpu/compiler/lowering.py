@@ -94,6 +94,7 @@ class CompiledModel:
         mesh=None,
         label_dtype: str = "int32",
         sync_precision: Optional[Dict[str, str]] = None,
+        sync_schedule=None,
     ):
         self.graph = graph
         self.strategy = strategy
@@ -103,6 +104,12 @@ class CompiledModel:
         # search builds this map (search/sync_precision.py) and absent
         # /empty means the historical bit-exact fp32 psum
         self.sync_precision: Dict[str, str] = dict(sync_precision or {})
+        # searched gradient-sync schedule (search/sync_schedule.py):
+        # when present, _sync_grads executes its buckets in issue order
+        # via comm/bucketed.py — fused per-bucket wire payloads with
+        # optimization_barrier anchoring inside the backward; None (the
+        # default) keeps the monolithic post-backward path
+        self.sync_schedule = sync_schedule
         self.loss_type = LossType.from_any(loss_type)
         self.metric_types = [MetricsType.from_any(m) for m in metric_types]
         self.optimizer = optimizer
@@ -428,20 +435,40 @@ class CompiledModel:
 
     # ------------------------------------------------------------------
     def _sync_grads(self, grads):
-        """Compressed gradient sync (EQuARX, comm/quantized.py) for the
-        weight groups ``self.sync_precision`` names: each group's grad
-        runs the quantized quantize → psum_scatter → requantize →
-        all_gather round trip over its replication axes inside the
-        jitted step.  With an empty map (or single device) this returns
-        ``grads`` untouched — the fp32 path stays bit-exact with the
-        historical lowering.  Composes with ZeRO-1: the round trip runs
-        before the optimizer update, so _constrain_update's
-        reduce-scatter/all-gather placement of the update is unchanged.
+        """Gradient sync inside the jitted step, before the optimizer
+        update.
+
+        With a searched ``sync_schedule`` the buckets execute in issue
+        order (comm/bucketed.py): each compressed bucket's member grads
+        flatten into ONE fused wire payload over their replication
+        axes, and buckets chain through ``optimization_barrier`` so XLA
+        issues the collectives in backward grad-readiness order — the
+        overlap the simulator prices (exposed-comm semantics).  fp32
+        buckets contribute only their value-identity ordering barrier,
+        so an all-fp32 schedule stays bit-exact with the monolithic
+        path.
+
+        Without a schedule, the weight groups ``self.sync_precision``
+        names run the quantized quantize → compressed all_to_all →
+        requantize → all_gather round trip (EQuARX, comm/quantized.py).
+        With neither (or a single device) this returns ``grads``
+        untouched — bit-exact with the historical lowering.  Both paths
+        compose with ZeRO-1: the round trip runs before the optimizer
+        update, so _constrain_update's reduce-scatter/all-gather
+        placement of the update is unchanged; with grad accumulation
+        the AVERAGED grads sync once per optimizer step.
         """
-        if not self.sync_precision or not self._multi_device:
+        if not self._multi_device:
             return grads
         shardings = getattr(self, "param_shardings", None)
         if shardings is None:  # init_params not run yet — nothing to map
+            return grads
+        schedule = self.sync_schedule
+        if schedule is not None and getattr(schedule, "buckets", None):
+            from flexflow_tpu.comm import bucketed_grad_sync
+
+            return bucketed_grad_sync(grads, self.mesh, shardings, schedule)
+        if not self.sync_precision:
             return grads
         from flexflow_tpu.comm import quantized_grad_sync
 

@@ -118,6 +118,18 @@ class FFConfig:
     # model prices each group's sync at its cheapest admissible
     # precision (wire bytes shrink, quantize overhead added) and the
     # chosen map is executed by the lowering's _sync_grads
+    sync_schedule: str = "off"  # gradient-sync SCHEDULE
+    # (search/sync_schedule.py): "search" partitions the synced weight
+    # groups into issue-ordered buckets (reverse-topological, coalesced
+    # to amortize collective latency, per-bucket precision composing
+    # with sync_precision), priced with the simulator's exposed-comm
+    # semantics and executed by comm/bucketed.py — adopted only when it
+    # beats the monolithic post-backward sync.  "off" (default) keeps
+    # the historical single post-backward sync (fp32 bit-exact).
+    sync_bucket_bytes: int = 0  # pin the schedule search's coalescing
+    # floor (fused fp32 payload bytes per bucket); 0 sweeps the
+    # DEFAULT_BUCKET_BYTES thresholds plus adaptive fractions of the
+    # model's total sync bytes
     # observability (flexflow_tpu/obs): unified telemetry
     obs_log_file: Optional[str] = None  # JSONL structured-event sink
     # (search-decision tracing, strategy tables, drift reports); also
@@ -159,6 +171,11 @@ class FFConfig:
             raise ValueError(
                 f"sync_precision must be fp32|bf16|int8|search, got "
                 f"{self.sync_precision!r}"
+            )
+        if self.sync_schedule not in ("off", "search"):
+            raise ValueError(
+                f"sync_schedule must be off|search, got "
+                f"{self.sync_schedule!r}"
             )
         if self.num_devices == 0:
             try:
@@ -234,6 +251,16 @@ class FFConfig:
                        help="gradient-sync wire precision; 'search' "
                             "lets the strategy search pick it per "
                             "weight group")
+        p.add_argument("--sync-schedule", dest="sync_schedule",
+                       choices=("off", "search"), default="off",
+                       help="gradient-sync schedule: 'search' buckets "
+                            "the weight-grad collectives and issues "
+                            "them inside the backward "
+                            "(search/sync_schedule.py)")
+        p.add_argument("--sync-bucket-bytes", dest="sync_bucket_bytes",
+                       type=int, default=0,
+                       help="pin the schedule search's per-bucket "
+                            "coalescing floor in bytes (0 = sweep)")
         p.add_argument("--obs-log", dest="obs_log", type=str, default=None,
                        help="JSONL structured-event telemetry sink "
                             "(flexflow_tpu/obs; tools/ffobs.py renders it)")
@@ -292,6 +319,8 @@ class FFConfig:
             remat=args.remat,
             zero_dp_shard=args.zero_dp_shard,
             sync_precision=args.sync_precision,
+            sync_schedule=args.sync_schedule,
+            sync_bucket_bytes=args.sync_bucket_bytes,
             obs_log_file=args.obs_log,
             obs_trace_file=args.obs_trace,
             drift_threshold=args.drift_threshold,

@@ -457,6 +457,10 @@ class FFModel:
                 "replicated while the user expects 1/N memory"
             )
         searched_strategy = False  # did the joint search pick it?
+        searched_strategy_obj = None  # the exact strategy the search
+        # returned (a placement proposal may replace `strategy` below)
+        imported_sync_schedule = None  # __meta__.sync_schedule of an
+        # imported strategy file (already behind the digest gate)
         if strategy is None:
             if pipeline is not None:
                 # dp over the devices left after the pp axis is carved off
@@ -503,6 +507,10 @@ class FFModel:
                         f"imported strategy "
                         f"{self.config.import_strategy_file!r} is illegal "
                         f"for this graph/mesh", bad)
+                from flexflow_tpu.search.strategy_io import read_meta
+
+                imported_sync_schedule = read_meta(
+                    self.config.import_strategy_file).get("sync_schedule")
             elif self.config.only_data_parallel:
                 strategy = data_parallel_strategy(self.graph, self.config.num_devices)
             else:
@@ -520,6 +528,11 @@ class FFModel:
                 )
                 self.graph = best_graph
                 searched_strategy = True
+                # the strategy object the driver's sync-schedule gate
+                # ran against — a pipeline/placement proposal below may
+                # REPLACE `strategy`, and the gated schedule must not
+                # follow it onto a strategy it was never linted for
+                searched_strategy_obj = strategy
                 # the search also costs pipelined candidates for
                 # stacked-block graphs (reference gap: OP_PIPELINE is an
                 # enum stub, ffconst.h:148) — a winning PipelineConfig
@@ -618,6 +631,8 @@ class FFModel:
         # runs exactly what the simulation priced.  Public state like
         # the strategy itself (bench_search reads it back).
         self.sync_precision_map: Dict[str, str] = {}
+        _sync_sim = None  # shared by the precision map + schedule
+        # builders below: one Simulator.for_config per compile, not three
         if (
             comp_mode == "training"
             and strategy
@@ -635,6 +650,70 @@ class FFModel:
             self.sync_precision_map = choose_sync_precision(
                 self.graph, strategy, _sync_sim.cost
             )
+        # gradient-sync SCHEDULE (search/sync_schedule.py): bucketed,
+        # issue-ordered collectives the lowering executes inside the
+        # backward (comm/bucketed.py).  The joint search already chose
+        # and legality-gated one for ITS result (driver
+        # _build_sync_schedule); other strategy sources (forced DP,
+        # caller-supplied, imported without one) run the same choice +
+        # always-on gate here.  Public state like the strategy itself.
+        self.sync_schedule = None
+        if (
+            comp_mode == "training"
+            and strategy
+            and pipeline is None
+            and getattr(self.config, "sync_schedule", "off") == "search"
+        ):
+            if imported_sync_schedule is not None:
+                # a schedule persisted next to an imported strategy
+                # (digest gate already passed) — re-lint against THIS
+                # graph before adopting: a hand-edited file must fail
+                # with a finding, not inside XLA
+                from flexflow_tpu.analysis import (
+                    AnalysisError,
+                    emit_findings,
+                    errors_only,
+                    lint_sync_schedule,
+                )
+                from flexflow_tpu.search.sync_schedule import SyncSchedule
+
+                try:
+                    sched = SyncSchedule.from_jsonable(imported_sync_schedule)
+                except ValueError as e:
+                    raise AnalysisError(
+                        f"imported strategy file carries a malformed "
+                        f"sync_schedule: {e}", []) from e
+                bad = errors_only(lint_sync_schedule(
+                    self.graph, strategy, sched, self.sync_precision_map))
+                if bad:
+                    emit_findings(bad)
+                    raise AnalysisError(
+                        "imported sync_schedule is illegal for this "
+                        "graph/strategy", bad)
+                self.sync_schedule = sched
+            elif searched_strategy and strategy is searched_strategy_obj:
+                from flexflow_tpu.search import driver as _driver
+
+                self.sync_schedule = _driver.LAST_SYNC_SCHEDULE
+            else:
+                # caller-supplied / forced-DP strategies, and searched
+                # strategies later REPLACED by a placement proposal:
+                # run the same choice + always-on gate against the
+                # strategy actually being lowered
+                from flexflow_tpu.search.driver import (
+                    _build_sync_schedule,
+                    coherent_calibration,
+                )
+                from flexflow_tpu.search.simulator import Simulator
+
+                if _sync_sim is None:
+                    _sync_sim = Simulator.for_config(
+                        self.config,
+                        calibration=coherent_calibration(self.config),
+                    )
+                self.sync_schedule = _build_sync_schedule(
+                    self.graph, strategy, _sync_sim, self.config
+                )
         # predicted step breakdown + strategy-explanation telemetry —
         # the predicted half of the DriftReport fit() completes.  Only
         # computed when something will consume it (profiling, the obs
@@ -666,7 +745,8 @@ class FFModel:
                 _sched: list = []
                 _comm: list = []
                 _psim.simulate(self.graph, strategy, breakdown=bd,
-                               schedule=_sched, comm_schedule=_comm)
+                               schedule=_sched, comm_schedule=_comm,
+                               sync_schedule=self.sync_schedule)
                 bd["calibrated"] = _psim.cost.calibration is not None
                 bd["machine"] = self.config.machine_spec.name
                 self.predicted_breakdown = bd
@@ -696,12 +776,16 @@ class FFModel:
         if self.config.export_strategy_file:
             from flexflow_tpu.search.strategy_io import export_strategy
 
+            _meta = {}
+            if self.predicted_breakdown:
+                _meta["predicted"] = self.predicted_breakdown
+            if self.sync_schedule is not None:
+                # the searched comm plan persists NEXT to the strategy,
+                # behind the same graph-digest gate import enforces
+                _meta["sync_schedule"] = self.sync_schedule.to_jsonable()
             export_strategy(
                 self.config.export_strategy_file, self.graph, strategy,
-                meta=(
-                    {"predicted": self.predicted_breakdown}
-                    if self.predicted_breakdown else None
-                ),
+                meta=_meta or None,
             )
         if self.config.export_strategy_computation_graph_file:
             self.graph.write_dot(
@@ -790,6 +874,7 @@ class FFModel:
                     LossType.from_any(loss_type), list(metrics),
                     self.optimizer, mesh=mesh,
                     sync_precision=self.sync_precision_map,
+                    sync_schedule=self.sync_schedule,
                 )
         else:
             self.compiled = CompiledModel(
@@ -801,6 +886,7 @@ class FFModel:
                 self.optimizer,
                 mesh=mesh,
                 sync_precision=self.sync_precision_map,
+                sync_schedule=self.sync_schedule,
             )
         from flexflow_tpu.compiler.staged_pipeline_lowering import (
             StagedPipelinedModel as _Staged,
@@ -820,12 +906,28 @@ class FFModel:
                 f"execute them; gradients sync at fp32"
             )
             self.sync_precision_map = {}
+        if self.sync_schedule is not None and getattr(
+                self.compiled, "sync_schedule", None) is None:
+            # same honesty rule for the sync schedule: placed/pipelined
+            # lowerings do not run _sync_grads, so the searched comm
+            # plan cannot execute there — say so instead of silently
+            # falling back to the monolithic sync
+            from flexflow_tpu.utils.logging import SEARCH_LOG
+
+            SEARCH_LOG.log(
+                f"sync_schedule chose {len(self.sync_schedule.buckets)} "
+                f"buckets but this lowering "
+                f"({type(self.compiled).__name__}) cannot execute them; "
+                f"gradients sync monolithically"
+            )
+            self.sync_schedule = None
 
         self._compile_ctx = dict(
             strategy=strategy, loss_type=LossType.from_any(loss_type),
             metrics=list(metrics), pipeline=pipeline, block_of=block_of,
             mesh=mesh,
             sync_precision=dict(self.sync_precision_map),
+            sync_schedule=self.sync_schedule,
             staged=(self.pipeline_proposal
                     if isinstance(self.compiled, _Staged) else None),
         )
@@ -884,6 +986,7 @@ class FFModel:
                     ctx["loss_type"], ctx["metrics"], self.optimizer,
                     mesh=ctx.get("mesh"),
                     sync_precision=ctx.get("sync_precision"),
+                    sync_schedule=ctx.get("sync_schedule"),
                 )
         old_params, old_state, old_opt = self.params, self.state, self.opt_state
         self.params, self.state = self.compiled.init_params(self.config.seed)
@@ -1201,9 +1304,25 @@ class FFModel:
             SEARCH_LOG.log(
                 f"calibration staleness: measured step is "
                 f"{report.ratio:.2f}x the calibrated prediction, "
-                f"outside [{lo:.2f}x, {hi:.2f}x] — re-probe with "
-                f"--calibrate"
+                f"outside [{lo:.2f}x, {hi:.2f}x]"
             )
+            # mark the persisted TABLE stale so the next
+            # optimize_strategy re-probes the drifted records
+            # automatically (driver re-probe policy) instead of ranking
+            # with measurements execution just falsified
+            if self.config.calibration_file:
+                from flexflow_tpu.search.calibration import (
+                    CalibrationTable,
+                )
+
+                if CalibrationTable.mark_stale_file(
+                        self.config.calibration_file, report.ratio):
+                    SEARCH_LOG.log(
+                        f"calibration table "
+                        f"{self.config.calibration_file} marked stale: "
+                        f"the next search re-probes it on the modeled "
+                        f"backend (or falls back to the roofline)"
+                    )
             # a stale table must also stop seeding future searches: mark
             # the persistent cost cache, which then refuses to serve its
             # rows/results until a recalibration rotates the signature
@@ -1218,6 +1337,13 @@ class FFModel:
                     f"cost cache {cache_path} marked calibration-stale: "
                     f"recalibrate or pass --no-cost-cache"
                 )
+        elif report.calibrated and self.config.calibration_file:
+            # drift cleared on a calibrated fit: reset the persisted
+            # staleness state and the auto-re-probe allowance, so the
+            # driver's re-probe cap only counts CONSECUTIVE failures
+            from flexflow_tpu.search.calibration import CalibrationTable
+
+            CalibrationTable.mark_healthy_file(self.config.calibration_file)
         if verbose:
             print(f"DRIFT {report}")
         if self.config.export_strategy_file:

@@ -33,9 +33,17 @@ class DriftReport:
     calibrated: bool = False
     calibration_stale: bool = False
     phases: Dict[str, dict] = field(default_factory=dict)
+    # per-bucket rows of a gradient-sync SCHEDULE's predicted lanes
+    # (search/sync_schedule.py): issue/sync/exposed seconds per bucket.
+    # The executed step is one fused XLA program, so each bucket's
+    # measured side stays None (honesty rule above) — the schedule's
+    # overlap claim is verified by the measured STEP delta between the
+    # scheduled and monolithic programs (bench_search --sync-schedule),
+    # not by inventing per-bucket host timings.
+    sync_buckets: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "predicted_s": self.predicted_s,
             "measured_s": self.measured_s,
             "ratio": self.ratio,
@@ -45,6 +53,9 @@ class DriftReport:
             "calibration_stale": self.calibration_stale,
             "phases": self.phases,
         }
+        if self.sync_buckets:
+            out["sync_buckets"] = self.sync_buckets
+        return out
 
     def __str__(self) -> str:
         flag = (" STALE-CALIBRATION" if self.calibration_stale
@@ -87,8 +98,24 @@ def build_drift_report(
         "compute": _phase(predicted.get("compute_end_s"), None),
         "sync": _phase(predicted.get("comm_end_s"), None),
     }
+    if predicted.get("sync_exposed_s") is not None:
+        # the EXPOSED sync tail the schedule search minimizes — the
+        # single-sided prediction whose measured counterpart is the
+        # scheduled-vs-monolithic step delta
+        phases["sync_exposed"] = _phase(predicted["sync_exposed_s"], None)
     for name, stats in (measured_phases or {}).items():
         phases[name] = _phase(None, stats.get("mean_s"))
+    buckets = []
+    for row in predicted.get("sync_buckets") or []:
+        buckets.append({
+            "name": row.get("name"),
+            "precision": row.get("precision"),
+            "ops": len(row.get("ops") or []),
+            "predicted_ready_s": row.get("ready_s"),
+            "predicted_sync_s": row.get("sync_s"),
+            "predicted_exposed_s": row.get("exposed_s"),
+            "measured_s": None,  # one fused program: no per-bucket probe
+        })
     return DriftReport(
         predicted_s=float(total),
         measured_s=float(measured_step_s),
@@ -98,4 +125,5 @@ def build_drift_report(
         calibrated=bool(calibrated),
         calibration_stale=bool(stale and calibrated),
         phases=phases,
+        sync_buckets=buckets,
     )

@@ -45,6 +45,10 @@ EVENT_KINDS = {
     # calibration / cost-model provenance
     "calibration.ignored": {"backend", "machine"},
     "calibration.staleness": {"ratio", "threshold"},
+    # the automatic re-probe policy acting on a drift-stale table:
+    # deferred=False re-probed on the live backend, True fell back to
+    # the roofline (live backend cannot probe for the machine model)
+    "calibration.reprobe": {"backend", "deferred"},
     # compile-time strategy explanation (model.py)
     "strategy.table": {"rows"},
     # static analysis (flexflow_tpu/analysis): one event per finding —

@@ -48,6 +48,21 @@ class CalibrationTable:
         # fingerprint this to notice in-place mutation — len() alone
         # misses re-measurements of existing keys
         self.version: int = 0
+        # DriftReport staleness flag (obs/drift.py): model.fit marks the
+        # persisted table when measured steps drift past the threshold;
+        # the NEXT optimize_strategy then re-probes (live backend
+        # matching) or discards the table instead of only warning —
+        # the ROADMAP re-probe-policy follow-up
+        self.stale: bool = False
+        self.stale_ratio: Optional[float] = None
+        # consecutive auto re-probes without the drift clearing: past
+        # MAX_AUTO_REPROBES the driver stops burning the calibration
+        # budget (the drift is then a cost-MODEL gap fresh measurements
+        # cannot fix, not stale measurements); a healthy calibrated fit
+        # resets it (mark_healthy_file)
+        self.reprobes: int = 0
+
+    MAX_AUTO_REPROBES = 2
 
     @staticmethod
     def _sig(op) -> str:
@@ -111,7 +126,9 @@ class CalibrationTable:
         with open(path, "w") as f:
             json.dump(
                 {"version": 1, "backend": self.backend, "records": rows,
-                 "clusters": clusters},
+                 "clusters": clusters, "stale": self.stale,
+                 "stale_ratio": self.stale_ratio,
+                 "reprobes": self.reprobes},
                 f, indent=1,
             )
 
@@ -121,6 +138,9 @@ class CalibrationTable:
         with open(path) as f:
             data = json.load(f)
         table.backend = data.get("backend")
+        table.stale = bool(data.get("stale", False))
+        table.stale_ratio = data.get("stale_ratio")
+        table.reprobes = int(data.get("reprobes", 0))
         for r in data.get("records", []):
             table._t[(r["sig"], tuple(r["degrees"]), int(r["replica"]))] = float(
                 r["seconds"]
@@ -131,6 +151,56 @@ class CalibrationTable:
             ] = float(r["seconds"])
         table.version = len(table._t) + len(table._clusters)
         return table
+
+    @staticmethod
+    def mark_stale_file(path: str, ratio: float) -> bool:
+        """Flag a persisted table stale IN PLACE (a cheap JSON edit —
+        model.fit calls this from the drift path, where re-parsing the
+        full table would be waste).  Returns False when the file is
+        missing/unreadable."""
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return False
+        data["stale"] = True
+        data["stale_ratio"] = float(ratio)
+        with open(path, "w") as f:
+            json.dump(data, f, indent=1)
+        return True
+
+    @staticmethod
+    def mark_healthy_file(path: str) -> bool:
+        """The drift cleared on a calibrated fit: reset the staleness
+        state AND the auto-re-probe counter, so a later genuine
+        staleness gets its full re-probe allowance again.  No-op (and
+        no rewrite) when the file is already healthy."""
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if not data.get("stale") and not data.get("reprobes"):
+            return True
+        data["stale"] = False
+        data["stale_ratio"] = None
+        data["reprobes"] = 0
+        with open(path, "w") as f:
+            json.dump(data, f, indent=1)
+        return True
+
+    def begin_reprobe(self) -> None:
+        """Drop every measured record so the next ``calibrate_graph``
+        re-measures from scratch (probes resume from the loaded table,
+        so stale records would otherwise survive a re-probe untouched);
+        clears the stale flag — the fresh probes ARE the response —
+        and counts the attempt against MAX_AUTO_REPROBES."""
+        self._t.clear()
+        self._clusters.clear()
+        self.stale = False
+        self.stale_ratio = None
+        self.reprobes += 1
+        self.version += 1
 
 
 def _shard_sizes(sizes, annot) -> Tuple[int, ...]:

@@ -320,8 +320,29 @@ class _UnityOptimizer:
             emit = BUS.enabled  # per-candidate events are chatty: one
             # branch when telemetry is off, full accept/reject
             # provenance when it is on
-            for xf in self.xfers:
-                for m in xf.find_matches(g):
+            # delta-aware matching (ROADMAP PR 3 follow-up): a popped
+            # candidate re-matches only the dirty region around its
+            # substitution, seeded by the parent's matches (attached at
+            # push time below) + the changed-guid sets.  All xfers'
+            # matches are collected BEFORE applying any, so every child
+            # inherits the complete parent-match payload.
+            parent_matches = getattr(g, "_parent_match_guids", None)
+            matches_by_xfer: List[list] = []
+            match_payload: Dict[int, List[int]] = {}
+            for xi, xf in enumerate(self.xfers):
+                delta_fn = getattr(xf, "find_matches_delta", None)
+                if delta_fn is not None:
+                    ms = delta_fn(
+                        g,
+                        parent_matches.get(xi) if parent_matches else None)
+                    match_payload[xi] = [n.guid for n in ms]
+                else:
+                    # dict-match xfers (BatchEmbeddingsXfer) group over
+                    # the WHOLE graph — no local delta applies
+                    ms = xf.find_matches(g)
+                matches_by_xfer.append(ms)
+            for xi, xf in enumerate(self.xfers):
+                for m in matches_by_xfer[xi]:
                     g2 = xf.apply(g, m)
                     if g2 is None:
                         if emit:
@@ -344,6 +365,7 @@ class _UnityOptimizer:
                     e2 = self._estimate(g2, parent_s, fixed)
                     if e2 < config.search_alpha * best_cost:
                         counter += 1
+                        g2._parent_match_guids = match_payload
                         heapq.heappush(heap, (e2, counter, g2, parent_s))
                         if emit:
                             BUS.emit("search.substitution", xfer=xf.name,
@@ -454,6 +476,48 @@ def _merge_split(
 # vs search and records the delta/cache hit rates from here
 LAST_SEARCH_STATS: Dict[str, object] = {}
 
+# the gradient-sync schedule the LAST optimize_strategy chose (and
+# gated) under config.sync_schedule="search" — compile() adopts it for
+# the strategy the search just returned instead of re-running the
+# choice; None when the mode is off or the monolithic baseline won
+LAST_SYNC_SCHEDULE = None
+
+
+def _build_sync_schedule(graph, strategy, sim, config):
+    """Choose + legality-gate the gradient-sync schedule for a search
+    result (search/sync_schedule.py) — runs on BOTH the fresh and the
+    cache-served paths of ``optimize_strategy``, so every result this
+    function hands out carries a linted schedule (or None).  The gate
+    (SHD12x) is always-on inside ``choose_sync_schedule``; a failure
+    there is a builder bug and raises."""
+    global LAST_SYNC_SCHEDULE
+    LAST_SYNC_SCHEDULE = None
+    if getattr(config, "sync_schedule", "off") != "search" or not strategy:
+        return None
+    from flexflow_tpu.search.sync_precision import choose_sync_precision
+    from flexflow_tpu.search.sync_schedule import choose_sync_schedule
+
+    pmap = {}
+    if getattr(config, "sync_precision", "fp32") != "fp32":
+        pmap = choose_sync_precision(graph, strategy, sim.cost)
+    schedule, info = choose_sync_schedule(graph, strategy, sim, pmap, config)
+    LAST_SEARCH_STATS["sync_schedule"] = {
+        "buckets": info.get("buckets", 0),
+        "monolithic_s": info.get("monolithic_s"),
+        "scheduled_s": info.get("scheduled_s"),
+    }
+    if schedule is not None:
+        from flexflow_tpu.utils.logging import SEARCH_LOG
+
+        SEARCH_LOG.log(
+            f"sync schedule: {len(schedule.buckets)} buckets beat the "
+            f"monolithic sync "
+            f"({info['monolithic_s'] * 1e3:.4f} -> "
+            f"{info['scheduled_s'] * 1e3:.4f} ms/iter simulated)"
+        )
+    LAST_SYNC_SCHEDULE = schedule
+    return schedule
+
 
 def _lint_findings(graph, strategy, num_devices):
     """Error-level static-analysis findings for a search result: graph
@@ -545,6 +609,14 @@ def _optimize_strategy(
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     t_start = time.monotonic()
+    # snapshot the delta-matching counters so search.perf reports THIS
+    # search's rescan shrink, not the process-lifetime aggregate
+    from flexflow_tpu.search import substitution as _subst
+
+    match_base = (
+        _subst._SCANS.value, _subst._DELTA_SCANS.value,
+        _subst._DELTA_NODES.value, _subst._DELTA_SKIPPED.value,
+    )
     t_cal = 0.0  # seconds spent probing/persisting calibration — split
     # out of the reported search time (bench satellite: the two were
     # conflated in one search_seconds number)
@@ -565,8 +637,59 @@ def _optimize_strategy(
         BUS.emit("calibration.ignored", backend=calibration.backend,
                  machine=config.machine_spec.name)
         calibration = None
+    reprobe = False
+    if calibration is not None and getattr(calibration, "stale", False):
+        # automatic re-probe policy (ROADMAP PR 2 follow-up): a
+        # DriftReport flagged this table stale (measured steps drifted
+        # past --drift-threshold).  When the live backend matches the
+        # machine model, RE-PROBE instead of only warning — drop the
+        # drifted records and measure fresh inside the calibration
+        # budget; otherwise the stale table must not keep seeding
+        # searches, so fall back to the analytic roofline.
+        import jax
+
+        live = jax.devices()[0].platform
+        ratio = getattr(calibration, "stale_ratio", None)
+        attempts = getattr(calibration, "reprobes", 0)
+        cap = getattr(type(calibration), "MAX_AUTO_REPROBES", 2)
+        if attempts >= cap:
+            # re-probing keeps reproducing the same drift: the gap is
+            # in the cost MODEL, not the measurements — stop burning
+            # the calibration budget every compile and fall back to
+            # the roofline (a healthy calibrated fit resets the count)
+            log.log(
+                f"calibration table still drift-stale after {attempts} "
+                f"auto re-probes (measured/predicted "
+                f"{ratio if ratio else '?'}): persistent cost-model "
+                f"gap — using the analytic roofline; re-probe manually "
+                f"with --calibrate if the machine changed"
+            )
+            BUS.emit("calibration.reprobe", backend=live, ratio=ratio,
+                     deferred=True, attempts=attempts)
+            calibration = None
+        elif live == target:
+            log.log(
+                f"calibration table is drift-stale "
+                f"(measured/predicted {ratio if ratio else '?'}): "
+                f"re-probing on the live backend "
+                f"(attempt {attempts + 1}/{cap})"
+            )
+            BUS.emit("calibration.reprobe", backend=live, ratio=ratio,
+                     deferred=False, attempts=attempts)
+            calibration.begin_reprobe()
+            reprobe = True
+        else:
+            log.log(
+                f"calibration table is drift-stale but the live backend "
+                f"({live!r}) cannot re-probe for "
+                f"{config.machine_spec.name!r}: using the analytic "
+                f"roofline until a re-probe runs on the modeled backend"
+            )
+            BUS.emit("calibration.reprobe", backend=live, ratio=ratio,
+                     deferred=True)
+            calibration = None
     can_probe = False
-    if config.calibrate:
+    if config.calibrate or reprobe:
         # probe this graph's (op, view) costs on the live backend before
         # ranking — the reference's default (it measures lazily inside
         # the search, simulator.cc:515-554; model.cu:38-74).  Probes
@@ -644,7 +767,11 @@ def _optimize_strategy(
                 floor_sim, best_graph, graph, best_strategy, best_cost,
                 kept_dp=False, helper=helper, t_start=t_start,
                 t_cal=t_cal, result_cache_hit=True,
+                match_base=match_base,
             )
+            # cache-served results pass the SAME schedule choice + gate
+            # as fresh ones — the persisted artifact never skips it
+            _build_sync_schedule(best_graph, best_strategy, sim, config)
             return best_graph, best_strategy
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
         best_cost, best_strategy = helper.graph_cost(graph)
@@ -777,8 +904,14 @@ def _optimize_strategy(
     _emit_search_done(
         floor_sim, best_graph, graph, best_strategy, best_cost,
         kept_dp=kept_dp, helper=helper, t_start=t_start, t_cal=t_cal,
-        result_cache_hit=False,
+        result_cache_hit=False, match_base=match_base,
     )
+
+    if best_strategy and math.isfinite(best_cost):
+        _build_sync_schedule(best_graph, best_strategy, floor_sim, config)
+    else:
+        global LAST_SYNC_SCHEDULE
+        LAST_SYNC_SCHEDULE = None
 
     if return_graph:
         return best_graph, best_strategy
@@ -787,11 +920,14 @@ def _optimize_strategy(
 
 def _emit_search_done(
     floor_sim, best_graph, graph, best_strategy, best_cost, kept_dp,
-    helper, t_start, t_cal, result_cache_hit,
+    helper, t_start, t_cal, result_cache_hit, match_base=(0, 0, 0, 0),
 ) -> None:
     """Search-completion telemetry: the final result/summary events
-    plus the search-perf roll-up (delta-vs-full simulation counts and
-    persistent-cache hit rates) that bench_search and ffobs report."""
+    plus the search-perf roll-up (delta-vs-full simulation counts,
+    delta-matching rescan shrink, and persistent-cache hit rates) that
+    bench_search and ffobs report."""
+    from flexflow_tpu.search import substitution as _subst
+
     sim = helper.sim
     cache = floor_sim.cost_cache or sim.cost_cache
     stats = {
@@ -804,6 +940,13 @@ def _emit_search_done(
             floor_sim.delta_sims if floor_sim is not sim else 0),
         "delta_bails": sim.delta_bails + (
             floor_sim.delta_bails if floor_sim is not sim else 0),
+        # delta-aware find_matches (ROADMAP PR 3 follow-up): full-scan
+        # calls vs dirty-region rescans, and the node-visit shrink the
+        # rescans bought (skipped = clean nodes served from the parent)
+        "match_full_scans": _subst._SCANS.value - match_base[0],
+        "match_delta_scans": _subst._DELTA_SCANS.value - match_base[1],
+        "match_nodes_rescanned": _subst._DELTA_NODES.value - match_base[2],
+        "match_nodes_skipped": _subst._DELTA_SKIPPED.value - match_base[3],
         "cache_row_hits": cache.row_hits if cache else 0,
         "cache_row_misses": cache.row_misses if cache else 0,
         "result_cache_hit": bool(result_cache_hit),

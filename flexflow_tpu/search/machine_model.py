@@ -509,23 +509,25 @@ class CostModel:
     # per-device bandwidth already encodes that holders share the core).
     OPT_UPDATE_PASSES = 7.0
 
-    def weight_sync_cost(
-        self, op: Operator, mv: MachineView, precision: str = "fp32"
-    ) -> float:
-        """Per-iteration grad-allreduce for weights replicated across
-        ``mv`` (reference: NCCL allreduce in optimizer, optimizer.cc:155-193;
-        here XLA's psum over the batch axes of the mesh), at the given
-        wire ``precision``.  The optimizer's elementwise update is
-        priced separately (``update_cost``) on the compute timeline."""
+    def weight_sync_parts(
+        self, op: Operator, mv: MachineView
+    ) -> Optional[list]:
+        """The per-weight sync terms of one (op, view): a list of
+        ``(shard_bytes, replica, spans_dcn, total_elems)`` tuples, one
+        per weight whose propagated annot is replicated (replica > 1) —
+        the shared decomposition ``weight_sync_cost`` sums and the
+        gradient-sync SCHEDULE coalesces into fused buckets
+        (search/sync_schedule.py, Simulator's per-bucket lanes).
+        Returns None when propagation rejects the view."""
         try:
             osh = op.propagate(mv)
         except AssertionError:
-            return math.inf
+            return None
         # view slot degrees in the lowering's assignment order
         # (output dims, then the replica/contraction slot)
         nslots = len(mv.dim_degrees)
         slot_degrees = tuple(mv.dim_degrees) + (mv.replica_degree,)
-        total = 0.0
+        parts = []
         for ws, annot in zip(op._weight_specs, osh.weights):
             if annot is None or annot.replica <= 1:
                 continue
@@ -548,16 +550,68 @@ class CostModel:
             if mv.replica_degree > 1 and REPLICA_SLOT not in weight_slots:
                 active.append(nslots)
             spans = self._spans_dcn(slot_degrees, active)
+            # group key: the (slot degrees, active slots) signature —
+            # under the lowering's canonical slot→axis assignment, two
+            # weights share their replication MESH AXES (and so can ride
+            # one fused collective, comm/bucketed.py groups by the axes)
+            # only when this signature matches; bucket_sync_cost fuses
+            # per key so mixed-sharding buckets are never under-priced
+            # with fewer latency floors than execution pays
+            parts.append(
+                (shard_elems * ws.dtype.itemsize, annot.replica, spans, n,
+                 (slot_degrees, tuple(active)))
+            )
+        return parts
+
+    def weight_sync_cost(
+        self, op: Operator, mv: MachineView, precision: str = "fp32"
+    ) -> float:
+        """Per-iteration grad-allreduce for weights replicated across
+        ``mv`` (reference: NCCL allreduce in optimizer, optimizer.cc:155-193;
+        here XLA's psum over the batch axes of the mesh), at the given
+        wire ``precision``.  The optimizer's elementwise update is
+        priced separately (``update_cost``) on the compute timeline."""
+        parts = self.weight_sync_parts(op, mv)
+        if parts is None:
+            return math.inf
+        total = 0.0
+        for nbytes, replica, spans, n, _key in parts:
             # sub-floor weights (bias/scale vectors) sync at fp32 even
             # inside a compressed group — mirrors quantized_grad_sync's
             # per-weight MIN_COMPRESS_ELEMS skip exactly
             p = precision
             if p != "fp32" and n < _min_compress_elems():
                 p = "fp32"
-            total += self.allreduce(
-                shard_elems * ws.dtype.itemsize, annot.replica, spans,
-                precision=p,
-            )
+            total += self.allreduce(nbytes, replica, spans, precision=p)
+        return total
+
+    def bucket_sync_cost(self, parts: list, precision: str = "fp32") -> float:
+        """Seconds for ONE coalesced sync bucket: every weight part
+        sharing a replication-axes signature (the group key from
+        ``weight_sync_parts``) and effective wire precision rides a
+        single fused collective over the summed bytes — one latency
+        term where ``weight_sync_cost`` pays one per weight.  That
+        amortization is what the schedule search trades against
+        exposure: XLA's all-reduce combiner batches small same-group
+        all-reduces the same way, and the bucketed execution path
+        (comm/bucketed.py) flattens each replication group's payload
+        into one wire buffer for real — the key keeps the priced fusion
+        granularity matched to the executed one, so mixed-sharding
+        buckets never get credited fewer latency floors than execution
+        pays.  Sub-floor weights inside a compressed bucket keep fp32,
+        exactly as ``weight_sync_cost``/``quantized_grad_sync`` do."""
+        groups: Dict[Tuple, float] = {}
+        for nbytes, replica, spans, n, key in parts:
+            if replica <= 1:
+                continue
+            p = precision
+            if p != "fp32" and n < _min_compress_elems():
+                p = "fp32"
+            gk = (replica, spans, p, key)
+            groups[gk] = groups.get(gk, 0.0) + nbytes
+        total = 0.0
+        for (replica, spans, p, _key), nbytes in groups.items():
+            total += self.allreduce(nbytes, replica, spans, precision=p)
         return total
 
     # the search compresses a group's sync only where the allreduce

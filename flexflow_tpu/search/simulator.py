@@ -238,6 +238,7 @@ class Simulator:
         schedule: Optional[list] = None,
         breakdown: Optional[dict] = None,
         comm_schedule: Optional[list] = None,
+        sync_schedule=None,
     ) -> float:
         """Seconds per training iteration under the strategy (or per
         inference when the simulator was built with inference=True —
@@ -251,6 +252,16 @@ class Simulator:
         split (compute/comm critical paths, total xfer/sync seconds,
         peak memory) — the predicted side of the obs DriftReport.
 
+        ``sync_schedule`` — a gradient-sync schedule
+        (search/sync_schedule.py): weight-gradient sync is then priced
+        per BUCKET under exposed-comm semantics — a bucket's collective
+        issues when the backward has produced all its members' grads
+        and only costs what is not hidden under the backward compute
+        still to run at that point (GSPMD async collectives,
+        arXiv:2105.04663) — instead of the legacy per-node issuance.
+        Per-bucket lanes land in ``comm_schedule`` and ``breakdown``
+        gains ``sync_exposed_s`` + ``sync_buckets``.
+
         When a delta baseline is armed (``set_baseline``), calls in the
         default scalar currency are served incrementally: only the
         substituted nodes plus the downstream cone whose ready-times
@@ -260,7 +271,8 @@ class Simulator:
             include_update = not self.inference
         snap = self._baseline
         if (snap is not None and schedule is None and breakdown is None
-                and comm_schedule is None and not self.placement_overlap
+                and comm_schedule is None and sync_schedule is None
+                and not self.placement_overlap
                 and include_update == snap.include_update
                 and snap.cal_version == getattr(
                     self.cost.calibration, "version", None)):
@@ -281,7 +293,8 @@ class Simulator:
             self.delta_bails += 1
             _DELTA_BAILS.inc()
         return self._simulate_full(graph, strategy, include_update,
-                                   schedule, breakdown, comm_schedule)
+                                   schedule, breakdown, comm_schedule,
+                                   sync_schedule)
 
     def _simulate_full(
         self,
@@ -291,6 +304,7 @@ class Simulator:
         schedule: Optional[list] = None,
         breakdown: Optional[dict] = None,
         comm_schedule: Optional[list] = None,
+        sync_schedule=None,
     ) -> float:
         self.full_sims += 1
         _FULL_SIMS.inc()
@@ -355,6 +369,10 @@ class Simulator:
         sync_total = 0.0
         compute_total = 0.0
         overlap = self.placement_overlap
+        # a gradient-sync schedule replaces the legacy per-node sync
+        # issuance with per-bucket exposed-comm pricing (below the loop)
+        sched = sync_schedule if include_update else None
+        node_rows: Optional[list] = [] if sched is not None else None
         # fast path: in the default (overlap=False) currency every op
         # occupies ALL device timelines, so device availability is ONE
         # scalar and per-device memory is the plain sum — identical math
@@ -431,7 +449,9 @@ class Simulator:
                 ready[(node.guid, i)] = finish
             if finish > end_time:
                 end_time = finish
-            if include_update and sync > 0:
+            if node_rows is not None:
+                node_rows.append((node, mv, fwd, dur, sync))
+            elif include_update and sync > 0:
                 if scalar:
                     comm_devs = self.view_device_set(mv, use_start=False)
                 s = finish
@@ -448,6 +468,11 @@ class Simulator:
                         (f"{node.op.name}:sync", s, f,
                          tuple(sorted(comm_devs))))
 
+        sync_buckets: Optional[list] = None
+        if sched is not None:
+            end_comm, sync_total, sync_buckets = self._scheduled_sync(
+                sched, node_rows, end_time, comm_avail, comm_schedule)
+
         peak = mem_total if scalar else max(mem.values())
         total = max(end_time, end_comm)
         oom = peak > self.machine.hbm_capacity
@@ -459,6 +484,10 @@ class Simulator:
                 compute_total_s=compute_total,
                 xfer_total_s=xfer_total,
                 sync_total_s=sync_total,
+                # the EXPOSED sync tail: comm past the last compute —
+                # what the step actually pays for gradient sync after
+                # overlap credit (0 when fully hidden)
+                sync_exposed_s=max(0.0, end_comm - end_time),
                 peak_mem_bytes=peak,
                 num_devices=self.num_devices,
                 include_update=include_update,
@@ -467,9 +496,106 @@ class Simulator:
                 # and leaves comm_schedule empty by design)
                 pooled_comm=False,
             )
+            if sync_buckets is not None:
+                breakdown["sync_buckets"] = sync_buckets
         if oom:
             return math.inf
         return total
+
+    def _scheduled_sync(self, sync_schedule, node_rows, end_time,
+                        comm_avail, comm_schedule):
+        """Exposed-comm pricing of a gradient-sync schedule over the
+        scan just finished.  Backward model: the backward sweeps the
+        graph in REVERSE topo order, so a bucket whose earliest member
+        sits at topo position p has all its grads ready once only the
+        backward shares of nodes 0..p-1 remain — its fused collective
+        issues at ``end_time - bwd_prefix[p]`` and hides under exactly
+        that remaining compute (GSPMD async collectives; the legacy
+        per-node issuance credits overlap in FORWARD order, which the
+        executed post-backward sync never earns).  Buckets serialize on
+        their device groups' comm lanes in schedule order; synced
+        groups the schedule does not cover issue after the full
+        backward (the monolithic behavior execution gives them).
+        Returns (end_comm, sync_total, per-bucket breakdown rows)."""
+        pos = {node.guid: i for i, (node, *_r) in enumerate(node_rows)}
+        bwd_prefix = [0.0] * (len(node_rows) + 1)
+        for i, (_n, _mv, fwd, dur, _s) in enumerate(node_rows):
+            bwd_prefix[i + 1] = bwd_prefix[i] + max(0.0, dur - fwd)
+        by_name = {node.op.name: (node, mv, sync)
+                   for node, mv, _f, _d, sync in node_rows}
+        end_comm = 0.0
+        sync_total = 0.0
+        rows = []
+        covered = set()
+        for bucket in getattr(sync_schedule, "buckets", sync_schedule):
+            members = [by_name[nm] for nm in bucket.ops if nm in by_name]
+            if not members:
+                continue
+            covered.update(nm for nm in bucket.ops)
+            parts = []
+            devs = set()
+            min_pos = len(node_rows)
+            for node, mv, _sync in members:
+                got = self.cost.weight_sync_parts(node.op, mv)
+                if got:
+                    parts.extend(got)
+                    devs |= self.view_device_set(mv, use_start=False)
+                    min_pos = min(min_pos, pos[node.guid])
+            cost = self.cost.bucket_sync_cost(
+                parts, getattr(bucket, "precision", "fp32"))
+            if cost <= 0.0 or not devs:
+                continue
+            ready = end_time - bwd_prefix[min_pos]
+            s = ready
+            for d in devs:
+                if comm_avail[d] > s:
+                    s = comm_avail[d]
+            f = s + cost
+            for d in devs:
+                comm_avail[d] = f
+            if f > end_comm:
+                end_comm = f
+            sync_total += cost
+            if comm_schedule is not None:
+                comm_schedule.append(
+                    (f"bucket:{bucket.name}:sync", s, f,
+                     tuple(sorted(devs))))
+            rows.append({
+                "name": bucket.name,
+                "ops": list(bucket.ops),
+                "precision": getattr(bucket, "precision", "fp32"),
+                "ready_s": ready,
+                "start_s": s,
+                "finish_s": f,
+                "sync_s": cost,
+            })
+        # uncovered synced groups: the executed _sync_grads leaves them
+        # on the post-backward monolithic path — price them there (the
+        # legality lint flags the coverage hole; pricing must not hide
+        # it as free communication)
+        for node, mv, _f, _d, sync in node_rows:
+            if sync <= 0 or node.op.name in covered:
+                continue
+            devs = self.view_device_set(mv, use_start=False)
+            s = end_time
+            for d in devs:
+                if comm_avail[d] > s:
+                    s = comm_avail[d]
+            f = s + sync
+            for d in devs:
+                comm_avail[d] = f
+            if f > end_comm:
+                end_comm = f
+            sync_total += sync
+            if comm_schedule is not None:
+                comm_schedule.append(
+                    (f"{node.op.name}:sync", s, f, tuple(sorted(devs))))
+        # the exposed share of each bucket's lane: the part of
+        # [start, finish] past the end of compute (what the step pays)
+        for r in rows:
+            r["exposed_s"] = max(0.0, r["finish_s"]
+                                 - max(r["start_s"], end_time))
+        return end_comm, sync_total, rows
 
     # ---- delta simulation (reference: simulator.h SIMULATE_DELTA) ----
     def set_baseline(self, graph: Graph,

@@ -41,6 +41,28 @@ Match = Node
 _SCANS = METRICS.counter("substitution.find_matches_calls")
 _MATCHES = METRICS.counter("substitution.matches_found")
 _APPLIES = METRICS.counter("substitution.applies")
+# delta-aware matching (ROADMAP PR 3 follow-up): per-pop rescans of the
+# DIRTY REGION only — these counters prove the shrink (search.perf)
+_DELTA_SCANS = METRICS.counter("substitution.delta_match_calls")
+_DELTA_NODES = METRICS.counter("substitution.delta_match_nodes_scanned")
+_DELTA_SKIPPED = METRICS.counter("substitution.delta_match_nodes_skipped")
+
+# how many undirected hops around the changed-guid seed sets a rescan
+# covers.  Every built-in matcher reads only its node's edge lists plus
+# properties of DIRECT neighbors (their op attrs — immutable per guid —
+# and their edge-list lengths), so radius 1 is sufficient; 2 is the
+# safety margin for future matchers.  The FLEXFLOW_TPU_DELTA_CHECK
+# oracle asserts delta == full at runtime.
+DELTA_MATCH_RADIUS = 2
+
+
+def _delta_check_enabled() -> bool:
+    import os
+
+    return os.environ.get("FLEXFLOW_TPU_DELTA_CHECK", "") not in ("", "0")
+
+
+DELTA_MATCH_CHECK = _delta_check_enabled()
 
 
 def _mark(g: Graph, ins=(), outs=()) -> None:
@@ -98,6 +120,63 @@ class GraphXfer:
         _SCANS.inc()
         if out:
             _MATCHES.inc(len(out))
+        return out
+
+    def find_matches_delta(
+        self, graph: Graph, parent_match_guids: Optional[List[int]]
+    ) -> List[Match]:
+        """Matches of ``graph`` computed incrementally from its rewrite
+        parent's matches: only the DIRTY REGION — the changed-guid seed
+        sets ``GraphXfer.apply`` attached (``graph._changed_vs``),
+        expanded ``DELTA_MATCH_RADIUS`` undirected hops — is rescanned;
+        a parent match surviving OUTSIDE that region still matches (the
+        matcher reads only its local neighborhood, all of it unchanged)
+        and a parent non-match outside it still does not.  Identical
+        result to ``find_matches``, in the same topo order — asserted
+        at runtime under FLEXFLOW_TPU_DELTA_CHECK=1.  Falls back to the
+        full scan when no parent matches or seed sets are available
+        (ROADMAP PR 3 follow-up: delta-aware find_matches)."""
+        cv = getattr(graph, "_changed_vs", None)
+        if parent_match_guids is None or cv is None:
+            return self.find_matches(graph)
+        nodes = graph.nodes
+        region = {g for g in cv[1] if g in nodes}
+        region.update(g for g in cv[2] if g in nodes)
+        frontier = set(region)
+        for _ in range(DELTA_MATCH_RADIUS):
+            nxt = set()
+            for g in frontier:
+                for e in graph.in_edges.get(g, ()):
+                    nxt.add(e.src)
+                for e in graph.out_edges.get(g, ()):
+                    nxt.add(e.dst)
+            nxt -= region
+            if not nxt:
+                break
+            region |= nxt
+            frontier = nxt
+        if 2 * len(region) >= len(nodes):
+            return self.find_matches(graph)  # no shrink to win
+        topo = graph.topo_order()
+        pos = {n.guid: i for i, n in enumerate(topo)}
+        hits = {
+            g for g in parent_match_guids if g in nodes and g not in region
+        }
+        for g in region:
+            if self.matcher(graph, nodes[g]):
+                hits.add(g)
+        out = [nodes[g] for g in sorted(hits, key=pos.__getitem__)]
+        _DELTA_SCANS.inc()
+        _DELTA_NODES.inc(len(region))
+        _DELTA_SKIPPED.inc(len(nodes) - len(region))
+        if out:
+            _MATCHES.inc(len(out))
+        if DELTA_MATCH_CHECK:
+            full = [n for n in topo if self.matcher(graph, n)]
+            assert [n.guid for n in out] == [n.guid for n in full], (
+                f"delta find_matches diverged from full for {self.name}: "
+                f"{[n.guid for n in out]} != {[n.guid for n in full]}"
+            )
         return out
 
     def apply(self, graph: Graph, match: Match) -> Optional[Graph]:

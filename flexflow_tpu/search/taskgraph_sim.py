@@ -44,14 +44,18 @@ class LogicalTaskGraphSimulator(Simulator):
 
     def simulate(self, graph: Graph, strategy: Dict[int, MachineView],
                  include_update=None, schedule=None, breakdown=None,
-                 comm_schedule=None) -> float:
+                 comm_schedule=None, sync_schedule=None) -> float:
         if include_update is None:
             include_update = not self.inference
         if self.cost.network is None:
             # no topology to pool flows on — fall back to the event sim
             return super().simulate(graph, strategy, include_update, schedule,
                                     breakdown=breakdown,
-                                    comm_schedule=comm_schedule)
+                                    comm_schedule=comm_schedule,
+                                    sync_schedule=sync_schedule)
+        # pooled-traffic currency: flows are joint, so a sync schedule's
+        # per-bucket lanes have no representation here — sync bytes are
+        # pooled identically either way (ignored by design)
 
         topo = graph.topo_order()
         shardings = {}

@@ -367,3 +367,103 @@ def test_cluster_probe_dedup_across_identical_chains(monkeypatch):
     assert len(calls) == len(set(calls)), (
         "identical chains must not be probed repeatedly")
     assert table.num_clusters == len(set(calls))
+
+
+# ---------------------------------------------------------------------------
+# satellite: drift-staleness -> automatic re-probe policy
+
+
+def test_stale_table_reprobed_when_live_backend_matches(tmp_path):
+    """A DriftReport-marked table must make the NEXT optimize_strategy
+    re-probe (live backend == machine target) instead of only warning:
+    fresh records, stale flag cleared on disk."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    path = str(tmp_path / "cal.json")
+    cfg = ff.FFConfig(batch_size=16, num_devices=8,
+                      machine_spec=MachineSpec.host_cpu(8),
+                      calibration_file=path, search_budget=0,
+                      calibration_budget_s=15.0, cost_cache_file="")
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([16, 32])
+    m.dense(m.dense(x, 64, name="fc1"), 8, name="head")
+    table = CalibrationTable()
+    calibrate_graph(m.graph, 8, table, time_budget_s=15.0)
+    table.save(path)
+    assert CalibrationTable.mark_stale_file(path, 2.5)
+    loaded = CalibrationTable.load(path)
+    assert loaded.stale and loaded.stale_ratio == 2.5
+    optimize_strategy(m.graph, cfg, return_graph=False)
+    after = CalibrationTable.load(path)
+    assert not after.stale, "re-probe must clear the stale flag"
+    assert len(after) > 0, "re-probe must produce fresh records"
+
+
+def test_stale_table_discarded_when_backend_cannot_reprobe(tmp_path):
+    """Stale table for a TPU machine model on a CPU host: the search
+    must fall back to the roofline (table ignored) rather than rank
+    with measurements execution falsified — and must NOT clear the
+    on-disk stale flag (the re-probe still owes)."""
+    from flexflow_tpu.search.driver import load_calibration, optimize_strategy
+
+    path = str(tmp_path / "cal.json")
+    cfg = ff.FFConfig(batch_size=16, num_devices=8,
+                      calibration_file=path, search_budget=0,
+                      cost_cache_file="")  # default machine: tpu_v5e
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([16, 32])
+    m.dense(m.dense(x, 64, name="fc1"), 8, name="head")
+    table = CalibrationTable()
+    table.backend = "tpu"
+    for node in m.graph.topo_order():
+        from flexflow_tpu.core.machine import MachineView
+
+        table.put(node.op, MachineView.trivial(
+            node.op.output_shapes[0].ndim), 1e-4)
+    table.stale = True
+    table.stale_ratio = 3.0
+    table.save(path)
+    optimize_strategy(m.graph, cfg, return_graph=False)
+    after = CalibrationTable.load(path)
+    assert after.stale, "deferred re-probe must keep the flag"
+    assert len(after) == len(table), "records must survive untouched"
+    assert load_calibration(cfg).stale  # and loading still sees it
+
+
+def test_auto_reprobe_capped_on_persistent_drift(tmp_path):
+    """Re-probing that keeps reproducing the drift is a cost-MODEL gap:
+    past MAX_AUTO_REPROBES the driver must stop burning the calibration
+    budget (records kept on disk, roofline used), and a healthy
+    calibrated fit resets the allowance (mark_healthy_file)."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    path = str(tmp_path / "cal.json")
+    cfg = ff.FFConfig(batch_size=16, num_devices=8,
+                      machine_spec=MachineSpec.host_cpu(8),
+                      calibration_file=path, search_budget=0,
+                      calibration_budget_s=15.0, cost_cache_file="")
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([16, 32])
+    m.dense(m.dense(x, 64, name="fc1"), 8, name="head")
+    table = CalibrationTable()
+    calibrate_graph(m.graph, 8, table, time_budget_s=15.0)
+    table.stale = True
+    table.stale_ratio = 2.0
+    table.reprobes = CalibrationTable.MAX_AUTO_REPROBES
+    n_records = len(table)
+    table.save(path)
+    optimize_strategy(m.graph, cfg, return_graph=False)
+    after = CalibrationTable.load(path)
+    # capped: no re-probe ran — flag and records untouched on disk
+    assert after.stale and len(after) == n_records
+    assert after.reprobes == CalibrationTable.MAX_AUTO_REPROBES
+    # a healthy calibrated fit resets the allowance
+    assert CalibrationTable.mark_healthy_file(path)
+    healthy = CalibrationTable.load(path)
+    assert not healthy.stale and healthy.reprobes == 0
+    # and the counter climbs through begin_reprobe on a fresh cycle
+    healthy.stale = True
+    healthy.begin_reprobe()
+    assert healthy.reprobes == 1 and not healthy.stale

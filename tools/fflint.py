@@ -18,6 +18,11 @@ Subcommands:
   fflint all [--root DIR]     the CI entry point: lint every committed
                               COST_CACHE*.json / *strategy*.json under
                               DIR (default .) plus the full registry
+  fflint pre-commit [--skip-registry]
+                              the git hook gate: lint the STAGED
+                              artifact files + prove the registry
+                              (.githooks/pre-commit runs this; enable
+                              with `git config core.hooksPath .githooks`)
 
 Exit codes: 0 clean, 1 findings, 2 usage/unreadable input.  Artifact
 subcommands never import jax, so they run anywhere the files land
@@ -77,6 +82,8 @@ def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
             "warn", "STR203",
             "no __meta__.graph_digest — import cannot prove the file "
             "matches its target graph (re-export with this tree)"))
+    if isinstance(meta, dict) and "sync_schedule" in meta:
+        out += _lint_sync_schedule_meta(meta["sync_schedule"])
     views = {k: v for k, v in data.items() if k != META_KEY}
     if not views:
         out.append(("error", "STR202", "file names no ops at all"))
@@ -99,6 +106,54 @@ def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
         if not isinstance(start, int) or start < 0:
             out.append(("error", "STR204",
                         f"op {name!r}: malformed start {start!r}"))
+    return out
+
+
+_SCHEDULE_SCHEMA = 1  # mirrors search/sync_schedule.SCHEDULE_SCHEMA
+_BUCKET_PRECISIONS = ("fp32", "bf16", "int8")
+
+
+def _lint_sync_schedule_meta(sched) -> List[Tuple[str, str, str]]:
+    """STR205: structural lint of a persisted ``__meta__.sync_schedule``
+    (the searched comm plan, search/sync_schedule.py).  Graph-side
+    legality (coverage, issue order vs readiness, precision coherence —
+    SHD12x) needs the graph and runs at import/compile time."""
+    out: List[Tuple[str, str, str]] = []
+    if not isinstance(sched, dict):
+        return [("error", "STR205", "sync_schedule is not an object")]
+    if sched.get("schema") != _SCHEDULE_SCHEMA:
+        out.append(("error", "STR205",
+                    f"sync_schedule schema {sched.get('schema')!r} unknown "
+                    f"(known: {_SCHEDULE_SCHEMA})"))
+    buckets = sched.get("buckets")
+    if not isinstance(buckets, list) or not buckets:
+        return out + [("error", "STR205", "sync_schedule has no buckets")]
+    seen_ops = set()
+    for i, b in enumerate(buckets):
+        if not isinstance(b, dict):
+            out.append(("error", "STR205",
+                        f"sync_schedule buckets[{i}] is not an object"))
+            continue
+        if not isinstance(b.get("name"), str) or not b.get("name"):
+            out.append(("error", "STR205",
+                        f"sync_schedule buckets[{i}] has no name"))
+        if b.get("precision", "fp32") not in _BUCKET_PRECISIONS:
+            out.append(("error", "STR205",
+                        f"sync_schedule buckets[{i}] precision "
+                        f"{b.get('precision')!r} unknown"))
+        ops = b.get("ops")
+        if (not isinstance(ops, list) or not ops
+                or any(not isinstance(o, str) for o in ops)):
+            out.append(("error", "STR205",
+                        f"sync_schedule buckets[{i}] has malformed ops "
+                        f"{str(ops)[:80]}"))
+            continue
+        for o in ops:
+            if o in seen_ops:
+                out.append(("error", "STR205",
+                            f"sync_schedule covers op {o!r} twice — its "
+                            f"gradient would sync twice"))
+            seen_ops.add(o)
     return out
 
 
@@ -205,6 +260,91 @@ def cmd_registry(args) -> int:
     return 1 if errors else 0
 
 
+def _staged_blobs(root: str, tmpdir: str) -> Optional[List[Tuple[str, str]]]:
+    """``(repo-relative path, staged-blob temp file under tmpdir)`` for
+    every artifact path staged for commit, or None when git is
+    unavailable / not a repository — pre-commit then lints the whole
+    tree like ``all``.  The lint must read the STAGED content
+    (``git show :path``), not the working tree: a file fixed after
+    ``git add`` would otherwise let the corrupt staged blob land (and
+    vice versa)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--cached", "--name-only", "--diff-filter=d"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: List[Tuple[str, str]] = []
+    for rel in proc.stdout.splitlines():
+        if not rel or not rel.endswith(".json"):
+            continue
+        base = os.path.basename(rel)
+        if not (base.startswith("COST_CACHE") or "strategy" in base.lower()):
+            continue
+        blob = subprocess.run(
+            ["git", "show", f":{rel}"], cwd=root, capture_output=True,
+            timeout=30)
+        if blob.returncode != 0:
+            continue
+        # mirror the repo-relative path: same-basename artifacts in
+        # different directories must not overwrite each other's blobs
+        tmp = os.path.join(tmpdir, rel)
+        os.makedirs(os.path.dirname(tmp) or tmpdir, exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(blob.stdout)
+        out.append((rel, tmp))
+    return out
+
+
+def cmd_precommit(args) -> int:
+    """The git pre-commit gate (ROADMAP PR 4 follow-up): lint the
+    STAGED artifact blobs (cost caches / strategy files — stdlib, fast)
+    and prove the rewrite registry (``fflint registry`` — imports jax).
+    Install via the committed hook file:
+
+        git config core.hooksPath .githooks
+
+    Skip once with ``git commit --no-verify``; skip the slow registry
+    proof with ``--skip-registry`` (artifact lints still run)."""
+    import tempfile
+
+    errors = 0
+    # the staged blobs live in one throwaway dir — the hook runs on
+    # every commit, so leaking it would accumulate unboundedly
+    with tempfile.TemporaryDirectory(prefix="fflint_staged_") as tmpdir:
+        staged = _staged_blobs(args.root, tmpdir)
+        if staged is None:
+            print("fflint pre-commit: no git staging info — linting the "
+                  "whole tree")
+            staged = [
+                (p, p) for p in sorted(glob.glob(
+                    os.path.join(args.root, "**", "*.json"),
+                    recursive=True))
+                if os.path.basename(p).startswith("COST_CACHE")
+                or "strategy" in os.path.basename(p).lower()
+            ]
+        caches = [(rel, p) for rel, p in staged
+                  if os.path.basename(rel).startswith("COST_CACHE")]
+        strategies = [(rel, p) for rel, p in staged
+                      if "strategy" in os.path.basename(rel).lower()]
+        for rel, path in caches:
+            errors += _report(rel, lint_cache_file(path))
+        for rel, path in strategies:
+            errors += _report(rel, lint_strategy_file(path))
+    if not args.skip_registry:
+        errors += _report("registry", lint_registry(args.devices))
+    print(f"fflint pre-commit: {len(caches)} cache file(s), "
+          f"{len(strategies)} strategy file(s)"
+          + ("" if args.skip_registry else
+             f", registry @ {args.devices} devices")
+          + f" — {errors} error(s)")
+    return 1 if errors else 0
+
+
 def cmd_all(args) -> int:
     errors = 0
     caches = sorted(glob.glob(
@@ -244,6 +384,17 @@ def main(argv=None) -> int:
     p.add_argument("--root", default=".")
     p.add_argument("--devices", type=int, default=8)
     p.set_defaults(fn=cmd_all)
+    p = sub.add_parser("pre-commit",
+                       help="git pre-commit gate: lint STAGED artifact "
+                            "files + prove the rewrite registry "
+                            "(install: git config core.hooksPath "
+                            ".githooks)")
+    p.add_argument("--root", default=".")
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--skip-registry", action="store_true",
+                   help="artifact lints only (skips the jax-importing "
+                        "registry proof)")
+    p.set_defaults(fn=cmd_precommit)
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:

@@ -189,6 +189,16 @@ def render_report(events: List[dict], top: int = 10,
         if p.get("result_cache_hit"):
             line += "; RESULT served from the persistent cost cache"
         lines.append(line)
+        md = p.get("match_delta_scans", 0)
+        if md:
+            scanned = p.get("match_nodes_rescanned", 0)
+            skipped = p.get("match_nodes_skipped", 0)
+            denom = max(1, scanned + skipped)
+            lines.append(
+                f"Delta matching: {md} dirty-region rescans / "
+                f"{p.get('match_full_scans', 0)} full scans; "
+                f"{scanned} nodes rescanned, {skipped} served from the "
+                f"parent ({skipped / denom:.0%} of match work skipped)")
     lines.append("")
 
     # ---- strategy table ---------------------------------------------------
@@ -269,6 +279,25 @@ def render_report(events: List[dict], top: int = 10,
                     f"{_ms(v.get('measured_s'))} | "
                     f"{f'{r:.2f}' if isinstance(r, (int, float)) else '—'} |"
                 )
+        buckets = d.get("sync_buckets") or []
+        if buckets:
+            lines.append("")
+            lines.append(
+                "Sync-schedule buckets (predicted lanes; the executed "
+                "step is one fused program, so the overlap claim is "
+                "verified by the scheduled-vs-monolithic measured step "
+                "delta, not per-bucket host timers):")
+            lines.append(
+                "| bucket | groups | precision | issue-ready ms | "
+                "sync ms | exposed ms |")
+            lines.append("|---|---|---|---|---|---|")
+            for b in buckets:
+                lines.append(
+                    f"| {b.get('name')} | {b.get('ops')} | "
+                    f"{b.get('precision')} | "
+                    f"{_ms(b.get('predicted_ready_s'))} | "
+                    f"{_ms(b.get('predicted_sync_s'))} | "
+                    f"{_ms(b.get('predicted_exposed_s'))} |")
         # only the aggregate step has both sides (single-sided phases
         # carry no ratio by design); rank the measured host phases by
         # their share of the step instead to point at where time went

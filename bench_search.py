@@ -589,6 +589,151 @@ def sync_schedule_sweep(n_devices, steps, drift_threshold=0.5):
     return sweep
 
 
+def topology_sweep(n_devices):
+    """The --topology sweep: hierarchical machine topologies as a
+    pricing + search dimension (search/machine_model.py link levels +
+    search/reduction_plan.py staged reduction plans).
+
+    Simulated only, deliberately: a CPU mesh has no slice boundary, so
+    executed numbers could not show a DCN win — the contract numbers
+    are the machine-model sync terms, falsifiable on a real multislice
+    pod.  For flat vs 2-slice vs 4-slice variants of the TPU machine
+    (10x ICI/DCN bandwidth gap, the production-typical ratio), each
+    model records the DP strategy's flat-ring sync term, the searched
+    staged-plan sync term, and the chosen per-bucket reduction plans
+    (the acceptance number: staged beats flat >= 2x on the sync term
+    for the sync-bound BERT)."""
+    import dataclasses
+    import math
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+    from flexflow_tpu.models import (
+        build_dlrm,
+        build_mlp_unify,
+        build_transformer,
+    )
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.search.sync_schedule import (
+        build_bucketed_schedule,
+        choose_sync_schedule,
+        synced_weight_groups,
+    )
+
+    builders = {
+        "bert": (8, lambda cfg: build_transformer(
+            cfg, **SYNC_BOUND_BERT_KW)),
+        "dlrm": (64, lambda cfg: build_dlrm(cfg)),
+        "mlp": (64, lambda cfg: build_mlp_unify(cfg)),
+    }
+    base_spec = ff.FFConfig(batch_size=8,
+                            num_devices=n_devices).machine_spec
+    gap = 10.0
+    topologies = {"flat": base_spec}
+    for k in (2, 4):
+        # a k-slice variant needs k even slices of >= 2 devices each —
+        # degenerate counts (--devices 2 with 4 slices) would build a
+        # spec with devices_per_host 0
+        if n_devices % k == 0 and n_devices // k >= 2:
+            topologies[f"{k}slice"] = dataclasses.replace(
+                base_spec, devices_per_host=n_devices // k,
+                dcn_bandwidth=base_spec.ici_bandwidth / gap)
+        else:
+            print(f"# topology sweep: skipping {k}slice "
+                  f"(needs {k} even slices of >=2 of {n_devices} devices)")
+    sweep = {
+        "devices": n_devices,
+        "ici_dcn_gap": gap,
+        "note": (
+            "simulated on the TPU machine model (a CPU mesh has no "
+            "slice boundary to execute across); sync terms are the DP "
+            "strategy's weight-gradient reduction priced flat (one "
+            "ring over every link class) vs with the searched staged "
+            "reduction plans (RS within slice, cross-slice exchange of "
+            "the shard, AG within slice)"
+        ),
+        "models": {},
+    }
+    for name, (batch, build) in builders.items():
+        cfg = ff.FFConfig(batch_size=batch, num_devices=n_devices)
+        g = build(cfg).graph
+        dp = data_parallel_strategy(g, n_devices)
+        rows = {}
+        for topo, spec in topologies.items():
+            sim = Simulator(spec, num_devices=n_devices)
+            synced = synced_weight_groups(g, dp, sim.cost)
+            mono = build_bucketed_schedule(synced, {}, math.inf)
+            bd = {}
+            sim.simulate(g, dp, breakdown=bd, sync_schedule=mono)
+            sched, info = choose_sync_schedule(g, dp, sim, {}, cfg)
+            row = {
+                "sim_flat_step_ms": round(bd["total_s"] * 1e3, 4),
+                "sim_flat_sync_ms": round(bd["sync_total_s"] * 1e3, 4),
+                "buckets": info.get("buckets", 0),
+                "staged_buckets": info.get("staged_buckets", 0),
+                "plans": {},
+            }
+            if sched is not None:
+                bd_s = {}
+                sim.simulate(g, dp, breakdown=bd_s, sync_schedule=sched)
+                row["sim_planned_step_ms"] = round(
+                    bd_s["total_s"] * 1e3, 4)
+                row["sim_planned_sync_ms"] = round(
+                    bd_s["sync_total_s"] * 1e3, 4)
+                row["sync_levels_ms"] = {
+                    k: round(v * 1e3, 4)
+                    for k, v in (bd_s.get("sync_levels_s") or {}).items()}
+                row["plans"] = {
+                    b.name: b.plan.name for b in sched.buckets
+                    if b.plan is not None}
+                if row["sim_planned_sync_ms"]:
+                    row["sync_ratio_flat_over_planned"] = round(
+                        row["sim_flat_sync_ms"]
+                        / row["sim_planned_sync_ms"], 3)
+            rows[topo] = row
+            print(json.dumps({"topology": topo, "model": name, **{
+                k: v for k, v in row.items() if k != "plans"}}))
+        sweep["models"][name] = rows
+    return sweep
+
+
+def _topology_sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## Hierarchical topology sweep (flat vs multi-slice, "
+        f"{sweep['ici_dcn_gap']:.0f}x ICI/DCN gap)",
+        "",
+        "The machine model's link hierarchy as a search dimension "
+        "(search/machine_model.py levels + search/reduction_plan.py): "
+        "on multi-slice topologies the search synthesizes staged "
+        "per-group reduction plans — reduce-scatter within each slice, "
+        "a cross-slice exchange of the 1/n shard, all-gather within "
+        "the slice — instead of dragging the full gradient around the "
+        "slow DCN ring.",
+        "",
+        "| model | topology | flat sync ms | planned sync ms | "
+        "sync ratio | flat step ms | planned step ms | staged buckets | "
+        "plans |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for name, rows in sweep["models"].items():
+        for topo, r in rows.items():
+            plans = ",".join(sorted(set(r.get("plans", {}).values()))) \
+                or "—"
+            lines.append(
+                f"| {name} | {topo} | {r.get('sim_flat_sync_ms', '—')} | "
+                f"{r.get('sim_planned_sync_ms', '—')} | "
+                f"{r.get('sync_ratio_flat_over_planned', '—')} | "
+                f"{r.get('sim_flat_step_ms', '—')} | "
+                f"{r.get('sim_planned_step_ms', '—')} | "
+                f"{r.get('staged_buckets', 0)} | {plans} |")
+    lines += [
+        "",
+        f"Honesty note: {sweep['note']}.",
+    ]
+    return lines
+
+
 def _schedule_sweep_md_lines(sweep):
     lines = [
         "",
@@ -721,6 +866,15 @@ def main():
                     help="run ONLY the sync-schedule sweep and merge it "
                          "into the existing artifact, leaving every "
                          "model row untouched")
+    ap.add_argument("--topology", action="store_true",
+                    help="also sweep hierarchical machine topologies "
+                         "(flat vs 2-slice vs 4-slice, 10x ICI/DCN "
+                         "gap): per-model chosen reduction plans + "
+                         "the flat-vs-staged DP sync term, simulated")
+    ap.add_argument("--topology-only", action="store_true",
+                    help="run ONLY the topology sweep and merge it "
+                         "into the existing artifact, leaving every "
+                         "model row untouched")
     ap.add_argument("--verify", action="store_true",
                     help="arm the static-analysis verifier "
                          "(flexflow_tpu/analysis, FLEXFLOW_TPU_VERIFY "
@@ -763,6 +917,39 @@ def main():
         BUS.configure(obs_log)
 
     sweep_precisions = [p for p in args.sync_precision.split(",") if p]
+    if args.topology_only:
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["topology_sweep"] = topology_sweep(args.devices)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous topology-sweep section (same
+            # merge discipline as the other --*-only modes)
+            marker = "\n## Hierarchical topology sweep"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_topology_sweep_md_lines(
+                        report["topology_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged topology sweep into {path} / {md}")
+        return
     if args.sync_schedule_only:
         path = f"{args.out_prefix}.json"
         if os.path.exists(path):
@@ -978,6 +1165,8 @@ def main():
         report["sync_schedule_sweep"] = sync_schedule_sweep(
             args.devices, args.steps,
             drift_threshold=args.drift_threshold)
+    if args.topology:
+        report["topology_sweep"] = topology_sweep(args.devices)
 
     with open(f"{args.out_prefix}.json", "w") as f:
         json.dump(report, f, indent=1)
@@ -1051,6 +1240,8 @@ def main():
         lines += _sweep_md_lines(report["sync_precision_sweep"])
     if report.get("sync_schedule_sweep"):
         lines += _schedule_sweep_md_lines(report["sync_schedule_sweep"])
+    if report.get("topology_sweep"):
+        lines += _topology_sweep_md_lines(report["topology_sweep"])
     with open(f"{args.out_prefix}.md", "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"# wrote {args.out_prefix}.json / {args.out_prefix}.md")

@@ -35,7 +35,11 @@ from flexflow_tpu.analysis.invariants import (
     set_verify,
     verification_enabled,
 )
-from flexflow_tpu.analysis.sharding import lint_strategy, lint_sync_schedule
+from flexflow_tpu.analysis.sharding import (
+    lint_reduction_plan,
+    lint_strategy,
+    lint_sync_schedule,
+)
 
 __all__ = [
     "AnalysisError",
@@ -49,6 +53,7 @@ __all__ = [
     "scoped_verify",
     "set_verify",
     "verification_enabled",
+    "lint_reduction_plan",
     "lint_strategy",
     "lint_sync_schedule",
 ]

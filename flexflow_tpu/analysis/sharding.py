@@ -49,6 +49,25 @@ always-on wherever a schedule is produced or imported):
   (search/sync_precision.py) — the two artifacts are built together
   and must not contradict
 
+Staged REDUCTION-PLAN legality (``lint_reduction_plan`` — the
+per-bucket hierarchical reduction strategies of
+search/reduction_plan.py, gated always-on with the schedule):
+
+* **SHD130** structural sanity: stages form the canonical RS..AR..AG
+  bracketing, kinds/precisions known, levels within the machine's
+  link hierarchy
+* **SHD131** level coverage: the plan's cross level equals the deepest
+  link level the bucket's replication groups actually span — too
+  shallow leaves the coarse links mispriced, too deep prices stages
+  the wire never runs
+* **SHD132** group/slice coherence: a staged bucket must contain at
+  least one group whose replication provably decomposes across the
+  slice boundary (a plan on a within-slice bucket is incoherent)
+* **SHD133** precision-per-level validity: only the cross-level
+  allreduce stage may compress, and its wire precision must be fp32 or
+  the bucket's own (sync-precision-map-coherent) precision — per-level
+  precision composes with the map, never contradicts it
+
 Pure host-side: no mesh construction, no XLA — safe to run inside
 ``optimize_strategy`` as an always-on gate.
 """
@@ -345,4 +364,90 @@ def lint_sync_schedule(graph, strategy: Dict[int, object], schedule,
             f"{len(uncovered)} synced weight group(s) uncovered (e.g. "
             f"{uncovered[:4]}) — they would fall back to the exposed "
             f"post-backward monolithic sync"))
+    return findings
+
+
+def _p(code: str, message: str, **kw) -> Finding:
+    return Finding(code=code, pass_name="reduction_plan", message=message,
+                   **kw)
+
+
+def lint_reduction_plan(graph, strategy: Dict[int, object], schedule,
+                        cost_model) -> List[Finding]:
+    """Legality findings for the staged reduction plans a schedule's
+    buckets carry, against (graph, strategy, machine) — SHD130-133
+    ([] = legal; a plan-free schedule is trivially legal).
+    ``cost_model`` supplies the link hierarchy and the slot→axis
+    replica decomposition — the SAME classifier the pricing used, so a
+    plan that lints clean is priced and executed coherently."""
+    from flexflow_tpu.search.reduction_plan import validate_stages_split
+    from flexflow_tpu.search.sync_schedule import synced_weight_groups
+
+    buckets = list(getattr(schedule, "buckets", schedule) or [])
+    if not any(getattr(b, "plan", None) is not None for b in buckets):
+        return []
+    findings: List[Finding] = []
+    levels = cost_model.levels()
+    num_levels = len(levels)
+    parts_by_op: Dict[str, list] = {}
+    for node, _mv, parts in synced_weight_groups(graph, strategy,
+                                                 cost_model):
+        parts_by_op[node.op.name] = parts
+    for bucket in buckets:
+        plan = getattr(bucket, "plan", None)
+        if plan is None:
+            continue
+        bname = getattr(bucket, "name", "?")
+        structural, prec_errs = validate_stages_split(
+            plan.stages, num_levels)
+        for e in structural:
+            findings.append(_p(
+                "SHD130", f"bucket {bname!r} plan {plan.name!r}: {e}"))
+        for e in prec_errs:
+            findings.append(_p(
+                "SHD133", f"bucket {bname!r} plan {plan.name!r}: {e}"))
+        if structural:
+            continue
+        # group/slice coherence + level coverage
+        deepest = 0
+        spanning = 0
+        for op in getattr(bucket, "ops", ()):
+            for part in parts_by_op.get(op, ()):
+                _nbytes, replica, _spans, _n, key = part
+                if replica <= 1:
+                    continue
+                factors = cost_model.replica_level_split(key, replica)
+                if factors is None:
+                    continue
+                d = max((i for i, f in enumerate(factors) if f > 1),
+                        default=0)
+                deepest = max(deepest, d)
+                if d > 0:
+                    spanning += 1
+        if spanning == 0:
+            findings.append(_p(
+                "SHD132",
+                f"bucket {bname!r} carries staged plan {plan.name!r} but "
+                f"none of its replication groups provably spans a slice "
+                f"boundary — the staged stages have no cross-level wire "
+                f"to ride"))
+        elif plan.cross_level != deepest:
+            findings.append(_p(
+                "SHD131",
+                f"bucket {bname!r} plan {plan.name!r} reaches link level "
+                f"{plan.cross_level} but the bucket's groups span level "
+                f"{deepest} — the plan's level coverage does not match "
+                f"the topology the groups actually cross"))
+        # SHD133: cross precision composes with the bucket precision
+        bprec = getattr(bucket, "precision", "fp32")
+        for s in plan.stages:
+            if s.kind == "allreduce" and s.precision not in (
+                    "fp32", bprec):
+                findings.append(_p(
+                    "SHD133",
+                    f"bucket {bname!r} plan {plan.name!r} compresses the "
+                    f"cross-level allreduce at {s.precision} but the "
+                    f"bucket's (sync-precision-map-coherent) precision "
+                    f"is {bprec!r} — per-level precision must compose "
+                    f"with the map, not contradict it"))
     return findings

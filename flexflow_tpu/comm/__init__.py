@@ -17,10 +17,18 @@ Two roles:
   fused wire payload, buckets chain through ``optimization_barrier``
   so collectives issue in backward grad-readiness order (overlap-aware
   bucketed sync; GSPMD async collectives, arXiv:2105.04663).
+* ``hierarchical`` — staged execution of the searched reduction PLANs
+  (search/reduction_plan.py) on multi-slice topologies: exact fp32
+  reduce-scatter/all-gather within each slice around a compressed
+  cross-slice exchange (arXiv:2110.10548's staged shape).
 """
 
 from flexflow_tpu.comm.bucketed import bucketed_grad_sync
 from flexflow_tpu.comm.compat import force_cpu_devices, shard_map
+from flexflow_tpu.comm.hierarchical import (
+    plan_axis_groups,
+    staged_allreduce,
+)
 from flexflow_tpu.comm.quantized import (
     DEFAULT_CHUNK,
     MIN_COMPRESS_ELEMS,
@@ -29,6 +37,7 @@ from flexflow_tpu.comm.quantized import (
     dequantize_chunked,
     quantize_chunked,
     quantized_allreduce,
+    quantized_allreduce_ef,
     quantized_grad_sync,
     replication_axes,
 )
@@ -41,8 +50,11 @@ __all__ = [
     "bucketed_grad_sync",
     "dequantize_chunked",
     "force_cpu_devices",
+    "plan_axis_groups",
     "quantize_chunked",
+    "staged_allreduce",
     "quantized_allreduce",
+    "quantized_allreduce_ef",
     "quantized_grad_sync",
     "replication_axes",
     "shard_map",

@@ -66,18 +66,38 @@ def bucketed_grad_sync(
     param_shardings: Dict[str, Dict[str, "jax.sharding.NamedSharding"]],
     schedule,
     chunk: int = DEFAULT_CHUNK,
+    machine=None,
 ) -> Dict[str, Dict[str, jax.Array]]:
     """Run ``schedule``'s buckets in issue order over ``grads`` (the
     already-GSPMD-reduced gradient tree) — call inside the jitted step,
     before the optimizer update.  Ops absent from the schedule (or
     whose params consume the whole mesh) pass through untouched, as do
-    fp32 buckets' values and sub-floor weights of compressed buckets."""
+    fp32 buckets' values and sub-floor weights of compressed buckets.
+
+    ``machine`` (a MachineSpec) arms the staged execution of buckets
+    carrying a reduction PLAN (search/reduction_plan.py): their
+    compressed wire runs the hierarchical RS → cross-slice exchange →
+    AG shape (comm/hierarchical.py) over the plan's nested axis
+    groupings instead of one flat collective.  All-fp32 plans stay
+    value-identity anchors — bit-exact with the monolithic path."""
     from flexflow_tpu.comm.compat import shard_map
+    from flexflow_tpu.comm.hierarchical import (
+        plan_axis_groups,
+        plan_cross_precision,
+        staged_allreduce,
+    )
 
     merged = {op: dict(ws) for op, ws in grads.items()}
     token = None
     for bucket in getattr(schedule, "buckets", schedule):
         prec = getattr(bucket, "precision", "fp32")
+        plan = getattr(bucket, "plan", None)
+        cross_prec = plan_cross_precision(plan)
+        # a plan whose every stage is fp32 has no explicit wire work
+        # (GSPMD's own psum reduced the grads; the priced stages model
+        # XLA's hierarchical psum) — its members all pass through
+        wire = prec in ("bf16", "int8") and (
+            plan is None or cross_prec is not None)
         # bucket members' replicated grads, grouped by replication axes
         # — one fused payload per (axes, n) group
         groups: Dict[Tuple, List[Tuple[str, str, jax.Array, object]]] = {}
@@ -90,7 +110,7 @@ def bucketed_grad_sync(
                 rep, n = replication_axes(sh, mesh)
                 if not rep:
                     continue
-                if prec in ("bf16", "int8") and g.size >= MIN_COMPRESS_ELEMS:
+                if wire and g.size >= MIN_COMPRESS_ELEMS:
                     groups.setdefault((rep, n), []).append(
                         (op_name, w_name, g, sh.spec))
                 else:
@@ -102,8 +122,30 @@ def bucketed_grad_sync(
             gs = [g for _o, _w, g, _s in members]
             gs, token = _ordered(gs, token)
             specs = [s for _o, _w, _g, s in members]
+            # per-group reduction: the plan's staged shape when its
+            # cross stage has axes to ride on this group, the flat
+            # quantized collective otherwise (a within-slice group of a
+            # staged bucket runs flat at the bucket precision — exactly
+            # how the cost model priced it)
+            staged = None
+            if plan is not None and cross_prec is not None \
+                    and machine is not None:
+                st_axes, st_sizes = plan_axis_groups(
+                    rep, mesh, machine, plan.cross_level)
+                if st_axes[-1]:
+                    staged = (st_axes, st_sizes)
 
-            def fused(*local, _rep=rep, _n=n):
+            def reduce_flat(flat, _rep=rep, _n=n, _staged=staged):
+                if _staged is not None:
+                    return staged_allreduce(
+                        flat, _staged[0], _staged[1], cross_prec,
+                        chunk=chunk, mean=True)
+                return quantized_allreduce(
+                    flat, _rep, precision=prec, chunk=chunk, mean=True,
+                    axis_size=_n,
+                )
+
+            def fused(*local, _red=reduce_flat):
                 # flatten the bucket into ONE wire payload: the fused
                 # collective pays a single latency floor for the whole
                 # bucket (what coalescing buys)
@@ -112,10 +154,7 @@ def bucketed_grad_sync(
                     local[0].reshape(-1) if len(local) == 1 else
                     jax.numpy.concatenate([x.reshape(-1) for x in local])
                 )
-                red = quantized_allreduce(
-                    flat, _rep, precision=prec, chunk=chunk, mean=True,
-                    axis_size=_n,
-                )
+                red = _red(flat)
                 out, off = [], 0
                 for x, sz in zip(local, sizes):
                     out.append(red[off:off + sz].reshape(x.shape))

@@ -162,6 +162,52 @@ def quantized_allreduce(
     return out.astype(orig_dtype)
 
 
+def quantized_allreduce_ef(
+    x: jax.Array,
+    residual: jax.Array,
+    axis_name: _AxisNames,
+    precision: str = "int8",
+    chunk: int = DEFAULT_CHUNK,
+    mean: bool = False,
+    axis_size: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Error-feedback (residual) variant of ``quantized_allreduce``:
+    each device transmits ``quantize(x + residual)`` and carries the
+    local quantization error forward — ``residual' = (x + residual) -
+    dequantize(quantize(x + residual))`` — so the compression error is
+    re-injected instead of lost (EF-SGD; what keeps int8 sync safe at
+    large replica counts, where n independent per-step roundings would
+    otherwise accumulate a bias the lone-step error bound does not
+    see).  Returns ``(reduced, new_residual)``; the caller threads the
+    residual across steps like optimizer state.  fp32 is the exact
+    psum with a zero residual.  The feedback compensates the entry
+    (stage-1) quantization — the per-addend error EF-SGD corrects; the
+    reduced-shard requantize of stage 2 remains bounded by the
+    one-step contract (``allreduce_error_bound``)."""
+    if precision not in SYNC_PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {SYNC_PRECISIONS}, got {precision!r}"
+        )
+    if precision == "fp32":
+        return (
+            quantized_allreduce(x, axis_name, "fp32", chunk, mean,
+                                axis_size),
+            jnp.zeros_like(x, dtype=jnp.float32),
+        )
+    carry = x.astype(jnp.float32) + residual.astype(jnp.float32)
+    if precision == "int8":
+        q, s = quantize_chunked(carry, chunk)
+        approx = dequantize_chunked(q, s, carry.size, carry.shape)
+    else:
+        approx = carry.astype(jnp.bfloat16).astype(jnp.float32)
+    new_residual = carry - approx
+    out = quantized_allreduce(
+        carry, axis_name, precision=precision, chunk=chunk, mean=mean,
+        axis_size=axis_size,
+    ).astype(x.dtype)
+    return out, new_residual
+
+
 def allreduce_error_bound(
     per_device_inputs, precision: str, chunk: int = DEFAULT_CHUNK
 ) -> float:

@@ -467,7 +467,12 @@ class CompiledModel:
         if schedule is not None and getattr(schedule, "buckets", None):
             from flexflow_tpu.comm import bucketed_grad_sync
 
-            return bucketed_grad_sync(grads, self.mesh, shardings, schedule)
+            # the machine spec arms staged (hierarchical) execution of
+            # buckets carrying a reduction plan — the nested axis split
+            # follows the spec's slice structure, not the live backend
+            return bucketed_grad_sync(
+                grads, self.mesh, shardings, schedule,
+                machine=self.config.machine_spec)
         if not self.sync_precision:
             return grads
         from flexflow_tpu.comm import quantized_grad_sync

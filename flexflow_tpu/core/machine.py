@@ -27,6 +27,20 @@ from typing import Dict, Optional, Tuple
 
 
 @dataclass(frozen=True)
+class LinkLevel:
+    """One level of the machine's link hierarchy: devices live in
+    aligned groups of ``span`` connected at this level's bandwidth;
+    collectives confined to one group never pay the coarser levels.
+    Level 0 is always ICI (within a slice); coarser levels are DCN
+    classes (across slices, across superpods, ...)."""
+
+    name: str
+    span: int  # devices per aligned group at this level
+    bandwidth: float  # bytes/s per device
+    latency: float  # seconds per hop
+
+
+@dataclass(frozen=True)
 class MachineSpec:
     """Hardware description used for cost modeling and mesh construction.
 
@@ -49,6 +63,14 @@ class MachineSpec:
     ici_torus: Tuple[int, ...] = ()  # physical torus shape, () = derive
     dcn_bandwidth: float = 3.125e9  # bytes/s per host (25 Gbps)
     dcn_latency: float = 10e-6
+    # optional N-LEVEL link hierarchy above ICI: tuples of
+    # (span, bandwidth, latency), spans strictly ascending, each a
+    # multiple of devices_per_host and a divisor of the next (aligned
+    # nesting).  Empty (the default) derives the classic two-level
+    # structure — one DCN class spanning the whole machine — from
+    # dcn_bandwidth/dcn_latency, so every existing spec prices
+    # bit-identically.  ``topology_levels()`` is the one reader.
+    slice_levels: Tuple[Tuple[int, float, float], ...] = ()
     # fixed seconds per GSPMD reshard op beyond its byte costs (kernel
     # launches, layout churn, fusion break).  ~launch-scale on TPU;
     # dominant at small sizes on a serialized CPU host (measured ~2 ms
@@ -119,11 +141,15 @@ class MachineSpec:
             cfg = json.load(f)
         if "ici_torus" in cfg:
             cfg["ici_torus"] = tuple(cfg["ici_torus"])
+        if "slice_levels" in cfg:
+            cfg["slice_levels"] = tuple(
+                tuple(lvl) for lvl in cfg["slice_levels"])
         return MachineSpec(**cfg)
 
     def to_file(self, path: str) -> None:
         d = {k: getattr(self, k) for k in self.__dataclass_fields__}
         d["ici_torus"] = list(d["ici_torus"])
+        d["slice_levels"] = [list(lvl) for lvl in d["slice_levels"]]
         with open(path, "w") as f:
             json.dump(d, f, indent=2)
 
@@ -131,6 +157,33 @@ class MachineSpec:
     @property
     def num_hosts(self) -> int:
         return max(1, self.num_devices // self.devices_per_host)
+
+    def topology_levels(self) -> Tuple[LinkLevel, ...]:
+        """The machine's link hierarchy, finest first.  Level 0 is
+        always ICI with span ``devices_per_host``; above it come the
+        configured ``slice_levels`` or — when none are configured and
+        the machine is bigger than one slice — the single classic DCN
+        level spanning the whole machine.  A flat machine (one slice)
+        is the degenerate single-level case."""
+        levels = [LinkLevel("ici", self.devices_per_host,
+                            self.ici_bandwidth, self.ici_latency)]
+        if self.slice_levels:
+            multi = len(self.slice_levels) > 1
+            prev = self.devices_per_host
+            for i, (span, bw, lat) in enumerate(self.slice_levels):
+                if span <= prev or span % prev != 0:
+                    raise ValueError(
+                        f"slice_levels[{i}] span {span} must be an "
+                        f"ascending multiple of the previous level's "
+                        f"span {prev}")
+                levels.append(LinkLevel(
+                    f"dcn{i + 1}" if multi else "dcn", span, bw, lat))
+                prev = span
+        elif self.num_devices > self.devices_per_host:
+            levels.append(LinkLevel(
+                "dcn", self.num_devices, self.dcn_bandwidth,
+                self.dcn_latency))
+        return tuple(levels)
 
     def matmul_time(self, flops: float) -> float:
         return flops / self.peak_flops

@@ -683,8 +683,18 @@ class FFModel:
                     raise AnalysisError(
                         f"imported strategy file carries a malformed "
                         f"sync_schedule: {e}", []) from e
-                bad = errors_only(lint_sync_schedule(
-                    self.graph, strategy, sched, self.sync_precision_map))
+                from flexflow_tpu.analysis import lint_reduction_plan
+                from flexflow_tpu.search.machine_model import CostModel
+
+                _lint_cm = CostModel(
+                    self.config.machine_spec,
+                    num_devices=self.config.search_devices)
+                bad = errors_only(
+                    lint_sync_schedule(
+                        self.graph, strategy, sched,
+                        self.sync_precision_map)
+                    + lint_reduction_plan(
+                        self.graph, strategy, sched, _lint_cm))
                 if bad:
                     emit_findings(bad)
                     raise AnalysisError(

@@ -103,6 +103,13 @@ def build_drift_report(
         # single-sided prediction whose measured counterpart is the
         # scheduled-vs-monolithic step delta
         phases["sync_exposed"] = _phase(predicted["sync_exposed_s"], None)
+    # per-link-level predicted comm rows (hierarchical topologies): the
+    # slow DCN class's share is visible separately from intra-slice
+    # traffic, so drift on the cross-slice links can be attributed
+    # without un-mixing one aggregate number.  Single-sided like the
+    # other sub-step phases (one fused program has no per-link timer).
+    for name, secs in (predicted.get("sync_levels_s") or {}).items():
+        phases[f"sync_{name}"] = _phase(secs, None)
     for name, stats in (measured_phases or {}).items():
         phases[name] = _phase(None, stats.get("mean_s"))
     buckets = []
@@ -110,10 +117,12 @@ def build_drift_report(
         buckets.append({
             "name": row.get("name"),
             "precision": row.get("precision"),
+            "plan": row.get("plan"),
             "ops": len(row.get("ops") or []),
             "predicted_ready_s": row.get("ready_s"),
             "predicted_sync_s": row.get("sync_s"),
             "predicted_exposed_s": row.get("exposed_s"),
+            "predicted_levels_s": row.get("levels") or {},
             "measured_s": None,  # one fused program: no per-bucket probe
         })
     return DriftReport(

@@ -47,6 +47,12 @@ from flexflow_tpu.parallel.mesh import (
 OP_OVERHEAD_S = 2e-6
 
 
+def _merge_levels(acc: Dict[str, float], split: Dict[str, float]) -> None:
+    """Accumulate a per-link-level seconds split into ``acc``."""
+    for name, t in split.items():
+        acc[name] = acc.get(name, 0.0) + t
+
+
 def _min_compress_elems() -> int:
     """comm.quantized.MIN_COMPRESS_ELEMS, imported lazily: the comm
     module pulls in jax, which this pure-python cost model otherwise
@@ -89,6 +95,52 @@ class CostModel:
     sync_precision: str = "fp32"
 
     # ---- slice topology --------------------------------------------------
+    def levels(self):
+        """The link hierarchy this cost model prices against
+        (``MachineSpec.topology_levels``), clamped to the SEARCH device
+        count: a level whose aligned group already contains every
+        searched device adds no crossing class (an 8-device search of a
+        16-chip 2-slice spec runs inside one slice).  Finest first;
+        a flat machine is the single-level degenerate case."""
+        if not hasattr(self, "_levels_cache"):
+            import dataclasses
+
+            from flexflow_tpu.core.machine import LinkLevel
+
+            ndev = self.num_devices or self.machine.num_devices
+            lv = list(self.machine.topology_levels())
+            out = [lv[0]]
+            for lvl in lv[1:]:
+                if ndev > out[-1].span:
+                    out.append(lvl)
+            if ndev > out[-1].span:
+                # a --search-num-nodes-style override spans more devices
+                # than the spec names: the extra reach is one more DCN
+                # hop class (widen the coarsest configured level, or add
+                # the classic machine-wide DCN level to a flat spec)
+                if len(out) == 1:
+                    out.append(LinkLevel(
+                        "dcn", ndev, self.machine.dcn_bandwidth,
+                        self.machine.dcn_latency))
+                else:
+                    out[-1] = dataclasses.replace(out[-1], span=ndev)
+            self._levels_cache = tuple(out)
+        return self._levels_cache
+
+    def _axis_level(self, span: int) -> int:
+        """The finest level whose aligned group contains an axis group
+        of aligned ``span`` (stride * size): groups along an axis live
+        in ALIGNED blocks, so the group stays inside one level-i block
+        iff the span both fits and DIVIDES the level's group size —
+        span 3 with slice 8 crosses at the [6,9) block even though
+        3 < 8.  Returns 0 for within-slice, k for a group only the
+        level-k links connect."""
+        levels = self.levels()
+        for i, lvl in enumerate(levels):
+            if span <= lvl.span and lvl.span % span == 0:
+                return i
+        return len(levels) - 1
+
     def _slot_axes(self, slot_degrees: Tuple[int, ...]):
         """Per-slot (stride, size) mesh axes under the lowering's
         canonical take-first assignment (parallel/mesh.py
@@ -132,34 +184,34 @@ class CostModel:
 
     def _spans_dcn(
         self, slot_degrees: Tuple[int, ...], active_slots, retained=None
-    ) -> Optional[bool]:
-        """Does a collective riding ``active_slots`` of a view with
-        ``slot_degrees`` cross an ICI-domain (slice) boundary?  Groups
-        along an axis of stride s and size f always live in ALIGNED
-        blocks of span s*f (inner axes contribute < s to the base,
-        outer axes multiples of the span), so a group stays inside one
-        contiguous devices-per-domain block iff the span both fits and
-        DIVIDES the domain size — span 3 with domain 8 crosses at the
-        [6,9) block even though 3 < 8.  ``retained[slot]`` is the degree
-        the destination keeps on that slot — its size-matched axes are
-        excluded (only the vanished axes move).  None = assignment
-        failed."""
+    ) -> Optional[int]:
+        """The deepest link LEVEL a collective riding ``active_slots``
+        of a view with ``slot_degrees`` crosses (0 = stays within one
+        ICI domain/slice; k = the coarsest DCN class it must traverse —
+        for the classic two-level machine the truthiness matches the
+        historical crosses-DCN bool).  Groups along an axis of stride s
+        and size f always live in ALIGNED blocks of span s*f (inner
+        axes contribute < s to the base, outer axes multiples of the
+        span), so the per-axis level is ``_axis_level(s*f)`` and the
+        collective pays the worst axis.  ``retained[slot]`` is the
+        degree the destination keeps on that slot — its size-matched
+        axes are excluded (only the vanished axes move).  None =
+        assignment failed."""
         dph = self.machine.devices_per_host
         if (self.num_devices or self.machine.num_devices) <= dph:
-            return False
+            return 0
         axes = self._slot_axes(tuple(slot_degrees))
         if axes is None:
             return None
         retained = retained or {}
+        level = 0
         for slot in active_slots:
             ax = axes[slot]
             if slot in retained:
                 ax = self._vanished_axes(ax, retained[slot])
             for stride, size in ax:
-                span = stride * size
-                if span > dph or dph % span != 0:
-                    return True
-        return False
+                level = max(level, self._axis_level(stride * size))
+        return level
 
     def _net_groups(self, n: int) -> Optional[list]:
         """Candidate device groups for an n-way collective on the torus.
@@ -274,23 +326,49 @@ class CostModel:
         )
 
     # ---- collectives -----------------------------------------------------
-    def _crosses(self, n: int, spans_dcn: Optional[bool]) -> bool:
-        """Does an n-way collective ride DCN?  Axis-aware when the
-        caller resolved it (spans_dcn), size heuristic otherwise."""
+    def _crosses(self, n: int, spans_dcn: Optional[int]) -> int:
+        """The deepest link level an n-way collective rides (0 = pure
+        ICI).  Axis-aware when the caller resolved it (``spans_dcn``,
+        the level from ``_spans_dcn`` — legacy bool True maps to the
+        deepest level), size heuristic otherwise."""
         if spans_dcn is not None:
-            return spans_dcn
-        return n > self.machine.devices_per_host
+            if spans_dcn is True:  # legacy callers/tests pass a bool
+                return len(self.levels()) - 1
+            return int(spans_dcn)
+        if n > self.machine.devices_per_host:
+            return len(self.levels()) - 1
+        return 0
 
     def _link_time(
-        self, bytes_per_device: float, n: int, spans_dcn: Optional[bool] = None
+        self, bytes_per_device: float, n: int, spans_dcn: Optional[int] = None
     ) -> Tuple[float, float]:
-        """(ici seconds, dcn seconds) for moving bytes once around a ring
-        of n devices; adds a DCN term when the ring spans ICI domains."""
+        """(ici seconds, cross-level seconds) for moving bytes once
+        around a ring of n devices; a ring crossing level k adds one
+        term per traversed DCN class 1..k (the classic two-level
+        machine keeps its single historical DCN term bit-identically)."""
         ici = bytes_per_device / self.machine.ici_bandwidth
         dcn = 0.0
-        if self._crosses(n, spans_dcn):
-            dcn = bytes_per_device / self.machine.dcn_bandwidth
+        crossed = self._crosses(n, spans_dcn)
+        if crossed:
+            levels = self.levels()
+            for i in range(1, crossed + 1):
+                dcn += bytes_per_device / levels[i].bandwidth
         return ici, dcn
+
+    def _cross_time(
+        self, nbytes: float, n: int, spans_dcn: Optional[int]
+    ) -> float:
+        """Seconds per byte-unit across the traversed DCN classes (one
+        term per level 1..crossed; 0 when the collective stays on ICI).
+        The DCN add-on of the network-routed collective paths."""
+        crossed = self._crosses(n, spans_dcn)
+        if not crossed:
+            return 0.0
+        t = 0.0
+        levels = self.levels()
+        for i in range(1, crossed + 1):
+            t += nbytes / levels[i].bandwidth
+        return t
 
     def allreduce(
         self, nbytes: float, n: int, spans_dcn: Optional[bool] = None,
@@ -310,8 +388,7 @@ class CostModel:
                 "ar", n, wire,
                 lambda: max(self.network.ring_allreduce_time(g, wire)
                             for g in groups))
-            if self._crosses(n, spans_dcn):
-                t += 2.0 * (n - 1) / n * wire / self.machine.dcn_bandwidth
+            t += 2.0 * (n - 1) / n * self._cross_time(wire, n, spans_dcn)
             return t + extra
         ici, dcn = self._link_time(2.0 * (n - 1) / n * wire, n, spans_dcn)
         return ici + dcn + 2 * (n - 1) * self.machine.ici_latency + extra
@@ -329,8 +406,7 @@ class CostModel:
                 "ag", n, wire,
                 lambda: max(self.network.allgather_time(g, wire)
                             for g in groups))
-            if self._crosses(n, spans_dcn):
-                t += (n - 1) * wire / self.machine.dcn_bandwidth
+            t += (n - 1) * self._cross_time(wire, n, spans_dcn)
             return t
         ici, dcn = self._link_time((n - 1) * wire, n, spans_dcn)
         return ici + dcn + (n - 1) * self.machine.ici_latency
@@ -358,8 +434,7 @@ class CostModel:
                 "a2a", n, nbytes_shard,
                 lambda: max(self.network.all_to_all_time(g, nbytes_shard)
                             for g in groups))
-            if self._crosses(n, spans_dcn):
-                t += nbytes_shard * (n - 1) / n / self.machine.dcn_bandwidth
+            t += (n - 1) / n * self._cross_time(nbytes_shard, n, spans_dcn)
             return t
         # each device exchanges (n-1)/n of its shard; ICI torus is
         # dimension-ordered so add a hop-count factor ~sqrt(n)/2
@@ -585,7 +660,9 @@ class CostModel:
             total += self.allreduce(nbytes, replica, spans, precision=p)
         return total
 
-    def bucket_sync_cost(self, parts: list, precision: str = "fp32") -> float:
+    def bucket_sync_cost(self, parts: list, precision: str = "fp32",
+                         plan=None, level_acc: Optional[dict] = None,
+                         ) -> float:
         """Seconds for ONE coalesced sync bucket: every weight part
         sharing a replication-axes signature (the group key from
         ``weight_sync_parts``) and effective wire precision rides a
@@ -599,7 +676,16 @@ class CostModel:
         granularity matched to the executed one, so mixed-sharding
         buckets never get credited fewer latency floors than execution
         pays.  Sub-floor weights inside a compressed bucket keep fp32,
-        exactly as ``weight_sync_cost``/``quantized_grad_sync`` do."""
+        exactly as ``weight_sync_cost``/``quantized_grad_sync`` do.
+
+        ``plan`` — a staged reduction plan (search/reduction_plan.py):
+        groups whose replication spans a link-level boundary are then
+        priced as the staged hierarchy (``staged_sync_cost``) at the
+        plan's per-level wire precisions instead of one flat ring; a
+        sub-floor (fp32-forced) group stays fp32 at every level.  With
+        ``plan=None`` the pricing is unchanged — the flat bit-identical
+        baseline.  ``level_acc`` accumulates per-link-level seconds
+        (the ICI-vs-DCN lanes of the simulator breakdown)."""
         groups: Dict[Tuple, float] = {}
         for nbytes, replica, spans, n, key in parts:
             if replica <= 1:
@@ -610,9 +696,133 @@ class CostModel:
             gk = (replica, spans, p, key)
             groups[gk] = groups.get(gk, 0.0) + nbytes
         total = 0.0
-        for (replica, spans, p, _key), nbytes in groups.items():
-            total += self.allreduce(nbytes, replica, spans, precision=p)
+        for (replica, spans, p, key), nbytes in groups.items():
+            if plan is not None and spans:
+                factors = self.replica_level_split(key, replica)
+                deepest = 0 if factors is None else max(
+                    (i for i, f in enumerate(factors) if f > 1), default=0)
+                # stage only when the plan reaches EXACTLY the deepest
+                # level this group spans (the SHD131 legality rule);
+                # a mismatched plan would otherwise be priced with
+                # compressed RS/AG stages or a flat-rated cross stage —
+                # a shape the executor never runs
+                if deepest > 0 and plan.cross_level == deepest:
+                    precs = tuple(
+                        sp if p != "fp32" else "fp32"
+                        for sp in plan.level_precisions)
+                    total += self.staged_sync_cost(
+                        nbytes, factors, precs, level_acc)
+                    continue
+            t = self.allreduce(nbytes, replica, spans, precision=p)
+            total += t
+            if level_acc is not None:
+                _merge_levels(level_acc, self.allreduce_level_split(
+                    nbytes, replica, spans, p, total=t))
         return total
+
+    # ---- hierarchical (staged) reduction pricing -------------------------
+    def replica_level_split(self, key, replica: int):
+        """Per-level group factors of one fused sync group: how the
+        replica-allreduce of a weight part (the group key from
+        ``weight_sync_parts``) decomposes over the link hierarchy —
+        ``factors[0]`` devices within a slice x ``factors[1]`` slice
+        groups at DCN level 1 x ...; the product equals ``replica``.
+        None when the slot→axis assignment fails or does not reproduce
+        the replica factor (callers fall back to flat pricing)."""
+        slot_degrees, active = key
+        axes = self._slot_axes(tuple(slot_degrees))
+        if axes is None:
+            return None
+        factors = [1] * len(self.levels())
+        for slot in active:
+            for stride, size in axes[slot]:
+                factors[self._axis_level(stride * size)] *= size
+        p = 1
+        for f in factors:
+            p *= f
+        if p != replica:
+            return None
+        return tuple(factors)
+
+    def staged_sync_cost(self, nbytes: float, factors: Tuple[int, ...],
+                         precisions: Tuple[str, ...],
+                         level_acc: Optional[dict] = None) -> float:
+        """Hierarchical allreduce over the level split ``factors``:
+        reduce-scatter within each level-0 group, recursively allreduce
+        the 1/f0 shard across the coarser levels, then all-gather
+        within the group (the staged shape of arXiv:2110.10548; XLA's
+        own multislice allreduce).  The cross-level traffic shrinks by
+        the within-level factor — THE hierarchical win the flat ring
+        never earns.  ``precisions[i]`` is the wire precision of the
+        level-i stage (the RS/AG pair below the deepest level, the
+        middle allreduce at it); per-level precision is how int8-over-
+        DCN composes with fp32-over-ICI."""
+        levels = self.levels()
+
+        def go(nb: float, li: int) -> float:
+            k = factors[li]
+            deeper = any(f > 1 for f in factors[li + 1:])
+            prec = precisions[li] if li < len(precisions) else "fp32"
+            if not deeper:
+                t = self.allreduce(nb, k, li, precision=prec)
+                if level_acc is not None and k > 1:
+                    _merge_levels(level_acc, self.allreduce_level_split(
+                        nb, k, li, prec, total=t))
+                return t
+            t = 0.0
+            if k > 1:
+                rs = self.reducescatter(nb, k, li, prec)
+                ag = self.allgather(nb / k, k, li, prec)
+                t += rs + ag
+                if level_acc is not None:
+                    _merge_levels(
+                        level_acc, {levels[li].name: rs + ag})
+                nb = nb / k
+            return t + go(nb, li + 1)
+
+        return go(nbytes, 0)
+
+    def allreduce_level_split(
+        self, nbytes: float, n: int, spans_dcn: Optional[int] = None,
+        precision: Optional[str] = None, total: Optional[float] = None,
+    ) -> Dict[str, float]:
+        """``allreduce(...)`` decomposed per link level (the predicted
+        ICI-vs-DCN lanes): each traversed DCN class gets its ring-bytes
+        term, level 0 the remainder (ici wire + latency + quantize
+        overhead) — the split sums exactly to the scalar cost."""
+        if total is None:
+            total = self.allreduce(nbytes, n, spans_dcn, precision)
+        if n <= 1 or not math.isfinite(total):
+            return {}
+        levels = self.levels()
+        crossed = self._crosses(n, spans_dcn)
+        wire = nbytes * self._wire_scale(precision)
+        split: Dict[str, float] = {}
+        acc = 0.0
+        for i in range(1, crossed + 1):
+            t = 2.0 * (n - 1) / n * wire / levels[i].bandwidth
+            split[levels[i].name] = split.get(levels[i].name, 0.0) + t
+            acc += t
+        split[levels[0].name] = max(0.0, total - acc)
+        return split
+
+    def sync_levels(self, op: Operator, mv: MachineView) -> Dict[str, float]:
+        """Per-link-level seconds of one (op, view)'s weight sync at the
+        mode-selected wire precision — the per-level predicted comm rows
+        the DriftReport renders (drift on the slow DCN class visible
+        separately from intra-slice drift)."""
+        parts = self.weight_sync_parts(op, mv)
+        if not parts:
+            return {}
+        prec = self.sync_precision_choice(op, mv)[0]
+        out: Dict[str, float] = {}
+        for nbytes, replica, spans, n, _key in parts:
+            p = prec
+            if p != "fp32" and n < _min_compress_elems():
+                p = "fp32"
+            _merge_levels(out, self.allreduce_level_split(
+                nbytes, replica, spans, p))
+        return out
 
     # the search compresses a group's sync only where the allreduce
     # actually DOMINATES: fp32 sync must exceed this fraction of the

@@ -469,9 +469,11 @@ class Simulator:
                          tuple(sorted(comm_devs))))
 
         sync_buckets: Optional[list] = None
+        sync_levels: Optional[dict] = None
         if sched is not None:
-            end_comm, sync_total, sync_buckets = self._scheduled_sync(
-                sched, node_rows, end_time, comm_avail, comm_schedule)
+            end_comm, sync_total, sync_buckets, sync_levels = \
+                self._scheduled_sync(
+                    sched, node_rows, end_time, comm_avail, comm_schedule)
 
         peak = mem_total if scalar else max(mem.values())
         total = max(end_time, end_comm)
@@ -498,6 +500,21 @@ class Simulator:
             )
             if sync_buckets is not None:
                 breakdown["sync_buckets"] = sync_buckets
+            # per-link-level sync seconds (ICI vs DCN lanes) — from the
+            # scheduled buckets when a schedule priced them, otherwise
+            # re-derived per synced node (track mode only: the split is
+            # not on the search's hot path)
+            if sync_levels is None:
+                sync_levels = {}
+                for node in topo:
+                    mv, _osh = shardings[node.guid]
+                    if include_update:
+                        for name, t in self.cost.sync_levels(
+                                node.op, mv).items():
+                            sync_levels[name] = sync_levels.get(
+                                name, 0.0) + t
+            if sync_levels:
+                breakdown["sync_levels_s"] = sync_levels
         if oom:
             return math.inf
         return total
@@ -516,7 +533,8 @@ class Simulator:
         their device groups' comm lanes in schedule order; synced
         groups the schedule does not cover issue after the full
         backward (the monolithic behavior execution gives them).
-        Returns (end_comm, sync_total, per-bucket breakdown rows)."""
+        Returns (end_comm, sync_total, per-bucket breakdown rows,
+        per-link-level seconds aggregate)."""
         pos = {node.guid: i for i, (node, *_r) in enumerate(node_rows)}
         bwd_prefix = [0.0] * (len(node_rows) + 1)
         for i, (_n, _mv, fwd, dur, _s) in enumerate(node_rows):
@@ -527,6 +545,7 @@ class Simulator:
         sync_total = 0.0
         rows = []
         covered = set()
+        level_tot: dict = {}
         for bucket in getattr(sync_schedule, "buckets", sync_schedule):
             members = [by_name[nm] for nm in bucket.ops if nm in by_name]
             if not members:
@@ -541,8 +560,10 @@ class Simulator:
                     parts.extend(got)
                     devs |= self.view_device_set(mv, use_start=False)
                     min_pos = min(min_pos, pos[node.guid])
+            levels: dict = {}
             cost = self.cost.bucket_sync_cost(
-                parts, getattr(bucket, "precision", "fp32"))
+                parts, getattr(bucket, "precision", "fp32"),
+                plan=getattr(bucket, "plan", None), level_acc=levels)
             if cost <= 0.0 or not devs:
                 continue
             ready = end_time - bwd_prefix[min_pos]
@@ -560,15 +581,22 @@ class Simulator:
                 comm_schedule.append(
                     (f"bucket:{bucket.name}:sync", s, f,
                      tuple(sorted(devs))))
+            plan = getattr(bucket, "plan", None)
             rows.append({
                 "name": bucket.name,
                 "ops": list(bucket.ops),
                 "precision": getattr(bucket, "precision", "fp32"),
+                "plan": plan.name if plan is not None else None,
                 "ready_s": ready,
                 "start_s": s,
                 "finish_s": f,
                 "sync_s": cost,
+                # per-link-level lanes (ICI vs DCN classes): drift on
+                # the slow cross-slice links visible separately
+                "levels": levels,
             })
+            for name, t in levels.items():
+                level_tot[name] = level_tot.get(name, 0.0) + t
         # uncovered synced groups: the executed _sync_grads leaves them
         # on the post-backward monolithic path — price them there (the
         # legality lint flags the coverage hole; pricing must not hide
@@ -587,6 +615,8 @@ class Simulator:
             if f > end_comm:
                 end_comm = f
             sync_total += sync
+            for name, t in self.cost.sync_levels(node.op, mv).items():
+                level_tot[name] = level_tot.get(name, 0.0) + t
             if comm_schedule is not None:
                 comm_schedule.append(
                     (f"{node.op.name}:sync", s, f, tuple(sorted(devs))))
@@ -595,7 +625,7 @@ class Simulator:
         for r in rows:
             r["exposed_s"] = max(0.0, r["finish_s"]
                                  - max(r["start_s"], end_time))
-        return end_comm, sync_total, rows
+        return end_comm, sync_total, rows, level_tot
 
     # ---- delta simulation (reference: simulator.h SIMULATE_DELTA) ----
     def set_baseline(self, graph: Graph,

@@ -54,11 +54,17 @@ DEFAULT_BUCKET_BYTES = (1 << 20, 4 << 20, 16 << 20)
 @dataclass(frozen=True)
 class SyncBucket:
     """One fused gradient-sync collective: the named weight groups'
-    grads flatten into a single wire payload at ``precision``."""
+    grads flatten into a single wire payload at ``precision``.
+    ``plan`` — an optional staged reduction plan for hierarchical
+    topologies (search/reduction_plan.py): the bucket's cross-slice
+    traffic then rides the staged RS/AR/AG shape at per-level wire
+    precision instead of one flat ring; None keeps the flat collective
+    (always the case on single-level machines)."""
 
     name: str
     ops: Tuple[str, ...]
     precision: str = "fp32"
+    plan: Optional[object] = None  # reduction_plan.ReductionPlan
 
 
 @dataclass
@@ -79,13 +85,16 @@ class SyncSchedule:
         return out
 
     def to_jsonable(self) -> dict:
+        out = []
+        for b in self.buckets:
+            d = {"name": b.name, "ops": list(b.ops),
+                 "precision": b.precision}
+            if b.plan is not None:
+                d["plan"] = b.plan.to_jsonable()
+            out.append(d)
         return {
             "schema": SCHEDULE_SCHEMA,
-            "buckets": [
-                {"name": b.name, "ops": list(b.ops),
-                 "precision": b.precision}
-                for b in self.buckets
-            ],
+            "buckets": out,
             **({"meta": dict(self.meta)} if self.meta else {}),
         }
 
@@ -120,8 +129,18 @@ class SyncSchedule:
             name = b.get("name")
             if not isinstance(name, str) or not name:
                 raise ValueError(f"buckets[{i}] has no name")
+            plan = None
+            if b.get("plan") is not None:
+                from flexflow_tpu.search.reduction_plan import ReductionPlan
+
+                try:
+                    plan = ReductionPlan.from_jsonable(b["plan"])
+                except ValueError as e:
+                    raise ValueError(
+                        f"buckets[{i}] carries a malformed reduction "
+                        f"plan: {e}") from e
             buckets.append(SyncBucket(name=name, ops=tuple(ops),
-                                      precision=prec))
+                                      precision=prec, plan=plan))
         meta = data.get("meta")
         return SyncSchedule(buckets, dict(meta) if isinstance(meta, dict)
                             else {})
@@ -188,11 +207,14 @@ def build_bucketed_schedule(
     return SyncSchedule(buckets)
 
 
-def lint_gate(graph, strategy, schedule, precision_map=None) -> None:
+def lint_gate(graph, strategy, schedule, precision_map=None,
+              cost_model=None) -> None:
     """Always-on legality gate on a schedule THIS tree produced: an
     error finding here is a builder bug, not a user error — fail loudly
     before the artifact is persisted or executed (same discipline as
-    ``optimize_strategy``'s strategy gate)."""
+    ``optimize_strategy``'s strategy gate).  With a ``cost_model`` the
+    per-bucket reduction plans are gated too (SHD13x — level coverage,
+    group/slice coherence, precision-per-level validity)."""
     from flexflow_tpu.analysis import (
         AnalysisError,
         emit_findings,
@@ -200,8 +222,13 @@ def lint_gate(graph, strategy, schedule, precision_map=None) -> None:
         lint_sync_schedule,
     )
 
-    bad = errors_only(
-        lint_sync_schedule(graph, strategy, schedule, precision_map))
+    findings = lint_sync_schedule(graph, strategy, schedule, precision_map)
+    if cost_model is not None:
+        from flexflow_tpu.analysis import lint_reduction_plan
+
+        findings = findings + lint_reduction_plan(
+            graph, strategy, schedule, cost_model)
+    bad = errors_only(findings)
     if bad:
         emit_findings(bad)
         raise AnalysisError(
@@ -222,11 +249,22 @@ def choose_sync_schedule(
     ``info`` records the comparison for telemetry/bench.  ``sim`` must
     be the Simulator the search ranked with, so the schedule is chosen
     in the same cost currency the strategy was.  The returned schedule
-    has passed the always-on legality gate (``lint_gate``)."""
-    info: Dict = {"monolithic_s": None, "scheduled_s": None, "buckets": 0}
+    has passed the always-on legality gate (``lint_gate``).
+
+    On a hierarchical machine (MachineSpec.topology_levels > 1) the
+    search gains the REDUCTION-PLAN dimension: every candidate (the
+    monolithic baseline included) is also priced with per-bucket
+    staged plans (search/reduction_plan.py — RS within slice, small
+    cross-slice exchange at per-level wire precision, AG within slice)
+    and the staged variant is adopted only when it beats the flat
+    plan.  Flat single-level machines enumerate no plans, so their
+    choice is bit-identical to the plan-free search."""
+    info: Dict = {"monolithic_s": None, "scheduled_s": None, "buckets": 0,
+                  "staged_buckets": 0}
     synced = synced_weight_groups(graph, strategy, sim.cost)
-    if len(synced) < 2:
-        return None, info  # nothing to order or coalesce
+    multi_level = len(sim.cost.levels()) > 1
+    if not synced or (len(synced) < 2 and not multi_level):
+        return None, info  # nothing to order, coalesce, or stage
     pmap = dict(precision_map or {})
     mono = build_bucketed_schedule(synced, pmap, math.inf)
     base = sim.simulate(graph, strategy, sync_schedule=mono)
@@ -260,6 +298,35 @@ def choose_sync_schedule(
         if c < best[1]:
             cand.meta = {"bucket_bytes": th}
             best = (cand, c)
+
+    # ---- reduction-plan dimension (hierarchical topologies only) ----
+    # the flat-winner AND the monolithic baseline both get a staged
+    # variant priced; a staged plan is adopted only when its simulated
+    # step beats everything flat (single-level machines enumerate no
+    # plans, so this is a no-op there — bit-identical flat behavior)
+    if multi_level:
+        from flexflow_tpu.search.reduction_plan import (
+            assign_reduction_plans,
+        )
+
+        plan_candidates = [mono]
+        if best[0] is not None:
+            plan_candidates.append(best[0])
+        for cand in plan_candidates:
+            aug, ainfo = assign_reduction_plans(cand, synced, sim.cost)
+            if aug is None:
+                continue
+            c = sim.simulate(graph, strategy, sync_schedule=aug)
+            if c < best[1]:
+                aug.meta.update(cand.meta)
+                aug.meta["reduction_plans"] = {
+                    b.name: b.plan.name for b in aug.buckets
+                    if b.plan is not None}
+                best = (aug, c)
+                info["staged_buckets"] = ainfo["staged_buckets"]
+                info["flat_sync_s"] = ainfo["flat_sync_s"]
+                info["planned_sync_s"] = ainfo["planned_sync_s"]
+
     schedule, cost = best
     if schedule is None:
         return None, info  # scheduled_s stays None: monolithic stands
@@ -267,5 +334,5 @@ def choose_sync_schedule(
     info["buckets"] = len(schedule.buckets)
     schedule.meta.update(
         predicted_monolithic_s=base, predicted_scheduled_s=cost)
-    lint_gate(graph, strategy, schedule, pmap)
+    lint_gate(graph, strategy, schedule, pmap, cost_model=sim.cost)
     return schedule, info

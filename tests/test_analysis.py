@@ -222,6 +222,170 @@ def test_clean_strategy_has_no_findings():
 
 
 # ---------------------------------------------------------------------------
+# reduction-plan mutations (SHD13x + STR206): seeded corruptions of the
+# staged hierarchical plans, each caught with its code
+
+
+def _two_slice_cm(n=8, gap=10.0):
+    import dataclasses
+
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.machine_model import CostModel
+
+    base = MachineSpec.tpu_v5e(n)
+    spec = dataclasses.replace(
+        base, devices_per_host=n // 2,
+        dcn_bandwidth=base.ici_bandwidth / gap)
+    return CostModel(spec, num_devices=n)
+
+
+def _planned_schedule(m, s, cm, precision="fp32", cross_precision=None):
+    import math
+
+    from flexflow_tpu.search.reduction_plan import (
+        ReductionPlan,
+        canonical_stages,
+    )
+    from flexflow_tpu.search.sync_schedule import (
+        build_bucketed_schedule,
+        synced_weight_groups,
+    )
+
+    synced = synced_weight_groups(m.graph, s, cm)
+    pmap = {node.op.name: precision for node, _mv, _parts in synced}
+    sched = build_bucketed_schedule(synced, pmap, math.inf)
+    plan = ReductionPlan(
+        "staged_l1", canonical_stages(1, cross_precision or precision))
+    import dataclasses
+
+    buckets = [dataclasses.replace(b, plan=plan) for b in sched.buckets]
+    from flexflow_tpu.search.sync_schedule import SyncSchedule
+
+    return SyncSchedule(buckets, dict(sched.meta))
+
+
+def test_clean_reduction_plan_has_no_findings():
+    from flexflow_tpu.analysis import lint_reduction_plan
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    sched = _planned_schedule(m, s, cm)
+    assert lint_reduction_plan(m.graph, s, sched, cm) == []
+
+
+def test_mutation_noncanonical_stages_shd130():
+    import dataclasses
+
+    from flexflow_tpu.analysis import lint_reduction_plan
+    from flexflow_tpu.search.reduction_plan import ReductionPlan
+    from flexflow_tpu.search.sync_schedule import SyncSchedule
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    sched = _planned_schedule(m, s, cm)
+    # drop the trailing all_gather: the bracketing is broken
+    b = sched.buckets[0]
+    broken = ReductionPlan("x", b.plan.stages[:-1])
+    mut = SyncSchedule([dataclasses.replace(b, plan=broken)])
+    assert "SHD130" in codes(lint_reduction_plan(m.graph, s, mut, cm))
+
+
+def test_mutation_level_coverage_shd131():
+    import dataclasses
+
+    from flexflow_tpu.analysis import lint_reduction_plan
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.machine_model import CostModel
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    # 3-level machine: DP-8 groups span level 2, but the plan stops at 1
+    spec3 = dataclasses.replace(
+        MachineSpec.tpu_v5e(8), devices_per_host=2,
+        slice_levels=((4, 5e9, 5e-6), (8, 1e9, 2e-5)))
+    cm3 = CostModel(spec3, num_devices=8)
+    sched = _planned_schedule(m, s, cm3)
+    assert "SHD131" in codes(lint_reduction_plan(m.graph, s, sched, cm3))
+
+
+def test_mutation_no_spanning_group_shd132():
+    import dataclasses
+
+    from flexflow_tpu.analysis import lint_reduction_plan
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.machine_model import CostModel
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    sched = _planned_schedule(m, s, cm)
+    # 12-device 2-slice machine: the strategy's power-of-two replica
+    # degrees do not factor into the (2, 2, 3) axis pool, so no group
+    # provably crosses the slice boundary — the plan has no wire to ride
+    spec12 = dataclasses.replace(
+        MachineSpec.tpu_v5e(12), devices_per_host=4)
+    cm12 = CostModel(spec12, num_devices=12)
+    assert "SHD132" in codes(lint_reduction_plan(m.graph, s, sched, cm12))
+
+
+def test_mutation_precision_contradiction_shd133():
+    from flexflow_tpu.analysis import lint_reduction_plan
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    # int8 cross stage on an fp32 bucket contradicts the precision map
+    sched = _planned_schedule(m, s, cm, precision="fp32",
+                              cross_precision="int8")
+    assert "SHD133" in codes(lint_reduction_plan(m.graph, s, sched, cm))
+
+
+def test_fflint_persisted_plan_str206(tmp_path):
+    """Stdlib-only seeded corruptions of a persisted reduction plan:
+    each malformation exits 1 with STR206."""
+    from tools.fflint import main
+
+    from flexflow_tpu.search.strategy_io import attach_meta, export_strategy
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    sched = _planned_schedule(m, s, cm)
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, s)
+    attach_meta(p, sync_schedule=sched.to_jsonable())
+    assert main(["strategy", p]) == 0
+    with open(p) as f:
+        clean = json.load(f)
+
+    def corrupted(mutate):
+        data = json.loads(json.dumps(clean))
+        plan = data["__meta__"]["sync_schedule"]["buckets"][0]["plan"]
+        mutate(plan)
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump(data, f)
+        return main(["strategy", bad])
+
+    # unknown stage kind / negative level / unknown precision /
+    # compressed RS stage / two cross allreduces: all STR206
+    assert corrupted(
+        lambda pl: pl["stages"][0].update(kind="teleport")) == 1
+    assert corrupted(
+        lambda pl: pl["stages"][0].update(level=-1)) == 1
+    assert corrupted(
+        lambda pl: pl["stages"][1].update(precision="fp8")) == 1
+    assert corrupted(
+        lambda pl: pl["stages"][0].update(precision="int8")) == 1
+    assert corrupted(
+        lambda pl: pl["stages"].append(
+            dict(kind="allreduce", level=1, precision="fp32"))) == 1
+    assert corrupted(lambda pl: pl.pop("stages")) == 1
+
+
+# ---------------------------------------------------------------------------
 # substitution soundness: the registry's executable proof + the
 # unconditional invariant run over every rewrite
 

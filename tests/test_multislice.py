@@ -105,10 +105,12 @@ def test_cost_model_uses_search_device_count():
     one full slice, pure ICI."""
     big = dataclasses.replace(MachineSpec.tpu_v5e(16), devices_per_host=8)
     cm = CostModel(big, num_devices=8)
-    assert cm._spans_dcn((2, 1, 1), [0]) is False
+    # the classifier returns the crossed link LEVEL (0 = within-slice,
+    # falsy — the historical False)
+    assert cm._spans_dcn((2, 1, 1), [0]) == 0
     # the same view searched over all 16 chips crosses slices
     cm16 = CostModel(big)
-    assert cm16._spans_dcn((2, 1, 1), [0]) is True
+    assert cm16._spans_dcn((2, 1, 1), [0]) == 1
 
 
 def test_mixed_prime_combine_matches_retained_axes_by_size():

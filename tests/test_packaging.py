@@ -72,3 +72,59 @@ def test_package_smoke_import():
                        env=env)
     assert r.returncode == 0, r.stderr[-1500:]
     assert "ok" in r.stdout
+
+
+def test_explicit_spmd_imports_shard_map_from_compat():
+    """ROADMAP carry-over rule, now a guard: every explicit-SPMD module
+    must import shard_map from flexflow_tpu/comm/compat.py (the one
+    place the jax version drift — jax.shard_map/check_vma vs
+    jax.experimental.shard_map/check_rep — is absorbed), never from
+    jax directly.  A direct import works on one jax and breaks on the
+    other, exactly the drift the compat shim exists to kill."""
+    import ast
+
+    pkg = os.path.join(REPO, "flexflow_tpu")
+    allow = {os.path.join("comm", "compat.py")}  # the shim itself
+    bad = []
+
+    def _attr_path(node):
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        return ".".join(reversed(parts))
+
+    for root, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, pkg)
+            if rel in allow:
+                continue
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=rel)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    if mod.split(".")[0] == "jax" and any(
+                            a.name == "shard_map" for a in node.names):
+                        bad.append(f"{rel}:{node.lineno}: "
+                                   f"from {mod} import shard_map")
+                elif isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name.startswith("jax") and \
+                                a.name.endswith("shard_map"):
+                            bad.append(f"{rel}:{node.lineno}: "
+                                       f"import {a.name}")
+                elif isinstance(node, ast.Attribute):
+                    dotted = _attr_path(node)
+                    if dotted in ("jax.shard_map",
+                                  "jax.experimental.shard_map",
+                                  "jax.experimental.shard_map.shard_map"):
+                        bad.append(f"{rel}:{node.lineno}: {dotted}")
+    assert not bad, (
+        "explicit-SPMD modules must import shard_map from "
+        "flexflow_tpu.comm.compat, not jax directly:\n" + "\n".join(bad))

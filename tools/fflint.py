@@ -154,6 +154,59 @@ def _lint_sync_schedule_meta(sched) -> List[Tuple[str, str, str]]:
                             f"sync_schedule covers op {o!r} twice — its "
                             f"gradient would sync twice"))
             seen_ops.add(o)
+        if b.get("plan") is not None:
+            out += _lint_reduction_plan_meta(b["plan"], i)
+    return out
+
+
+_PLAN_STAGE_KINDS = ("reduce_scatter", "allreduce", "all_gather")
+# mirrors search/reduction_plan.STAGE_KINDS (stdlib path)
+
+
+def _lint_reduction_plan_meta(plan, bi: int) -> List[Tuple[str, str, str]]:
+    """STR206: structural lint of a persisted per-bucket reduction plan
+    (the staged hierarchical comm shape, search/reduction_plan.py).
+    Machine-side legality (level coverage vs the topology the groups
+    span — SHD13x) needs the graph + machine model and runs at
+    import/compile time."""
+    where = f"sync_schedule buckets[{bi}] plan"
+    out: List[Tuple[str, str, str]] = []
+    if not isinstance(plan, dict):
+        return [("error", "STR206", f"{where} is not an object")]
+    if not isinstance(plan.get("name"), str) or not plan.get("name"):
+        out.append(("error", "STR206", f"{where} has no name"))
+    stages = plan.get("stages")
+    if not isinstance(stages, list) or not stages:
+        return out + [("error", "STR206", f"{where} has no stages")]
+    ar_levels = []
+    for j, s in enumerate(stages):
+        if not isinstance(s, dict):
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] is not an object"))
+            continue
+        kind = s.get("kind")
+        if kind not in _PLAN_STAGE_KINDS:
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] kind {kind!r} unknown "
+                        f"(known: {list(_PLAN_STAGE_KINDS)})"))
+        level = s.get("level")
+        if not isinstance(level, int) or level < 0:
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] malformed level {level!r}"))
+        prec = s.get("precision", "fp32")
+        if prec not in _BUCKET_PRECISIONS:
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] precision {prec!r} unknown"))
+        elif kind != "allreduce" and prec != "fp32":
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] compresses a {kind} stage "
+                        f"— only the cross-level allreduce may"))
+        if kind == "allreduce":
+            ar_levels.append(level)
+    if len(ar_levels) != 1:
+        out.append(("error", "STR206",
+                    f"{where} must have exactly one cross-level "
+                    f"allreduce stage (found {len(ar_levels)})"))
     return out
 
 

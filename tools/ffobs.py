@@ -288,16 +288,21 @@ def render_report(events: List[dict], top: int = 10,
                 "verified by the scheduled-vs-monolithic measured step "
                 "delta, not per-bucket host timers):")
             lines.append(
-                "| bucket | groups | precision | issue-ready ms | "
-                "sync ms | exposed ms |")
-            lines.append("|---|---|---|---|---|---|")
+                "| bucket | groups | precision | plan | issue-ready ms | "
+                "sync ms | exposed ms | per-level ms |")
+            lines.append("|---|---|---|---|---|---|---|---|")
             for b in buckets:
+                lv = b.get("predicted_levels_s") or {}
+                lv_cell = " ".join(
+                    f"{k}={_ms(v)}" for k, v in lv.items()) or "—"
                 lines.append(
                     f"| {b.get('name')} | {b.get('ops')} | "
                     f"{b.get('precision')} | "
+                    f"{b.get('plan') or 'flat'} | "
                     f"{_ms(b.get('predicted_ready_s'))} | "
                     f"{_ms(b.get('predicted_sync_s'))} | "
-                    f"{_ms(b.get('predicted_exposed_s'))} |")
+                    f"{_ms(b.get('predicted_exposed_s'))} | "
+                    f"{lv_cell} |")
         # only the aggregate step has both sides (single-sided phases
         # carry no ratio by design); rank the measured host phases by
         # their share of the step instead to point at where time went

@@ -15,6 +15,26 @@ from typing import Dict, Optional, Sequence, Tuple
 from flexflow_tpu.core.machine import MachineSpec
 
 
+def parse_slice_levels(value) -> Tuple[Tuple[int, float, float], ...]:
+    """Normalize a slice-level hierarchy: the CLI spelling
+    ``"span:bw:lat[,span:bw:lat...]"`` or an iterable of (span,
+    bandwidth, latency) triples -> MachineSpec.slice_levels tuples.
+    Structural validation (ascending aligned spans) stays in
+    MachineSpec.topology_levels(), the one reader."""
+    if isinstance(value, str):
+        levels = []
+        for part in value.split(","):
+            fields = part.split(":")
+            if len(fields) != 3:
+                raise ValueError(
+                    f"slice level {part!r} must be span:bandwidth:latency")
+            levels.append(
+                (int(fields[0]), float(fields[1]), float(fields[2])))
+        return tuple(levels)
+    return tuple(
+        (int(span), float(bw), float(lat)) for span, bw, lat in value)
+
+
 @dataclass
 class IterationConfig:
     """Per-iteration knobs threaded into forward/backward
@@ -34,6 +54,13 @@ class FFConfig:
     num_devices: int = 0  # 0 = all visible jax devices
     machine_spec: Optional[MachineSpec] = None
     machine_model_file: Optional[str] = None
+    slice_levels: Optional[object] = None  # multi-slice link hierarchy
+    # above ICI (MachineSpec.slice_levels, PR 6) without writing a
+    # machine file: a tuple of (span, bandwidth, latency) tuples, or
+    # the CLI spelling "span:bw:lat[,span:bw:lat...]"
+    # (--slice-levels).  Applied on top of whichever machine_spec /
+    # machine_model_file resolves, the way --machine-model-file itself
+    # layers over the default spec.
     # parallelization search (reference: config.h:116-157; the osdi22ae
     # scripts run with budgets 10-30)
     search_budget: int = 16
@@ -189,6 +216,16 @@ class FFConfig:
                 self.machine_spec = MachineSpec.from_file(self.machine_model_file)
             else:
                 self.machine_spec = MachineSpec.tpu_v5e(self.num_devices)
+        if self.slice_levels:
+            import dataclasses as _dc
+
+            levels = parse_slice_levels(self.slice_levels)
+            self.machine_spec = _dc.replace(
+                self.machine_spec, slice_levels=levels)
+            # fail at construction, not mid-search: topology_levels()
+            # validates the aligned-nesting rules
+            self.machine_spec.topology_levels()
+            self.slice_levels = levels
 
     @property
     def search_devices(self) -> int:
@@ -237,6 +274,14 @@ class FFConfig:
                             "its graph digest/coverage does not match "
                             "(provenance checks downgrade to warnings)")
         p.add_argument("--machine-model-file", type=str, default=None)
+        p.add_argument("--slice-levels", dest="slice_levels", type=str,
+                       default=None,
+                       help="multi-slice link hierarchy above ICI "
+                            "without a machine file: comma list of "
+                            "span:bandwidth:latency triples, e.g. "
+                            "'16:3.1e9:1e-5' for one DCN class "
+                            "spanning 16 devices (MachineSpec."
+                            "slice_levels)")
         p.add_argument("--taskgraph", dest="export_taskgraph", type=str, default=None)
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--trace-steps", dest="trace_steps", type=int, default=1)
@@ -313,6 +358,7 @@ class FFConfig:
             import_strategy_partial=args.import_strategy_partial,
             export_strategy_task_graph_file=args.export_taskgraph,
             machine_model_file=args.machine_model_file,
+            slice_levels=args.slice_levels,
             profiling=args.profiling,
             trace_steps=args.trace_steps,
             grad_accum_steps=args.grad_accum_steps,

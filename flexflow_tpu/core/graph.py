@@ -66,6 +66,10 @@ class Graph:
         self._hash_cache: Optional[int] = None
         self._node_hash_cache: Optional[Dict[int, int]] = None
         self._anc_hash_cache: Optional[Dict[int, int]] = None
+        # process-stable digests (persistent DP memo keys) — computed
+        # lazily by stable_node_digests / cost_cache.stable_graph_digest
+        self._stable_nh_cache: Optional[Dict[int, str]] = None
+        self._stable_gd_cache: Optional[str] = None
 
     # ---- construction ----------------------------------------------------
     def new_node(self, op) -> Node:
@@ -79,6 +83,8 @@ class Graph:
         self._hash_cache = None
         self._node_hash_cache = None
         self._anc_hash_cache = None
+        self._stable_nh_cache = None
+        self._stable_gd_cache = None
 
     def add_node(self, node: Node) -> None:
         if node.guid in self.nodes:
@@ -125,6 +131,8 @@ class Graph:
         self._hash_cache = None
         self._node_hash_cache = None
         self._anc_hash_cache = None
+        self._stable_nh_cache = None
+        self._stable_gd_cache = None
 
     def copy(self) -> "Graph":
         g = Graph()
@@ -331,6 +339,42 @@ class Graph:
             desc[node.guid] = hash((self._sig_repr(node), tuple(outs)))
         combined = {g: hash((anc[g], desc[g])) for g in self.nodes}
         self._node_hash_cache = combined
+        return combined
+
+    def stable_node_digests(self) -> Dict[int, str]:
+        """Process-stable analogue of ``node_hashes``: per-node
+        structural digests combining the ancestor- and descendant-
+        refined context, as blake2b hex over signature strings instead
+        of python tuple hashes (PYTHONHASHSEED randomizes those across
+        processes).  Nodes with equal digests are interchangeable under
+        graph isomorphism — the pairing rule the persistent DP memo
+        (search/cost_cache.py dp-row layer) stores strategies under, so
+        a COLD process can remap a row solved by any prior run.  Cached
+        per graph; only consumers that persist/serve rows compute it."""
+        if self._stable_nh_cache is not None:
+            return self._stable_nh_cache
+        from hashlib import blake2b
+
+        def h(payload: str) -> str:
+            return blake2b(payload.encode(), digest_size=12).hexdigest()
+
+        topo = self.topo_order()
+        anc: Dict[int, str] = {}
+        for node in topo:
+            ins = sorted(
+                (anc[e.src], e.src_idx, e.dst_idx)
+                for e in self.in_edges[node.guid]
+            )
+            anc[node.guid] = h(self._sig_repr(node) + repr(ins))
+        desc: Dict[int, str] = {}
+        for node in reversed(topo):
+            outs = sorted(
+                (desc[e.dst], e.src_idx, e.dst_idx)
+                for e in self.out_edges[node.guid]
+            )
+            desc[node.guid] = h(self._sig_repr(node) + repr(outs))
+        combined = {g: h(anc[g] + desc[g]) for g in self.nodes}
+        self._stable_nh_cache = combined
         return combined
 
     def remap(self, mapping: Dict[int, int], fresh_start: Optional[int] = None) -> Tuple["Graph", Dict[int, int]]:

@@ -4,7 +4,12 @@ ships as examples (reference: examples/cpp/*, SURVEY.md §2.6)."""
 from flexflow_tpu.models.alexnet import build_alexnet, build_alexnet_cifar10
 from flexflow_tpu.models.resnet import build_resnet, build_resnext50
 from flexflow_tpu.models.inception import build_inception_v3
-from flexflow_tpu.models.transformer import build_bert, build_gpt, build_transformer
+from flexflow_tpu.models.transformer import (
+    build_bert,
+    build_gpt,
+    build_gpt_xl,
+    build_transformer,
+)
 from flexflow_tpu.models.dlrm import build_dlrm
 from flexflow_tpu.models.xdl import build_xdl
 from flexflow_tpu.models.candle_uno import build_candle_uno
@@ -20,6 +25,7 @@ __all__ = [
     "build_transformer",
     "build_bert",
     "build_gpt",
+    "build_gpt_xl",
     "build_dlrm",
     "build_xdl",
     "build_candle_uno",

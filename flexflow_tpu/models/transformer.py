@@ -106,3 +106,19 @@ def build_gpt(config: FFConfig, vocab: int = 32000, num_layers: int = 12,
     t = model.layer_norm(t, name="final_ln")
     t = model.dense(t, vocab, use_bias=False, name="lm_head")
     return model
+
+
+# the canonical production-scale config (~1015 PCG nodes at 144 layers
+# x ~7 nodes/layer): the ROADMAP-item-3 scale target — a thousand-node
+# stacked LLM PCG the segment-reuse search must solve in inception time
+GPT_XL_KW = dict(vocab=32000, num_layers=144, hidden=512, num_heads=8,
+                 ff_dim=2048, seq_len=256)
+
+
+def build_gpt_xl(config: FFConfig, **overrides):
+    """``build_gpt`` at production depth (GPT_XL_KW, ≥1000 nodes).
+    Exists so the scale benchmark and tests name ONE canonical xl
+    config instead of each re-inventing a layer count."""
+    kw = dict(GPT_XL_KW)
+    kw.update(overrides)
+    return build_gpt(config, **kw)

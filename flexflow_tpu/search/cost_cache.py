@@ -47,11 +47,19 @@ from typing import Dict, Optional, Tuple
 from flexflow_tpu.obs.metrics import METRICS
 
 SCHEMA_VERSION = 1
+# sub-schema of the persisted DP-memo rows ("dp_rows"/"dp_schema" keys,
+# additive to SCHEMA_VERSION so caches written before the layer existed
+# stay valid).  An UNKNOWN dp_schema drops the dp layer loudly (stderr +
+# fflint CCH405) and keeps the rest of the cache — corrupt memo rows
+# must cost a recompute, never serve a wrong strategy.
+DP_SCHEMA = 1
 
 _ROW_HITS = METRICS.counter("cost_cache.row_hits")
 _ROW_MISSES = METRICS.counter("cost_cache.row_misses")
 _RESULT_HITS = METRICS.counter("cost_cache.result_hits")
 _RESULT_MISSES = METRICS.counter("cost_cache.result_misses")
+_DP_HITS = METRICS.counter("cost_cache.dp_row_hits")
+_DP_MISSES = METRICS.counter("cost_cache.dp_row_misses")
 
 RowKey = Tuple[str, Tuple[int, ...], int]
 
@@ -97,6 +105,7 @@ def cost_signature(cost_model) -> str:
             m.hbm_bandwidth, m.hbm_capacity, m.ici_bandwidth,
             m.ici_latency, list(m.ici_torus), m.dcn_bandwidth,
             m.dcn_latency, m.reshard_overhead_s, m.name, m.platform,
+            [list(lvl) for lvl in m.slice_levels],
         ],
         "num_devices": cost_model.num_devices,
         "zero_dp_shard": cost_model.zero_dp_shard,
@@ -116,7 +125,12 @@ def stable_graph_digest(graph) -> str:
     op signatures plus position-indexed edges.  InputOp signatures
     embed the frontend's GLOBAL tensor_guid counter (process-lifetime,
     build-order dependent); the digest replaces it with the input's
-    rank of appearance, which carries the same distinctness."""
+    rank of appearance, which carries the same distinctness.  Cached on
+    the graph object (cleared by Graph._invalidate on mutation) — the
+    persistent DP memo keys every tier-2 segment query by it."""
+    cached = getattr(graph, "_stable_gd_cache", None)
+    if cached is not None:
+        return cached
     order = graph.topo_order()
     pos = {n.guid: i for i, n in enumerate(order)}
     input_rank: Dict[object, int] = {}
@@ -138,7 +152,9 @@ def stable_graph_digest(graph) -> str:
         ):
             h.update(repr(e).encode())
         h.update(b";")
-    return h.hexdigest()
+    out = h.hexdigest()
+    graph._stable_gd_cache = out
+    return out
 
 
 class CostCache:
@@ -151,6 +167,16 @@ class CostCache:
         self.signature = signature
         self.rows: Dict[RowKey, Tuple[float, float, float, float]] = {}
         self.results: Dict[str, tuple] = {}
+        # persisted tier-2 DP memo rows (dp-row layer): key string ->
+        # {"cost": float, "strategy": [[node_digest, dims, replica,
+        # start], ...]} — guid-free, remappable onto isomorphic
+        # segments in any process (search/dp.py serves them).
+        # ``dp_loaded`` marks rows that arrived FROM DISK: only those
+        # are served — within one run the in-process DP memo already
+        # covers anything this run wrote, so a cold cache stays inert
+        # and the bit-identical regression gate holds
+        self.dp_rows: Dict[str, dict] = {}
+        self.dp_loaded = False
         self.stale = False
         self.invalidated = False  # file existed with another signature
         self._dirty = False
@@ -158,6 +184,8 @@ class CostCache:
         self.row_misses = 0
         self.result_hits = 0
         self.result_misses = 0
+        self.dp_row_hits = 0
+        self.dp_row_misses = 0
         self._load()
 
     # ------------------------------------------------------------------
@@ -196,6 +224,25 @@ class CostCache:
             self.rows[(r["sig"], tuple(r["degrees"]), int(r["replica"]))] = (
                 tuple(float(x) for x in r["row"])
             )
+        dp = data.get("dp_rows")
+        if dp:
+            if data.get("dp_schema") != DP_SCHEMA:
+                # fail LOUD, not wrong: an unknown/missing dp sub-schema
+                # means these memo rows were written by a different
+                # layout — drop the layer (one recompute), keep the
+                # still-valid row/result layers
+                print(
+                    f"flexflow_tpu cost cache: persisted DP-memo rows "
+                    f"carry unknown dp_schema "
+                    f"{data.get('dp_schema')!r} (known: {DP_SCHEMA}) — "
+                    f"dropping the dp-row layer; rows will be "
+                    f"recomputed (run tools/fflint.py cache to "
+                    f"inspect)",
+                    file=sys.stderr,
+                )
+            elif isinstance(dp, dict):
+                self.dp_rows = dp
+                self.dp_loaded = True
         if os.path.exists(self.result_path):
             try:
                 with open(self.result_path, "rb") as f:
@@ -231,7 +278,8 @@ class CostCache:
         with open(tmp, "w") as f:
             json.dump(
                 {"schema": SCHEMA_VERSION, "signature": self.signature,
-                 "calibration_stale": False, "rows": rows},
+                 "calibration_stale": False, "rows": rows,
+                 "dp_schema": DP_SCHEMA, "dp_rows": self.dp_rows},
                 f,
             )
         os.replace(tmp, self.path)
@@ -279,6 +327,40 @@ class CostCache:
         if not all(isinstance(x, (int, float)) for x in row):
             return
         self.rows[self.row_key(op, mv)] = tuple(float(x) for x in row)
+        self._dirty = True
+
+    # ---- DP memo-row layer (tier-2 segment results) -------------------
+    def get_dp_row(self, key: str) -> Optional[dict]:
+        """The persisted tier-2 DP memo row for a (segment digest,
+        fixed-view digest, budget, start) key, or None.  The payload is
+        guid-free: ``strategy`` pairs process-stable node digests
+        (Graph.stable_node_digests) with view tuples; search/dp.py
+        remaps it onto the caller's guids."""
+        if self.stale:
+            return None
+        hit = self.dp_rows.get(key)
+        if hit is None:
+            self.dp_row_misses += 1
+            _DP_MISSES.inc()
+            return None
+        self.dp_row_hits += 1
+        _DP_HITS.inc()
+        return hit
+
+    # soft bound on the persisted memo: a production sweep over many
+    # large graphs must not grow COST_CACHE.json without limit — beyond
+    # the cap new rows cost a recompute next run, nothing breaks
+    DP_MAX_ROWS = 20000
+
+    def put_dp_row(self, key: str, cost: float, strategy_rows) -> None:
+        if self.stale or not math.isfinite(cost):
+            return
+        if key in self.dp_rows:
+            return  # deterministic DP: first write wins, stays stable
+        if len(self.dp_rows) >= self.DP_MAX_ROWS:
+            return
+        self.dp_rows[key] = {"cost": float(cost),
+                             "strategy": strategy_rows}
         self._dirty = True
 
     # ---- search-result layer -----------------------------------------

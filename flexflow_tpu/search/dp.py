@@ -39,6 +39,68 @@ from flexflow_tpu.search.views import boundary_views, candidate_views
 _MEMO_HITS = METRICS.counter("dp.memo_hits")
 _MEMO_MISSES = METRICS.counter("dp.memo_misses")
 _NATIVE_HITS = METRICS.counter("dp.native_hits")
+_CTX_PATCHES = METRICS.counter("dp.ctx_patch_hits")
+_CTX_REBUILDS = METRICS.counter("dp.ctx_rebuilds")
+_DP_ROWS_SERVED = METRICS.counter("dp.rows_served")
+
+# persistent DP memo: rows below this node count are not worth the
+# stable-digest hashing (tiny leaves re-solve in microseconds, and the
+# small-segment storm would bloat COST_CACHE.json for nothing)
+DP_PERSIST_MIN_NODES = 6
+
+
+def _ctx_check_enabled() -> bool:
+    """FLEXFLOW_TPU_DELTA_CHECK=1 also arms the ctx-patch oracle: every
+    PATCHED native-DP ctx is re-derived by the full build and asserted
+    identical (same topo order, same packed view/candidate arrays) —
+    the incremental-assembly contract as a runtime check, mirroring the
+    delta-simulation oracle in search/simulator.py."""
+    import os
+
+    return os.environ.get("FLEXFLOW_TPU_DELTA_CHECK", "") not in ("", "0")
+
+
+CTX_CHECK = _ctx_check_enabled()
+
+
+def _same_stamp(a, b) -> bool:
+    """Element-wise stamp comparison: numbers by value, everything else
+    by identity (id() of a freed CostModel can be reallocated — holding
+    the references in the stamp prevents reuse, `is` detects swaps)."""
+    return len(a) == len(b) and all(
+        x is y or x == y if isinstance(x, (int, bool, float)) else x is y
+        for x, y in zip(a, b)
+    )
+
+
+def _assert_ctx_equal(patched, rebuilt) -> None:
+    """The ctx-patch oracle: a PATCHED native-DP ctx must be
+    indistinguishable from a full rebuild — same topo order, same
+    budgets, same packed per-view cost/candidate arrays, same edge
+    matrices.  The C engine is a deterministic function of these
+    inputs, so array equality is the whole contract."""
+    import numpy as _np
+
+    assert [n.guid for n in patched["topo"]] == \
+        [n.guid for n in rebuilt["topo"]], "ctx patch: topo order diverged"
+    assert patched["budgets"] == rebuilt["budgets"], \
+        "ctx patch: budget set diverged"
+    a, b = patched["pack"], rebuilt["pack"]
+    assert set(a) == set(b), "ctx patch: pack keys diverged"
+    for k in a:
+        va, vb = a[k], b[k]
+        if isinstance(va, _np.ndarray):
+            assert va.shape == vb.shape and bool((va == vb).all()), (
+                f"ctx patch: packed array {k!r} diverged")
+        else:
+            assert va == vb, f"ctx patch: pack entry {k!r} diverged"
+    ea, eb = patched["edges"], rebuilt["edges"]
+    assert len(ea) == len(eb), "ctx patch: edge count diverged"
+    for (sa, da, ga, ma), (sb, db, gb, mb) in zip(ea, eb):
+        assert (sa, da, ga) == (sb, db, gb), "ctx patch: edge diverged"
+        assert ma is mb or bool((ma == mb).all()), \
+            "ctx patch: edge matrix diverged"
+
 
 Strategy = Dict[int, MachineView]
 
@@ -82,7 +144,16 @@ def reconstruct_strategy(
     caller must re-simulate rather than trust the cached cost.  Strategy
     is None when the canonical form does not fit at all (hash
     collision — caller recomputes)."""
-    nh = graph.node_hashes()
+    return _pair_views(graph, graph.node_hashes(), canon, fixed)
+
+
+def _pair_views(graph: Graph, nh, canon, fixed: Optional[Strategy]):
+    """The ONE guid-pairing rule shared by the in-process memo
+    (``reconstruct_strategy``, int node hashes) and the persistent DP
+    memo (stable hex digests): group guids by structural key, honor
+    ``fixed`` pins first, pair the rest in sorted-guid order.  Both
+    layers MUST pair identically or a warm serve could diverge from the
+    in-process replay of the same row."""
     groups: Dict[int, List[int]] = {}
     for g in sorted(graph.nodes):
         groups.setdefault(nh[g], []).append(g)
@@ -146,6 +217,14 @@ class SearchHelper:
         self.memo_hits = 0
         self.memo_misses = 0
         self.native_hits = 0
+        # incremental ctx assembly + persistent DP memo + segment
+        # stamping effectiveness (search.perf: ctx_patch_hits/
+        # ctx_rebuilds/dp_rows_served/segments_stamped — the driver's
+        # _UnityOptimizer increments segments_stamped on cache remaps)
+        self.ctx_patch_hits = 0
+        self.ctx_rebuilds = 0
+        self.dp_rows_served = 0
+        self.segments_stamped = 0
 
     # ------------------------------------------------------------------
     def _views(self, node: Node, budget: int, start: int = 0) -> List[MachineView]:
@@ -215,27 +294,38 @@ class SearchHelper:
             self.leaf_threshold, self.max_bottleneck_tries,
         )
 
-        def same_stamp(a, b):
-            return len(a) == len(b) and all(
-                x is y or x == y if isinstance(x, (int, bool, float))
-                else x is y
-                for x, y in zip(a, b)
-            )
-
         cached = getattr(graph, "_ndp_ctx", None)
         if cached == "ineligible":
             return None  # hard override (tests force the Python path)
-        if cached is not None and same_stamp(cached[0], stamp):
+        if cached is not None and _same_stamp(cached[0], stamp):
             return cached[1]  # may be None (= ineligible)
         from flexflow_tpu import native as _native
 
         if _native.get_lib() is None:
             graph._ndp_ctx = (stamp, None)
             return None
+        # incremental assembly: a substitution candidate patches its
+        # parent's ctx from the changed-guid seed sets instead of
+        # re-deriving every per-node block (the per-pop tier-2 rebuild
+        # ROADMAP item 3 names); a failed patch falls back to the full
+        # build, and FLEXFLOW_TPU_DELTA_CHECK asserts patched == rebuilt
+        ctx = None
         try:
-            ctx = self._build_native_dp(graph)
+            ctx = self._patch_native_dp(graph, stamp)
         except Exception:
             ctx = None
+        if ctx is not None:
+            self.ctx_patch_hits += 1
+            _CTX_PATCHES.inc()
+            if CTX_CHECK:
+                _assert_ctx_equal(ctx, self._build_native_dp(graph))
+        else:
+            try:
+                ctx = self._build_native_dp(graph)
+            except Exception:
+                ctx = None
+            self.ctx_rebuilds += 1
+            _CTX_REBUILDS.inc()
         graph._ndp_ctx = (stamp, ctx)
         return ctx
 
@@ -313,6 +403,19 @@ class SearchHelper:
             "parts": parts, "valid": valid, "annots": annots,
             "cand": cand_lists, "bview": bview_lists,
             "default": defaults, "trivial": trivial, "fixed": fixed,
+            # flat per-signature arrays (node-major, budget-minor once
+            # concatenated): _pack_native_dp assembles a ctx by
+            # concatenating these per node instead of re-flattening
+            # python lists per (node, budget) on every build
+            "cand_counts": _np.asarray(
+                [len(c) for c in cand_lists], dtype=_np.int32),
+            "cand_flat": _np.asarray(
+                [i for lst in cand_lists for i in lst], dtype=_np.int32),
+            "bview_counts": _np.asarray(
+                [len(b) for b in bview_lists], dtype=_np.int32),
+            "bview_flat": _np.asarray(
+                [i for lst in bview_lists for i in lst], dtype=_np.int32),
+            "default_arr": _np.asarray(defaults, dtype=_np.int32),
         }
         self._node_digest_cache[sig] = digest
         return digest
@@ -353,7 +456,39 @@ class SearchHelper:
         self._edge_matrix_cache[key] = mat
         return mat
 
-    def _build_native_dp(self, graph: Graph):
+    def _dp_budgets(self) -> Tuple[List[int], List[int]]:
+        cands = sorted(self._budget_cands())
+        return sorted(set(cands) | {self.num_devices}), cands
+
+    def _node_block(self, node: Node, budgets: List[int], membership):
+        """Per-node assembly unit of the native-DP ctx: the shared
+        per-signature digest plus this GRAPH's cluster-scaled cost rows
+        (scaling is chain-contextual, so it adjusts a per-graph copy,
+        never the digest cache).  ``cm_key`` fingerprints the chain
+        context the rows were scaled under — the patch path may reuse a
+        block only while it matches."""
+        d = self._node_digest(node, budgets)
+        rows = d["rows"]
+        cm_key = None
+        cm = membership.get(node.guid) if membership else None
+        if cm is not None:
+            cm_key = (tuple(m.guid for m in cm[0]), cm[1])
+            rows = rows.copy()
+            for vi, mv in enumerate(d["views"]):
+                if not d["valid"][vi]:
+                    continue
+                rows[vi] = self.sim.cluster_scaled_costs(
+                    node, mv, tuple(rows[vi]), membership)
+        return {"digest": d, "rows": rows, "cm_key": cm_key}
+
+    def _assemble_native_dp(self, graph: Graph, blocks: Dict[int, dict],
+                            budgets: List[int], cands: List[int]):
+        """Concatenate per-node blocks (topo order) into the packed
+        arrays the native engine consumes, upload, and return the ctx.
+        The per-(node, budget) candidate/boundary lists ride the
+        digests' pre-flattened arrays (``cand_flat``/``bview_flat``), so
+        assembly is numpy concatenation instead of the O(nodes x
+        budgets) python loops the per-pop rebuild used to pay."""
         import numpy as _np
 
         from flexflow_tpu import native as _native
@@ -364,11 +499,8 @@ class SearchHelper:
         index = {node.guid: i for i, node in enumerate(topo)}
         guid_rank = {g: r for r, g in enumerate(sorted(graph.nodes))}
 
-        cands = sorted(self._budget_cands())
-        budgets = sorted(set(cands) | {self.num_devices})
-        nb = len(budgets)
-
-        digests = [self._node_digest(node, budgets) for node in topo]
+        digests = [blocks[node.guid]["digest"] for node in topo]
+        rows_list = [blocks[node.guid]["rows"] for node in topo]
         ndp = _native.NativeDPGraph(
             n, self.num_devices, sim.machine.hbm_capacity,
             include_update=not sim.inference,
@@ -376,68 +508,115 @@ class SearchHelper:
             max_tries=self.max_bottleneck_tries,
         )
         node_off = _np.zeros(n + 1, dtype=_np.int32)
-        for i, d in enumerate(digests):
-            node_off[i + 1] = node_off[i] + len(d["views"])
-        # digests are shared per op SIGNATURE across graphs; fusion-
-        # cluster scaling is graph-contextual (chain membership), so it
-        # adjusts a per-graph COPY of the rows here, never the cache
-        rows_list = [d["rows"] for d in digests]
-        membership = sim.cluster_membership(graph)
-        if membership:
-            for guid, cm in membership.items():
-                i = index[guid]
-                d = digests[i]
-                new = d["rows"].copy()
-                for vi, mv in enumerate(d["views"]):
-                    if not d["valid"][vi]:
-                        continue
-                    new[vi] = sim.cluster_scaled_costs(
-                        topo[i], mv, tuple(new[vi]), membership)
-                rows_list[i] = new
-        ndp.set_views(
-            node_off,
-            _np.concatenate([r[:, 0] for r in rows_list]),
-            _np.concatenate([r[:, 1] for r in rows_list]),
-            _np.concatenate([r[:, 2] for r in rows_list]),
-            _np.concatenate([r[:, 3] for r in rows_list]),
-            _np.concatenate([d["parts"] for d in digests]),
-            _np.concatenate([d["valid"] for d in digests]),
-        )
-        ndp.set_node_meta(
-            [d["fixed"] for d in digests],
-            [d["trivial"] for d in digests],
-            [guid_rank[node.guid] for node in topo],
-        )
+        _np.cumsum([len(d["views"]) for d in digests], out=node_off[1:])
+        pack = {
+            "node_off": node_off,
+            "fwd": _np.concatenate([r[:, 0] for r in rows_list]),
+            "full": _np.concatenate([r[:, 1] for r in rows_list]),
+            "sync": _np.concatenate([r[:, 2] for r in rows_list]),
+            "mem": _np.concatenate([r[:, 3] for r in rows_list]),
+            "parts": _np.concatenate([d["parts"] for d in digests]),
+            "valid": _np.concatenate([d["valid"] for d in digests]),
+            "fixed": _np.asarray([d["fixed"] for d in digests],
+                                 dtype=_np.int32),
+            "trivial": _np.asarray([d["trivial"] for d in digests],
+                                   dtype=_np.int32),
+            "guid_rank": _np.asarray(
+                [guid_rank[node.guid] for node in topo], dtype=_np.int32),
+        }
+        ndp.set_views(node_off, pack["fwd"], pack["full"], pack["sync"],
+                      pack["mem"], pack["parts"], pack["valid"])
+        ndp.set_node_meta(pack["fixed"], pack["trivial"], pack["guid_rank"])
         ndp.set_budgets(budgets, cands)
-        cand_off = [0] * (n * nb + 1)
-        bview_off = [0] * (n * nb + 1)
-        cand_idx: List[int] = []
-        bview_idx: List[int] = []
-        default_idx = [0] * (n * nb)
-        for i, d in enumerate(digests):
-            for bi in range(nb):
-                at = i * nb + bi
-                cand_idx.extend(d["cand"][bi])
-                bview_idx.extend(d["bview"][bi])
-                cand_off[at + 1] = len(cand_idx)
-                bview_off[at + 1] = len(bview_idx)
-                default_idx[at] = d["default"][bi]
-        ndp.set_lists(cand_off, cand_idx, bview_off, bview_idx, default_idx)
+        nb = len(budgets)
+        cand_counts = _np.concatenate([d["cand_counts"] for d in digests])
+        bview_counts = _np.concatenate([d["bview_counts"] for d in digests])
+        cand_off = _np.zeros(n * nb + 1, dtype=_np.int64)
+        bview_off = _np.zeros(n * nb + 1, dtype=_np.int64)
+        _np.cumsum(cand_counts, out=cand_off[1:])
+        _np.cumsum(bview_counts, out=bview_off[1:])
+        pack["cand_off"] = cand_off
+        pack["bview_off"] = bview_off
+        pack["cand_idx"] = _np.concatenate([d["cand_flat"] for d in digests])
+        pack["bview_idx"] = _np.concatenate(
+            [d["bview_flat"] for d in digests])
+        pack["default_idx"] = _np.concatenate(
+            [d["default_arr"] for d in digests])
+        ndp.set_lists(cand_off, pack["cand_idx"], bview_off,
+                      pack["bview_idx"], pack["default_idx"])
 
+        edges = []
         for guid in graph.nodes:
             for e in graph.out_edges[guid]:
-                ndp.add_edge(
-                    index[e.src], index[e.dst],
-                    not graph.nodes[e.src].op.is_gradient_free,
-                    self._edge_matrix(
-                        graph.nodes[e.src], graph.nodes[e.dst],
-                        e.src_idx, e.dst_idx, budgets),
-                )
+                mat = self._edge_matrix(
+                    graph.nodes[e.src], graph.nodes[e.dst],
+                    e.src_idx, e.dst_idx, budgets)
+                has_grad = not graph.nodes[e.src].op.is_gradient_free
+                ndp.add_edge(index[e.src], index[e.dst], has_grad, mat)
+                if CTX_CHECK:
+                    edges.append((index[e.src], index[e.dst], has_grad, mat))
         ctx = {"ndp": ndp, "index": index,
                "views": [d["views"] for d in digests],
                "view_key": [d["view_key"] for d in digests],
-               "topo": topo, "budgets": set(budgets)}
+               "topo": topo, "budgets": set(budgets), "blocks": blocks}
+        if CTX_CHECK:
+            # pack/edges duplicate what blocks + the native graph already
+            # hold; only the patched-vs-rebuilt oracle reads them.
+            ctx["pack"] = pack
+            ctx["edges"] = edges
         return ctx
+
+    def _build_native_dp(self, graph: Graph):
+        budgets, cands = self._dp_budgets()
+        membership = self.sim.cluster_membership(graph)
+        blocks = {
+            node.guid: self._node_block(node, budgets, membership)
+            for node in graph.topo_order()
+        }
+        return self._assemble_native_dp(graph, blocks, budgets, cands)
+
+    def _patch_native_dp(self, graph: Graph, stamp):
+        """Incremental ctx assembly: a substitution candidate reuses its
+        parent ctx's per-node blocks outside the changed-guid seed sets
+        (the same sets that drive delta simulation and delta matching)
+        and re-derives blocks only for the dirty cone.  A block is a
+        pure function of (op signature, budgets, chain membership, cost
+        surface); the stamp tail proves the surface matches and
+        ``cm_key`` proves the chain context does.  Returns None when
+        ineligible — the caller falls back to the full build — and the
+        FLEXFLOW_TPU_DELTA_CHECK oracle asserts patched == rebuilt."""
+        cv = getattr(graph, "_changed_vs", None)
+        if cv is None:
+            return None
+        parent = cv[0]()
+        if parent is None:
+            return None
+        pcached = getattr(parent, "_ndp_ctx", None)
+        if pcached in (None, "ineligible") or pcached[1] is None:
+            return None
+        if not _same_stamp(pcached[0][1:], stamp[1:]):
+            return None  # costing surface moved under the parent ctx
+        pblocks = pcached[1].get("blocks")
+        if pblocks is None:
+            return None
+        dirty = set(cv[1]) | set(cv[2])
+        budgets, cands = self._dp_budgets()
+        membership = self.sim.cluster_membership(graph)
+        blocks: Dict[int, dict] = {}
+        for node in graph.topo_order():
+            g = node.guid
+            pb = None if g in dirty else pblocks.get(g)
+            if pb is not None:
+                cm = membership.get(g) if membership else None
+                cm_key = (
+                    (tuple(m.guid for m in cm[0]), cm[1])
+                    if cm is not None else None
+                )
+                if cm_key != pb["cm_key"]:
+                    pb = None  # chain context shifted under a clean guid
+            blocks[g] = pb if pb is not None else self._node_block(
+                node, budgets, membership)
+        return self._assemble_native_dp(graph, blocks, budgets, cands)
 
     def _budget_cands(self) -> List[int]:
         """_sub_budgets' candidate sizes (shared with the native DP)."""
@@ -489,7 +668,121 @@ class SearchHelper:
         if key not in self.memo:
             self.memo[key] = (
                 float(cost), canonicalize_strategy(graph, strategy))
+            self._persist_dp_row(graph, fixed, budget, 0, float(cost),
+                                 strategy)
         return float(cost), strategy
+
+    # ------------------------------------------------------------------
+    # persistent DP memo (cost_cache.py dp-row layer): tier-2 segment
+    # results keyed by PROCESS-STABLE digests, so a cold process skips
+    # DP on any segment a prior run has solved.  Serving is restricted
+    # to rows LOADED from disk — within one run the in-process memo is
+    # a superset of anything this run wrote, so the layer is inert on a
+    # cold cache and the bit-identical regression gate holds.
+
+    def _dp_cache_warm(self) -> bool:
+        cc = self.sim.cost_cache
+        return cc is not None and getattr(cc, "dp_loaded", False) \
+            and not cc.stale
+
+    def _dp_persist_key(self, graph: Graph, fixed: Strategy, budget: int,
+                        start: int) -> str:
+        """Guid-free persistent key: stable graph digest + stable
+        canonical pinned views + every knob that changes the DP's
+        answer and is not already in the cache's cost-surface signature
+        (budget/start plus this helper's search shape)."""
+        from hashlib import blake2b
+
+        from flexflow_tpu.search.cost_cache import stable_graph_digest
+
+        snh = graph.stable_node_digests()
+        pins = tuple(sorted(
+            (snh[g], tuple(v.dim_degrees), int(v.replica_degree),
+             int(v.start_part))
+            for g, v in fixed.items() if g in graph.nodes
+        ))
+        knobs = (budget, start, self.num_devices, self.leaf_threshold,
+                 self.max_views_per_op, self.max_bottleneck_tries,
+                 bool(self.sim.placement_overlap))
+        tail = blake2b(repr((pins, knobs)).encode(),
+                       digest_size=10).hexdigest()
+        return stable_graph_digest(graph) + ":" + tail
+
+    def _serve_persistent_dp(self, graph, fixed, budget, start):
+        """(cost, strategy) from a persisted DP memo row remapped onto
+        this graph's guids, or None.  The remap uses the SAME pairing
+        rule as the in-process memo (_pair_views) over stable digests;
+        ambiguous pairings are re-simulated for an honest cost, and the
+        stamped strategy must still pass the SHD1xx legality lint — a
+        corrupt row costs one recompute, never a wrong serve."""
+        if graph.num_nodes < DP_PERSIST_MIN_NODES:
+            return None
+        cc = self.sim.cost_cache
+        row = cc.get_dp_row(
+            self._dp_persist_key(graph, fixed, budget, start))
+        if row is None:
+            return None
+        try:
+            cost = float(row["cost"])
+            canon = tuple(
+                (h, MachineView(tuple(int(x) for x in dims), int(rep),
+                                int(st)))
+                for h, dims, rep, st in row["strategy"]
+            )
+        except (KeyError, TypeError, ValueError):
+            return None
+        strategy, ambiguous = _pair_views(
+            graph, graph.stable_node_digests(), canon, fixed)
+        if strategy is None or len(strategy) != graph.num_nodes:
+            return None
+        if ambiguous:
+            cost = self.sim.simulate(graph, strategy)
+        from flexflow_tpu.analysis import errors_only, lint_strategy
+
+        if errors_only(lint_strategy(graph, strategy, self.num_devices)):
+            return None
+        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
+        if key not in self.memo:
+            self.memo[key] = (cost, canonicalize_strategy(graph, strategy))
+        self.dp_rows_served += 1
+        _DP_ROWS_SERVED.inc()
+        return cost, strategy
+
+    def _persist_dp_row(self, graph, fixed, budget, start, cost,
+                        strategy) -> None:
+        cc = self.sim.cost_cache
+        if (cc is None or cc.stale or not math.isfinite(cost)
+                or graph.num_nodes < DP_PERSIST_MIN_NODES or not strategy):
+            return
+        snh = graph.stable_node_digests()
+        rows = [
+            [snh[g], list(strategy[g].dim_degrees),
+             int(strategy[g].replica_degree), int(strategy[g].start_part)]
+            for g in sorted(strategy, key=lambda g: (snh.get(g, ""), g))
+            if g in graph.nodes
+        ]
+        if len(rows) != graph.num_nodes:
+            return  # partial coverage is not a DP result
+        cc.put_dp_row(self._dp_persist_key(graph, fixed, budget, start),
+                      float(cost), rows)
+
+    def _memo_lookup(self, graph, key, fixed):
+        """The in-process structural memo hit path (reconstruction +
+        ambiguity grounding) shared by graph_cost and the warm-serve
+        prelude."""
+        hit = self.memo.get(key)
+        if hit is None:
+            return None
+        cost, canon = hit
+        strategy, ambiguous = reconstruct_strategy(graph, canon, fixed)
+        if strategy is None:
+            return None
+        if ambiguous:
+            # multi-member hash groups: the in-group pairing may not
+            # follow one isomorphism, so the cached cost may not match
+            # this strategy — ground it in the sim
+            cost = self.sim.simulate(graph, strategy)
+        return cost, strategy
 
     # ------------------------------------------------------------------
     def graph_cost(
@@ -504,6 +797,20 @@ class SearchHelper:
         devices beginning at device ``start``."""
         fixed = fixed or {}
         budget = budget or self.num_devices
+        if self._dp_cache_warm():
+            # warm prelude: the in-process memo first (repeat queries
+            # must not re-lint a served row), then the persisted rows —
+            # BEFORE the native engine, which is the work being skipped
+            key = (graph.hash(), canon_fixed_views(graph, fixed), budget,
+                   start)
+            got = self._memo_lookup(graph, key, fixed)
+            if got is not None:
+                self.memo_hits += 1
+                _MEMO_HITS.inc()
+                return got
+            served = self._serve_persistent_dp(graph, fixed, budget, start)
+            if served is not None:
+                return served
         if start == 0:
             native = self._native_graph_cost(graph, fixed, budget)
             if native is not None:
@@ -517,19 +824,11 @@ class SearchHelper:
         # guids (reconstruct_strategy); round 2's guid-set key blocked
         # exactly this sharing and made 12-layer search intractable.
         key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
-        hit = self.memo.get(key)
-        if hit is not None:
-            cost, canon = hit
-            strategy, ambiguous = reconstruct_strategy(graph, canon, fixed)
-            if strategy is not None:
-                if ambiguous:
-                    # multi-member hash groups: the in-group pairing may
-                    # not follow one isomorphism, so the cached cost may
-                    # not match this strategy — ground it in the sim
-                    cost = self.sim.simulate(graph, strategy)
-                self.memo_hits += 1
-                _MEMO_HITS.inc()
-                return cost, strategy
+        got = self._memo_lookup(graph, key, fixed)
+        if got is not None:
+            self.memo_hits += 1
+            _MEMO_HITS.inc()
+            return got
 
         self.memo_misses += 1
         _MEMO_MISSES.inc()
@@ -549,6 +848,17 @@ class SearchHelper:
         graph.cc:1456-1526, exists for exactly this reason)."""
         fixed = fixed or {}
         budget = budget or self.num_devices
+        if self._dp_cache_warm():
+            key = (graph.hash(), canon_fixed_views(graph, fixed), budget,
+                   start)
+            hit = self.memo.get(key)
+            if hit is not None:
+                self.memo_hits += 1
+                _MEMO_HITS.inc()
+                return hit[0]
+            served = self._serve_persistent_dp(graph, fixed, budget, start)
+            if served is not None:
+                return served[0]
         if start == 0:
             native = self._native_graph_cost(graph, fixed, budget)
             if native is not None:
@@ -583,6 +893,7 @@ class SearchHelper:
         if c_dp < cost:
             cost, strategy = c_dp, dp
         self.memo[key] = (cost, canonicalize_strategy(graph, strategy))
+        self._persist_dp_row(graph, fixed, budget, start, cost, strategy)
         return cost, strategy
 
     def _default_strategy(self, graph, fixed, budget, start) -> Strategy:

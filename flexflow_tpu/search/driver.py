@@ -47,10 +47,24 @@ from flexflow_tpu.config import FFConfig
 from flexflow_tpu.core.graph import Graph, Node
 from flexflow_tpu.core.machine import MachineView
 from flexflow_tpu.obs.events import BUS
+from flexflow_tpu.obs.metrics import METRICS
 from flexflow_tpu.search.dp import SearchHelper, Strategy, canon_fixed_views
 from flexflow_tpu.search.simulator import Simulator
 from flexflow_tpu.search.substitution import generate_all_pcg_xfers
 from flexflow_tpu.search.views import boundary_views
+
+_SEG_STAMPS = METRICS.counter("search.segments_stamped")
+
+# production-scale threshold: above this node count the binary
+# sequence_optimize recursion is replaced by the K-WAY chain
+# decomposition (chain_optimize) — one bottleneck sweep, one segment
+# solve per isomorphism class x boundary-view pair, a chain DP over
+# boundary views, one final merge+simulate.  The binary recursion's
+# per-level merge simulations and find_split_node sweeps are O(n^2)-ish
+# at thousand-node scale; every zoo graph sits below this threshold
+# (the native DP engine's own ceiling), so the bit-identical regression
+# gate on the zoo holds trivially.
+CHAIN_MIN_NODES = 256
 
 
 @contextlib.contextmanager
@@ -211,7 +225,128 @@ class _UnityOptimizer:
         # is honest for the remapped strategy (code-review r3 finding)
         if any(len(v) > 1 for v in stored_groups.values()):
             cost = self.helper.sim.simulate(g2, strat2)
+        # segment STAMP: a solved segment transplanted onto an
+        # isomorphic sibling (repeated transformer layers).  Stamped
+        # strategies must still prove legal — the always-on SHD1xx gate
+        # the fresh path passes; a lint failure costs one re-search of
+        # this segment, never an illegal serve
+        from flexflow_tpu.analysis import errors_only, lint_strategy
+
+        if errors_only(lint_strategy(g2, strat2, self.helper.num_devices)):
+            return None
+        self.helper.segments_stamped += 1
+        _SEG_STAMPS.inc()
         return g2, cost, strat2
+
+    # -- k-way chain decomposition (production-scale graphs) ---------------
+    def chain_optimize(
+        self, graph: Graph, fixed: Strategy
+    ) -> Optional[Tuple[Graph, float, Strategy]]:
+        """Sequence optimization for graphs past the binary recursion's
+        scale (> CHAIN_MIN_NODES — thousand-node stacked LLM PCGs): cut
+        at every ``base_optimize_threshold``-spaced bottleneck in ONE
+        pass, solve each segment per (in-view, out-view) boundary pair
+        — the structural segment cache collapses the N isomorphic
+        layers of a transformer stack to one solve per equivalence
+        class x pair, stamped onto the rest — compose with a chain DP
+        over boundary views, then merge once and simulate once.  The
+        binary recursion pays a merge + full-graph simulation per level
+        x view (O(n^2) at this scale: the 455-node GPT took 600+
+        deadline-truncated seconds); this is O(classes x views^2)
+        segment solves + O(n).  Returns None when the graph has no
+        usable chain structure (caller falls back)."""
+        bottlenecks = [b for b in graph.bottlenecks()
+                       if b.guid not in fixed]
+        if len(bottlenecks) < 8:
+            return None
+        order = {n.guid: i for i, n in enumerate(graph.topo_order())}
+        threshold = max(4, self.config.base_optimize_threshold)
+        cuts = []
+        last = 0
+        for bn in bottlenecks:
+            at = order[bn.guid]
+            if at - last >= threshold and at < len(order) - 1:
+                cuts.append(bn)
+                last = at
+        if len(cuts) < 4:
+            return None
+        segments = []  # (segment graph, in-cut guid|None, out-cut guid|None)
+        rest = graph
+        try:
+            for i, bn in enumerate(cuts):
+                pre, rest = rest.split_at_node(bn)
+                segments.append(
+                    (pre, cuts[i - 1].guid if i else None, bn.guid))
+        except ValueError:
+            return None  # a residual edge crossed a cut — not a chain
+        segments.append((rest, cuts[-1].guid, None))
+        if BUS.enabled:
+            BUS.emit(
+                "search.chain", nodes=graph.num_nodes,
+                segments=len(segments),
+                max_segment=max(s[0].num_nodes for s in segments),
+            )
+
+        views_at = {bn.guid: self._boundary_views(bn) for bn in cuts}
+        NO_PIN = (None,)  # chain ends have no boundary to enumerate
+
+        def solve(seg, in_guid, u, out_guid, v):
+            f2 = dict(fixed)
+            if u is not None:
+                f2[in_guid] = u
+            if v is not None:
+                f2[out_guid] = v
+            return self.sequence_optimize(seg, f2)
+
+        # chain DP over boundary views: state = out-view of segment i.
+        # Segment costs double-count the shared cut node and ignore
+        # cross-segment overlap — the same pruning-bound currency the
+        # binary recursion sums; the merged graph's one simulation at
+        # the end is the honest cost.
+        prev: Dict[object, Tuple[float, tuple]] = {None: (0.0, ())}
+        for seg, in_guid, out_guid in segments:
+            out_views = views_at[out_guid] if out_guid else NO_PIN
+            in_views = list(prev)
+            if self._expired():
+                # deadline: stop enumerating, keep the first live lane
+                out_views = out_views[:1]
+                in_views = in_views[:1]
+            cur: Dict[object, Tuple[float, tuple]] = {}
+            for v in out_views:
+                best_c, best_path = math.inf, None
+                for u in in_views:
+                    c_in, path = prev[u]
+                    if c_in >= best_c:
+                        continue
+                    _, c_seg, _ = solve(seg, in_guid, u, out_guid, v)
+                    if c_in + c_seg < best_c:
+                        best_c, best_path = c_in + c_seg, path + (u,)
+                if best_path is not None and math.isfinite(best_c):
+                    cur[v] = (best_c, best_path)
+            if not cur:
+                return None  # no feasible lane: fall back to recursion
+            prev = cur
+        # the last segment has no out boundary, so the final state is
+        # the single un-pinned lane; path[i] is the in-view of segment
+        # i (= the pin at cut i-1), path[0] the None chain start
+        bound, path = prev[None]
+        pins = path[1:] + (None,)
+
+        merged_g, merged_s = None, {}
+        for (seg, in_guid, out_guid), v in zip(segments, pins):
+            u = merged_s.get(in_guid) if in_guid else None
+            g_i, _, s_i = solve(seg, in_guid, u, out_guid, v)
+            if merged_g is None:
+                merged_g, merged_s = g_i, dict(s_i)
+            else:
+                merged_g, merged_s = _merge_split(
+                    merged_g, merged_s, g_i, s_i, in_guid)
+            if out_guid is not None:
+                merged_s[out_guid] = v
+        c_true = self.helper.sim.simulate(merged_g, merged_s)
+        if BUS.enabled:
+            BUS.emit("search.chain_done", bound_s=bound, cost_s=c_true)
+        return merged_g, c_true, merged_s
 
     # -- recursive sequence optimization (reference: :2190-2370) -----------
     def sequence_optimize(
@@ -221,6 +356,11 @@ class _UnityOptimizer:
         hit = self._cache_load(key, graph, fixed)
         if hit is not None:
             return hit
+        if graph.num_nodes > CHAIN_MIN_NODES:
+            chained = self.chain_optimize(graph, fixed)
+            if chained is not None:
+                self._cache_store(key, graph, fixed, chained)
+                return chained
         bn = self.find_split_node(graph)
         if bn is None or bn.guid in fixed:
             result = self.base_optimize(graph, fixed)
@@ -774,8 +914,27 @@ def _optimize_strategy(
             _build_sync_schedule(best_graph, best_strategy, sim, config)
             return best_graph, best_strategy
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
-        best_cost, best_strategy = helper.graph_cost(graph)
-        log.log(f"baseline DP-search cost: {best_cost * 1e3:.4f} ms/iter")
+        if (return_graph and config.search_budget > 0
+                and graph.num_nodes > CHAIN_MIN_NODES):
+            # production scale: the flat whole-graph DP recursion is
+            # super-linear past the native engine's ceiling (a 1014-node
+            # GPT did not finish it in 880 s).  Seed with the batch-
+            # parallel floor; the chain decomposition inside the unity
+            # loop carries the real per-segment DP, and the champion-
+            # vs-DP floor below still gates the final answer.
+            from flexflow_tpu.compiler.lowering import (
+                data_parallel_strategy as _dps,
+            )
+
+            best_strategy = _dps(graph, n)
+            best_cost = sim.simulate(graph, best_strategy)
+            log.log(
+                f"baseline data-parallel cost: {best_cost * 1e3:.4f} "
+                f"ms/iter (whole-graph DP deferred to the segment "
+                f"chain search at this scale)")
+        else:
+            best_cost, best_strategy = helper.graph_cost(graph)
+            log.log(f"baseline DP-search cost: {best_cost * 1e3:.4f} ms/iter")
     BUS.emit("search.baseline", cost_s=best_cost)
     best_graph = graph
     search_expired = False
@@ -950,6 +1109,15 @@ def _emit_search_done(
         "cache_row_hits": cache.row_hits if cache else 0,
         "cache_row_misses": cache.row_misses if cache else 0,
         "result_cache_hit": bool(result_cache_hit),
+        # segment-reuse mechanics (ROADMAP item 3): incremental native
+        # ctx assembly, persisted DP memo rows, and isomorphic-segment
+        # stamping — the counters the scale sweep and ffobs report
+        "ctx_patch_hits": helper.ctx_patch_hits,
+        "ctx_rebuilds": helper.ctx_rebuilds,
+        "segments_stamped": helper.segments_stamped,
+        "dp_rows_served": helper.dp_rows_served,
+        "dp_memo_hits": helper.memo_hits,
+        "dp_memo_misses": helper.memo_misses,
     }
     LAST_SEARCH_STATS.clear()
     LAST_SEARCH_STATS.update(stats)

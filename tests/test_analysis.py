@@ -702,6 +702,47 @@ def test_fflint_strategy_and_cache(tmp_path):
     assert main(["cache", corrupt]) == 1
 
 
+def test_fflint_cache_dp_row_layer(tmp_path, capsys):
+    """CCH405/406: the persisted DP-memo-row layer must lint — a
+    well-formed layer passes, an unknown dp_schema is the DISTINCT
+    loud-refusal code (CCH405), malformed rows are CCH406."""
+    from tools.fflint import main
+
+    good = {"schema": 1, "signature": "0123456789abcdef", "calibration_stale": False,
+            "rows": [],
+            "dp_schema": 1,
+            "dp_rows": {"aabb:ccdd": {
+                "cost": 1.5e-3,
+                "strategy": [["0123abcd", [1, 8], 1, 0]]}}}
+    p = str(tmp_path / "cc.json")
+    with open(p, "w") as f:
+        json.dump(good, f)
+    assert main(["cache", p]) == 0
+
+    for mutate, code in (
+        (lambda d: d.update(dp_schema=99), "CCH405"),
+        (lambda d: d.update(dp_rows={"nocolon": good["dp_rows"][
+            "aabb:ccdd"]}), "CCH406"),
+        (lambda d: d.update(dp_rows={"aa:bb": {"cost": -1.0,
+                                               "strategy": [
+            ["0123abcd", [1, 8], 1, 0]]}}), "CCH406"),
+        (lambda d: d.update(dp_rows={"aa:bb": {"cost": 1.0,
+                                               "strategy": []}}),
+         "CCH406"),
+        (lambda d: d.update(dp_rows={"aa:bb": {"cost": 1.0, "strategy": [
+            ["XYZ", [0], 1, -1]]}}), "CCH406"),
+    ):
+        bad = {k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in good.items()}
+        mutate(bad)
+        with open(p, "w") as f:
+            json.dump(bad, f)
+        capsys.readouterr()
+        assert main(["cache", p]) == 1
+        out = capsys.readouterr().out
+        assert code in out, (code, out)
+
+
 def test_fflint_registry_exits_zero():
     """The CI contract: the full rewrite registry carries passing
     proofs through the CLI entry point."""

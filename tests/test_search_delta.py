@@ -372,6 +372,182 @@ def test_delta_find_matches_falls_back_without_seeds():
         [n.guid for n in xf.find_matches(g)]
 
 
+# ---------------------------------------------------------------------------
+# segment reuse (PR 7): incremental native-DP ctx assembly + persistent
+# DP memo rows under process-stable digests
+
+
+def test_ctx_patch_oracle_across_random_substitutions(monkeypatch):
+    """Property: every PATCHED native-DP ctx must be indistinguishable
+    from a full rebuild (same topo order, packed arrays, edge
+    matrices).  CTX_CHECK arms the runtime oracle — _assert_ctx_equal
+    raises on any divergence — and the walk must actually take the
+    patch path, not fall back to rebuilds."""
+    from flexflow_tpu import native as _native
+    from flexflow_tpu.search import dp as dp_mod
+    from flexflow_tpu.search.dp import SearchHelper
+
+    if _native.get_lib() is None:
+        pytest.skip("native library not built (see tests/test_native.py)")
+    monkeypatch.setattr(dp_mod, "CTX_CHECK", True)
+    n = 8
+    for builder in (_bert_graph, _dlrm_graph):
+        graph = builder()
+        sim = Simulator(ff.FFConfig(num_devices=n).machine_spec,
+                        num_devices=n)
+        helper = SearchHelper(sim, n)
+        assert helper._native_dp_ctx(graph) is not None
+        xfers = generate_all_pcg_xfers(n)
+        rng = random.Random(11)
+        parent = graph
+        for step in range(10):
+            children = []
+            for xf in xfers:
+                matches = xf.find_matches(parent)
+                if not matches:
+                    continue
+                child = xf.apply(parent, rng.choice(matches))
+                if child is None or child.num_nodes > 256:
+                    continue
+                # the oracle runs inside: patched ctx asserted == rebuilt
+                assert helper._native_dp_ctx(child) is not None
+                children.append(child)
+                if len(children) >= 3:
+                    break
+            if not children:
+                break
+            parent = rng.choice(children)
+        assert helper.ctx_patch_hits > 0, (
+            "substitution children never took the incremental ctx path")
+
+
+def test_ctx_patch_falls_back_without_parent_ctx():
+    """A graph with no _changed_vs (or a parent that never built a ctx)
+    must take the full-rebuild path, not crash."""
+    from flexflow_tpu import native as _native
+    from flexflow_tpu.search.dp import SearchHelper
+
+    if _native.get_lib() is None:
+        pytest.skip("native library not built (see tests/test_native.py)")
+    g = _bert_graph()
+    sim = Simulator(ff.FFConfig(num_devices=8).machine_spec, num_devices=8)
+    helper = SearchHelper(sim, 8)
+    assert helper._native_dp_ctx(g) is not None
+    assert helper.ctx_patch_hits == 0
+    assert helper.ctx_rebuilds == 1
+
+
+_DIGEST_SCRIPT = r"""
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_transformer
+from flexflow_tpu.search.cost_cache import stable_graph_digest
+
+cfg = ff.FFConfig(batch_size=8, num_devices=8)
+g = build_transformer(cfg, num_layers=2, hidden=128, num_heads=4,
+                      ff_dim=256, seq_len=32).graph
+snh = g.stable_node_digests()
+order = {n.guid: i for i, n in enumerate(g.topo_order())}
+print("GD", stable_graph_digest(g))
+print("NH", ";".join(snh[guid] for guid in sorted(snh, key=order.get)))
+"""
+
+
+def _run_subprocess(script, hash_seed, *argv):
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, PYTHONHASHSEED=str(hash_seed),
+               JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script, *map(str, argv)],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r.stdout
+
+
+def test_stable_digests_identical_across_processes():
+    """The persistence contract: two FRESH processes — with different
+    PYTHONHASHSEED, the thing that randomizes python tuple hashes and
+    thus node_hashes() — must produce identical stable node digests and
+    graph digest, or no prior run's DP memo rows could ever be served."""
+    a = _run_subprocess(_DIGEST_SCRIPT, 101)
+    b = _run_subprocess(_DIGEST_SCRIPT, 202)
+    lines_a = [ln for ln in a.splitlines() if ln[:3] in ("GD ", "NH ")]
+    lines_b = [ln for ln in b.splitlines() if ln[:3] in ("GD ", "NH ")]
+    assert lines_a and lines_a == lines_b
+
+
+_WARM_SCRIPT = r"""
+import json
+import sys
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_transformer
+from flexflow_tpu.search.driver import LAST_SEARCH_STATS, optimize_strategy
+
+cache, budget = sys.argv[1], int(sys.argv[2])
+cfg = ff.FFConfig(batch_size=8, num_devices=8, search_budget=budget,
+                  cost_cache_file=cache)
+g = build_transformer(cfg, num_layers=2, hidden=128, num_heads=4,
+                      ff_dim=256, seq_len=32).graph
+bg, strat = optimize_strategy(g, cfg, return_graph=True)
+print("STATS " + json.dumps({
+    "served": LAST_SEARCH_STATS["dp_rows_served"],
+    "result_hit": LAST_SEARCH_STATS["result_cache_hit"],
+    "covered": len(strat) == bg.num_nodes,
+}))
+"""
+
+
+def test_warm_process_serves_persisted_dp_rows(tmp_path):
+    """A COLD process must not touch the dp-row layer (within one run
+    the in-process memo supersedes it — the bit-identical gate), and a
+    WARM second process (different PYTHONHASHSEED, different search
+    budget so the whole-result layer misses) must serve tier-2 DP
+    results from the persisted rows."""
+    import json as _json
+
+    cache = str(tmp_path / "cc.json")
+    out = _run_subprocess(_WARM_SCRIPT, 101, cache, 8)
+    cold = _json.loads(out.split("STATS ", 1)[1])
+    assert cold["served"] == 0 and not cold["result_hit"]
+    assert cold["covered"]
+    with open(cache) as f:
+        data = _json.load(f)
+    assert data["dp_schema"] == 1 and data["dp_rows"], (
+        "first search persisted no DP memo rows")
+
+    out = _run_subprocess(_WARM_SCRIPT, 202, cache, 9)
+    warm = _json.loads(out.split("STATS ", 1)[1])
+    assert warm["served"] > 0, warm
+    assert not warm["result_hit"]  # budget differs: result layer missed
+    assert warm["covered"]
+
+
+def test_unknown_dp_schema_drops_layer_loudly(tmp_path, capsys):
+    """Corrupt/unknown dp_schema: the loader must drop the dp-row layer
+    with a stderr warning (one recompute, never a wrong serve) while
+    keeping the rest of the cache."""
+    import json as _json
+
+    from flexflow_tpu.search.cost_cache import DP_SCHEMA
+
+    path = str(tmp_path / "cc.json")
+    sig = "test-signature"
+    with open(path, "w") as f:
+        _json.dump({"schema": 1, "signature": sig,
+                    "calibration_stale": False, "rows": [],
+                    "dp_schema": DP_SCHEMA + 99,
+                    "dp_rows": {"aa:bb": {"cost": 1.0, "strategy": [
+                        ["ab12", [1, 8], 1, 0]]}}}, f)
+    cc = CostCache(path, sig)
+    assert not cc.dp_rows and not cc.dp_loaded
+    assert cc.get_dp_row("aa:bb") is None
+    assert "dp_schema" in capsys.readouterr().err
+
+
 def test_search_perf_reports_match_shrink():
     """The satellite's proof counter: a search over a big graph must
     report dirty-region rescans with most match work skipped."""

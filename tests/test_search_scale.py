@@ -122,6 +122,35 @@ def test_default_search_gpt_under_60s_and_splits_lm_head():
     ), f"lm_head stayed pure-DP: {hv}"
 
 
+def test_chain_search_scales_past_native_ceiling():
+    """Production-scale gate (PR 7): a GPT stack past the native DP
+    engine's 256-node ceiling must route through the k-way chain
+    decomposition — seconds, not the minutes the binary recursion took
+    (pre-PR: a 455-node GPT hit the 600 s deadline) — stamp the
+    repeated isomorphic layers instead of re-solving each, and still
+    beat pure data parallelism."""
+    from flexflow_tpu.models import build_gpt
+    from flexflow_tpu.search.driver import CHAIN_MIN_NODES, LAST_SEARCH_STATS
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file="")
+    m = build_gpt(cfg, vocab=4000, num_layers=40, hidden=256, num_heads=4,
+                  ff_dim=512, seq_len=64)
+    g = m.graph
+    assert g.num_nodes > CHAIN_MIN_NODES
+    t0 = time.monotonic()
+    best_graph, strategy = optimize_strategy(g, cfg, return_graph=True)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, f"287-node chain search took {elapsed:.1f}s"
+    assert len(strategy) == best_graph.num_nodes
+    sim = Simulator(cfg.machine_spec, num_devices=8)
+    c_searched = sim.simulate(best_graph, strategy)
+    c_dp = sim.simulate(g, data_parallel_strategy(g, 8))
+    assert c_searched <= c_dp * 1.001, (c_searched, c_dp)
+    # the repeated layers must be STAMPED from solved siblings, not
+    # re-searched N times (the lint-gated transplant path)
+    assert LAST_SEARCH_STATS["segments_stamped"] > 0, LAST_SEARCH_STATS
+
+
 def test_calibrated_search_stays_native_fast():
     """Regression gate: a CLUSTER-bearing calibration table must not
     knock the search off the native DP engine (pre-fix, the committed

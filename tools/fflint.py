@@ -45,6 +45,7 @@ sys.path.insert(
 
 META_KEY = "__meta__"  # mirrors search/strategy_io.py (stdlib path)
 CACHE_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.SCHEMA_VERSION
+DP_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.DP_SCHEMA
 
 
 def _load_json(path: str):
@@ -263,6 +264,62 @@ def lint_cache_file(path: str) -> List[Tuple[str, str, str]]:
     sidecar = path + ".results.pkl"
     if os.path.exists(sidecar) and os.path.getsize(sidecar) == 0:
         out.append(("error", "CCH404", f"empty results sidecar {sidecar}"))
+    out += _lint_dp_rows(data)
+    return out
+
+
+def _lint_dp_rows(data) -> List[Tuple[str, str, str]]:
+    """CCH405/406: the persisted DP-memo-row layer (search/cost_cache.py
+    dp_rows — tier-2 segment strategies under process-stable digests).
+    An unknown ``dp_schema`` is a DISTINCT error (CCH405): the loader
+    drops the layer loudly rather than serving rows written under
+    another layout; malformed rows are CCH406."""
+    dp = data.get("dp_rows")
+    if dp is None:
+        return []
+    out: List[Tuple[str, str, str]] = []
+    if data.get("dp_schema") not in DP_SCHEMA_VERSIONS:
+        out.append(("error", "CCH405",
+                    f"dp_rows present but dp_schema "
+                    f"{data.get('dp_schema')!r} unknown (known: "
+                    f"{list(DP_SCHEMA_VERSIONS)}) — the loader will drop "
+                    f"the whole dp-row layer"))
+    if not isinstance(dp, dict):
+        return out + [("error", "CCH406", "dp_rows is not an object")]
+    for key, row in sorted(dp.items()):
+        where = f"dp_rows[{key[:32]}...]" if len(key) > 32 else \
+            f"dp_rows[{key}]"
+        if not isinstance(key, str) or ":" not in key:
+            out.append(("error", "CCH406",
+                        f"{where}: malformed key (expect "
+                        f"'<graph digest>:<pin/knob digest>')"))
+        if not isinstance(row, dict):
+            out.append(("error", "CCH406", f"{where}: row is not an "
+                        "object"))
+            continue
+        cost = row.get("cost")
+        if not isinstance(cost, (int, float)) or not math.isfinite(cost) \
+                or cost < 0:
+            out.append(("error", "CCH406",
+                        f"{where}: malformed cost {cost!r}"))
+        strat = row.get("strategy")
+        if not isinstance(strat, list) or not strat:
+            out.append(("error", "CCH406", f"{where}: no strategy rows"))
+            continue
+        for j, entry in enumerate(strat):
+            ok = (
+                isinstance(entry, list) and len(entry) == 4
+                and isinstance(entry[0], str) and entry[0]
+                and all(c in "0123456789abcdef" for c in entry[0])
+                and isinstance(entry[1], list)
+                and all(isinstance(d, int) and d >= 1 for d in entry[1])
+                and isinstance(entry[2], int) and entry[2] >= 1
+                and isinstance(entry[3], int) and entry[3] >= 0
+            )
+            if not ok:
+                out.append(("error", "CCH406",
+                            f"{where}: strategy[{j}] malformed: "
+                            f"{str(entry)[:100]}"))
     return out
 
 

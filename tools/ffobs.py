@@ -189,6 +189,19 @@ def render_report(events: List[dict], top: int = 10,
         if p.get("result_cache_hit"):
             line += "; RESULT served from the persistent cost cache"
         lines.append(line)
+        cp, cr = p.get("ctx_patch_hits", 0), p.get("ctx_rebuilds", 0)
+        if cp + cr:
+            lines.append(
+                f"Native DP ctx assembly: {cp} patched from the parent's "
+                f"ctx / {cr} full rebuilds "
+                f"({cp / max(1, cp + cr):.0%} incremental)")
+        stamped = p.get("segments_stamped", 0)
+        served = p.get("dp_rows_served", 0)
+        if stamped or served:
+            lines.append(
+                f"Segment reuse: {stamped} isomorphic segments stamped "
+                f"(lint-gated), {served} tier-2 DP results served from "
+                f"persisted memo rows")
         md = p.get("match_delta_scans", 0)
         if md:
             scanned = p.get("match_nodes_rescanned", 0)

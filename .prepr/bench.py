@@ -1,0 +1,348 @@
+#!/usr/bin/env python
+"""Benchmark entry point — prints ONE JSON line.
+
+Measures training throughput (samples/s) and MFU of the flagship model
+(Transformer encoder, the reference's examples/cpp/Transformer workload:
+transformer.cc:112-211 self-reports THROUGHPUT the same way) on the
+available accelerator.  The reference repo publishes no absolute
+numbers (BASELINE.md), so vs_baseline reports delivered MFU against a
+0.40 good-utilization bar for this workload — exceeding 1.0 means the
+chip is running at better than 40% of bf16 MXU peak.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_LASTGOOD.json")
+
+
+def _subprocess_probe(timeout_s: float):
+    """Probe the accelerator in a FRESH subprocess: a wedged device
+    tunnel hangs backend init forever IN-PROCESS (observed: a
+    remote-compile failure left the relay claiming for hours), and a
+    hung plugin cannot be re-initialized from the same interpreter —
+    only a new process gets a clean attempt.  Returns
+    ("ok" | "error" | "hung", stderr_text) — a fast nonzero exit is a
+    deterministic environment breakage whose cause must be SURFACED,
+    not papered over with a stale fallback."""
+    import subprocess
+
+    # JAX_PLATFORMS=cpu alone is NOT honored under the axon TPU plugin
+    # (its sitecustomize re-selects the platform at import); a CPU-
+    # forced bench must force it via jax.config before backend init
+    code = (
+        "import os, jax; "
+        "os.environ.get('JAX_PLATFORMS') == 'cpu' and "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        "import jax.numpy as jnp; "
+        "d = jax.devices(); x = jnp.ones((64, 64)); "
+        "(x @ x).block_until_ready(); print(d[0].platform)"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=timeout_s, text=True,
+        )
+        return ("ok" if r.returncode == 0 else "error"), r.stderr
+    except subprocess.TimeoutExpired as e:
+        err = getattr(e, "stderr", None) or b""
+        if isinstance(err, bytes):
+            err = err.decode("utf-8", "replace")
+        return "hung", err
+
+
+def _probe_backend(timeout_s: float = 120.0, attempts: int = 3,
+                   retry_wait_s: float = 20.0):
+    """Fail-SOFT accelerator probe with bounded recovery.  Each attempt
+    runs in a fresh subprocess (see _subprocess_probe); only after the
+    subprocess confirms a live backend does THIS process touch jax.
+    Returns jax.devices() on success, None when the backend stays
+    unresponsive — the caller then falls back to the last good
+    measurement window instead of recording nothing (round-3 failure:
+    BENCH_r03.json was an rc=3 tombstone)."""
+    for attempt in range(1, attempts + 1):
+        status, stderr = _subprocess_probe(timeout_s)
+        if status == "error":
+            # deterministic breakage (bad plugin/env), not a wedge:
+            # surface the actual cause and fail hard — a stale fallback
+            # here would report an old number forever
+            print("# bench: backend probe ERRORED (not hung); stderr:",
+                  file=sys.stderr)
+            print(stderr[-2000:], file=sys.stderr)
+            os._exit(2)
+        if status == "ok":
+            import threading
+
+            done = threading.Event()
+            out = []
+
+            def _try():
+                try:
+                    import jax
+
+                    if os.environ.get("JAX_PLATFORMS") == "cpu":
+                        jax.config.update("jax_platforms", "cpu")
+                    import jax.numpy as jnp
+
+                    devs = jax.devices()
+                    x = jnp.ones((64, 64))
+                    (x @ x).block_until_ready()
+                    out.append(devs)
+                except Exception as e:  # pragma: no cover
+                    out.append(e)
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=_try, daemon=True)
+            t.start()
+            # subprocess said alive; in-process init can still wedge
+            if done.wait(timeout_s):
+                if isinstance(out[0], Exception):
+                    # the tunnel's documented failure mode is transient
+                    # RPC errors FOLLOWED by wedges — surface the error
+                    # and spend the remaining attempts before falling
+                    # back (the subprocess 'error' path above handles
+                    # deterministic env breakage with a hard exit)
+                    print(
+                        f"# bench: in-process backend init raised "
+                        f"(attempt {attempt}/{attempts}): "
+                        f"{type(out[0]).__name__}: {out[0]}",
+                        file=sys.stderr,
+                    )
+                    if attempt < attempts:
+                        time.sleep(retry_wait_s)
+                    continue
+                return out[0]
+            print(
+                f"# bench: in-process backend init hung after a "
+                f"successful subprocess probe (attempt {attempt})",
+                file=sys.stderr,
+            )
+            return None  # this interpreter is wedged; don't retry here
+        print(
+            f"# bench: accelerator unresponsive after {timeout_s:.0f}s "
+            f"(attempt {attempt}/{attempts})"
+            + (f"; retrying in {retry_wait_s:.0f}s" if attempt < attempts
+               else ""),
+            file=sys.stderr,
+        )
+        if attempt < attempts:
+            time.sleep(retry_wait_s)
+    return None
+
+
+def _emit_last_good_or_die():
+    """The tunnel stayed wedged: re-emit the most recent good
+    measurement window, clearly marked stale, so the round still
+    records a parsed number with provenance instead of a tombstone."""
+    if os.path.exists(LAST_GOOD_PATH):
+        with open(LAST_GOOD_PATH) as f:
+            rec = json.load(f)
+        rec["stale"] = True
+        rec["stale_reason"] = (
+            "accelerator tunnel unresponsive; value is the last good "
+            f"measurement window from {rec.get('measured_at', 'unknown')}"
+        )
+        print(json.dumps(rec))
+        sys.stdout.flush()  # os._exit skips stdio flush — a piped stdout
+        # would otherwise drop the record and exit 0 with empty output
+        os._exit(0)
+    print(
+        "# bench: accelerator unreachable and no last-good window "
+        "recorded",
+        file=sys.stderr,
+    )
+    os._exit(3)  # hung init threads cannot be joined
+
+
+def main():
+    """Orchestrator: probe, then run the ENTIRE measurement in a fresh
+    subprocess with a hard deadline — the tunnel's documented failure
+    mode can wedge MID-measurement, and a wedged interpreter can only
+    be abandoned, not recovered (round-4: two rc=3 tombstones).  The
+    subprocess prints the JSON record; on timeout/failure the parent
+    falls back to the last good window."""
+    devices = _probe_backend()
+    if devices is None:
+        _emit_last_good_or_die()
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--measure"],
+            timeout=1500.0, text=True, capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("# bench: measurement subprocess exceeded its deadline "
+              "(tunnel wedged mid-run); falling back", file=sys.stderr)
+        _emit_last_good_or_die()
+    if r.returncode == 0 and r.stdout.strip():
+        sys.stderr.write(r.stderr)
+        print(r.stdout.strip().splitlines()[-1])
+        return
+    print(f"# bench: measurement subprocess failed rc={r.returncode}; "
+          f"stderr tail:", file=sys.stderr)
+    print(r.stderr[-2000:], file=sys.stderr)
+    _emit_last_good_or_die()
+
+
+def measure():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon plugin's sitecustomize overrides the env var; only a
+        # pre-init jax.config update reliably forces CPU
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+
+    on_tpu = devices[0].platform == "tpu" or "TPU" in str(devices[0])
+    # sized for a single v5e chip; shrink on CPU so CI-style runs finish
+    if on_tpu:
+        batch, seq, hidden, layers, heads, ff_dim = 64, 256, 512, 6, 8, 2048
+        steps = 30
+        dtype = "bfloat16"
+    else:
+        batch, seq, hidden, layers, heads, ff_dim = 8, 32, 64, 2, 4, 128
+        steps = 5
+        dtype = "float32"
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.models import build_transformer
+
+    cfg = ff.FFConfig(
+        batch_size=batch,
+        epochs=1,
+        num_devices=len(devices),
+        only_data_parallel=len(devices) == 1,
+        compute_dtype=dtype,
+    )
+    # bf16 activation stream on TPU: ops cast outputs back to the input
+    # tensor's dtype, so a bf16 input keeps every inter-op activation at
+    # 2 bytes (half the HBM traffic); matmuls still accumulate f32 and
+    # loss/metrics upcast internally
+    model = build_transformer(
+        cfg, num_layers=layers, hidden=hidden, num_heads=heads,
+        ff_dim=ff_dim, seq_len=seq, dtype=dtype,
+    )
+    model.compile(
+        optimizer=ff.AdamOptimizer(alpha=1e-4),
+        loss_type="mean_squared_error",
+        metrics=["mean_squared_error"],
+    )
+
+    rng = np.random.default_rng(0)
+    # N distinct batches stacked on a leading step axis: one
+    # train_steps() call scans all N inside a single compiled program —
+    # the XLA analogue of the reference's Legion iteration tracing
+    # (flexflow_cffi.py:1867-1874), amortizing per-call dispatch (which
+    # dominates through a remote-device tunnel)
+    trace_n = 10 if on_tpu else steps
+    import ml_dtypes
+
+    in_np = np.float32 if dtype == "float32" else np.dtype(
+        getattr(ml_dtypes, dtype))
+    xs = rng.normal(size=(trace_n, batch, seq, hidden)).astype(in_np)
+    ys = rng.normal(size=(trace_n, batch, seq, hidden)).astype(np.float32)
+    xs_d = jax.device_put(xs, model.compiled.stacked_input_sharding(0))
+    ys_d = jax.device_put(ys, model.compiled.stacked_batch_sharding())
+
+    import jax.random as jrandom
+
+    # warmup: first call compiles; later calls through the device tunnel
+    # still need a few rounds to reach steady state
+    params, opt_state, state = model.params, model.opt_state, model.state
+    for i in range(3 if on_tpu else 1):
+        params, opt_state, state, losses, m = model.compiled.train_steps(
+            params, opt_state, state, jrandom.key(1000 + i), [xs_d], ys_d
+        )
+    float(losses[-1])  # host readback — block_until_ready may not fence
+    # through remote-device tunnels, a readback always does
+
+    # Timed block: reps calls dispatched back-to-back (async dispatch
+    # keeps the device pipelined, as a real training loop would), one
+    # readback fence at the end.  The block repeats and the MEDIAN block
+    # time is reported — robust to tunnel-latency outliers that made
+    # single-block runs swing by ~8%.  Per-call fencing would serialize
+    # the pipeline and measure round-trips, not training.
+    reps = max(1, steps // trace_n)
+    block_times = []
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        for i in range(reps):
+            params, opt_state, state, losses, m = model.compiled.train_steps(
+                params, opt_state, state, jrandom.key(i + 1), [xs_d], ys_d
+            )
+        float(losses[-1])
+        block_times.append(time.perf_counter() - t0)
+    elapsed = float(np.median(block_times))
+    steps = reps * trace_n
+    throughput = steps * batch / elapsed
+
+    # MFU = model FLOPs actually trained / elapsed / chip peak.  Forward
+    # FLOPs come from the PCG's own per-op estimates (the same numbers the
+    # cost model ranks strategies with); training ≈ 3x forward (bwd does
+    # the two grad matmuls per fwd matmul).
+    fwd_flops = sum(
+        n.op.flops() for n in model.graph.nodes.values()
+    )
+    train_flops_per_step = 3.0 * fwd_flops
+    from flexflow_tpu.core.machine import MachineSpec
+
+    if on_tpu:
+        kind = getattr(devices[0], "device_kind", "").lower().replace(" ", "")
+        # bf16 MXU peaks per chip by generation; v5 "lite" spellings all
+        # mean v5e silicon (the tunnel reports "tpuv5lite")
+        known_peaks = {
+            "v5p": 4.59e14,
+            "v5e": 1.97e14,
+            "v5litepod": 1.97e14,
+            "v5lite": 1.97e14,
+            "v6e": 9.2e14,
+            "v6": 9.2e14,
+            "v4": 2.75e14,
+            "v3": 1.23e14,
+        }
+        peak = next(
+            (p for k, p in known_peaks.items() if k in kind),
+            MachineSpec.tpu_v5e(1).peak_flops,
+        )
+        if not any(k in kind for k in known_peaks):
+            print(f"# warning: unknown TPU kind {kind!r}, assuming v5e peak",
+                  file=sys.stderr)
+    else:
+        peak = MachineSpec.host_cpu(1).peak_flops
+    mfu = train_flops_per_step * steps / elapsed / (peak * len(devices))
+    # vs_baseline: the reference publishes no absolute numbers
+    # (BASELINE.md); its per-chip contract is utilization, so report the
+    # ratio of delivered MFU to a 40% good-MFU bar for this workload.
+    record = {
+        "metric": "transformer_train_throughput",
+        "value": round(throughput, 2),
+        "unit": "samples/s",
+        "mfu": round(mfu, 4),
+        "vs_baseline": round(mfu / 0.40, 3),
+    }
+    print(json.dumps(record))
+    if on_tpu:
+        # persist the window so a later wedged-tunnel run can re-emit a
+        # real (stale-marked) number instead of a tombstone
+        with open(LAST_GOOD_PATH, "w") as f:
+            json.dump(
+                {**record,
+                 "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime())},
+                f, indent=1,
+            )
+
+
+if __name__ == "__main__":
+    if "--measure" in sys.argv:
+        measure()
+    else:
+        main()

@@ -1,0 +1,1273 @@
+#!/usr/bin/env python
+"""Searched-vs-DP benchmark artifact (reference: the OSDI'22 Unity
+artifact scripts, scripts/osdi22ae/{bert,dlrm,candle_uno,inception}.sh —
+each runs an example twice, searched vs --only-data-parallel, and
+compares throughput).
+
+For each model this reports:
+  * simulated 8-device cost of the searched strategy vs pure data
+    parallelism (full-size model, the TPU machine model), and
+  * a REAL executed step-time ratio for the same two strategies on the
+    available mesh (>=8 devices required; sizes are scaled down when
+    executing on a CPU mesh and recorded as such — honest numbers,
+    clearly labeled).
+
+Writes BENCH_SEARCH.json and BENCH_SEARCH.md.
+
+Usage:
+  python bench_search.py [--models bert,dlrm,candle_uno,inception]
+                         [--calibrate] [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+# The sync-bound transformer regime (osdi22ae/bert.sh scaled to the
+# CPU mesh): per-device batch 1, full hidden/ff widths — DP's weight
+# allreduce dominates and the searched TP strategy wins at EXECUTION.
+# Shared with tests/test_search_exec_coherence.py so the benchmark and
+# the CI gate measure the SAME program pair.
+SYNC_BOUND_BERT_KW = dict(num_layers=2, hidden=512, num_heads=4,
+                          ff_dim=2048, seq_len=16)
+
+
+def _model_specs():
+    """Per-model configs mirror the osdi22ae scripts (bert.sh: batch 8,
+    budget 30; dlrm.sh/candle_uno.sh: budget 20; inception.sh: batch 64,
+    budget 10)."""
+    from flexflow_tpu.models import (
+        build_alexnet,
+        build_alexnet_cifar10,
+        build_candle_uno,
+        build_dlrm,
+        build_gpt,
+        build_inception_v3,
+        build_mlp_unify,
+        build_resnext50,
+        build_transformer,
+        build_xdl,
+    )
+
+    return {
+        "alexnet": dict(
+            # the 5th BASELINE.json target config (AlexNet/CIFAR-10):
+            # sim at full ImageNet size, exec at the native CIFAR size
+            build=lambda cfg: build_alexnet(cfg),
+            batch=64, budget=10, loss="sparse_categorical_crossentropy",
+            exec_build=lambda cfg: build_alexnet_cifar10(cfg),
+            exec_batch=16,
+        ),
+        "bert": dict(
+            build=lambda cfg: build_transformer(
+                cfg, num_layers=12, hidden=512, num_heads=8, ff_dim=2048,
+                seq_len=512),
+            batch=8, budget=30, loss="mean_squared_error",
+            # exec tier keeps the full hidden/ff widths at short seq:
+            # the per-device batch is 1, so DP's weight allreduce
+            # dominates and the search's TP strategy wins at EXECUTION
+            # (the osdi22ae/bert.sh regime; measured 3.7x on the CPU
+            # mesh) — a narrowed exec model collapses to DP and the
+            # two-program comparison degenerates.  The coherence CI
+            # gates THE SAME spec (SYNC_BOUND_BERT_KW).
+            exec_build=lambda cfg: build_transformer(
+                cfg, **SYNC_BOUND_BERT_KW),
+            exec_batch=8,
+        ),
+        "gpt": dict(
+            # causal LM (beyond the reference's workload set): the
+            # 32k-vocab lm_head is the largest weight — the search
+            # row-splits it instead of paying its gradient allreduce
+            build=lambda cfg: build_gpt(
+                cfg, vocab=32000, num_layers=8, hidden=512, num_heads=8,
+                ff_dim=2048, seq_len=512),
+            batch=8, budget=30, loss="sparse_categorical_crossentropy",
+            exec_build=lambda cfg: build_gpt(
+                cfg, vocab=2048, num_layers=2, hidden=128, num_heads=4,
+                ff_dim=256, seq_len=64),
+            exec_batch=8,
+        ),
+        "dlrm": dict(
+            build=lambda cfg: build_dlrm(cfg),
+            batch=64, budget=20, loss="mean_squared_error",
+            exec_build=lambda cfg: build_dlrm(
+                cfg, embedding_sizes=(100000,) * 4, embedding_dim=32,
+                bot_mlp=(64, 32), top_mlp=(64, 1)),
+            exec_batch=64,
+        ),
+        "candle_uno": dict(
+            build=lambda cfg: build_candle_uno(cfg),
+            batch=64, budget=20, loss="mean_squared_error",
+            exec_build=lambda cfg: build_candle_uno(cfg),
+            exec_batch=32,
+        ),
+        "inception": dict(
+            build=lambda cfg: build_inception_v3(cfg),
+            batch=64, budget=10, loss="sparse_categorical_crossentropy",
+            # 75x75 is InceptionV3's minimum input: ~10 s/step on the
+            # CPU mesh — slow but real; the 299x299 full size stays
+            # sim-only (hours per artifact run)
+            exec_build=lambda cfg: build_inception_v3(
+                cfg, num_classes=100, image=75),
+            exec_batch=4,
+        ),
+        # the remaining osdi22ae scripts: resnext-50.sh, xdl.sh, mlp.sh
+        "resnext50": dict(
+            build=lambda cfg: build_resnext50(cfg),
+            batch=64, budget=10, loss="sparse_categorical_crossentropy",
+            # 32x32 is the executable floor for the grouped-conv stack
+            # on a CPU mesh (~45 s/step at batch 4; batch 2 halves it);
+            # the 224x224 full size stays sim-only
+            exec_build=lambda cfg: build_resnext50(
+                cfg, num_classes=10, image=32),
+            exec_batch=2,
+        ),
+        "xdl": dict(
+            build=lambda cfg: build_xdl(cfg),
+            batch=64, budget=20, loss="mean_squared_error",
+            exec_build=lambda cfg: build_xdl(
+                cfg, num_tables=8, vocab=20000, embedding_dim=16,
+                mlp=(64, 32, 1)),
+            exec_batch=64,
+        ),
+        "mlp": dict(
+            build=lambda cfg: build_mlp_unify(cfg),
+            batch=64, budget=20, loss="sparse_categorical_crossentropy",
+            exec_build=lambda cfg: build_mlp_unify(
+                cfg, in_dim=512, hidden=(512, 512, 512)),
+            exec_batch=32,
+        ),
+    }
+
+
+def simulate_pair(name, spec, n_devices, calibration=None,
+                  calibration_file=None, cost_cache_file=None,
+                  verify=False):
+    import flexflow_tpu as ff
+    from flexflow_tpu.analysis import CHECK_STATS
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+    from flexflow_tpu.search.driver import LAST_SEARCH_STATS, optimize_strategy
+    from flexflow_tpu.search.simulator import Simulator
+
+    cfg = ff.FFConfig(batch_size=spec["batch"], num_devices=n_devices,
+                      search_budget=spec["budget"],
+                      # the SEARCH must rank with the measured table too,
+                      # or it optimizes the roofline and the calibrated
+                      # re-simulation below exposes a bad pick
+                      calibration_file=calibration_file,
+                      cost_cache_file=cost_cache_file)
+    model = spec["build"](cfg)
+    g = model.graph
+    if calibration is not None and (
+            calibration.backend not in (None, cfg.machine_spec.platform)):
+        print(f"# {name}: calibration probed on {calibration.backend!r} is "
+              f"incoherent with machine model {cfg.machine_spec.name!r}; "
+              "simulating with the roofline")
+        calibration = None
+    sim = Simulator(cfg.machine_spec, num_devices=n_devices,
+                    calibration=calibration)
+    c_dp = sim.simulate(g, data_parallel_strategy(g, n_devices))
+    verify_before = dict(CHECK_STATS)
+    t0 = time.monotonic()
+    best_graph, strategy = optimize_strategy(g, cfg, return_graph=True)
+    search_s = time.monotonic() - t0
+    stats = dict(LAST_SEARCH_STATS)
+    verify_stats = None
+    if verify:
+        # per-model verifier overhead: wall seconds spent inside the
+        # invariant checker during THIS search (the measured cost of
+        # always-on checking, not a guess)
+        verify_stats = {
+            "verify_checks": int(
+                CHECK_STATS["checks"] - verify_before["checks"]),
+            "verify_seconds": round(
+                CHECK_STATS["seconds"] - verify_before["seconds"], 4),
+        }
+    c_se = Simulator(cfg.machine_spec, num_devices=n_devices,
+                     calibration=calibration).simulate(best_graph, strategy)
+    d, f = stats.get("delta_sims", 0), stats.get("full_sims", 0)
+    rh = stats.get("cache_row_hits", 0)
+    rm = stats.get("cache_row_misses", 0)
+    return {
+        "nodes": g.num_nodes,
+        # whether THIS model's sim numbers actually consulted measured
+        # records (False when the table was discarded as incoherent
+        # with the machine model above)
+        "sim_calibrated": calibration is not None,
+        "sim_dp_ms": round(c_dp * 1e3, 4),
+        "sim_searched_ms": round(c_se * 1e3, 4),
+        "sim_ratio": round(c_dp / c_se, 3) if c_se > 0 else None,
+        # split timing (was one conflated search_seconds): any
+        # compile-time calibration probing is reported separately
+        "search_seconds": round(stats.get("search_seconds", search_s), 2),
+        "calibration_seconds": round(stats.get("calibration_seconds", 0.0),
+                                     2),
+        # delta-simulation and persistent-cache effectiveness — the
+        # tracked trajectory numbers for search throughput
+        "delta_sims": d,
+        "full_sims": f,
+        "delta_hit_rate": round(d / (d + f), 3) if (d + f) else None,
+        "cost_cache_row_hit_rate": (
+            round(rh / (rh + rm), 3) if (rh + rm) else None),
+        "cost_cache_result_hit": bool(stats.get("result_cache_hit")),
+        **(verify_stats or {}),
+    }
+
+
+def _steady_step_seconds(model, xs, y, steps, blocks: int = 5):
+    """Median-of-blocks step time: single-core hosts jitter 8-18%
+    between consecutive blocks (observed), which is larger than the
+    effects being measured — the median of several short blocks is
+    stable to ~2-3%."""
+    import statistics
+
+    import jax
+    import jax.random as jrandom
+
+    compiled = model.compiled
+    loader_inputs = [
+        jax.device_put(x, compiled.input_sharding(i)) for i, x in enumerate(xs)
+    ]
+    labels = jax.device_put(y, compiled.batch_sharding())
+    params, opt_state, state = model.params, model.opt_state, model.state
+    for i in range(3):  # compile + settle
+        params, opt_state, state, loss, _ = compiled.train_step(
+            params, opt_state, state, jrandom.key(i), loader_inputs, labels)
+    float(loss)
+    times = []
+    for b in range(blocks):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            params, opt_state, state, loss, _ = compiled.train_step(
+                params, opt_state, state, jrandom.key(100 + b * steps + i),
+                loader_inputs, labels)
+        float(loss)
+        times.append((time.perf_counter() - t0) / steps)
+    return statistics.median(times)
+
+
+def _exec_cfg_kwargs(n_devices, on_cpu):
+    """The live-mesh execution recipe SHARED by execute_pair and the
+    sync-precision sweep, so the two 'executed' measurements in one
+    artifact can never diverge in methodology: on a CPU mesh rank with
+    the CPU machine model in float32; on the real accelerator keep the
+    TPU model and bfloat16."""
+    from flexflow_tpu.core.machine import MachineSpec
+
+    return dict(
+        num_devices=n_devices,
+        compute_dtype="float32" if on_cpu else "bfloat16",
+        machine_spec=MachineSpec.host_cpu(n_devices) if on_cpu else None,
+    )
+
+
+def execute_pair(name, spec, n_devices, steps, calibration_file=None,
+                 obs=False, out_prefix="BENCH_SEARCH",
+                 drift_threshold=0.5):
+    """Measure real per-step seconds for DP vs searched strategies on
+    the live mesh.  Returns None when the model has no executable
+    reduced config.  With ``obs`` the unified telemetry rides along:
+    a per-strategy DriftReport (simulated prediction vs the measured
+    steady step, per phase) lands in the returned row, and the
+    searched strategy's PREDICTED timeline is written as
+    Perfetto-loadable Chrome-trace JSON."""
+    if spec["exec_build"] is None:
+        return None
+    import os
+
+    import jax
+
+    import flexflow_tpu as ff
+    from examples.common import synthetic_inputs, synthetic_labels
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+
+    results = {}
+    programs = {}  # mode -> (graph, strategy, cfg, executor) for obs
+    searched_is_dp = False
+    for mode in ("dp", "searched"):
+        # the osdi22ae contract runs searched-vs-DP on the SAME hardware,
+        # with the search targeting that hardware — on a CPU mesh the
+        # search must rank with the CPU machine model, not the TPU one
+        # (a TPU-optimal strategy can be a CPU pessimization); on the
+        # real accelerator the search gets the calibration file too, so
+        # the executed strategy is the one the calibrated sim ranked
+        cfg = ff.FFConfig(batch_size=spec["exec_batch"],
+                          search_budget=spec["budget"],
+                          calibration_file=(None if on_cpu
+                                            else calibration_file),
+                          only_data_parallel=(mode == "dp"),
+                          **_exec_cfg_kwargs(n_devices, on_cpu))
+        model = spec["exec_build"](cfg)
+        if mode == "dp":
+            strategy = data_parallel_strategy(model.graph, n_devices)
+            model.compile(loss_type=spec["loss"], metrics=[], strategy=strategy)
+        else:
+            model.compile(loss_type=spec["loss"], metrics=[])  # joint search
+            # did the search's champion-vs-DP floor keep plain DP?  Then
+            # both compiled programs are identical and the measured
+            # ratio is pure timing noise around 1.0 — record that.
+            searched_is_dp = (
+                model.strategy == data_parallel_strategy(model.graph, n_devices)
+            )
+        xs = synthetic_inputs(model, cfg.batch_size)
+        y = synthetic_labels(model, cfg.batch_size, spec["loss"])
+        results[mode] = _steady_step_seconds(model, xs, y, steps)
+        if obs:
+            programs[mode] = (
+                model.graph,
+                model.strategy if mode == "searched" else strategy,
+                cfg, type(model.compiled).__name__,
+            )
+    obs_row = {}
+    if obs:
+        from flexflow_tpu.obs.drift import build_drift_report
+        from flexflow_tpu.search.driver import coherent_calibration
+        from flexflow_tpu.search.simulator import Simulator
+
+        drift = {}
+        for mode, (g, strat, cfg_m, executor) in programs.items():
+            # predict with the same table the search ranked with — a
+            # roofline prediction labeled "calibrated" would flag the
+            # calibration table stale for drift it never caused
+            cal = coherent_calibration(cfg_m)
+            sim = Simulator.for_config(cfg_m, calibration=cal)
+            bd = {}
+            schedule, comm = [], []
+            sim.simulate(g, strat, breakdown=bd, schedule=schedule,
+                         comm_schedule=comm)
+            rep = build_drift_report(
+                bd, measured_step_s=results[mode],
+                threshold=drift_threshold,
+                calibrated=cal is not None,
+            )
+            if rep is not None:
+                d = rep.to_dict()
+                d["executor"] = executor
+                drift[mode] = d
+            if mode == "searched":
+                trace_path = f"{out_prefix}_timeline_{name}.json"
+                sim.export_chrome_trace(
+                    g, strat, trace_path,
+                    label=f"predicted ({name}, searched)",
+                    schedule=schedule, comm_schedule=comm,
+                    total_s=bd.get("total_s"))
+                obs_row["predicted_timeline"] = trace_path
+        if drift:
+            obs_row["drift"] = drift
+    return {
+        **obs_row,
+        "searched_is_dp": searched_is_dp,
+        "exec_backend": jax.devices()[0].platform,
+        "exec_devices": n_devices,
+        # virtual devices share the host's physical cores: when cores <
+        # devices, per-device compute serializes and compute-parallel
+        # strategies cannot win — only work/communication-avoiding wins
+        # (DLRM-style) are observable on such a host
+        "exec_host_cores": os.cpu_count(),
+        "exec_scale": "reduced" if on_cpu else "full",
+        "exec_dp_ms": round(results["dp"] * 1e3, 3),
+        "exec_searched_ms": round(results["searched"] * 1e3, 3),
+        "exec_ratio": round(results["dp"] / results["searched"], 3),
+    }
+
+
+def sync_precision_sweep(n_devices, steps, precisions):
+    """The --sync-precision sweep: gradient-sync wire precision as a
+    strategy dimension (comm/quantized.py, EQuARX arXiv:2506.17615) on
+    the sync-bound BERT config (SYNC_BOUND_BERT_KW — per-device batch
+    1, full widths, where DP's weight allreduce dominates).
+
+    Simulated: the DP strategy's weight-sync (allreduce) term and full
+    step cost under the TPU machine model, per precision.  Executed:
+    real CPU-mesh step time running the SAME per-weight-group map the
+    TPU pricing chooses — on a CPU mesh there is no fat wire to save,
+    so the executed ratio measures the quantize round-trip OVERHEAD
+    honestly (the win is the simulated number); the map is forced
+    because the CPU machine model itself declines to compress."""
+    import jax
+
+    import flexflow_tpu as ff
+    from examples.common import synthetic_inputs, synthetic_labels
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.search.sync_precision import choose_sync_precision
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    can_exec = len(jax.devices()) >= n_devices
+
+    sweep = {
+        "model": "bert",
+        "config": dict(SYNC_BOUND_BERT_KW),
+        "batch": 8,
+        "note": (
+            "simulated numbers price the wire win on the TPU machine "
+            "model; executed numbers run the TPU-chosen compression map "
+            "on the live mesh — on a CPU mesh that measures the "
+            "quantize round-trip overhead with no wire to save, so "
+            "exec_ratio <= 1.0 there is expected and honest"
+        ),
+        "rows": {},
+    }
+    from flexflow_tpu.models import build_transformer
+
+    for prec in precisions:
+        cfg = ff.FFConfig(batch_size=8, num_devices=n_devices,
+                          sync_precision=prec)
+        g = build_transformer(cfg, **SYNC_BOUND_BERT_KW).graph
+        sim = Simulator(cfg.machine_spec, num_devices=n_devices,
+                        sync_precision=prec)
+        dp = data_parallel_strategy(g, n_devices)
+        step_s = sim.simulate(g, dp)
+        sync_s = sum(
+            sim.cost.sync_cost(node.op, dp[node.guid])
+            for node in g.topo_order()
+        )
+        groups = choose_sync_precision(g, dp, sim.cost)
+        row = {
+            "sim_allreduce_ms": round(sync_s * 1e3, 4),
+            "sim_step_ms": round(step_s * 1e3, 4),
+            "compressed_groups": len(groups),
+        }
+        if can_exec:
+            cfg_x = ff.FFConfig(
+                batch_size=8, only_data_parallel=True,
+                **_exec_cfg_kwargs(n_devices, on_cpu))
+            m = build_transformer(cfg_x, **SYNC_BOUND_BERT_KW)
+            dp_x = data_parallel_strategy(m.graph, n_devices)
+            m.compile(loss_type="mean_squared_error", metrics=[],
+                      strategy=dp_x)
+            # force the TPU-chosen map (see docstring): the compiled
+            # step is lazily jitted, so setting the map here is enough
+            m.compiled.sync_precision = dict(
+                choose_sync_precision(m.graph, dp_x, sim.cost, mode=prec)
+            )
+            xs = synthetic_inputs(m, cfg_x.batch_size)
+            y = synthetic_labels(m, cfg_x.batch_size, "mean_squared_error")
+            row["exec_ms"] = round(
+                _steady_step_seconds(m, xs, y, steps) * 1e3, 3)
+            row["exec_backend"] = jax.devices()[0].platform
+        sweep["rows"][prec] = row
+        print(json.dumps({"sync_precision": prec, **row}))
+    base = sweep["rows"].get("fp32")
+    if base:
+        for prec, row in sweep["rows"].items():
+            if row.get("sim_allreduce_ms"):
+                row["sim_allreduce_ratio_vs_fp32"] = round(
+                    base["sim_allreduce_ms"] / row["sim_allreduce_ms"], 3)
+                row["sim_step_ratio_vs_fp32"] = round(
+                    base["sim_step_ms"] / row["sim_step_ms"], 3)
+            if row.get("exec_ms") and base.get("exec_ms"):
+                row["exec_ratio_vs_fp32"] = round(
+                    base["exec_ms"] / row["exec_ms"], 3)
+    return sweep
+
+
+def sync_schedule_sweep(n_devices, steps, drift_threshold=0.5):
+    """The --sync-schedule sweep: the gradient-sync SCHEDULE as a
+    searched comm plan (search/sync_schedule.py) on the sync-bound BERT
+    config, per sync-precision mode.
+
+    Simulated (TPU machine model): the DP strategy's step under the
+    MONOLITHIC schedule (one post-backward fused sync — the executed
+    status quo) vs the SEARCHED bucketed schedule, with the exposed
+    sync tail and per-bucket lanes recorded — the acceptance number is
+    scheduled < monolithic.  Executed (live mesh): the same two
+    programs run for real — monolithic ``_sync_grads`` vs the bucketed
+    executor (comm/bucketed.py) — each with a DriftReport carrying the
+    per-bucket predicted-exposed rows.  On a CPU mesh fp32 buckets are
+    value-identity barriers and there is no fat wire, so the executed
+    ratio measures the anchoring/quantize overhead honestly; the
+    overlap win is the simulated number, falsifiable on real ICI."""
+    import math
+
+    import jax
+
+    import flexflow_tpu as ff
+    from examples.common import synthetic_inputs, synthetic_labels
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+    from flexflow_tpu.obs.drift import build_drift_report
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.search.sync_precision import choose_sync_precision
+    from flexflow_tpu.search.sync_schedule import (
+        build_bucketed_schedule,
+        choose_sync_schedule,
+        synced_weight_groups,
+    )
+    from flexflow_tpu.models import build_transformer
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    can_exec = len(jax.devices()) >= n_devices
+
+    sweep = {
+        "model": "bert",
+        "config": dict(SYNC_BOUND_BERT_KW),
+        "batch": 8,
+        "note": (
+            "simulated numbers price overlap on the TPU machine model "
+            "(monolithic = one post-backward fused sync, scheduled = "
+            "searched issue-ordered buckets); executed numbers run both "
+            "programs for real — on a CPU mesh fp32 buckets are "
+            "value-identity barriers with no wire to save, so "
+            "exec_ratio ~= 1.0 there is expected and honest, and the "
+            "per-bucket drift rows stay predicted-side only (one fused "
+            "XLA program has no per-bucket host timer)"
+        ),
+        "rows": {},
+    }
+    for prec_mode in ("fp32", "search"):
+        cfg = ff.FFConfig(batch_size=8, num_devices=n_devices,
+                          sync_precision=prec_mode, sync_schedule="search")
+        g = build_transformer(cfg, **SYNC_BOUND_BERT_KW).graph
+        sim = Simulator(cfg.machine_spec, num_devices=n_devices,
+                        sync_precision=prec_mode)
+        dp = data_parallel_strategy(g, n_devices)
+        pmap = (choose_sync_precision(g, dp, sim.cost)
+                if prec_mode != "fp32" else {})
+        synced = synced_weight_groups(g, dp, sim.cost)
+        mono = build_bucketed_schedule(synced, pmap, math.inf)
+        bd_mono = {}
+        sim.simulate(g, dp, breakdown=bd_mono, sync_schedule=mono)
+        sched, info = choose_sync_schedule(g, dp, sim, pmap, cfg)
+        row = {
+            "sim_monolithic_ms": round(bd_mono["total_s"] * 1e3, 4),
+            "sim_exposed_monolithic_ms": round(
+                bd_mono["sync_exposed_s"] * 1e3, 4),
+            "buckets": info.get("buckets", 0),
+            "compressed_groups": len(pmap),
+        }
+        if sched is not None:
+            bd_s = {}
+            sim.simulate(g, dp, breakdown=bd_s, sync_schedule=sched)
+            row["sim_scheduled_ms"] = round(bd_s["total_s"] * 1e3, 4)
+            row["sim_exposed_scheduled_ms"] = round(
+                bd_s["sync_exposed_s"] * 1e3, 4)
+            row["sim_step_ratio"] = round(
+                bd_mono["total_s"] / bd_s["total_s"], 3)
+            row["bucket_lanes"] = bd_s.get("sync_buckets", [])
+        if can_exec and sched is not None:
+            drift = {}
+            execd = {}
+            for mode, use_sched in (("monolithic", None),
+                                    ("scheduled", sched)):
+                cfg_x = ff.FFConfig(
+                    batch_size=8, only_data_parallel=True,
+                    **_exec_cfg_kwargs(n_devices, on_cpu))
+                m = build_transformer(cfg_x, **SYNC_BOUND_BERT_KW)
+                dp_x = data_parallel_strategy(m.graph, n_devices)
+                m.compile(loss_type="mean_squared_error", metrics=[],
+                          strategy=dp_x)
+                # force the TPU-chosen artifacts (see docstring): the
+                # compiled step is lazily jitted, so setting them here
+                # is enough — same discipline as the precision sweep
+                m.compiled.sync_precision = dict(pmap)
+                m.compiled.sync_schedule = use_sched
+                xs = synthetic_inputs(m, cfg_x.batch_size)
+                y = synthetic_labels(m, cfg_x.batch_size,
+                                     "mean_squared_error")
+                execd[mode] = _steady_step_seconds(m, xs, y, steps)
+                bd = bd_s if use_sched is not None else bd_mono
+                rep = build_drift_report(
+                    bd, measured_step_s=execd[mode],
+                    threshold=drift_threshold)
+                if rep is not None:
+                    drift[mode] = rep.to_dict()
+            row["exec_monolithic_ms"] = round(execd["monolithic"] * 1e3, 3)
+            row["exec_scheduled_ms"] = round(execd["scheduled"] * 1e3, 3)
+            row["exec_ratio"] = round(
+                execd["monolithic"] / execd["scheduled"], 3)
+            row["exec_backend"] = jax.devices()[0].platform
+            if drift:
+                row["drift"] = drift
+        sweep["rows"][prec_mode] = row
+        print(json.dumps({"sync_schedule": prec_mode, **{
+            k: v for k, v in row.items()
+            if k not in ("bucket_lanes", "drift")}}))
+    return sweep
+
+
+def topology_sweep(n_devices):
+    """The --topology sweep: hierarchical machine topologies as a
+    pricing + search dimension (search/machine_model.py link levels +
+    search/reduction_plan.py staged reduction plans).
+
+    Simulated only, deliberately: a CPU mesh has no slice boundary, so
+    executed numbers could not show a DCN win — the contract numbers
+    are the machine-model sync terms, falsifiable on a real multislice
+    pod.  For flat vs 2-slice vs 4-slice variants of the TPU machine
+    (10x ICI/DCN bandwidth gap, the production-typical ratio), each
+    model records the DP strategy's flat-ring sync term, the searched
+    staged-plan sync term, and the chosen per-bucket reduction plans
+    (the acceptance number: staged beats flat >= 2x on the sync term
+    for the sync-bound BERT)."""
+    import dataclasses
+    import math
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+    from flexflow_tpu.models import (
+        build_dlrm,
+        build_mlp_unify,
+        build_transformer,
+    )
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.search.sync_schedule import (
+        build_bucketed_schedule,
+        choose_sync_schedule,
+        synced_weight_groups,
+    )
+
+    builders = {
+        "bert": (8, lambda cfg: build_transformer(
+            cfg, **SYNC_BOUND_BERT_KW)),
+        "dlrm": (64, lambda cfg: build_dlrm(cfg)),
+        "mlp": (64, lambda cfg: build_mlp_unify(cfg)),
+    }
+    base_spec = ff.FFConfig(batch_size=8,
+                            num_devices=n_devices).machine_spec
+    gap = 10.0
+    topologies = {"flat": base_spec}
+    for k in (2, 4):
+        # a k-slice variant needs k even slices of >= 2 devices each —
+        # degenerate counts (--devices 2 with 4 slices) would build a
+        # spec with devices_per_host 0
+        if n_devices % k == 0 and n_devices // k >= 2:
+            topologies[f"{k}slice"] = dataclasses.replace(
+                base_spec, devices_per_host=n_devices // k,
+                dcn_bandwidth=base_spec.ici_bandwidth / gap)
+        else:
+            print(f"# topology sweep: skipping {k}slice "
+                  f"(needs {k} even slices of >=2 of {n_devices} devices)")
+    sweep = {
+        "devices": n_devices,
+        "ici_dcn_gap": gap,
+        "note": (
+            "simulated on the TPU machine model (a CPU mesh has no "
+            "slice boundary to execute across); sync terms are the DP "
+            "strategy's weight-gradient reduction priced flat (one "
+            "ring over every link class) vs with the searched staged "
+            "reduction plans (RS within slice, cross-slice exchange of "
+            "the shard, AG within slice)"
+        ),
+        "models": {},
+    }
+    for name, (batch, build) in builders.items():
+        cfg = ff.FFConfig(batch_size=batch, num_devices=n_devices)
+        g = build(cfg).graph
+        dp = data_parallel_strategy(g, n_devices)
+        rows = {}
+        for topo, spec in topologies.items():
+            sim = Simulator(spec, num_devices=n_devices)
+            synced = synced_weight_groups(g, dp, sim.cost)
+            mono = build_bucketed_schedule(synced, {}, math.inf)
+            bd = {}
+            sim.simulate(g, dp, breakdown=bd, sync_schedule=mono)
+            sched, info = choose_sync_schedule(g, dp, sim, {}, cfg)
+            row = {
+                "sim_flat_step_ms": round(bd["total_s"] * 1e3, 4),
+                "sim_flat_sync_ms": round(bd["sync_total_s"] * 1e3, 4),
+                "buckets": info.get("buckets", 0),
+                "staged_buckets": info.get("staged_buckets", 0),
+                "plans": {},
+            }
+            if sched is not None:
+                bd_s = {}
+                sim.simulate(g, dp, breakdown=bd_s, sync_schedule=sched)
+                row["sim_planned_step_ms"] = round(
+                    bd_s["total_s"] * 1e3, 4)
+                row["sim_planned_sync_ms"] = round(
+                    bd_s["sync_total_s"] * 1e3, 4)
+                row["sync_levels_ms"] = {
+                    k: round(v * 1e3, 4)
+                    for k, v in (bd_s.get("sync_levels_s") or {}).items()}
+                row["plans"] = {
+                    b.name: b.plan.name for b in sched.buckets
+                    if b.plan is not None}
+                if row["sim_planned_sync_ms"]:
+                    row["sync_ratio_flat_over_planned"] = round(
+                        row["sim_flat_sync_ms"]
+                        / row["sim_planned_sync_ms"], 3)
+            rows[topo] = row
+            print(json.dumps({"topology": topo, "model": name, **{
+                k: v for k, v in row.items() if k != "plans"}}))
+        sweep["models"][name] = rows
+    return sweep
+
+
+def _topology_sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## Hierarchical topology sweep (flat vs multi-slice, "
+        f"{sweep['ici_dcn_gap']:.0f}x ICI/DCN gap)",
+        "",
+        "The machine model's link hierarchy as a search dimension "
+        "(search/machine_model.py levels + search/reduction_plan.py): "
+        "on multi-slice topologies the search synthesizes staged "
+        "per-group reduction plans — reduce-scatter within each slice, "
+        "a cross-slice exchange of the 1/n shard, all-gather within "
+        "the slice — instead of dragging the full gradient around the "
+        "slow DCN ring.",
+        "",
+        "| model | topology | flat sync ms | planned sync ms | "
+        "sync ratio | flat step ms | planned step ms | staged buckets | "
+        "plans |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for name, rows in sweep["models"].items():
+        for topo, r in rows.items():
+            plans = ",".join(sorted(set(r.get("plans", {}).values()))) \
+                or "—"
+            lines.append(
+                f"| {name} | {topo} | {r.get('sim_flat_sync_ms', '—')} | "
+                f"{r.get('sim_planned_sync_ms', '—')} | "
+                f"{r.get('sync_ratio_flat_over_planned', '—')} | "
+                f"{r.get('sim_flat_step_ms', '—')} | "
+                f"{r.get('sim_planned_step_ms', '—')} | "
+                f"{r.get('staged_buckets', 0)} | {plans} |")
+    lines += [
+        "",
+        f"Honesty note: {sweep['note']}.",
+    ]
+    return lines
+
+
+def _schedule_sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## Overlap-aware sync schedule (sync-bound BERT, "
+        "SYNC_BOUND_BERT_KW)",
+        "",
+        "The gradient-sync schedule as a searched comm plan "
+        "(search/sync_schedule.py): issue-ordered buckets overlap the "
+        "backward, coalescing amortizes collective latency; the "
+        "simulator prices the EXPOSED sync tail and the lowering "
+        "executes the buckets (comm/bucketed.py).  'monolithic' is the "
+        "one-post-backward-sync status quo in the same pricing "
+        "currency.",
+        "",
+        "| precision mode | sim monolithic ms | sim scheduled ms | "
+        "sim ratio | exposed mono ms | exposed sched ms | buckets | "
+        "exec mono ms | exec sched ms | exec ratio |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for mode, r in sweep["rows"].items():
+        lines.append(
+            f"| {mode} | {r.get('sim_monolithic_ms', '—')} | "
+            f"{r.get('sim_scheduled_ms', '—')} | "
+            f"{r.get('sim_step_ratio', '—')} | "
+            f"{r.get('sim_exposed_monolithic_ms', '—')} | "
+            f"{r.get('sim_exposed_scheduled_ms', '—')} | "
+            f"{r.get('buckets', '—')} | "
+            f"{r.get('exec_monolithic_ms', '—')} | "
+            f"{r.get('exec_scheduled_ms', '—')} | "
+            f"{r.get('exec_ratio', '—')} |")
+    lines += [
+        "",
+        f"Honesty note: {sweep['note']}.",
+    ]
+    return lines
+
+
+def _sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## Sync-precision sweep (sync-bound BERT, SYNC_BOUND_BERT_KW)",
+        "",
+        "Gradient-sync wire precision as a searchable strategy dimension "
+        "(EQuARX-style quantized allreduce, comm/quantized.py).  "
+        "Simulated columns price the DP weight-allreduce term on the "
+        "TPU machine model; exec columns run the TPU-chosen "
+        "per-weight-group map for real on the live mesh.",
+        "",
+        "| precision | sim allreduce ms | sim step ms | sim allreduce "
+        "ratio | sim step ratio | exec ms | exec ratio | groups |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for prec, r in sweep["rows"].items():
+        lines.append(
+            f"| {prec} | {r.get('sim_allreduce_ms', '—')} | "
+            f"{r.get('sim_step_ms', '—')} | "
+            f"{r.get('sim_allreduce_ratio_vs_fp32', '—')} | "
+            f"{r.get('sim_step_ratio_vs_fp32', '—')} | "
+            f"{r.get('exec_ms', '—')} | "
+            f"{r.get('exec_ratio_vs_fp32', '—')} | "
+            f"{r.get('compressed_groups', '—')} |")
+    lines += [
+        "",
+        f"Honesty note: {sweep['note']}.",
+    ]
+    return lines
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--models",
+        default="alexnet,bert,gpt,dlrm,candle_uno,inception,resnext50,"
+                "xdl,mlp")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--cpu-mesh", action="store_true",
+                    help="run on a virtual CPU mesh of --devices devices "
+                         "(jax may be pre-imported with another platform, "
+                         "so env vars alone can be too late)")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="measure per-(op,view) costs on the live backend "
+                         "first (search/calibration.py) and rank with them")
+    ap.add_argument("--calibrate-only", action="store_true",
+                    help="save the calibration table and exit without "
+                         "touching the BENCH_SEARCH artifacts — the "
+                         "on-TPU half of the calibrate-on-TPU / "
+                         "execute-on-CPU-mesh split")
+    ap.add_argument("--calibrate-budget", type=float, default=120.0,
+                    help="per-model probe wall budget in seconds")
+    ap.add_argument("--load-calibration", action="store_true",
+                    help="rank with an existing --calibration-file (e.g. "
+                         "measured earlier on the real TPU) instead of "
+                         "probing the live backend — the way to combine "
+                         "TPU-calibrated sim ratios with CPU-mesh "
+                         "executed ratios")
+    ap.add_argument("--calibration-file", default="CALIBRATION.json")
+    ap.add_argument("--out-prefix", default="BENCH_SEARCH",
+                    help="artifact file prefix — point smoke runs at a "
+                         "scratch prefix so they never overwrite the "
+                         "committed full artifact")
+    ap.add_argument("--sim-only", action="store_true",
+                    help="skip the executed-step tier even when enough "
+                         "devices are visible — the search-throughput "
+                         "measurement mode (cold vs warm cost cache)")
+    ap.add_argument("--cost-cache-file", default="COST_CACHE.json",
+                    help="persistent cost cache (search/cost_cache.py): "
+                         "per-(op, view) cost rows + finished search "
+                         "results keyed by graph digest x machine view x "
+                         "calibration signature; repeat sweeps start warm")
+    ap.add_argument("--no-cost-cache", action="store_true",
+                    help="bypass the persistent cost cache (cold-cache "
+                         "run)")
+    ap.add_argument("--sync-precision", default="fp32,bf16,int8",
+                    help="comma list of gradient-sync wire precisions to "
+                         "sweep on the sync-bound BERT config (simulated "
+                         "allreduce term + executed step time per "
+                         "precision); empty disables the sweep")
+    ap.add_argument("--sync-sweep-only", action="store_true",
+                    help="run ONLY the sync-precision sweep and merge it "
+                         "into the existing artifact, leaving every "
+                         "model row untouched")
+    ap.add_argument("--sync-schedule", action="store_true",
+                    help="also sweep the gradient-sync SCHEDULE on the "
+                         "sync-bound BERT config: searched issue-ordered "
+                         "buckets vs the monolithic post-backward sync, "
+                         "simulated (exposed-comm pricing) + executed, "
+                         "with per-bucket DriftReports")
+    ap.add_argument("--sync-schedule-only", action="store_true",
+                    help="run ONLY the sync-schedule sweep and merge it "
+                         "into the existing artifact, leaving every "
+                         "model row untouched")
+    ap.add_argument("--topology", action="store_true",
+                    help="also sweep hierarchical machine topologies "
+                         "(flat vs 2-slice vs 4-slice, 10x ICI/DCN "
+                         "gap): per-model chosen reduction plans + "
+                         "the flat-vs-staged DP sync term, simulated")
+    ap.add_argument("--topology-only", action="store_true",
+                    help="run ONLY the topology sweep and merge it "
+                         "into the existing artifact, leaving every "
+                         "model row untouched")
+    ap.add_argument("--verify", action="store_true",
+                    help="arm the static-analysis verifier "
+                         "(flexflow_tpu/analysis, FLEXFLOW_TPU_VERIFY "
+                         "semantics) during the searches and record "
+                         "per-model verifier overhead "
+                         "(verify_checks/verify_seconds) in each row")
+    ap.add_argument("--obs", action="store_true",
+                    help="unified telemetry: JSONL event log "
+                         "(<prefix>_obs.jsonl), per-model "
+                         "predicted-timeline Chrome-trace JSON, a "
+                         "per-strategy DriftReport in every executed "
+                         "row, and an ffobs strategy-explanation "
+                         "report (<prefix>_report.md)")
+    ap.add_argument("--drift-threshold", type=float, default=0.5,
+                    help="predicted-vs-measured ratio beyond which a "
+                         "DriftReport flags staleness")
+    args = ap.parse_args()
+
+    import os
+
+    import jax
+
+    if args.cpu_mesh or os.environ.get("JAX_PLATFORMS") == "cpu":
+        from flexflow_tpu.comm.compat import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+
+    obs_log = None
+    if args.obs:
+        from flexflow_tpu.obs.events import BUS
+
+        obs_log = f"{args.out_prefix}_obs.jsonl"
+        # fresh log per run: the report renders THIS run's decisions.
+        # Close first — FLEXFLOW_TPU_OBS may have bound the bus to this
+        # very path at import, and removing a file an open sink holds
+        # would silently strand every later event on the unlinked inode
+        BUS.close()
+        if os.path.exists(obs_log):
+            os.remove(obs_log)
+        BUS.configure(obs_log)
+
+    sweep_precisions = [p for p in args.sync_precision.split(",") if p]
+    if args.topology_only:
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["topology_sweep"] = topology_sweep(args.devices)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous topology-sweep section (same
+            # merge discipline as the other --*-only modes)
+            marker = "\n## Hierarchical topology sweep"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_topology_sweep_md_lines(
+                        report["topology_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged topology sweep into {path} / {md}")
+        return
+    if args.sync_schedule_only:
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["sync_schedule_sweep"] = sync_schedule_sweep(
+            args.devices, args.steps,
+            drift_threshold=args.drift_threshold)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous schedule-sweep section (same
+            # merge discipline as --sync-sweep-only)
+            marker = "\n## Overlap-aware sync schedule"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_schedule_sweep_md_lines(
+                        report["sync_schedule_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged sync-schedule sweep into {path} / {md}")
+        return
+    if args.sync_sweep_only:
+        if not sweep_precisions:
+            ap.error("--sync-sweep-only needs a non-empty --sync-precision "
+                     "list (empty means 'sweep disabled')")
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["sync_precision_sweep"] = sync_precision_sweep(
+            args.devices, args.steps, sweep_precisions)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous sweep section: everything from
+            # its marker to the next "## " heading (or EOF) — later
+            # sections survive the merge
+            marker = "\n## Sync-precision sweep"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_sweep_md_lines(report["sync_precision_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged sync-precision sweep into {path} / {md}")
+        return
+
+    specs = _model_specs()
+    names = [n for n in args.models.split(",") if n in specs]
+    if args.calibrate_only:
+        args.calibrate = True
+    calibration = None
+    bench_cal = {}  # per-model seconds spent in the bench's own probe
+    # loop — reported as calibration_seconds, never folded into
+    # search_seconds (the satellite split)
+    if args.load_calibration:
+        from flexflow_tpu.search.calibration import CalibrationTable
+
+        if args.calibrate:
+            print("# --load-calibration takes precedence over --calibrate: "
+                  "using the existing file, no new probes")
+        if not os.path.exists(args.calibration_file):
+            ap.error(f"--load-calibration: {args.calibration_file} does not "
+                     "exist (run with --calibrate first, e.g. on the TPU)")
+        calibration = CalibrationTable.load(args.calibration_file)
+        print(f"# loaded {len(calibration)} calibration records from "
+              f"{args.calibration_file}")
+    elif args.calibrate:
+        from flexflow_tpu.search.calibration import (
+            CalibrationTable,
+            calibrate_graph,
+        )
+
+        import flexflow_tpu as ff
+
+        def _coverage_graph():
+            """Ops the zoo's calibrate sweep misses or under-reaches
+            (the reference measures every op kind it runs,
+            simulator.cc:515): dropout, batch_matmul, pooling, and the
+            MoE dispatch chain (top_k/group_by/aggregate)."""
+            cfg = ff.FFConfig(batch_size=32, num_devices=args.devices)
+            m = ff.FFModel(cfg)
+            x = m.create_tensor([32, 64, 64], name="cal_x")
+            a = m.dropout(x, rate=0.1, name="cal_dropout")
+            bmm = m.batch_matmul(a, x, name="cal_bmm")
+            pooled = m.mean(bmm, dims=[1], name="cal_mean")
+            img = m.create_tensor([32, 16, 16, 8], name="cal_img")
+            p = m.pool2d(img, 2, 2, stride_h=2, stride_w=2, name="cal_pool")
+            pf = m.flat(p, name="cal_flat")
+            gate_in = m.dense(pooled, 8, name="cal_gate")
+            gates = m.softmax(gate_in, name="cal_gates")
+            tg, ti = m.top_k(gates, 2, name="cal_topk")
+            grouped = m.group_by(pf, ti, 8, name="cal_groupby")
+            experts = [m.dense(g, 16, name=f"cal_exp{i}")
+                       for i, g in enumerate(grouped[:2])]
+            del experts
+            return m.graph
+
+        live = jax.devices()[0].platform
+        if os.path.exists(args.calibration_file):
+            calibration = CalibrationTable.load(args.calibration_file)
+            if calibration.backend not in (None, live):
+                # mixing probes from different backends would mislabel
+                # the table's provenance — start fresh on this backend
+                print(f"# existing calibration is from "
+                      f"{calibration.backend!r}, live backend is {live!r}: "
+                      f"recalibrating from scratch")
+                calibration = CalibrationTable()
+            else:
+                print(f"# resuming calibration: {len(calibration)} existing "
+                      f"records")
+        else:
+            calibration = CalibrationTable()
+        for n in names:
+            cfg = ff.FFConfig(batch_size=specs[n]["batch"],
+                              num_devices=args.devices)
+            t0 = time.monotonic()
+            calibrate_graph(specs[n]["build"](cfg).graph, args.devices,
+                            calibration,
+                            time_budget_s=args.calibrate_budget)
+            bench_cal[n] = time.monotonic() - t0
+            print(f"# calibration after {n}: {len(calibration)} records, "
+                  f"{calibration.num_clusters} clusters")
+        calibrate_graph(_coverage_graph(), args.devices, calibration,
+                        time_budget_s=args.calibrate_budget / 2)
+        # the full MoE dispatch chain (group_by/aggregate/cache) probes
+        # from the zoo's MoE builder (reference: moe.cc self-reports
+        # throughput the same way the other examples do)
+        from flexflow_tpu.models import build_moe
+
+        calibrate_graph(
+            build_moe(ff.FFConfig(batch_size=32,
+                                  num_devices=args.devices)).graph,
+            args.devices, calibration,
+            time_budget_s=args.calibrate_budget / 2)
+        calibration.save(args.calibration_file)
+        print(f"# calibrated {len(calibration)} (op, view) records + "
+              f"{calibration.num_clusters} fusion clusters "
+              f"on {jax.devices()[0].platform}")
+    if args.calibrate_only:
+        # applies to the --load-calibration combination too: the flag's
+        # contract is "never touch the BENCH_SEARCH artifacts"
+        return
+
+    cost_cache = None if args.no_cost_cache else args.cost_cache_file
+    report = {"devices": args.devices,
+              "calibrated": bool(calibration) and len(calibration) > 0,
+              "calibration_backend": getattr(calibration, "backend", None)
+              if calibration else None,
+              "backend": jax.devices()[0].platform,
+              "cost_cache": cost_cache,
+              "models": {}}
+    can_exec = len(jax.devices()) >= args.devices and not args.sim_only
+    cal_file = args.calibration_file if calibration is not None else None
+    if args.verify:
+        from flexflow_tpu.analysis import set_verify
+
+        set_verify(True)
+    for n in names:
+        row = simulate_pair(n, specs[n], args.devices, calibration,
+                            calibration_file=cal_file,
+                            cost_cache_file=cost_cache or "",
+                            verify=args.verify)
+        row["calibration_seconds"] = round(
+            row.get("calibration_seconds", 0.0) + bench_cal.get(n, 0.0), 2)
+        if can_exec:
+            try:
+                ex = execute_pair(n, specs[n], args.devices, args.steps,
+                                  calibration_file=cal_file,
+                                  obs=args.obs, out_prefix=args.out_prefix,
+                                  drift_threshold=args.drift_threshold)
+            except Exception as e:  # honest artifact: record the failure
+                ex = {"exec_error": f"{type(e).__name__}: {e}"}
+            if ex:
+                row.update(ex)
+        report["models"][n] = row
+        print(json.dumps({"model": n, **row}))
+    # "calibrated" must mean the sims CONSULTED measurements, not merely
+    # that a table object existed (it may have been discarded per-model
+    # as incoherent with the machine model)
+    report["calibrated"] = any(
+        r.get("sim_calibrated") for r in report["models"].values())
+    if sweep_precisions:
+        report["sync_precision_sweep"] = sync_precision_sweep(
+            args.devices, args.steps, sweep_precisions)
+    if args.sync_schedule:
+        report["sync_schedule_sweep"] = sync_schedule_sweep(
+            args.devices, args.steps,
+            drift_threshold=args.drift_threshold)
+    if args.topology:
+        report["topology_sweep"] = topology_sweep(args.devices)
+
+    with open(f"{args.out_prefix}.json", "w") as f:
+        json.dump(report, f, indent=1)
+    lines = [
+        f"# {args.out_prefix} — searched strategy vs pure data parallelism",
+        "",
+        "Reference contract: scripts/osdi22ae/*.sh (searched vs "
+        "`--only-data-parallel`, same hardware).  Simulated costs are for "
+        f"the full-size models on the {args.devices}-device TPU machine "
+        "model; executed ratios run BOTH strategies for real on the "
+        "available mesh (scaled-down model sizes when the mesh is CPU — "
+        "see exec_scale).",
+        "",
+        "| model | nodes | sim DP ms | sim searched ms | sim ratio | "
+        "exec ratio | exec backend/scale | cal s | search s | "
+        "delta hit | cache |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for n, r in report["models"].items():
+        cache_cell = ("result" if r.get("cost_cache_result_hit")
+                      else (f"rows {r['cost_cache_row_hit_rate']:.0%}"
+                            if r.get("cost_cache_row_hit_rate") is not None
+                            else "—"))
+        lines.append(
+            f"| {n} | {r['nodes']} | {r['sim_dp_ms']} | "
+            f"{r['sim_searched_ms']} | {r['sim_ratio']} | "
+            f"{r.get('exec_ratio', '—')} | "
+            f"{r.get('exec_backend', '—')}/{r.get('exec_scale', '—')} | "
+            f"{r.get('calibration_seconds', 0.0)} | {r['search_seconds']} | "
+            f"{r.get('delta_hit_rate', '—')} | {cache_cell} |")
+    cal_note = (
+        f"Calibrated cost model: {report['calibrated']}"
+        + (f" (probes measured on {report['calibration_backend']})."
+           if report.get("calibration_backend") else ".")
+    )
+    # honesty notes derived from THIS run's numbers — a hardcoded list
+    # of winners goes stale (and self-contradictory) on regeneration
+    exec_rows = {
+        k: v["exec_ratio"] for k, v in report["models"].items()
+        if isinstance(v.get("exec_ratio"), (int, float))
+    }
+    won = sorted(k for k, r in exec_rows.items() if r > 1.0)
+    lost = sorted(k for k, r in exec_rows.items() if r <= 1.0)
+    kept_dp = sorted(
+        k for k, v in report["models"].items() if v.get("searched_is_dp"))
+    lines += [
+        "",
+        cal_note,
+        "Honesty notes: the simulator's DLRM DP cost is dominated by the "
+        "full-table gradient allreduce (the real phenomenon Unity "
+        "exploits, dlrm.cc + osdi22ae/dlrm.sh).  Executed ratios on a CPU "
+        "mesh are bounded by the host: with fewer physical cores than "
+        "virtual devices (see exec_host_cores) per-device compute "
+        "serializes, so work/communication-AVOIDING strategies can show "
+        "real wins there while compute-parallel ones also pay GSPMD "
+        "resharding copies; single-core timing jitter moves ratios near "
+        "1.0 between runs.  "
+        f"In this run the searched strategy won at execution for "
+        f"{', '.join(won) or 'none'} and did not for "
+        f"{', '.join(lost) or 'none'}.  "
+        + (f"For {', '.join(kept_dp)} the search's champion-vs-DP floor "
+           "kept plain data parallelism (predicted win below the "
+           "uncertainty margin), so both executed programs are "
+           "IDENTICAL and the measured ratio is timing noise around "
+           "1.0.  " if kept_dp else "")
+        + "The contract number for "
+        "compute-parallel strategies is the TPU-machine-model sim "
+        "ratio, which the calibrated table makes falsifiable.",
+    ]
+    if report.get("sync_precision_sweep"):
+        lines += _sweep_md_lines(report["sync_precision_sweep"])
+    if report.get("sync_schedule_sweep"):
+        lines += _schedule_sweep_md_lines(report["sync_schedule_sweep"])
+    if report.get("topology_sweep"):
+        lines += _topology_sweep_md_lines(report["topology_sweep"])
+    with open(f"{args.out_prefix}.md", "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"# wrote {args.out_prefix}.json / {args.out_prefix}.md")
+
+    if args.obs and obs_log and os.path.exists(obs_log):
+        # render the strategy-explanation report from this run's event
+        # log (tools/ffobs.py is stdlib-only, so the subprocess is fast)
+        import subprocess
+        import sys as _sys
+
+        from flexflow_tpu.obs.events import BUS
+
+        BUS.flush()
+        ffobs = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools", "ffobs.py")
+        proc = subprocess.run(
+            [_sys.executable, ffobs, "report", obs_log],
+            capture_output=True, text=True)
+        if proc.returncode == 0:
+            with open(f"{args.out_prefix}_report.md", "w") as f:
+                f.write(proc.stdout)
+            print(f"# wrote {args.out_prefix}_report.md (telemetry: "
+                  f"{obs_log})")
+        else:
+            print(f"# ffobs report failed: {proc.stderr.strip()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""AlexNet example (reference: examples/cpp/AlexNet/alexnet.cc).
+
+Usage: python examples/alexnet.py -b 64 -e 1 [--only-data-parallel]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_alexnet_cifar10
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_alexnet_cifar10(config)
+    run_example(model, "alexnet", optimizer=ff.SGDOptimizer(lr=0.01, momentum=0.9))
+
+
+if __name__ == "__main__":
+    main()

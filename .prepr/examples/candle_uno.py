@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""CANDLE-Uno example (reference: examples/cpp/candle_uno/candle_uno.cc)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_candle_uno
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_candle_uno(config)
+    run_example(model, "candle_uno", loss="mean_squared_error",
+                metrics=["mean_squared_error"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""InceptionV3 example (reference: examples/cpp/InceptionV3/inception.cc;
+osdi22ae/inception.sh runs -b 64 --budget 10)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_inception_v3
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_inception_v3(config)
+    run_example(model, "inception_v3", optimizer=ff.SGDOptimizer(lr=0.01, momentum=0.9))
+
+
+if __name__ == "__main__":
+    main()

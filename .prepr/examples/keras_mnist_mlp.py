@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Keras-frontend example (reference: examples/python/keras/ scripts —
+Sequential MNIST-style MLP with callbacks).
+
+Usage: python examples/keras_mnist_mlp.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import flexflow_tpu as ffpkg  # noqa: F401 (package path setup)
+from flexflow_tpu import keras
+from flexflow_tpu.config import FFConfig
+
+
+def main():
+    config = FFConfig.parse_args()
+    model = keras.Sequential([
+        keras.layers.Dense(256, activation="relu", input_shape=(784,)),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dropout(0.1),
+        keras.layers.Dense(10),
+    ])
+    model.compile(optimizer=keras.optimizers.SGD(0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=config)
+    # synthetic MNIST-shaped data (the reference ships dataset loaders;
+    # zero-egress environments use synthetic samples)
+    rng = np.random.default_rng(0)
+    n = config.batch_size * 16
+    digits = rng.integers(0, 10, n)
+    x = (rng.normal(size=(n, 784)) * 0.1 + digits[:, None] / 10.0).astype(np.float32)
+    model.fit(x, digits.astype(np.int32), epochs=config.epochs,
+              callbacks=[keras.callbacks.EarlyStopping(monitor="loss", patience=2)])
+    print(model.summary())
+
+
+if __name__ == "__main__":
+    main()

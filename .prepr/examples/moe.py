@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Mixture-of-Experts example with dynamic recompilation
+(reference: examples/cpp/mixture_of_experts/moe.cc:46-92 — the cache
+score drives a RecompileState trigger; alter() flips the gate to the
+cached expert assignments mid-training)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_moe
+from flexflow_tpu.runtime.recompile import RecompileState, cache_score
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_moe(config, use_cache=True)
+
+    # reference moe.cc:73-84: trigger when the gate assignments have
+    # stabilized — cache score (mean |live - cached|) dropped below the
+    # initial churn — then switch to the cached assignments
+    cache_node = model.node_by_name("gate_cache")
+    scores = []
+
+    def trigger(m):
+        try:
+            s = cache_score(m, "gate_cache")
+        except KeyError:
+            return False
+        scores.append(s)
+        return len(scores) >= 3 and s < 0.92 * max(scores[:3])
+
+    def alter(m):
+        print(f"[moe] recompiling with cached assignments (score={scores[-1]:.4f})")
+        cache_node.op.attrs["use_cached"] = True
+
+    run_example(model, "moe", recompile_state=RecompileState(trigger, alter))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""HuggingFace BERT import example (reference: examples/python/pytorch
+bert_proxy / mt5 — those trace hand-built proxies; this imports the
+real `transformers.BertModel` through torch.fx and trains it).
+
+The importer constant-folds the HF mask-construction chain, decomposes
+scaled_dot_product_attention into PCG ops, and carries module buffers
+(position ids) as compile-time constants — see
+flexflow_tpu/frontends/torch_fx.py.
+
+Usage: python examples/pytorch_bert.py -b 8 -e 1
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.frontends import PyTorchModel, transfer_torch_weights
+
+
+def main():
+    import torch
+    import transformers
+    from transformers.utils import fx as hf_fx
+
+    config = ff.FFConfig.parse_args()
+    B, S, H = config.batch_size, 32, 128
+
+    bcfg = transformers.BertConfig(
+        hidden_size=H, num_hidden_layers=4, num_attention_heads=4,
+        intermediate_size=4 * H, vocab_size=2048, max_position_embeddings=S,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    tmodel = transformers.BertModel(bcfg)
+    tmodel.eval()
+    gm = hf_fx.symbolic_trace(tmodel, input_names=["input_ids"])
+
+    model = ff.FFModel(config)
+    ids = model.create_tensor([B, S], dtype="int32")
+    example = torch.randint(0, bcfg.vocab_size, (B, S))
+    outs = PyTorchModel(gm, example_inputs=[example]).torch_to_ff(model, [ids])
+    print("imported BERT outputs:", [tuple(o.sizes) for o in outs])
+
+    model.compile(
+        optimizer=ff.AdamOptimizer(alpha=1e-4),
+        loss_type="mean_squared_error",
+        metrics=["mean_squared_error"],
+    )
+    transfer_torch_weights(tmodel, model)
+
+    rng = np.random.default_rng(config.seed)
+    n = B * 8
+    x = rng.integers(0, bcfg.vocab_size, (n, S)).astype(np.int32)
+    y = rng.normal(size=(n, outs[-1].sizes[-1])).astype(np.float32)
+    model.fit(x=x, y=y, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""PyTorch import example (reference: examples/python/pytorch/*):
+trace a torch module, import via torch.fx, train with the framework.
+
+Usage: python examples/pytorch_import.py -b 32 -e 2
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.frontends import PyTorchModel, transfer_torch_weights
+
+
+def main():
+    import torch.nn as nn
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(64, 256)
+            self.act = nn.ReLU()
+            self.fc2 = nn.Linear(256, 10)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    config = ff.FFConfig.parse_args()
+    torch_net = Net()
+    model = ff.FFModel(config)
+    x = model.create_tensor([config.batch_size, 64])
+    PyTorchModel(torch_net).torch_to_ff(model, [x])
+    model.compile(loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    transfer_torch_weights(torch_net, model)
+
+    rng = np.random.default_rng(0)
+    n = config.batch_size * 8
+    centers = rng.normal(size=(10, 64)) * 2
+    y = rng.integers(0, 10, n)
+    xs = (centers[y] + rng.normal(size=(n, 64))).astype(np.float32)
+    model.fit(x=xs, y=y.astype(np.int32))
+
+
+if __name__ == "__main__":
+    main()

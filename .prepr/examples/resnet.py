@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""ResNet / ResNeXt-50 example (reference: examples/cpp/ResNet/resnet.cc,
+examples/cpp/resnext50/resnext.cc).
+
+Usage: python examples/resnet.py -b 64 -e 1 [--resnext] [--only-data-parallel]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_resnet, build_resnext50
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    if "--resnext" in sys.argv:
+        model = build_resnext50(config, num_classes=1000, image=64)
+        name = "resnext50"
+    else:
+        model = build_resnet(config, num_classes=1000, image=64)
+        name = "resnet"
+    run_example(model, name, optimizer=ff.SGDOptimizer(lr=0.01, momentum=0.9))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Ulysses all-to-all sequence parallelism example.
+
+A small encoder whose attention runs under an explicit seq-sharded
+strategy with ``sp_mode="ulysses"``: the head-exchange all-to-all pair
+(parallel/ulysses.py) serves the sharded sequence dim instead of the
+K/V ring, moving 2/n of the ring's wire bytes.  The reference cannot
+split MHA's sequence dim at all (substitution.cc:2599-2654 — sample-dim
+repartition and head split only; SURVEY.md §5 gap), so both SP schemes
+are beyond-reference capabilities.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.core.machine import MachineView
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    b, s, e, heads = config.batch_size, 64, 64, 8
+    m = ff.FFModel(config)
+    x = m.create_tensor([b, s, e], name="tokens")
+    t = m.multihead_attention(x, x, x, embed_dim=e, num_heads=heads,
+                              causal=True, sp_mode="ulysses", name="mha")
+    t = m.dense(t, e, activation="relu", name="ff1")
+    t = m.mean(t, dims=[1], name="pool")
+    t = m.dense(t, 8, name="head")
+
+    # dp x sp hybrid: batch degree 2 everywhere (the stock DP helper
+    # handles divisibility/fixed-view edge cases), the attention also
+    # shards its sequence dim sp-ways — served by the ulysses exchange
+    from flexflow_tpu.compiler.lowering import data_parallel_strategy
+
+    n = config.num_devices
+    sp = max(1, min(4, n // 2, heads))
+    strategy = dict(data_parallel_strategy(m.graph, min(2, n)))
+    mha = m.node_by_name("mha")
+    dp_deg = strategy[mha.guid].dim_degrees[0]
+    if n >= dp_deg * sp and s % sp == 0 and b % max(dp_deg, 1) == 0:
+        strategy[mha.guid] = MachineView(dim_degrees=(dp_deg, sp, 1))
+    m.compile(loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"], strategy=strategy)
+    run_example(m, "ulysses_sp", loss="sparse_categorical_crossentropy",
+                skip_compile=True)
+
+
+if __name__ == "__main__":
+    main()

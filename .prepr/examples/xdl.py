@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""XDL example — embedding-heavy ads/recommendation model
+(reference: examples/cpp/XDL/xdl.cc).
+
+Usage: python examples/xdl.py -b 256 -e 1
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import flexflow_tpu as ff
+from examples.common import run_example
+from flexflow_tpu.models import build_xdl
+
+
+def main():
+    config = ff.FFConfig.parse_args()
+    model = build_xdl(config)
+    run_example(model, "xdl")
+
+
+if __name__ == "__main__":
+    main()

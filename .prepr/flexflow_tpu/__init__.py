@@ -1,0 +1,66 @@
+"""flexflow_tpu — a TPU-native distributed DNN training framework.
+
+A ground-up re-design of the capabilities of FlexFlow/Unity
+(automatic parallelization over a parallel computation graph, Unity
+OSDI'22 joint algebraic-transformation + parallelization search) for
+TPU hardware: JAX/XLA for the compute path, ``jax.sharding.Mesh`` +
+GSPMD/shard_map for distribution over ICI/DCN, Pallas for hot kernels,
+and a host-side compiler stack for the strategy search.
+
+The public API mirrors the reference FFModel surface
+(reference: include/flexflow/model.h:316, python/flexflow/core/flexflow_cffi.py:784)
+but the implementation shares no code and no architecture with the
+CUDA/Legion reference: there is no task runtime — an entire training
+iteration is one XLA program; parallelization is expressed as sharding
+of tensor dims over named mesh axes rather than Legion region partitions.
+"""
+
+__version__ = "0.1.0"
+
+# Lazy attribute loading keeps `import flexflow_tpu` cheap (no jax import
+# until a model is actually built) and breaks import cycles.
+_LAZY = {
+    "FFConfig": ("flexflow_tpu.config", "FFConfig"),
+    "IterationConfig": ("flexflow_tpu.config", "IterationConfig"),
+    "OperatorType": ("flexflow_tpu.core.optype", "OperatorType"),
+    "DataType": ("flexflow_tpu.core.ptensor", "DataType"),
+    "ParallelDim": ("flexflow_tpu.core.ptensor", "ParallelDim"),
+    "ParallelTensorShape": ("flexflow_tpu.core.ptensor", "ParallelTensorShape"),
+    "Tensor": ("flexflow_tpu.core.ptensor", "Tensor"),
+    "MachineSpec": ("flexflow_tpu.core.machine", "MachineSpec"),
+    "MachineView": ("flexflow_tpu.core.machine", "MachineView"),
+    "Graph": ("flexflow_tpu.core.graph", "Graph"),
+    "FFModel": ("flexflow_tpu.model", "FFModel"),
+    "SGDOptimizer": ("flexflow_tpu.optimizers", "SGDOptimizer"),
+    "AdamOptimizer": ("flexflow_tpu.optimizers", "AdamOptimizer"),
+    "LossType": ("flexflow_tpu.losses", "LossType"),
+    "MetricsType": ("flexflow_tpu.metrics", "MetricsType"),
+    "UniformInitializer": ("flexflow_tpu.initializers", "UniformInitializer"),
+    "GlorotUniformInitializer": ("flexflow_tpu.initializers", "GlorotUniformInitializer"),
+    "ZeroInitializer": ("flexflow_tpu.initializers", "ZeroInitializer"),
+    "ConstantInitializer": ("flexflow_tpu.initializers", "ConstantInitializer"),
+    "NormInitializer": ("flexflow_tpu.initializers", "NormInitializer"),
+    "CheckpointManager": ("flexflow_tpu.runtime.checkpoint", "CheckpointManager"),
+    "RecompileState": ("flexflow_tpu.runtime.recompile", "RecompileState"),
+    "StepProfiler": ("flexflow_tpu.runtime.profiler", "StepProfiler"),
+    "device_trace": ("flexflow_tpu.runtime.profiler", "device_trace"),
+    "measure_operator_cost": ("flexflow_tpu.runtime.profiler", "measure_operator_cost"),
+    "RecursiveLogger": ("flexflow_tpu.utils.logging", "RecursiveLogger"),
+    # unified telemetry (flexflow_tpu/obs)
+    "OBS_BUS": ("flexflow_tpu.obs.events", "BUS"),
+    "METRICS": ("flexflow_tpu.obs.metrics", "METRICS"),
+    "DriftReport": ("flexflow_tpu.obs.drift", "DriftReport"),
+}
+
+__all__ = ["__version__", *_LAZY]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module 'flexflow_tpu' has no attribute {name!r}")

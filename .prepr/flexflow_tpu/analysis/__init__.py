@@ -1,0 +1,59 @@
+"""Static analysis for the PCG pipeline — the correctness layer that
+PROVES what the rest of the system assumes (reference inspiration:
+GSPMD's decidable sharding propagation, arXiv:2105.04663; placement
+legality as a constraint system, arXiv:2110.10548).
+
+Three passes, one finding vocabulary (``findings.py``):
+
+1. ``invariants``  — graph well-formedness after every rewrite
+   (``PCG0xx``), armed by ``FLEXFLOW_TPU_VERIFY=1`` / ``--verify``.
+2. ``equivalence`` — executable numeric proofs for the substitution
+   registry (``EQV3xx``).
+3. ``sharding``    — strategy/MachineView legality + search/lowering
+   coherence (``SHD1xx``), the always-on gate in ``optimize_strategy``.
+
+``tools/fflint.py`` exposes all of it as a CI-friendly CLI; findings
+also flow through the obs event bus as ``analysis.finding`` events.
+
+``equivalence`` is intentionally NOT imported here: it imports the
+substitution machinery, which itself imports ``invariants`` — load it
+explicitly (``from flexflow_tpu.analysis.equivalence import …``).
+"""
+
+from flexflow_tpu.analysis.findings import (
+    AnalysisError,
+    Finding,
+    emit_findings,
+    errors_only,
+)
+from flexflow_tpu.analysis.invariants import (
+    CHECK_STATS,
+    GraphInvariantError,
+    assert_graph_ok,
+    check_graph,
+    scoped_verify,
+    set_verify,
+    verification_enabled,
+)
+from flexflow_tpu.analysis.sharding import (
+    lint_reduction_plan,
+    lint_strategy,
+    lint_sync_schedule,
+)
+
+__all__ = [
+    "AnalysisError",
+    "Finding",
+    "emit_findings",
+    "errors_only",
+    "CHECK_STATS",
+    "GraphInvariantError",
+    "assert_graph_ok",
+    "check_graph",
+    "scoped_verify",
+    "set_verify",
+    "verification_enabled",
+    "lint_reduction_plan",
+    "lint_strategy",
+    "lint_sync_schedule",
+]

@@ -1,0 +1,404 @@
+"""Substitution soundness verifier — pass 2 of the static-analysis
+stack (TASO/Unity discipline: every rewrite in the registry carries an
+EXECUTABLE proof, not a comment).
+
+For each registered ``GraphXfer`` (including the duck-typed
+``BatchEmbeddingsXfer``) this materializes a small proof graph the
+rewrite matches, applies it, evaluates BOTH graphs in the global
+(single-device logical) view on random inputs with deterministically
+derived weights, and asserts the values of every node surviving the
+rewrite agree within dtype tolerance.  Parallel ops are identity
+computations in the global view, so a legal rewrite must be value-
+preserving node-by-node — a much stronger check than comparing sinks.
+
+Weight correspondence across a rewrite ("the bridge"):
+
+* surviving nodes (same guid) reuse the source graph's weights;
+* a new weighted op whose weight specs equal a removed op's specs
+  inherits that op's weights (linear+activation fusion);
+* a new weighted op whose weight shape is ``(K, *removed_shape)``
+  stacks the K removed ops' weights in topo order (the
+  ``BatchEmbeddingsXfer`` stacked-table contract);
+* anything else is an **EQV303** finding — a registry rewrite with no
+  executable weight bridge has no proof.
+
+Finding codes: EQV300 apply declined a reported match, EQV301 value
+mismatch, EQV302 evaluation failure, EQV303 unbridgeable weights,
+EQV305 a registered rewrite matched no proof graph (coverage hole).
+Invariant findings (PCG0xx) from the rewritten graph are passed
+through — an unsound splice usually fails well-formedness first.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.analysis.findings import Finding
+from flexflow_tpu.analysis.invariants import check_graph
+
+DEFAULT_RTOL = 1e-4
+DEFAULT_ATOL = 1e-5
+
+
+def _f(code: str, message: str, **kw) -> Finding:
+    return Finding(code=code, pass_name="equivalence", message=message, **kw)
+
+
+# ---------------------------------------------------------------------------
+# evaluation in the global view
+
+
+def make_inputs(graph, seed: int = 0) -> Dict[int, np.ndarray]:
+    """Random feed arrays for every InputOp, keyed by guid.  Integer
+    inputs are bounded by the smallest vocab among direct embedding
+    consumers so lookups stay in range."""
+    from flexflow_tpu.ops.inout import InputOp
+
+    rng = np.random.default_rng(seed)
+    out: Dict[int, np.ndarray] = {}
+    for node in graph.topo_order():
+        if not isinstance(node.op, InputOp):
+            continue
+        shape = node.op.output_shapes[0]
+        dtype = shape.dtype.to_numpy()
+        if np.issubdtype(dtype, np.integer):
+            high = 16
+            for e in graph.out_edges[node.guid]:
+                n_entries = graph.nodes[e.dst].op.attrs.get("num_entries")
+                if n_entries:
+                    high = min(high, int(n_entries))
+            out[node.guid] = rng.integers(
+                0, high, size=shape.sizes).astype(dtype)
+        elif dtype == np.bool_:
+            out[node.guid] = rng.integers(0, 2, size=shape.sizes) > 0
+        else:
+            out[node.guid] = rng.standard_normal(
+                shape.sizes).astype(np.float32).astype(dtype)
+    return out
+
+
+def make_weights(graph, seed: int = 0) -> Dict[int, Dict[str, np.ndarray]]:
+    """Deterministic per-op weights via each spec's own initializer,
+    keyed by guid; the fold key depends on the op NAME so sibling ops
+    (e.g. K parallel embedding tables) get distinct values and a
+    rewrite that permutes them cannot pass by accident."""
+    import jax
+
+    out: Dict[int, Dict[str, np.ndarray]] = {}
+    base = jax.random.key(seed)
+    for node in graph.topo_order():
+        specs = node.op._weight_specs
+        if not specs:
+            continue
+        ws = {}
+        for w in specs:
+            k = jax.random.fold_in(
+                base,
+                zlib.crc32(f"{node.op.name}/{w.name}".encode()) & 0x7FFFFFFF,
+            )
+            ws[w.name] = np.asarray(w.initializer.init(
+                k, w.shape, w.dtype.to_numpy()))
+        out[node.guid] = ws
+    return out
+
+
+def evaluate_graph(graph, inputs: Dict[int, np.ndarray],
+                   weights: Dict[int, Dict[str, np.ndarray]],
+                   ) -> Dict[Tuple[int, int], np.ndarray]:
+    """Forward the whole PCG in the global view (single logical device,
+    float32 compute, eval mode) and return every ``(guid, out_idx)``
+    value."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.base import LoweringContext
+    from flexflow_tpu.ops.inout import InputOp
+
+    ctx = LoweringContext(compute_dtype=jnp.float32, train=False, rng=None)
+    values: Dict[Tuple[int, int], np.ndarray] = {}
+    for node in graph.topo_order():
+        if isinstance(node.op, InputOp):
+            values[(node.guid, 0)] = inputs[node.guid]
+            continue
+        in_edges = sorted(graph.in_edges[node.guid], key=lambda e: e.dst_idx)
+        ins = [values[(e.src, e.src_idx)] for e in in_edges]
+        outs = node.op.forward(ctx, ins, weights.get(node.guid, {}))
+        for i, y in enumerate(outs):
+            values[(node.guid, i)] = np.asarray(y)
+    return values
+
+
+# ---------------------------------------------------------------------------
+# weight bridging across a rewrite
+
+
+def _spec_key(w) -> Tuple:
+    return (w.name, tuple(w.shape), w.dtype.value)
+
+
+def bridge_weights(src_graph, dst_graph,
+                   src_weights: Dict[int, Dict[str, np.ndarray]],
+                   ) -> Tuple[Dict[int, Dict[str, np.ndarray]], List[Finding]]:
+    findings: List[Finding] = []
+    dst_w: Dict[int, Dict[str, np.ndarray]] = {}
+    # removed weighted ops, in source topo order (the order
+    # BatchEmbeddingsXfer stacks its match groups in)
+    pool = [n for n in src_graph.topo_order()
+            if n.guid not in dst_graph.nodes and n.op._weight_specs]
+    for node in dst_graph.topo_order():
+        specs = node.op._weight_specs
+        if not specs:
+            continue
+        if node.guid in src_graph.nodes:
+            dst_w[node.guid] = src_weights[node.guid]
+            continue
+        spec_keys = [_spec_key(w) for w in specs]
+        direct = next(
+            (p for p in pool
+             if [_spec_key(w) for w in p.op._weight_specs] == spec_keys),
+            None,
+        )
+        if direct is not None:
+            dst_w[node.guid] = src_weights[direct.guid]
+            pool.remove(direct)
+            continue
+        ws: Dict[str, np.ndarray] = {}
+        ok = True
+        for w in specs:
+            k = w.shape[0] if w.shape else 0
+            donors = [p for p in pool
+                      if any(_spec_key(x) == (w.name, tuple(w.shape[1:]),
+                                              w.dtype.value)
+                             for x in p.op._weight_specs)]
+            if k >= 2 and len(donors) >= k:
+                take = donors[:k]
+                ws[w.name] = np.stack(
+                    [src_weights[p.guid][w.name] for p in take], axis=0)
+                for p in take:
+                    pool.remove(p)
+            else:
+                ok = False
+                break
+        if ok:
+            dst_w[node.guid] = ws
+        else:
+            findings.append(_f(
+                "EQV303",
+                f"no weight bridge from the removed ops to new op "
+                f"{node.op.name!r} (specs {spec_keys})",
+                node=node.guid, op=node.op.name))
+    return dst_w, findings
+
+
+# ---------------------------------------------------------------------------
+# the proof
+
+
+def verify_rewrite(graph, xfer, match, seed: int = 0,
+                   rtol: float = DEFAULT_RTOL, atol: float = DEFAULT_ATOL,
+                   ) -> List[Finding]:
+    """Numeric-equivalence findings for applying ``xfer`` at ``match``
+    ([] = the rewrite is a sound, well-formed, value-preserving
+    transformation of this graph)."""
+    from flexflow_tpu.analysis.invariants import GraphInvariantError
+
+    name = getattr(xfer, "name", type(xfer).__name__)
+    try:
+        g2 = xfer.apply(graph, match)
+    except GraphInvariantError as e:
+        # with FLEXFLOW_TPU_VERIFY armed the apply hook raises at the
+        # rewrite; surface its findings instead of dying — fflint's
+        # exit-code contract holds either way
+        return list(e.findings)
+    if g2 is None:
+        return [_f("EQV300",
+                   f"{name}: apply declined a match find_matches reported")]
+    findings = check_graph(g2)
+    if findings:
+        return findings
+    inputs = make_inputs(graph, seed)
+    src_w = make_weights(graph, seed)
+    try:
+        src_vals = evaluate_graph(graph, inputs, src_w)
+    except Exception as e:
+        return [_f("EQV302",
+                   f"{name}: source graph failed to evaluate: "
+                   f"{type(e).__name__}: {e}")]
+    dst_w, findings = bridge_weights(graph, g2, src_w)
+    if findings:
+        return findings
+    try:
+        dst_vals = evaluate_graph(g2, inputs, dst_w)
+    except Exception as e:
+        return [_f("EQV302",
+                   f"{name}: rewritten graph failed to evaluate: "
+                   f"{type(e).__name__}: {e}")]
+    for guid in sorted(graph.nodes.keys() & g2.nodes.keys()):
+        node = g2.nodes[guid]
+        for i in range(len(node.op.output_shapes)):
+            a = src_vals.get((guid, i))
+            b = dst_vals.get((guid, i))
+            if a is None or b is None:
+                continue
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape != b.shape:
+                findings.append(_f(
+                    "EQV301",
+                    f"{name}: output {i} of {node.op.name!r} changed "
+                    f"shape {a.shape} -> {b.shape}",
+                    node=guid, op=node.op.name))
+            elif np.issubdtype(a.dtype, np.floating):
+                if not np.allclose(a.astype(np.float64),
+                                   b.astype(np.float64),
+                                   rtol=rtol, atol=atol):
+                    diff = float(np.max(np.abs(
+                        a.astype(np.float64) - b.astype(np.float64))))
+                    findings.append(_f(
+                        "EQV301",
+                        f"{name}: output {i} of {node.op.name!r} diverges "
+                        f"(max abs diff {diff:.3e})",
+                        node=guid, op=node.op.name))
+            elif not np.array_equal(a, b):
+                findings.append(_f(
+                    "EQV301",
+                    f"{name}: integer output {i} of {node.op.name!r} "
+                    f"diverges", node=guid, op=node.op.name))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# proof graphs: small models that together match EVERY registered xfer.
+# Sizes scale with the device count so every divisor degree the
+# registry generates divides the partitioned dims (a dim of size N or
+# 2N is divisible by every divisor of N).
+
+
+def _proof_graphs(num_devices: int = 8) -> List:
+    import flexflow_tpu as ff
+
+    n = max(2, num_devices)
+    b = max(8, n)  # batch: every divisor of n divides b (b = n or 8|n…)
+    if b % n:
+        b = n
+    w = 2 * n  # feature width
+    # a batch-dividing degree for the hand-placed repartitions (hoist's
+    # apply re-checks divisibility and declines otherwise)
+    d_b = next((d for d in (4, 3, 2) if b % d == 0), b)
+    graphs = []
+    cfg = lambda: ff.FFConfig(batch_size=b, num_devices=num_devices,  # noqa: E731
+                              only_data_parallel=True)
+
+    # linear / relu / fusion / replicate-reduce
+    m = ff.FFModel(cfg())
+    x = m.create_tensor([b, w], name="pf_mlp_in")
+    t = m.dense(x, w, name="pf_fc1")
+    t = m.relu(t, name="pf_act")
+    t = m.dense(t, w, name="pf_fc2")
+    m.dense(t, 4, name="pf_mlp_head")
+    graphs.append(m.graph)
+
+    # attention (dims 0/1 + head-parallel replicate-reduce): seq = n,
+    # heads = n (head_dim 2), so every divisor degree fits
+    m = ff.FFModel(cfg())
+    x = m.create_tensor([b, n, w], name="pf_attn_in")
+    t = m.multihead_attention(x, x, x, w, n, name="pf_attn")
+    m.dense(t, 4, name="pf_attn_head")
+    graphs.append(m.graph)
+
+    # conv / pool / flat (batch-dim partitions only in the registry)
+    m = ff.FFModel(cfg())
+    x = m.create_tensor([b, 8, 8, 8], name="pf_img")
+    t = m.conv2d(x, 8, 3, 3, 1, 1, 1, 1, name="pf_conv")
+    t = m.pool2d(t, 2, 2, stride_h=2, stride_w=2, name="pf_pool")
+    t = m.flat(t, name="pf_flat")
+    m.dense(t, 4, name="pf_conv_head")
+    graphs.append(m.graph)
+
+    # embeddings (x2 same-signature: BatchEmbeddingsXfer) / concat /
+    # layernorm / softmax / ew_add
+    m = ff.FFModel(cfg())
+    outs = []
+    for i in range(2):
+        ids = m.create_tensor([b, 2], dtype="int32", name=f"pf_ids{i}")
+        outs.append(m.embedding(ids, 4 * n, n, aggr="sum",
+                                name=f"pf_emb{i}"))
+    t = m.concat(outs, axis=1, name="pf_cat")
+    a = m.dense(t, w, name="pf_ba")
+    b_ = m.dense(t, w, name="pf_bb")
+    t = m.add(a, b_, name="pf_add")
+    t = m.layer_norm(t, name="pf_ln")
+    t = m.softmax(t, name="pf_sm")
+    m.dense(t, 4, name="pf_emb_head")
+    graphs.append(m.graph)
+
+    # cancel_repartition_combine
+    m = ff.FFModel(cfg())
+    x = m.create_tensor([b, w], name="pf_cc_in")
+    t = m.repartition(x, dim=0, degree=d_b, name="pf_cc_rep")
+    t = m.combine(t, dim=0, degree=1, name="pf_cc_comb")
+    m.dense(t, 4, name="pf_cc_head")
+    graphs.append(m.graph)
+
+    # fuse_parallel_op_chain
+    m = ff.FFModel(cfg())
+    x = m.create_tensor([b, w], name="pf_ch_in")
+    t = m.repartition(x, dim=0, degree=2, name="pf_ch_r1")
+    t = m.repartition(t, dim=1, degree=2, name="pf_ch_r2")
+    m.dense(t, 4, name="pf_ch_head")
+    graphs.append(m.graph)
+
+    # sink_combine_through_concat
+    m = ff.FFModel(cfg())
+    x = m.create_tensor([b, w], name="pf_sk_in")
+    outs = []
+    for i in range(3):
+        t = m.dense(x, w, name=f"pf_sk_b{i}")
+        outs.append(m.combine(t, dim=0, degree=1, name=f"pf_sk_c{i}"))
+    t = m.concat(outs, axis=1, name="pf_sk_cat")
+    m.dense(t, 4, name="pf_sk_head")
+    graphs.append(m.graph)
+
+    # hoist_partition_above_unary
+    m = ff.FFModel(cfg())
+    x = m.create_tensor([b, w], name="pf_ho_in")
+    t = m.relu(x, name="pf_ho_act")
+    outs = []
+    for i in range(3):
+        p = m.repartition(t, dim=0, degree=d_b, name=f"pf_ho_p{i}")
+        outs.append(m.dense(p, w, name=f"pf_ho_fc{i}"))
+    m.concat(outs, axis=1, name="pf_ho_cat")
+    graphs.append(m.graph)
+
+    return graphs
+
+
+def verify_registry(num_devices: int = 8, seed: int = 0,
+                    xfers=None) -> List[Finding]:
+    """Executable proof for the whole rewrite registry: every xfer from
+    ``generate_all_pcg_xfers(num_devices)`` must match at least one
+    proof graph and pass ``verify_rewrite`` there.  [] = the registry
+    is sound."""
+    from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+
+    if xfers is None:
+        xfers = generate_all_pcg_xfers(num_devices)
+    graphs = _proof_graphs(num_devices)
+    findings: List[Finding] = []
+    for xf in xfers:
+        name = getattr(xf, "name", type(xf).__name__)
+        matched = False
+        for g in graphs:
+            matches = xf.find_matches(g)
+            if not matches:
+                continue
+            matched = True
+            findings += verify_rewrite(g, xf, matches[0], seed=seed)
+            break
+        if not matched:
+            findings.append(_f(
+                "EQV305",
+                f"registered rewrite {name!r} matched no proof graph — "
+                f"it carries no executable soundness proof"))
+    return findings

@@ -1,0 +1,188 @@
+"""Bucketed, issue-ordered gradient sync — the execution half of the
+sync SCHEDULE (search/sync_schedule.py).
+
+The monolithic ``_sync_grads`` fires every weight group's collective
+after the whole backward; GSPMD-style compilers hide reduction latency
+by issuing collectives asynchronously under the remaining backward
+compute (arXiv:2105.04663), and the weight-update/sync tail is where
+data-parallel steps lose their time (arXiv:2004.13336).  This module
+executes a searched ``SyncSchedule`` for real:
+
+* **Fused wire payload** — a compressed bucket's member grads flatten
+  into ONE buffer per replication group and ride a single
+  ``quantized_allreduce`` round trip (int8/bf16 chunk-scaled wire,
+  comm/quantized.py): k collectives' latency floors collapse into one,
+  exactly the amortization the cost model prices
+  (``CostModel.bucket_sync_cost``).
+* **Issue ordering** — buckets chain through
+  ``lax.optimization_barrier``: bucket k+1's payload is data-dependent
+  on bucket k's result, so XLA must issue the collectives in schedule
+  order (reverse-topological = backward grad-readiness order) instead
+  of clumping them after the last use, and its latency-hiding scheduler
+  may overlap each one with backward compute that does not feed it.
+* **fp32 buckets are bit-exact** — their gradients were already reduced
+  by GSPMD's own backward psum (the fp32 "wire" is that psum); the
+  bucket contributes only its ordering barrier, which is a value
+  identity, so an all-fp32 schedule produces bitwise the same step as
+  the monolithic lowering (test-enforced).  Sub-floor weights inside a
+  compressed bucket pass through untouched (``MIN_COMPRESS_ELEMS``),
+  mirroring ``quantized_grad_sync`` and the cost model exactly.
+
+Composition: the round trip runs before the optimizer update, so
+ZeRO-1's reduce-scatter/all-gather placement (``_constrain_update``)
+and grad accumulation (sync of the averaged grads) are untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+from jax import lax
+
+from flexflow_tpu.comm.quantized import (
+    DEFAULT_CHUNK,
+    MIN_COMPRESS_ELEMS,
+    quantized_allreduce,
+    replication_axes,
+)
+
+
+def _ordered(arrays: List[jax.Array], token) -> Tuple[List[jax.Array], object]:
+    """Tie ``arrays`` to the previous bucket's completion token: every
+    output of one optimization_barrier depends on every input, so the
+    collectives consuming the returned arrays cannot issue before the
+    token's producer — the schedule's serialization, with zero value
+    change."""
+    if token is None or not arrays:
+        return arrays, token
+    tied = lax.optimization_barrier(tuple(arrays) + (token,))
+    return list(tied[:-1]), tied[-1]
+
+
+def bucketed_grad_sync(
+    grads: Dict[str, Dict[str, jax.Array]],
+    mesh,
+    param_shardings: Dict[str, Dict[str, "jax.sharding.NamedSharding"]],
+    schedule,
+    chunk: int = DEFAULT_CHUNK,
+    machine=None,
+) -> Dict[str, Dict[str, jax.Array]]:
+    """Run ``schedule``'s buckets in issue order over ``grads`` (the
+    already-GSPMD-reduced gradient tree) — call inside the jitted step,
+    before the optimizer update.  Ops absent from the schedule (or
+    whose params consume the whole mesh) pass through untouched, as do
+    fp32 buckets' values and sub-floor weights of compressed buckets.
+
+    ``machine`` (a MachineSpec) arms the staged execution of buckets
+    carrying a reduction PLAN (search/reduction_plan.py): their
+    compressed wire runs the hierarchical RS → cross-slice exchange →
+    AG shape (comm/hierarchical.py) over the plan's nested axis
+    groupings instead of one flat collective.  All-fp32 plans stay
+    value-identity anchors — bit-exact with the monolithic path."""
+    from flexflow_tpu.comm.compat import shard_map
+    from flexflow_tpu.comm.hierarchical import (
+        plan_axis_groups,
+        plan_cross_precision,
+        staged_allreduce,
+    )
+
+    merged = {op: dict(ws) for op, ws in grads.items()}
+    token = None
+    for bucket in getattr(schedule, "buckets", schedule):
+        prec = getattr(bucket, "precision", "fp32")
+        plan = getattr(bucket, "plan", None)
+        cross_prec = plan_cross_precision(plan)
+        # a plan whose every stage is fp32 has no explicit wire work
+        # (GSPMD's own psum reduced the grads; the priced stages model
+        # XLA's hierarchical psum) — its members all pass through
+        wire = prec in ("bf16", "int8") and (
+            plan is None or cross_prec is not None)
+        # bucket members' replicated grads, grouped by replication axes
+        # — one fused payload per (axes, n) group
+        groups: Dict[Tuple, List[Tuple[str, str, jax.Array, object]]] = {}
+        plain: List[Tuple[str, str, jax.Array]] = []
+        for op_name in bucket.ops:
+            for w_name, g in grads.get(op_name, {}).items():
+                sh = param_shardings.get(op_name, {}).get(w_name)
+                if sh is None:
+                    continue
+                rep, n = replication_axes(sh, mesh)
+                if not rep:
+                    continue
+                if wire and g.size >= MIN_COMPRESS_ELEMS:
+                    groups.setdefault((rep, n), []).append(
+                        (op_name, w_name, g, sh.spec))
+                else:
+                    # fp32 wire = GSPMD's own backward psum (already
+                    # happened); the bucket only anchors issue order
+                    plain.append((op_name, w_name, g))
+        toks: List[jax.Array] = []
+        for (rep, n), members in groups.items():
+            gs = [g for _o, _w, g, _s in members]
+            gs, token = _ordered(gs, token)
+            specs = [s for _o, _w, _g, s in members]
+            # per-group reduction: the plan's staged shape when its
+            # cross stage has axes to ride on this group, the flat
+            # quantized collective otherwise (a within-slice group of a
+            # staged bucket runs flat at the bucket precision — exactly
+            # how the cost model priced it)
+            staged = None
+            if plan is not None and cross_prec is not None \
+                    and machine is not None:
+                st_axes, st_sizes = plan_axis_groups(
+                    rep, mesh, machine, plan.cross_level)
+                if st_axes[-1]:
+                    staged = (st_axes, st_sizes)
+
+            def reduce_flat(flat, _rep=rep, _n=n, _staged=staged):
+                if _staged is not None:
+                    return staged_allreduce(
+                        flat, _staged[0], _staged[1], cross_prec,
+                        chunk=chunk, mean=True)
+                return quantized_allreduce(
+                    flat, _rep, precision=prec, chunk=chunk, mean=True,
+                    axis_size=_n,
+                )
+
+            def fused(*local, _red=reduce_flat):
+                # flatten the bucket into ONE wire payload: the fused
+                # collective pays a single latency floor for the whole
+                # bucket (what coalescing buys)
+                sizes = [x.size for x in local]
+                flat = (
+                    local[0].reshape(-1) if len(local) == 1 else
+                    jax.numpy.concatenate([x.reshape(-1) for x in local])
+                )
+                red = _red(flat)
+                out, off = [], 0
+                for x, sz in zip(local, sizes):
+                    out.append(red[off:off + sz].reshape(x.shape))
+                    off += sz
+                return tuple(out)
+
+            synced = shard_map(
+                fused, mesh=mesh, in_specs=tuple(specs),
+                out_specs=tuple(specs),
+            )(*gs)
+            for (op_name, w_name, _g, _s), y in zip(members, synced):
+                merged[op_name][w_name] = y
+            # one completion scalar PER fused collective: the next
+            # bucket must order after every one of this bucket's
+            # replication-group collectives, not just the first
+            toks.append(synced[0].ravel()[0])
+        if plain:
+            gs = [g for _o, _w, g in plain]
+            gs, token = _ordered(gs, token)
+            for (op_name, w_name, _g), y in zip(plain, gs):
+                merged[op_name][w_name] = y
+            toks.append(gs[0].ravel()[0])
+        if toks:
+            # completion token for the NEXT bucket's barrier — summing
+            # makes it data-dependent on ALL of this bucket's
+            # collectives, so bucket k+1 cannot issue before any of
+            # bucket k's groups
+            token = toks[0]
+            for t in toks[1:]:
+                token = token + t
+    return merged

@@ -1,0 +1,151 @@
+"""Staged hierarchical gradient collectives — the execution half of the
+reduction PLAN (search/reduction_plan.py).
+
+A flat compressed allreduce drags the full payload over every link
+class; the staged shape — reduce-scatter within each slice, a small
+cross-slice exchange of the 1/n shard, all-gather within each slice —
+ships only the shard across the slow DCN links, at the plan's
+per-level wire precision (int8 over DCN, exact fp32 over ICI).  This
+module lowers a chosen plan to nested shard_map collectives:
+
+* **within-slice stages are exact** — ``lax.psum_scatter`` /
+  ``lax.all_gather`` in fp32 (the plan's RS/AG stages are fp32 by
+  construction, reduction_plan.canonical_stages), so quantization
+  touches the value only at the cross-slice stage — the staged plan's
+  error is never worse than the flat compressed ring's;
+* **the cross-slice stage reuses the quantized collective**
+  (comm/quantized.py ``quantized_allreduce``): the wire genuinely
+  carries the compressed shard across the slice boundary;
+* **axis split mirrors the cost model** — ``plan_axis_groups`` groups a
+  param's replication mesh axes by link level with the SAME
+  aligned-span rule the pricing's ``_axis_level`` uses (an axis of
+  stride s and size f lives in aligned blocks of span s*f), so the
+  executed nesting is exactly the priced one;
+* **all-fp32 plans execute as value-identity anchors** — like the fp32
+  buckets of comm/bucketed.py, their gradients were already reduced by
+  GSPMD's own backward psum (which XLA itself lowers hierarchically on
+  a real multislice mesh); the plan's priced stages model that psum,
+  and the bucket contributes only its ordering barrier, keeping fp32
+  staged plans bit-exact with the flat ``_sync_grads`` path.
+
+Composition: called from ``comm/bucketed.py`` inside the bucket's
+fused shard_map, so issue ordering, fused payloads, ZeRO-1 and grad
+accumulation all compose unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flexflow_tpu.comm.quantized import DEFAULT_CHUNK, quantized_allreduce
+
+
+def mesh_axis_spans(mesh) -> dict:
+    """Aligned span (stride * size) of every mesh axis under jax's
+    device ordering (axis i's stride is the product of the later axes'
+    sizes — the same row-major layout ``build_mesh`` reshapes into)."""
+    spans = {}
+    stride = 1
+    for name, size in reversed(list(mesh.shape.items())):
+        spans[name] = stride * size
+        stride *= size
+    return spans
+
+
+def plan_axis_groups(
+    rep_axes: Tuple[str, ...], mesh, machine, cross_level: int
+) -> Tuple[List[Tuple[str, ...]], List[int]]:
+    """Group a param's replication axes by link level, finest first:
+    ``axes[i]`` for i < cross_level are the level-i RS/AG stage axes,
+    ``axes[-1]`` the cross-allreduce axes (everything at or beyond the
+    plan's cross level).  ``sizes[i]`` is the group extent (1 when the
+    level contributes no axis).  Same aligned-span membership rule as
+    the cost model's ``_axis_level`` — priced and executed nestings
+    agree."""
+    levels = machine.topology_levels()
+    spans = mesh_axis_spans(mesh)
+
+    def axis_level(span: int) -> int:
+        for i, lvl in enumerate(levels):
+            if span <= lvl.span and lvl.span % span == 0:
+                return i
+        return len(levels) - 1
+
+    groups: List[List[str]] = [[] for _ in range(cross_level + 1)]
+    for a in rep_axes:
+        li = min(axis_level(spans[a]), cross_level)
+        groups[li].append(a)
+    sizes = []
+    for g in groups:
+        n = 1
+        for a in g:
+            n *= mesh.shape[a]
+        sizes.append(n)
+    return [tuple(g) for g in groups], sizes
+
+
+def staged_allreduce(
+    x: jax.Array,
+    stage_axes: List[Tuple[str, ...]],
+    stage_sizes: List[int],
+    cross_precision: str,
+    chunk: int = DEFAULT_CHUNK,
+    mean: bool = False,
+) -> jax.Array:
+    """Hierarchical allreduce of ``x`` — call inside shard_map.
+
+    ``stage_axes``/``stage_sizes`` from ``plan_axis_groups``: exact
+    fp32 reduce-scatters peel the within-level axes finest-first, the
+    compressed cross-level allreduce (``quantized_allreduce`` at
+    ``cross_precision``) reduces the surviving shard across slices,
+    and mirrored all-gathers reconstruct.  Equivalent to
+    ``psum(x, all axes)`` up to the cross stage's quantization (exact
+    for ``cross_precision='fp32'``)."""
+    orig_shape, size, orig_dtype = x.shape, x.size, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    inner_total = 1
+    for n in stage_sizes[:-1]:
+        inner_total *= n
+    pad = (-flat.shape[0]) % max(1, inner_total)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    shard = flat
+    applied: List[Tuple[str, ...]] = []
+    for axes in stage_axes[:-1]:
+        if not axes:
+            continue
+        shard = lax.psum_scatter(shard, axes, scatter_dimension=0,
+                                 tiled=True)
+        applied.append(axes)
+    cross = stage_axes[-1]
+    if cross:
+        shard = quantized_allreduce(
+            shard, cross, precision=cross_precision, chunk=chunk,
+            axis_size=stage_sizes[-1],
+        )
+    for axes in reversed(applied):
+        shard = lax.all_gather(shard, axes, axis=0, tiled=True)
+    out = shard[:size].reshape(orig_shape)
+    if mean:
+        total = 1
+        for n in stage_sizes:
+            total *= n
+        out = out / total
+    return out.astype(orig_dtype)
+
+
+def plan_cross_precision(plan) -> Optional[str]:
+    """The compressed wire precision of a plan's cross-level allreduce
+    stage, or None when every stage is fp32 (the plan then has no
+    explicit wire work to run — GSPMD's own backward psum already
+    reduced the gradient, and the bucket is a value-identity anchor)."""
+    if plan is None:
+        return None
+    for s in plan.stages:
+        if s.kind == "allreduce" and s.precision != "fp32":
+            return s.precision
+    return None

@@ -1,0 +1,3 @@
+from flexflow_tpu.compiler.lowering import CompiledModel, data_parallel_strategy
+
+__all__ = ["CompiledModel", "data_parallel_strategy"]

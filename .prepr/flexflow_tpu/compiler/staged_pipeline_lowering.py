@@ -1,0 +1,529 @@
+"""General staged-pipeline executor: GPipe over ARBITRARY graph cuts.
+
+The stacked-block pipelined lowering (pipeline_lowering.py) requires S
+isomorphic blocks so one stage program can scan over stacked weights.
+The reference's inter-op device splits have no such limit — any graph
+cut can be staged (reference: src/runtime/graph.cc:161-295; the
+OP_PIPELINE the reference stubs, ffconst.h:148).  This module executes
+the general shape the search proposes (search/pipeline_search.py
+propose_pipeline_general): the PCG's topological interval partition
+into S heterogeneous stages, each lowered as an ordinary
+``CompiledModel`` over its OWN contiguous submesh of ``n/S`` devices,
+with the microbatch wavefront driven from the host:
+
+  forward:  for tick t:   stage s runs microbatch t-s   (t-s in [0,M))
+  backward: reverse wavefront, per-stage ``jax.vjp`` re-running the
+            stage forward with the SAME per-(stage, microbatch) rng
+            (activation rematerialization — only the cross-stage
+            boundary tensors are ever stored)
+  update:   per-stage optimizer apply on microbatch-averaged grads
+
+Because consecutive wavefront dispatches target DISJOINT submeshes and
+jax dispatch is asynchronous, stage s's microbatch m overlaps stage
+s+1's microbatch m-1 on real hardware — host-side GPipe, the XLA
+analogue of the reference mapper running per-stage Legion tasks on
+disjoint device sets.
+
+Cross-stage tensors (skip edges included) enter their consumer stage
+as synthetic boundary inputs, batch-dp over the consumer's submesh
+when divisible; cotangents flow back under the producer's own output
+sharding (same mechanics as the 2-block placed lowering, which this
+generalizes to S stages + microbatching).
+
+Unsupported (loud): state-writing ops (BatchNorm running stats —
+microbatch wavefronts would race them), grad accumulation, ZeRO,
+multi-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from flexflow_tpu.compiler.lowering import CompiledModel
+from flexflow_tpu.core.graph import Graph, Node
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.losses import LossType, compute_loss
+from flexflow_tpu.metrics import compute_metrics
+from flexflow_tpu.ops.inout import InputOp
+
+
+class StagedPipelinedModel:
+    """S heterogeneous stages over contiguous submeshes, microbatched."""
+
+    def __init__(self, graph: Graph, stage_guids: List[List[int]],
+                 num_microbatches: int, config, loss_type, metric_types,
+                 optimizer, label_dtype: str = "int32"):
+        from flexflow_tpu.compiler.lowering import data_parallel_strategy
+        from flexflow_tpu.parallel.mesh import build_mesh
+
+        if getattr(config, "grad_accum_steps", 1) > 1:
+            raise NotImplementedError(
+                "grad_accum_steps > 1 is not supported with the staged "
+                "pipeline (microbatching already plays that role)")
+        if getattr(config, "zero_dp_shard", False):
+            raise NotImplementedError(
+                "zero_dp_shard is not supported with the staged pipeline")
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-process staged pipelining is not supported (the "
+                "wavefront is host-composed)")
+        self.graph = graph
+        self.config = config
+        self.optimizer = optimizer
+        self.loss_type = LossType.from_any(loss_type)
+        self.metric_types = list(metric_types)
+        self.num_stages = S = len(stage_guids)
+        self.num_microbatches = M = int(num_microbatches)
+        assert S >= 2 and M >= 1
+        if config.batch_size % M:
+            raise ValueError(
+                f"batch {config.batch_size} must divide into "
+                f"{M} microbatches")
+        n = config.num_devices
+        if n % S:
+            raise ValueError(f"{n} devices do not split into {S} stages")
+        d = n // S
+
+        stage_of: Dict[int, int] = {}
+        for si, guids in enumerate(stage_guids):
+            for g in guids:
+                if g not in graph.nodes:
+                    raise ValueError(f"stage {si} names unknown node {g}")
+                stage_of[g] = si
+        if set(stage_of) != set(graph.nodes):
+            raise ValueError("stages must partition the graph")
+        for guid in graph.nodes:
+            for e in graph.out_edges[guid]:
+                if stage_of[e.src] > stage_of[e.dst]:
+                    raise ValueError(
+                        "stage partition has a backward edge — stages "
+                        "must follow a topological interval order")
+            if getattr(graph.nodes[guid].op, "writes_state", False) or \
+                    getattr(graph.nodes[guid].op, "state_specs", None):
+                raise NotImplementedError(
+                    f"op {graph.nodes[guid].op.name!r} carries state — "
+                    "the microbatch wavefront would race its updates")
+        self._stage_of = stage_of
+
+        # cross-stage boundary tensors, per producer (src, src_idx):
+        # consumer stages receive them as synthetic inputs
+        crossing: Dict[Tuple[int, int], List] = {}
+        for guid in graph.nodes:
+            for e in graph.out_edges[guid]:
+                if stage_of[e.src] != stage_of[e.dst]:
+                    crossing.setdefault((e.src, e.src_idx), []).append(e)
+        # stable global boundary order
+        self._boundaries = sorted(crossing)
+        self._boundary_stage = {
+            key: stage_of[key[0]] for key in self._boundaries
+        }
+
+        micro_b = config.batch_size // M
+        devices = jax.devices()[:n]
+        self._stage_models: List[CompiledModel] = []
+        self._stage_out_keys: List[List[Tuple[int, int]]] = []
+        self._stage_in_keys: List[List[Tuple[int, int]]] = []
+        self._stage_boundary_nodes: List[Dict[Tuple[int, int], Node]] = []
+        next_guid = max(graph.nodes) + 1
+        for si, guids in enumerate(stage_guids):
+            member = set(guids)
+            sg = Graph()
+            # boundary inputs: every cross-stage tensor consumed here,
+            # in global boundary order; negative tensor_guids sort them
+            # first and in order in CompiledModel's input ordering
+            in_keys = sorted({
+                (e.src, e.src_idx)
+                for g in member
+                for e in graph.in_edges[g]
+                if e.src not in member
+            })
+            K = len(in_keys)
+            bmap: Dict[Tuple[int, int], Node] = {}
+            for bi, (src, idx) in enumerate(in_keys):
+                shp = graph.nodes[src].op.output_shapes[idx]
+                # per-microbatch shape: the batch dim shrinks to B/M
+                shp_m = self._micro_shape(shp, micro_b)
+                node = Node(
+                    next_guid,
+                    InputOp(f"stage{si}_boundary_{bi}", shp_m,
+                            tensor_guid=bi - K),
+                )
+                next_guid += 1
+                bmap[(src, idx)] = node
+                sg.add_node(node)
+            for g in guids:
+                sg.add_node(graph.nodes[g])
+            for g in guids:
+                for e in graph.in_edges[g]:
+                    if e.src in member:
+                        sg.add_edge(graph.nodes[e.src], graph.nodes[e.dst],
+                                    e.src_idx, e.dst_idx)
+                    else:
+                        sg.add_edge(bmap[(e.src, e.src_idx)],
+                                    graph.nodes[e.dst], 0, e.dst_idx)
+            out_keys = [k for k in self._boundaries
+                        if self._boundary_stage[k] == si]
+            mesh = build_mesh(devices[si * d:(si + 1) * d])
+            cfg_s = dataclasses.replace(
+                config, num_devices=d, batch_size=micro_b)
+            strat = data_parallel_strategy(sg, d)
+            is_last = si == S - 1
+            self._stage_models.append(CompiledModel(
+                sg, strat, cfg_s,
+                self.loss_type if is_last else LossType.IDENTITY,
+                self.metric_types if is_last else [],
+                optimizer, mesh=mesh, label_dtype=label_dtype))
+            self._stage_out_keys.append(out_keys)
+            self._stage_in_keys.append(in_keys)
+            self._stage_boundary_nodes.append(bmap)
+
+        # NOTE: stage sub-Graphs hold per-microbatch boundary shapes;
+        # real InputOps keep full-batch shapes in the original graph but
+        # the stage model compiles with batch_size=micro_b, so real
+        # inputs are fed PER MICROBATCH too (fit() hands us the full
+        # batch; train_step slices it).
+        self._micro_b = micro_b
+        self._op_stage = {
+            graph.nodes[g].op.name: stage_of[g] for g in graph.nodes
+        }
+        # original input binding order (global input index -> (stage,
+        # stage-local input position))
+        self._input_map: List[Tuple[int, int]] = []
+        all_inputs = sorted(
+            (nd for nd in graph.topo_order() if isinstance(nd.op, InputOp)),
+            key=lambda nd: nd.op.attrs.get("tensor_guid", nd.guid),
+        )
+        for nd in all_inputs:
+            si = stage_of[nd.guid]
+            comp = self._stage_models[si]
+            local = [m.guid for m in comp._input_nodes].index(nd.guid)
+            self._input_map.append((si, local))
+        self._fwd_fns = None
+        self._bwd_fns = None
+        self._upd_fns = None
+        self._eval_fns = None
+        self.supports_trace = False
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _micro_shape(shape, micro_b):
+        from flexflow_tpu.core.ptensor import ParallelTensorShape
+
+        sizes = list(shape.sizes)
+        if sizes:
+            sizes[0] = micro_b
+        return ParallelTensorShape.make(tuple(sizes), shape.dtype)
+
+    # -- params ---------------------------------------------------------
+    def _split(self, tree: dict):
+        parts = [dict() for _ in self._stage_models]
+        for k, v in (tree or {}).items():
+            parts[self._op_stage[k]][k] = v
+        return parts
+
+    def _split_opt(self, opt):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        names = set(self._op_stage)
+        parts = [dict() for _ in self._stage_models]
+        for k, v in (opt or {}).items():
+            if isinstance(v, dict) and v and set(v) <= names:
+                for si in range(self.num_stages):
+                    parts[si][k] = {
+                        op: w for op, w in v.items()
+                        if self._op_stage[op] == si
+                    }
+            else:
+                for si, comp in enumerate(self._stage_models):
+                    parts[si][k] = jax.device_put(
+                        v, NamedSharding(comp.mesh, PartitionSpec()))
+        return parts
+
+    @staticmethod
+    def _merge(parts):
+        out = {}
+        for p in parts:
+            for k, v in p.items():
+                if isinstance(v, dict) and isinstance(out.get(k), dict):
+                    out[k] = {**out[k], **v}
+                else:
+                    out[k] = v
+        return out
+
+    def init_params(self, seed: int = 0):
+        ps, ss = {}, {}
+        for comp in self._stage_models:
+            p, s = comp.init_params(seed)  # name-keyed: placement-invariant
+            ps.update(p)
+            ss.update(s)
+        return ps, ss
+
+    def shard_opt_state(self, opt_state):
+        parts = self._split_opt(opt_state)
+        parts = [comp.shard_opt_state(p)
+                 for comp, p in zip(self._stage_models, parts)]
+        return self._merge(parts)
+
+    # -- shardings ------------------------------------------------------
+    def input_sharding(self, i: int):
+        si, local = self._input_map[i]
+        return self._stage_models[si].input_sharding(local)
+
+    def batch_sharding(self):
+        return self._stage_models[-1].batch_sharding()
+
+    # -- programs -------------------------------------------------------
+    def _make_stage_fns(self, si: int):
+        import jax.numpy as jnp
+
+        comp = self._stage_models[si]
+        out_keys = list(self._stage_out_keys[si])
+        is_last = si == self.num_stages - 1
+        optimizer = self.optimizer
+        M = self.num_microbatches
+        metric_types, loss_type = self.metric_types, self.loss_type
+
+        @jax.jit
+        def fwd(p, ins, rng):
+            """Training-forward of one microbatch: boundary outputs."""
+            outs, _ = comp.apply_multi(
+                p, {}, list(ins), rng, train=True, outputs=out_keys)
+            return outs
+
+        @jax.jit
+        def bwd(p, gacc, bounds, rest, rng, d_outs, labels):
+            """vjp of this stage for one microbatch: cotangents for its
+            boundary OUTPUTS in (loss seeds the last stage), param
+            grads (accumulated into ``gacc``) and cotangents for its
+            boundary INPUTS out.  Re-runs the stage forward under
+            jax.vjp with the same rng — activation remat."""
+            if is_last:
+
+                def f(pp, bb):
+                    logits, new_state = comp.apply(
+                        pp, {}, list(bb) + list(rest), rng, train=True)
+                    loss = comp._loss_from(logits, labels, new_state)
+                    return loss, logits
+
+                loss, vjp, logits = jax.vjp(f, p, tuple(bounds),
+                                            has_aux=True)
+                gp, gb = vjp(jnp.float32(1.0))
+                m = compute_metrics(metric_types, loss_type, logits,
+                                    labels)
+            else:
+
+                def f(pp, bb):
+                    outs, _ = comp.apply_multi(
+                        pp, {}, list(bb) + list(rest), rng, train=True,
+                        outputs=out_keys)
+                    return outs
+
+                _, vjp = jax.vjp(f, p, tuple(bounds))
+                gp, gb = vjp(tuple(d_outs))
+                loss, m = jnp.float32(0.0), {}
+            gacc = jax.tree.map(jnp.add, gacc, gp)
+            return gacc, gb, loss, m
+
+        @jax.jit
+        def upd(p, o, gacc):
+            g = jax.tree.map(lambda x: x / M, gacc)
+            return optimizer.apply(p, g, o)
+
+        @jax.jit
+        def eval_fwd(p, ins):
+            if is_last:
+                logits, _ = comp.apply(p, {}, list(ins), None, train=False)
+                return (), logits
+            outs, _ = comp.apply_multi(
+                p, {}, list(ins), None, train=False, outputs=out_keys)
+            return outs, None
+
+        return fwd, bwd, upd, eval_fwd
+
+    def _programs(self):
+        if self._fwd_fns is None:
+            fns = [self._make_stage_fns(si)
+                   for si in range(self.num_stages)]
+            self._fwd_fns = [f[0] for f in fns]
+            self._bwd_fns = [f[1] for f in fns]
+            self._upd_fns = [f[2] for f in fns]
+            self._eval_fns = [f[3] for f in fns]
+        return self._fwd_fns, self._bwd_fns, self._upd_fns, self._eval_fns
+
+    # -- wavefront helpers ---------------------------------------------
+    def _micro_slice(self, x, m):
+        mb = self._micro_b
+        return x[m * mb:(m + 1) * mb]
+
+    def _bind_stage_inputs(self, inputs):
+        """Global input list -> per-stage list of real-input arrays in
+        each stage model's input order (boundaries excluded)."""
+        per_stage: List[List] = [
+            [None] * (len(comp._input_nodes) - len(self._stage_in_keys[si]))
+            for si, comp in enumerate(self._stage_models)
+        ]
+        for (si, local), x in zip(self._input_map, inputs):
+            per_stage[si][local - len(self._stage_in_keys[si])] = x
+        return per_stage
+
+    def _producer_sharding(self, key):
+        """Sharding of boundary ``key`` on its PRODUCER stage's mesh
+        (cached; cotangents re-enter under it)."""
+        cache = getattr(self, "_prod_sh_cache", None)
+        if cache is None:
+            cache = self._prod_sh_cache = {}
+        hit = cache.get(key)
+        if hit is None:
+            si = self._boundary_stage[key]
+            hit = self._stage_models[si].value_sharding(*key)
+            cache[key] = hit
+        return hit
+
+    def _gather_bounds(self, si, m, bound_vals):
+        """Boundary inputs of stage si for microbatch m, device_put onto
+        the stage's mesh in its input order."""
+        comp = self._stage_models[si]
+        out = []
+        for bi, key in enumerate(self._stage_in_keys[si]):
+            out.append(jax.device_put(
+                bound_vals[key][m], comp.input_sharding(bi)))
+        return out
+
+    # -- steps ----------------------------------------------------------
+    def train_step(self, params, opt_state, state, rng, inputs, labels):
+        import jax.numpy as jnp
+        import jax.random as jrandom
+
+        fwds, bwds, upds, _ = self._programs()
+        S, M = self.num_stages, self.num_microbatches
+        ps = self._split(params)
+        os_ = self._split_opt(opt_state)
+        stage_inputs = self._bind_stage_inputs(inputs)
+        keys = [[jrandom.fold_in(rng, si * M + m) for m in range(M)]
+                for si in range(S)]
+
+        # forward wavefront: boundary values per (producer key, micro)
+        bound_vals: Dict[Tuple[int, int], List] = {
+            key: [None] * M for key in self._boundaries
+        }
+        for t in range(M + S - 1):
+            for si in range(S):
+                m = t - si
+                if not 0 <= m < M:
+                    continue
+                ins = self._gather_bounds(si, m, bound_vals) + [
+                    self._micro_slice(x, m) for x in stage_inputs[si]
+                ]
+                outs = fwds[si](ps[si], ins, keys[si][m])
+                for key, val in zip(self._stage_out_keys[si], outs):
+                    bound_vals[key][m] = val
+
+        # backward wavefront (reverse): cotangents per (key, micro),
+        # summed over a boundary's consumer stages
+        d_bounds: Dict[Tuple[int, int], List] = {
+            key: [None] * M for key in self._boundaries
+        }
+        gaccs = [jax.tree.map(jnp.zeros_like, p) for p in ps]
+        losses = []
+        metrics_acc = None
+        for t in reversed(range(M + S - 1)):
+            for si in range(S):  # consumers (larger si) ran at larger t
+                m = t - si
+                if not 0 <= m < M:
+                    continue
+                bounds = self._gather_bounds(si, m, bound_vals)
+                rest = [self._micro_slice(x, m) for x in stage_inputs[si]]
+                d_outs = []
+                for key in self._stage_out_keys[si]:
+                    d = d_bounds[key][m]
+                    assert d is not None, (
+                        "missing cotangent for boundary "
+                        f"{key} microbatch {m}")
+                    d_outs.append(d)
+                lab = (self._micro_slice(labels, m)
+                       if si == S - 1 else None)
+                gaccs[si], gb, loss, mtr = bwds[si](
+                    ps[si], gaccs[si], bounds, rest, keys[si][m],
+                    tuple(d_outs), lab)
+                for key, g in zip(self._stage_in_keys[si], gb):
+                    # cotangents land (and, for multi-consumer
+                    # boundaries, sum) under the PRODUCER's own output
+                    # sharding — the d_outs consumer above then needs no
+                    # further transfer
+                    g_prod = jax.device_put(g, self._producer_sharding(key))
+                    prev = d_bounds[key][m]
+                    d_bounds[key][m] = (
+                        g_prod if prev is None else jnp.add(prev, g_prod)
+                    )
+                if si == S - 1:
+                    losses.append(loss)
+                    if metrics_acc is None:
+                        metrics_acc = mtr
+                    else:
+                        metrics_acc = jax.tree.map(
+                            jnp.add, metrics_acc, mtr)
+
+        new_ps, new_os = [], []
+        for si in range(S):
+            p2, o2 = upds[si](ps[si], os_[si], gaccs[si])
+            new_ps.append(p2)
+            new_os.append(o2)
+        loss = sum(jax.device_get(l) for l in losses) / max(len(losses), 1)
+        import numpy as _np
+
+        return (
+            self._merge(new_ps),
+            self._merge(new_os),
+            dict(state or {}),
+            _np.float32(loss),
+            metrics_acc or {},
+        )
+
+    def eval_step(self, params, state, inputs, labels):
+        logits = self._forward_all(params, inputs)
+        loss = compute_loss(self.loss_type, logits, labels)
+        m = compute_metrics(self.metric_types, self.loss_type, logits,
+                            labels)
+        return loss, m
+
+    def _forward_all(self, params, inputs):
+        import jax.numpy as jnp
+
+        _, _, _, evals = self._programs()
+        S, M = self.num_stages, self.num_microbatches
+        ps = self._split(dict(params))
+        stage_inputs = self._bind_stage_inputs(list(inputs))
+        bound_vals: Dict[Tuple[int, int], List] = {
+            key: [None] * M for key in self._boundaries
+        }
+        logits = [None] * M
+        for t in range(M + S - 1):
+            for si in range(S):
+                m = t - si
+                if not 0 <= m < M:
+                    continue
+                ins = self._gather_bounds(si, m, bound_vals) + [
+                    self._micro_slice(x, m) for x in stage_inputs[si]
+                ]
+                outs, lg = evals[si](ps[si], ins)
+                for key, val in zip(self._stage_out_keys[si], outs):
+                    bound_vals[key][m] = val
+                if si == S - 1:
+                    logits[m] = lg
+        return jnp.concatenate(logits, axis=0)
+
+    def forward_fn(self):
+        def fwd(params, state, inputs):
+            del state
+            return self._forward_all(params, inputs)
+
+        return fwd
+
+    def train_steps(self, *a, **k):
+        raise NotImplementedError(
+            "traced multi-step scans are not supported with the staged "
+            "pipeline — the wavefront is host-composed")

@@ -1,0 +1,330 @@
+"""Runtime configuration.
+
+The TPU-native analogue of FFConfig (reference: include/flexflow/config.h:92-157,
+src/runtime/model.cc:3371 parse_args): every knob of the training run,
+the search, and the cost model, parseable from argv with the reference's
+flag spellings so existing launch scripts translate directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineSpec
+
+
+@dataclass
+class IterationConfig:
+    """Per-iteration knobs threaded into forward/backward
+    (reference: config.h:159-164 FFIterationConfig.seq_length)."""
+
+    seq_length: int = -1
+
+
+@dataclass
+class FFConfig:
+    # training
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    # machine
+    num_devices: int = 0  # 0 = all visible jax devices
+    machine_spec: Optional[MachineSpec] = None
+    machine_model_file: Optional[str] = None
+    # parallelization search (reference: config.h:116-157; the osdi22ae
+    # scripts run with budgets 10-30)
+    search_budget: int = 16
+    search_alpha: float = 1.05
+    only_data_parallel: bool = False
+    enable_parameter_parallel: bool = True
+    enable_attribute_parallel: bool = True
+    enable_inplace_optimizations: bool = True
+    search_num_devices: int = 0  # override devices for search (search a big
+    # strategy on a small machine, reference: graph.cc:1535-1540)
+    base_optimize_threshold: int = 10
+    search_timeout_s: float = 45.0  # wall-clock bound on the joint
+    # search; <=0 disables.  The reference bounds work via --budget
+    # alone (substitution.cc:2007); a hard deadline guarantees compile
+    # latency at any model scale
+    enable_pipeline_search: bool = True  # compile's joint search also
+    # costs pp in {2,4,8} pipelined candidates for stacked-block graphs
+    # (search/pipeline_search.py) and lowers a winner automatically —
+    # the capability the reference stubs as OP_PIPELINE (ffconst.h:148)
+    enable_placement_search: bool = True  # compile also costs 2-block
+    # inter-op placed candidates (search/placement_search.py) and lowers
+    # a margin-beating winner via the placed executor — the reference's
+    # VERTICAL resource splits + mapper placement (graph.cc:161-295,
+    # mapper.cc:371-475)
+    placement_search_max_nodes: int = 80  # placement cut enumeration is
+    # quadratic-ish in graph size; larger graphs skip the pass
+    search_improvement_margin: float = 0.03  # a searched strategy is
+    # accepted only when its simulated win over plain data parallelism
+    # exceeds this fraction — the simulator has finite fidelity, and a
+    # sub-margin "win" is noise that execution routinely loses to GSPMD
+    # resharding (measured: a 1.4% predicted BERT win executed 7-12%
+    # SLOWER than DP on the 8-device host mesh).  Within the margin the
+    # search returns uniform DP, whose lowering has zero resharding
+    # boundaries.
+    substitution_json: Optional[str] = None
+    calibration_file: Optional[str] = None  # persisted measured
+    # per-(op, view) costs (search/calibration.py); the search loads it
+    # when present (reference: ProfilingRecord, simulator.cc:515-554)
+    calibrate: bool = False  # probe this graph's (op, view) costs on
+    # the live backend at compile time and rank with them — the
+    # reference's default behavior (it measures lazily mid-search,
+    # simulator.cc:515; model.cu:38-74).  Off by default here because
+    # probing costs real wall time per compile; combined with
+    # calibration_file the probes persist and later compiles are free
+    calibration_budget_s: float = 60.0  # wall bound on compile-time probes
+    export_strategy_file: Optional[str] = None
+    import_strategy_file: Optional[str] = None
+    import_strategy_partial: bool = False  # best-effort strategy import
+    # (--import-strategy-partial): downgrade the provenance checks
+    # (digest/coverage, STR2xx) to warnings and apply the views whose op
+    # names match — the historical behavior, now an explicit opt-in
+    export_strategy_computation_graph_file: Optional[str] = None
+    export_strategy_task_graph_file: Optional[str] = None  # simulated
+    # schedule dot export (reference: config.h:142, simulator.cc:1008)
+    comp_mode: str = "training"  # "training" | "inference" — set by
+    # compile(comp_mode=...); inference searches rank strategies by
+    # forward latency with no weight sync (reference:
+    # COMP_MODE_INFERENCE, config.h:47-50) and fit() refuses to run
+    # numerics
+    compute_dtype: str = "bfloat16"  # matmul dtype on TPU
+    param_dtype: str = "float32"
+    # execution
+    profiling: bool = False
+    perform_fusion: bool = True
+    grad_accum_steps: int = 1  # >1: each optimizer step processes the
+    # batch as this many microbatches inside a lax.scan, averaging
+    # grads — full effective batch at batch/N activation memory
+    # (reference has no analogue; with remat, the second memory lever)
+    trace_steps: int = 1  # >1: fit() runs this many optimizer steps per
+    # compiled call (lax.scan over stacked batches) — the XLA-native
+    # analogue of the reference's Legion iteration tracing
+    # (flexflow_cffi.py:1867-1874), amortizing per-step dispatch
+    remat: bool = False  # rematerialize activations in backward
+    # (jax.checkpoint) — trades FLOPs for HBM; the reference has no
+    # equivalent (Legion keeps all activations resident)
+    sync_precision: str = "fp32"  # gradient-sync wire precision
+    # (comm/quantized.py, EQuARX arXiv:2506.17615): "fp32" keeps the
+    # historical bit-exact psum; "bf16"/"int8" request compressed
+    # collectives for every weight group the gradient-safety heuristic
+    # admits (search/sync_precision.py); "search" makes the precision a
+    # PER-WEIGHT-GROUP dimension of the strategy search — the cost
+    # model prices each group's sync at its cheapest admissible
+    # precision (wire bytes shrink, quantize overhead added) and the
+    # chosen map is executed by the lowering's _sync_grads
+    sync_schedule: str = "off"  # gradient-sync SCHEDULE
+    # (search/sync_schedule.py): "search" partitions the synced weight
+    # groups into issue-ordered buckets (reverse-topological, coalesced
+    # to amortize collective latency, per-bucket precision composing
+    # with sync_precision), priced with the simulator's exposed-comm
+    # semantics and executed by comm/bucketed.py — adopted only when it
+    # beats the monolithic post-backward sync.  "off" (default) keeps
+    # the historical single post-backward sync (fp32 bit-exact).
+    sync_bucket_bytes: int = 0  # pin the schedule search's coalescing
+    # floor (fused fp32 payload bytes per bucket); 0 sweeps the
+    # DEFAULT_BUCKET_BYTES thresholds plus adaptive fractions of the
+    # model's total sync bytes
+    # observability (flexflow_tpu/obs): unified telemetry
+    obs_log_file: Optional[str] = None  # JSONL structured-event sink
+    # (search-decision tracing, strategy tables, drift reports); also
+    # enabled process-wide via FLEXFLOW_TPU_OBS=<path>.  None (the
+    # default) keeps every emit to a single boolean check — near-zero
+    # overhead off.
+    obs_trace_file: Optional[str] = None  # compile() writes the
+    # PREDICTED task timeline here as Chrome-trace JSON (Perfetto-
+    # loadable), the artifact to view next to the real device_trace
+    drift_threshold: float = 0.5  # |measured/predicted - 1| above which
+    # the DriftReport flags the prediction stale (and, when a measured
+    # calibration table was consulted, the TABLE as stale)
+    cost_cache_file: Optional[str] = None  # persistent cost cache
+    # (search/cost_cache.py): per-(op, view) cost rows + search results
+    # keyed by node digest x machine view x calibration signature,
+    # invalidated wholesale when the signature moves.  None falls back
+    # to $FLEXFLOW_TPU_COST_CACHE (path; "0"/empty disables); empty
+    # string "" disables outright (--no-cost-cache)
+    verify: bool = False  # static-analysis verification
+    # (flexflow_tpu/analysis, --verify, env FLEXFLOW_TPU_VERIFY=1):
+    # run the graph-invariant checker after EVERY GraphXfer.apply and
+    # check the compile-time graph before lowering.  The strategy/
+    # sharding legality lint in optimize_strategy is always on; this
+    # flag adds the per-rewrite structural proof (bench_search.py
+    # --verify measures its overhead).
+    zero_dp_shard: bool = False  # ZeRO-1 / weight-update sharding
+    # (arXiv:2004.13336): shard optimizer state (and the update
+    # compute) of replicated weights over the mesh axes they are
+    # replicated on.  Grad psum becomes reduce-scatter + all-gather of
+    # the update (same ring bytes), optimizer memory and update FLOPs
+    # drop by the replication factor.  Beyond the reference (its PS
+    # mode reduces on ONE owner device, optimizer.cc:90-155 — this
+    # spreads the update over all of them)
+    seed: int = 0
+    iteration: IterationConfig = field(default_factory=IterationConfig)
+
+    def __post_init__(self):
+        if self.sync_precision not in ("fp32", "bf16", "int8", "search"):
+            raise ValueError(
+                f"sync_precision must be fp32|bf16|int8|search, got "
+                f"{self.sync_precision!r}"
+            )
+        if self.sync_schedule not in ("off", "search"):
+            raise ValueError(
+                f"sync_schedule must be off|search, got "
+                f"{self.sync_schedule!r}"
+            )
+        if self.num_devices == 0:
+            try:
+                import jax
+
+                self.num_devices = len(jax.devices())
+            except Exception:
+                self.num_devices = 1
+        if self.machine_spec is None:
+            if self.machine_model_file:
+                self.machine_spec = MachineSpec.from_file(self.machine_model_file)
+            else:
+                self.machine_spec = MachineSpec.tpu_v5e(self.num_devices)
+
+    @property
+    def search_devices(self) -> int:
+        return self.search_num_devices or self.num_devices
+
+    # ---- argv parsing ----------------------------------------------------
+    @staticmethod
+    def parse_args(argv: Optional[Sequence[str]] = None) -> "FFConfig":
+        """Accepts the reference's flag spellings
+        (reference: model.cc:3371-3654, README.md:79-102)."""
+        p = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
+        p.add_argument("-e", "--epochs", type=int, default=1)
+        p.add_argument("-b", "--batch-size", type=int, default=64)
+        p.add_argument("--lr", "--learning-rate", dest="lr", type=float, default=0.01)
+        p.add_argument("--wd", "--weight-decay", dest="wd", type=float, default=1e-4)
+        p.add_argument("-ll:tpu", "--num-devices", dest="num_devices", type=int, default=0)
+        p.add_argument("--budget", "--search-budget", dest="budget", type=int, default=128)
+        p.add_argument("--alpha", "--search-alpha", dest="alpha", type=float, default=1.05)
+        p.add_argument("--only-data-parallel", action="store_true")
+        p.add_argument("--enable-parameter-parallel", action="store_true", default=True)
+        p.add_argument("--enable-attribute-parallel", action="store_true", default=True)
+        p.add_argument("--search-num-nodes", type=int, default=0)
+        p.add_argument("--search-num-workers", type=int, default=0)
+        p.add_argument("--base-optimize-threshold", type=int, default=10)
+        p.add_argument("--search-timeout", dest="search_timeout", type=float, default=45.0)
+        p.add_argument("--search-improvement-margin",
+                       dest="search_improvement_margin", type=float,
+                       default=0.03,
+                       help="minimum simulated win over plain DP before a "
+                            "searched strategy is accepted (champion-vs-DP "
+                            "floor)")
+        p.add_argument("--disable-pipeline-search",
+                       dest="disable_pipeline_search", action="store_true",
+                       help="compile() stops proposing pipelined lowerings "
+                            "for stacked-block graphs")
+        p.add_argument("--substitution-json", type=str, default=None)
+        p.add_argument("--calibration-file", type=str, default=None)
+        p.add_argument("--calibrate", action="store_true")
+        p.add_argument("--calibration-budget", dest="calibration_budget",
+                       type=float, default=60.0)
+        p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
+        p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
+        p.add_argument("--import-strategy-partial",
+                       dest="import_strategy_partial", action="store_true",
+                       help="apply a strategy file best-effort even when "
+                            "its graph digest/coverage does not match "
+                            "(provenance checks downgrade to warnings)")
+        p.add_argument("--machine-model-file", type=str, default=None)
+        p.add_argument("--taskgraph", dest="export_taskgraph", type=str, default=None)
+        p.add_argument("--profiling", action="store_true")
+        p.add_argument("--trace-steps", dest="trace_steps", type=int, default=1)
+        p.add_argument("--grad-accum-steps", dest="grad_accum_steps",
+                       type=int, default=1)
+        p.add_argument("--remat", action="store_true")
+        p.add_argument("--zero-dp-shard", dest="zero_dp_shard",
+                       action="store_true")
+        p.add_argument("--sync-precision", dest="sync_precision",
+                       choices=("fp32", "bf16", "int8", "search"),
+                       default="fp32",
+                       help="gradient-sync wire precision; 'search' "
+                            "lets the strategy search pick it per "
+                            "weight group")
+        p.add_argument("--sync-schedule", dest="sync_schedule",
+                       choices=("off", "search"), default="off",
+                       help="gradient-sync schedule: 'search' buckets "
+                            "the weight-grad collectives and issues "
+                            "them inside the backward "
+                            "(search/sync_schedule.py)")
+        p.add_argument("--sync-bucket-bytes", dest="sync_bucket_bytes",
+                       type=int, default=0,
+                       help="pin the schedule search's per-bucket "
+                            "coalescing floor in bytes (0 = sweep)")
+        p.add_argument("--obs-log", dest="obs_log", type=str, default=None,
+                       help="JSONL structured-event telemetry sink "
+                            "(flexflow_tpu/obs; tools/ffobs.py renders it)")
+        p.add_argument("--obs-trace", dest="obs_trace", type=str,
+                       default=None,
+                       help="write the PREDICTED task timeline as "
+                            "Chrome-trace JSON at compile (Perfetto)")
+        p.add_argument("--drift-threshold", dest="drift_threshold",
+                       type=float, default=0.5,
+                       help="predicted-vs-measured step-time drift "
+                            "beyond which the DriftReport flags "
+                            "calibration staleness")
+        p.add_argument("--cost-cache-file", dest="cost_cache_file",
+                       type=str, default=None,
+                       help="persistent per-(op, view) cost-row + "
+                            "search-result cache (search/cost_cache.py); "
+                            "repeated searches start warm")
+        p.add_argument("--no-cost-cache", dest="no_cost_cache",
+                       action="store_true",
+                       help="bypass the persistent cost cache even when "
+                            "a file/env default is configured")
+        p.add_argument("--verify", action="store_true",
+                       help="static-analysis verification "
+                            "(flexflow_tpu/analysis): check graph "
+                            "invariants after every rewrite and the "
+                            "compile-time graph before lowering")
+        p.add_argument("--seed", type=int, default=0)
+        args, _ = p.parse_known_args(argv)
+        search_devs = args.search_num_workers * max(1, args.search_num_nodes or 1)
+        return FFConfig(
+            epochs=args.epochs,
+            batch_size=args.batch_size,
+            learning_rate=args.lr,
+            weight_decay=args.wd,
+            num_devices=args.num_devices,
+            search_budget=args.budget,
+            search_alpha=args.alpha,
+            only_data_parallel=args.only_data_parallel,
+            search_num_devices=search_devs,
+            base_optimize_threshold=args.base_optimize_threshold,
+            search_timeout_s=args.search_timeout,
+            search_improvement_margin=args.search_improvement_margin,
+            enable_pipeline_search=not args.disable_pipeline_search,
+            substitution_json=args.substitution_json,
+            calibration_file=args.calibration_file,
+            calibrate=args.calibrate,
+            calibration_budget_s=args.calibration_budget,
+            export_strategy_file=args.export_strategy,
+            import_strategy_file=args.import_strategy,
+            import_strategy_partial=args.import_strategy_partial,
+            export_strategy_task_graph_file=args.export_taskgraph,
+            machine_model_file=args.machine_model_file,
+            profiling=args.profiling,
+            trace_steps=args.trace_steps,
+            grad_accum_steps=args.grad_accum_steps,
+            remat=args.remat,
+            zero_dp_shard=args.zero_dp_shard,
+            sync_precision=args.sync_precision,
+            sync_schedule=args.sync_schedule,
+            sync_bucket_bytes=args.sync_bucket_bytes,
+            obs_log_file=args.obs_log,
+            obs_trace_file=args.obs_trace,
+            drift_threshold=args.drift_threshold,
+            cost_cache_file="" if args.no_cost_cache else args.cost_cache_file,
+            verify=args.verify,
+            seed=args.seed,
+        )

@@ -1,0 +1,24 @@
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import (
+    DataType,
+    ParallelDim,
+    ParallelTensorShape,
+    Tensor,
+    replica_dim,
+)
+from flexflow_tpu.core.machine import MachineSpec, MachineView
+from flexflow_tpu.core.graph import Edge, Graph, Node
+
+__all__ = [
+    "OperatorType",
+    "DataType",
+    "ParallelDim",
+    "ParallelTensorShape",
+    "Tensor",
+    "replica_dim",
+    "MachineSpec",
+    "MachineView",
+    "Edge",
+    "Graph",
+    "Node",
+]

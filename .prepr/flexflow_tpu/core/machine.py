@@ -1,0 +1,249 @@
+"""Machine description and per-op parallelization descriptors.
+
+Replaces the reference's MachineView/MachineResource/ParallelConfig
+triple (reference: include/flexflow/machine_view.h:14-87) with TPU-mesh
+concepts:
+
+* ``MachineSpec`` — the hardware: chip count, per-chip peak FLOPs and
+  HBM bandwidth, ICI link bandwidth/latency and torus shape, DCN
+  bandwidth/latency for multi-slice.  Parameterizes the cost model the
+  way MachineModel does in the reference
+  (reference: src/runtime/machine_model.cc:57-68, machine_config_example:1-40).
+* ``MachineView`` — a per-op parallelization: partition degree for each
+  output dim plus a replica degree.  Where the reference's MachineView
+  is a strided box of physical device ids decoded by the Legion mapper
+  (reference: src/mapper/mapper.cc:371-475), here device placement is
+  delegated to XLA: degrees are canonically factored onto named mesh
+  axes (see flexflow_tpu.parallel.mesh.assign_axes) and GSPMD places
+  the shards.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class LinkLevel:
+    """One level of the machine's link hierarchy: devices live in
+    aligned groups of ``span`` connected at this level's bandwidth;
+    collectives confined to one group never pay the coarser levels.
+    Level 0 is always ICI (within a slice); coarser levels are DCN
+    classes (across slices, across superpods, ...)."""
+
+    name: str
+    span: int  # devices per aligned group at this level
+    bandwidth: float  # bytes/s per device
+    latency: float  # seconds per hop
+
+
+@dataclass(frozen=True)
+class MachineSpec:
+    """Hardware description used for cost modeling and mesh construction.
+
+    Bandwidths are bytes/second, latencies seconds, flops are
+    peak per-chip FLOP/s at the matmul dtype (bf16 on TPU).
+    """
+
+    num_devices: int = 1
+    # size of one ICI domain — a host for CPU machines, a SLICE for
+    # multislice TPU (ICI spans all chips of a slice; DCN links slices).
+    # Collectives confined to one domain ride ICI; crossing ones add a
+    # DCN term (search/machine_model.py _spans_dcn).
+    devices_per_host: int = 8
+    peak_flops: float = 1.97e14  # TPU v5e bf16 MXU peak
+    hbm_bandwidth: float = 8.1e11  # bytes/s
+    hbm_capacity: float = 16e9  # bytes
+    vmem_capacity: float = 128e6  # bytes (~VMEM per core)
+    ici_bandwidth: float = 4.5e10  # bytes/s per link per direction
+    ici_latency: float = 1e-6  # seconds per hop
+    ici_torus: Tuple[int, ...] = ()  # physical torus shape, () = derive
+    dcn_bandwidth: float = 3.125e9  # bytes/s per host (25 Gbps)
+    dcn_latency: float = 10e-6
+    # optional N-LEVEL link hierarchy above ICI: tuples of
+    # (span, bandwidth, latency), spans strictly ascending, each a
+    # multiple of devices_per_host and a divisor of the next (aligned
+    # nesting).  Empty (the default) derives the classic two-level
+    # structure — one DCN class spanning the whole machine — from
+    # dcn_bandwidth/dcn_latency, so every existing spec prices
+    # bit-identically.  ``topology_levels()`` is the one reader.
+    slice_levels: Tuple[Tuple[int, float, float], ...] = ()
+    # fixed seconds per GSPMD reshard op beyond its byte costs (kernel
+    # launches, layout churn, fusion break).  ~launch-scale on TPU;
+    # dominant at small sizes on a serialized CPU host (measured ~2 ms
+    # per boundary for a 128 KB tensor — 20x the byte estimate)
+    reshard_overhead_s: float = 1e-6
+    name: str = "tpu_v5e"
+    # the jax platform this spec models ("tpu" or "cpu") — measured
+    # calibration records are only coherent with a simulator whose
+    # machine model describes the backend they were probed on.  An
+    # explicit field (not a name heuristic): custom-named models from
+    # --machine-model-file stay correctly classified, and to_file /
+    # from_file round-trip it.
+    platform: str = "tpu"
+
+    # ---- constructors ----------------------------------------------------
+    @staticmethod
+    def tpu_v5e(num_devices: int = 8) -> "MachineSpec":
+        side = int(math.isqrt(num_devices))
+        torus = (side, num_devices // side) if side * (num_devices // side) == num_devices else (num_devices,)
+        return MachineSpec(num_devices=num_devices, ici_torus=torus)
+
+    @staticmethod
+    def tpu_v5p(num_devices: int = 8) -> "MachineSpec":
+        return MachineSpec(
+            num_devices=num_devices,
+            peak_flops=4.59e14,
+            hbm_bandwidth=2.765e12,
+            hbm_capacity=95e9,
+            ici_bandwidth=9e10,
+            name="tpu_v5p",
+        )
+
+    @staticmethod
+    def host_cpu(num_devices: int = 8) -> "MachineSpec":
+        """Virtual-device CPU machine for tests (same role as the
+        reference's --search-num-workers override, graph.cc:1535-1540).
+
+        Measured on the CI-style host (often ONE physical core serving
+        all virtual devices): ~1.4e11 FLOP/s f32 matmul for the WHOLE
+        host, so per-device peak is host/num_devices — virtual devices
+        serialize, parallel speedup on this "mesh" is zero and the
+        model must say so or the search picks replication-heavy
+        strategies that execution loses.  Collectives serialize through
+        the same core, so the ring formula needs the EFFECTIVE
+        bandwidth that reproduces measured wall times: an 8-way psum
+        measures ~0.10 ms fixed + total-bytes/7.6e9 across 4KB-32MB
+        payloads, which the 2(n-1)/n-shard ring formula reproduces at
+        0.95e9 B/s with the fixed cost spread over 2(n-1) hops
+        (~7 us/hop).  Memory traffic (the reshard materialization term)
+        shares the core too: ~1.25e9 B/s per virtual device."""
+        return MachineSpec(
+            num_devices=num_devices,
+            peak_flops=1.4e11 / max(1, num_devices),
+            hbm_bandwidth=1.25e9,
+            ici_bandwidth=0.95e9,
+            ici_latency=7e-6,
+            reshard_overhead_s=1.5e-3,
+            name="host_cpu",
+            platform="cpu",
+        )
+
+    @staticmethod
+    def from_file(path: str) -> "MachineSpec":
+        """Load from a JSON machine-config file — the TPU analogue of
+        the reference's EnhancedMachineModel config
+        (reference: machine_config_example:1-40, --machine-model-file)."""
+        with open(path) as f:
+            cfg = json.load(f)
+        if "ici_torus" in cfg:
+            cfg["ici_torus"] = tuple(cfg["ici_torus"])
+        if "slice_levels" in cfg:
+            cfg["slice_levels"] = tuple(
+                tuple(lvl) for lvl in cfg["slice_levels"])
+        return MachineSpec(**cfg)
+
+    def to_file(self, path: str) -> None:
+        d = {k: getattr(self, k) for k in self.__dataclass_fields__}
+        d["ici_torus"] = list(d["ici_torus"])
+        d["slice_levels"] = [list(lvl) for lvl in d["slice_levels"]]
+        with open(path, "w") as f:
+            json.dump(d, f, indent=2)
+
+    # ---- queries ---------------------------------------------------------
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_devices // self.devices_per_host)
+
+    def topology_levels(self) -> Tuple[LinkLevel, ...]:
+        """The machine's link hierarchy, finest first.  Level 0 is
+        always ICI with span ``devices_per_host``; above it come the
+        configured ``slice_levels`` or — when none are configured and
+        the machine is bigger than one slice — the single classic DCN
+        level spanning the whole machine.  A flat machine (one slice)
+        is the degenerate single-level case."""
+        levels = [LinkLevel("ici", self.devices_per_host,
+                            self.ici_bandwidth, self.ici_latency)]
+        if self.slice_levels:
+            multi = len(self.slice_levels) > 1
+            prev = self.devices_per_host
+            for i, (span, bw, lat) in enumerate(self.slice_levels):
+                if span <= prev or span % prev != 0:
+                    raise ValueError(
+                        f"slice_levels[{i}] span {span} must be an "
+                        f"ascending multiple of the previous level's "
+                        f"span {prev}")
+                levels.append(LinkLevel(
+                    f"dcn{i + 1}" if multi else "dcn", span, bw, lat))
+                prev = span
+        elif self.num_devices > self.devices_per_host:
+            levels.append(LinkLevel(
+                "dcn", self.num_devices, self.dcn_bandwidth,
+                self.dcn_latency))
+        return tuple(levels)
+
+    def matmul_time(self, flops: float) -> float:
+        return flops / self.peak_flops
+
+    def hbm_time(self, num_bytes: float) -> float:
+        return num_bytes / self.hbm_bandwidth
+
+
+@dataclass(frozen=True)
+class MachineView:
+    """Parallelization of one operator: degree per output dim + replicas.
+
+    ``dim_degrees[i]`` partitions output dim i into that many shards;
+    ``replica_degree`` replicates the op's output (data-parallel
+    weights / partial-sum inputs use this slot).  Total parts =
+    product, must divide the machine's device count — the same divisor
+    rule the reference uses when registering candidate views
+    (reference: src/runtime/graph.cc:1778-1810).
+
+    ``start_part`` is the placement offset: the op's shards occupy the
+    contiguous device block [start_part, start_part + num_parts) — the
+    reference's MachineView.start_device_id / MachineResource
+    start_gpu_id (reference: include/flexflow/machine_view.h:14-87,
+    graph.cc:180-205 VERTICAL/HORIZONTAL resource splits).  The
+    simulator uses it to credit inter-op overlap of branches placed on
+    disjoint device blocks; the GSPMD lowering ignores it (XLA
+    time-shares the full mesh instead — degrees alone determine the
+    compiled program, so a strategy with offsets is still numerically
+    exact when lowered).
+    """
+
+    dim_degrees: Tuple[int, ...]
+    replica_degree: int = 1
+    start_part: int = 0
+
+    @property
+    def num_parts(self) -> int:
+        p = self.replica_degree
+        for d in self.dim_degrees:
+            p *= d
+        return p
+
+    @property
+    def is_trivial(self) -> bool:
+        return self.num_parts == 1
+
+    def __str__(self) -> str:
+        s = "x".join(str(d) for d in self.dim_degrees)
+        if self.replica_degree > 1:
+            s += f"*R{self.replica_degree}"
+        if self.start_part:
+            s += f"@{self.start_part}"
+        return f"MV[{s}]"
+
+    @staticmethod
+    def trivial(ndim: int) -> "MachineView":
+        return MachineView(dim_degrees=(1,) * ndim)
+
+    @staticmethod
+    def data_parallel(ndim: int, degree: int, batch_dim: int = 0) -> "MachineView":
+        dims = [1] * ndim
+        dims[batch_dim] = degree
+        return MachineView(dim_degrees=tuple(dims))

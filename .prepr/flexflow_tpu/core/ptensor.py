@@ -1,0 +1,309 @@
+"""Parallel tensor shape algebra.
+
+The central abstraction of the framework, re-imagined for TPU: a
+``ParallelTensorShape`` describes a logical tensor plus *how it is laid
+out over a device mesh* — each dim carries a partition degree and the
+named mesh axes it is sharded over, and **replica dims** make
+replication/partial-sum state first-class (the key idea of the
+reference's ParallelTensor, reference: include/flexflow/parallel_tensor.h:35-103,
+re-expressed so that it lowers directly onto
+``jax.sharding.NamedSharding(mesh, PartitionSpec(...))``).
+
+Unlike the reference there are no Legion regions/partitions behind a
+parallel tensor: lowering produces a sharding spec and XLA/GSPMD
+materializes the layout.  Dim order is row-major (dim 0 outermost),
+i.e. NumPy order — NOT the reference's reversed Legion order.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DataType(enum.Enum):
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT32 = "int32"
+    INT64 = "int64"
+    BOOL = "bool"
+
+    def to_numpy(self):
+        if self is DataType.BFLOAT16:
+            import ml_dtypes
+
+            return np.dtype(ml_dtypes.bfloat16)
+        return np.dtype(self.value)
+
+    @staticmethod
+    def from_any(x: "DataType | str | np.dtype") -> "DataType":
+        if isinstance(x, DataType):
+            return x
+        if isinstance(x, str) and x in DataType._value2member_map_:
+            return DataType(x)
+        name = np.dtype(x).name
+        return DataType(name)
+
+    @property
+    def itemsize(self) -> int:
+        return _ITEMSIZE[self]
+
+
+# itemsize sits in the cost model's innermost loop; np.dtype() per call
+# is measurably hot during search
+_ITEMSIZE = {
+    DataType.FLOAT32: 4,
+    DataType.FLOAT16: 2,
+    DataType.BFLOAT16: 2,
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.BOOL: 1,
+}
+
+
+@dataclass(frozen=True)
+class ParallelDim:
+    """One dim of a parallel tensor.
+
+    ``size``   — global logical extent of the dim (for replica dims,
+                 equals ``degree``).
+    ``degree`` — number of shards along this dim (1 = unsharded).
+    ``axes``   — named mesh axes this dim is mapped onto, outermost
+                 first; product of their sizes == degree.  Empty when
+                 degree == 1.
+    ``is_replica`` — replica dim: does not exist in the logical tensor;
+                 expresses replication (forward) / partial-sum gradient
+                 (backward) over ``axes``.  The reference models the
+                 same state as an extra tensor dim with
+                 ``is_replica_dim`` (parallel_tensor.h:35-63).
+    """
+
+    size: int
+    degree: int = 1
+    axes: Tuple[str, ...] = ()
+    is_replica: bool = False
+
+    def __post_init__(self):
+        if self.is_replica and self.size != self.degree:
+            raise ValueError(
+                f"replica dim must have size == degree, got {self.size} != {self.degree}"
+            )
+        if self.degree > 1 and self.size % self.degree != 0:
+            raise ValueError(
+                f"dim size {self.size} not divisible by degree {self.degree}"
+            )
+
+    @property
+    def shard_size(self) -> int:
+        return self.size // self.degree
+
+
+def replica_dim(degree: int, axes: Tuple[str, ...] = ()) -> ParallelDim:
+    return ParallelDim(size=degree, degree=degree, axes=axes, is_replica=True)
+
+
+@dataclass(frozen=True)
+class ParallelTensorShape:
+    """Shape + dtype + sharding of a tensor over the mesh.
+
+    ``dims`` holds the logical dims in NumPy order.  ``replicas`` holds
+    zero or more replica dims (kept separate rather than interleaved
+    as in the reference — cleaner for lowering to PartitionSpec, where
+    replica axes simply do not appear).
+    """
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType = DataType.FLOAT32
+    replicas: Tuple[ParallelDim, ...] = ()
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def make(
+        sizes: Sequence[int],
+        dtype: "DataType | str" = DataType.FLOAT32,
+        degrees: Optional[Sequence[int]] = None,
+        axes: Optional[Sequence[Tuple[str, ...]]] = None,
+    ) -> "ParallelTensorShape":
+        n = len(sizes)
+        degrees = list(degrees) if degrees is not None else [1] * n
+        axes = list(axes) if axes is not None else [()] * n
+        return ParallelTensorShape(
+            dims=tuple(
+                ParallelDim(size=s, degree=d, axes=tuple(a))
+                for s, d, a in zip(sizes, degrees, axes)
+            ),
+            dtype=DataType.from_any(dtype),
+        )
+
+    # -- basic queries -----------------------------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.dims)
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(d.size for d in self.dims)
+
+    @property
+    def degrees(self) -> Tuple[int, ...]:
+        return tuple(d.degree for d in self.dims)
+
+    @property
+    def num_elements(self) -> int:
+        # cached — this sits in the cost model's innermost loop and the
+        # shape is frozen
+        n = self.__dict__.get("_num_elements")
+        if n is None:
+            n = 1
+            for d in self.dims:
+                n *= d.size
+            object.__setattr__(self, "_num_elements", n)
+        return n
+
+    @property
+    def num_bytes(self) -> int:
+        n = self.__dict__.get("_num_bytes")
+        if n is None:
+            n = self.num_elements * self.dtype.itemsize
+            object.__setattr__(self, "_num_bytes", n)
+        return n
+
+    @property
+    def total_degree(self) -> int:
+        """Number of shards = product of all dim degrees and replica degrees."""
+        deg = 1
+        for d in self.dims:
+            deg *= d.degree
+        for r in self.replicas:
+            deg *= r.degree
+        return deg
+
+    @property
+    def replica_degree(self) -> int:
+        deg = 1
+        for r in self.replicas:
+            deg *= r.degree
+        return deg
+
+    @property
+    def shard_sizes(self) -> Tuple[int, ...]:
+        return tuple(d.shard_size for d in self.dims)
+
+    @property
+    def shard_bytes(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d.shard_size
+        return n * self.dtype.itemsize
+
+    def used_axes(self) -> Tuple[str, ...]:
+        out = []
+        for d in self.dims:
+            out.extend(d.axes)
+        for r in self.replicas:
+            out.extend(r.axes)
+        return tuple(out)
+
+    # -- mutation helpers (functional) ------------------------------------
+    def with_dim_degree(
+        self, dim: int, degree: int, axes: Tuple[str, ...] = ()
+    ) -> "ParallelTensorShape":
+        new = list(self.dims)
+        new[dim] = replace(new[dim], degree=degree, axes=tuple(axes))
+        return replace(self, dims=tuple(new))
+
+    def with_replica(self, degree: int, axes: Tuple[str, ...] = ()) -> "ParallelTensorShape":
+        if degree == 1:
+            return replace(self, replicas=())
+        return replace(self, replicas=(replica_dim(degree, tuple(axes)),))
+
+    def drop_parallelism(self) -> "ParallelTensorShape":
+        return ParallelTensorShape(
+            dims=tuple(ParallelDim(size=d.size) for d in self.dims),
+            dtype=self.dtype,
+        )
+
+    def logical_eq(self, other: "ParallelTensorShape") -> bool:
+        return self.sizes == other.sizes and self.dtype == other.dtype
+
+    # -- lowering ----------------------------------------------------------
+    def partition_spec(self):
+        """Lower to a ``jax.sharding.PartitionSpec``.
+
+        Replica dims do not appear: a mesh axis that shards no dim is
+        automatically a replication axis under GSPMD — exactly the
+        semantics the reference implements with aliased Legion
+        partitions (reference: src/parallel_ops/replicate.cc:107-118).
+        """
+        from jax.sharding import PartitionSpec
+
+        entries = []
+        for d in self.dims:
+            if not d.axes:
+                entries.append(None)
+            elif len(d.axes) == 1:
+                entries.append(d.axes[0])
+            else:
+                entries.append(tuple(d.axes))
+        # trim trailing Nones for canonical form
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def named_sharding(self, mesh):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(mesh, self.partition_spec())
+
+    def __str__(self) -> str:
+        parts = []
+        for d in self.dims:
+            if d.degree > 1:
+                parts.append(f"{d.size}/{d.degree}{list(d.axes)}")
+            else:
+                parts.append(str(d.size))
+        s = "x".join(parts)
+        for r in self.replicas:
+            s += f" *R{r.degree}{list(r.axes)}"
+        return f"<{s}:{self.dtype.value}>"
+
+
+class Tensor:
+    """Logical frontend tensor: a symbolic value flowing between layers.
+
+    Mirrors the role of the reference's lazy ``Tensor``/``TensorBase``
+    (reference: include/flexflow/tensor.h:81, src/runtime/layer.cc) —
+    created by FFModel layer methods before compile; carries no data.
+    """
+
+    _next_guid = 1000
+
+    def __init__(
+        self,
+        sizes: Sequence[int],
+        dtype: "DataType | str" = DataType.FLOAT32,
+        owner_layer=None,
+        owner_idx: int = 0,
+        name: str = "",
+    ):
+        self.guid = Tensor._next_guid
+        Tensor._next_guid += 1
+        self.sizes = tuple(int(s) for s in sizes)
+        self.dtype = DataType.from_any(dtype)
+        self.owner_layer = owner_layer  # Layer that produces this tensor
+        self.owner_idx = owner_idx  # which output of the layer
+        self.name = name or f"tensor_{self.guid}"
+
+    @property
+    def ndim(self) -> int:
+        return len(self.sizes)
+
+    def to_shape(self) -> ParallelTensorShape:
+        return ParallelTensorShape.make(self.sizes, self.dtype)
+
+    def __repr__(self) -> str:
+        return f"Tensor({self.name}, {list(self.sizes)}, {self.dtype.value})"

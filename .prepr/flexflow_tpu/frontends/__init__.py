@@ -1,0 +1,31 @@
+"""Frontends — import models from other ecosystems onto FFModel.
+
+Parity targets (reference, structure only — no code shared):
+* python/flexflow/torch/model.py  — torch.fx symbolic-trace importer
+  (~60 Node subclasses with parse/to_ff) + ``torch_to_flexflow`` file
+  format round-trip.
+* python/flexflow/onnx/model.py   — ONNX graph importer (handle_* per
+  ONNX op type).
+* python/flexflow/keras/          — drop-in Sequential / functional
+  Model frontend with callbacks.
+"""
+
+from flexflow_tpu.frontends.torch_fx import (  # noqa: F401
+    PyTorchModel,
+    torch_to_flexflow,
+    transfer_torch_weights,
+)
+from flexflow_tpu.frontends.onnx_frontend import ONNXModel  # noqa: F401
+from flexflow_tpu.frontends.tf_keras import (  # noqa: F401
+    TFKerasModel,
+    transfer_tf_weights,
+)
+
+__all__ = [
+    "PyTorchModel",
+    "torch_to_flexflow",
+    "transfer_torch_weights",
+    "ONNXModel",
+    "TFKerasModel",
+    "transfer_tf_weights",
+]

@@ -1,0 +1,93 @@
+"""Weight initializers.
+
+TPU-native equivalents of the reference's initializer task suite
+(reference: src/runtime/initializer.cc, initializer_kernel.cu:1-302):
+pure functions of a jax PRNG key — no curand state, no per-device
+tasks; when the target weight is sharded, initialization runs sharded
+because it is jitted with the weight's out_sharding.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def init(self, key, shape: Tuple[int, ...], dtype) -> jax.Array:
+        raise NotImplementedError
+
+    def signature(self):
+        return (type(self).__name__,) + tuple(
+            sorted(self.__dict__.items())
+        )
+
+
+@dataclass
+class GlorotUniformInitializer(Initializer):
+    """Glorot/Xavier uniform (reference: initializer.cc GlorotUniform::init_task).
+
+    fan_in/fan_out follow the Keras convention: for rank>=2 weights the
+    last two dims are (fan_in, fan_out) with receptive-field scaling for
+    convs.
+    """
+
+    seed: int = 0
+    # Optional explicit fans (the reference lets ops override, e.g. conv)
+    fan_in: int = 0
+    fan_out: int = 0
+
+    def init(self, key, shape, dtype):
+        if self.fan_in and self.fan_out:
+            fan_in, fan_out = self.fan_in, self.fan_out
+        elif len(shape) >= 2:
+            receptive = 1
+            for s in shape[:-2]:
+                receptive *= s
+            fan_in, fan_out = shape[-2] * receptive, shape[-1] * receptive
+        else:
+            fan_in = fan_out = shape[0] if shape else 1
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+@dataclass
+class ZeroInitializer(Initializer):
+    def init(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@dataclass
+class ConstantInitializer(Initializer):
+    value: float = 0.0
+
+    def init(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@dataclass
+class UniformInitializer(Initializer):
+    seed: int = 0
+    min_val: float = -0.05
+    max_val: float = 0.05
+
+    def init(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, self.min_val, self.max_val)
+
+
+@dataclass
+class NormInitializer(Initializer):
+    seed: int = 0
+    mean: float = 0.0
+    stddev: float = 0.05
+
+    def init(self, key, shape, dtype):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+DEFAULT_WEIGHT_INIT = GlorotUniformInitializer()
+DEFAULT_BIAS_INIT = ZeroInitializer()

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the ops where XLA's default lowering is weak
+(SURVEY.md §7: flash attention, MoE dispatch) — the counterpart of the
+reference's hand-written CUDA kernels, written against the MXU/VMEM
+model instead."""
+
+from flexflow_tpu.kernels.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

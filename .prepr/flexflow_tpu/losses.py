@@ -1,0 +1,109 @@
+"""Loss functions.
+
+Mirrors the reference's loss vocabulary (reference:
+include/flexflow/loss_functions.h:26-63, src/loss_functions/loss_functions.cu)
+but as differentiable scalar losses: the reference hand-seeds logit
+gradients with a 1/batch scale inside LOSS_BWD; here the identical
+gradients fall out of ``jax.grad`` of the mean-reduced loss.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+
+class LossType(enum.Enum):
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR = "mean_squared_error"
+    MEAN_SQUARED_ERROR_AVG_REDUCE = "mean_squared_error_avg_reduce"
+    MEAN_SQUARED_ERROR_SUM_REDUCE = "mean_squared_error_sum_reduce"
+    IDENTITY = "identity"
+
+    @staticmethod
+    def from_any(x) -> "LossType":
+        if isinstance(x, LossType):
+            return x
+        aliases = {
+            "categorical_crossentropy": LossType.CATEGORICAL_CROSSENTROPY,
+            "sparse_categorical_crossentropy": LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            "mean_squared_error": LossType.MEAN_SQUARED_ERROR,
+            "mse": LossType.MEAN_SQUARED_ERROR,
+        }
+        return aliases.get(x, LossType(x))
+
+
+def _match_shape(labels: jax.Array, logits: jax.Array) -> jax.Array:
+    """Reshape labels to logits' shape for regression losses — guards
+    against silent [B,1] vs [B] broadcasting to [B,B]."""
+    if labels.shape != logits.shape:
+        if labels.size != logits.size:
+            raise ValueError(
+                f"label shape {labels.shape} incompatible with output {logits.shape}"
+            )
+        labels = labels.reshape(logits.shape)
+    return labels.astype(jnp.float32)
+
+
+def sparse_targets(labels, logits):
+    """(int targets, per_position) for the sparse-CCE family — the ONE
+    shape-dispatch rule, shared with metrics.compute_metrics.
+    Per-position when the labels match ALL leading dims of 3D+ logits
+    (causal LM: logits [B,S,V], labels [B,S] or [B,S,1]);
+    classification-style first-label otherwise (the reference's
+    sparse-CCE semantics, loss_functions.h:26-63)."""
+    lab = labels.astype(jnp.int32)
+    if lab.ndim == logits.ndim and lab.shape[-1] == 1:
+        lab = lab.reshape(lab.shape[:-1])  # trailing singleton class dim
+    if logits.ndim > 2:
+        if lab.shape == logits.shape[:-1]:
+            return lab, True
+        raise ValueError(
+            f"sparse labels {labels.shape} incompatible with logits "
+            f"{logits.shape}: per-position labels must match "
+            f"{logits.shape[:-1]} (optionally with a trailing singleton)"
+        )
+    return lab.reshape(lab.shape[0], -1)[:, 0], False
+
+
+def compute_loss(loss_type: LossType, logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Scalar loss. ``logits`` are the final op's output (pre-softmax for
+    the CCE losses, matching the reference where Softmax output feeds a
+    fused log-softmax CCE backward)."""
+    loss_type = LossType.from_any(loss_type)
+    if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+        lab, per_pos = sparse_targets(labels, logits)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        if per_pos:
+            # per-position labels (causal LM: logits [B,S,V], labels
+            # [B,S]) — token-level NLL averaged over all positions
+            nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)
+            return jnp.mean(nll)
+        nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)[:, 0]
+        return jnp.mean(nll)
+    if loss_type is LossType.CATEGORICAL_CROSSENTROPY:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.sum(labels.astype(jnp.float32) * logp, axis=-1))
+    if loss_type is LossType.MEAN_SQUARED_ERROR:
+        # Keras semantics for the Keras-named loss: mean over ALL
+        # elements.  (The reference's MSE kernel divides by batch only,
+        # loss_functions.h:26-63 — that scale made gradients grow with
+        # the per-sample element count, so the default lr diverged on
+        # seq models; use _AVG_REDUCE below for reference parity.)
+        d = logits.astype(jnp.float32) - _match_shape(labels, logits)
+        return jnp.mean(d * d)
+    if loss_type is LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+        # reference parity: sum over non-batch dims, mean over batch
+        d = logits.astype(jnp.float32) - _match_shape(labels, logits)
+        return jnp.mean(jnp.sum(d * d, axis=tuple(range(1, d.ndim))))
+    if loss_type is LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:
+        d = logits.astype(jnp.float32) - _match_shape(labels, logits)
+        return jnp.sum(d * d)
+    if loss_type is LossType.IDENTITY:
+        # reference: identity loss backprops the model output as its own
+        # gradient (loss_functions.cc identity_loss) — equivalent scalar:
+        return jnp.mean(logits.astype(jnp.float32))
+    raise ValueError(f"unknown loss {loss_type}")

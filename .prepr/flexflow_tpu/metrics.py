@@ -1,0 +1,130 @@
+"""Training metrics.
+
+Reference: include/flexflow/metrics_functions.h:27-57 PerfMetrics — the
+same accumulator (train_all/train_correct/cce/sparse-cce/mse/rmse/mae),
+computed on-device inside the jitted step and reduced with ``psum``
+semantics for free (metrics are unsharded scalars of a sharded
+computation), replacing the reference's Legion future folding
+(reference: src/runtime/model.cc:3153 update_metrics_task).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.losses import LossType, sparse_targets
+
+
+class MetricsType(enum.Enum):
+    ACCURACY = "accuracy"
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR = "mean_squared_error"
+    ROOT_MEAN_SQUARED_ERROR = "root_mean_squared_error"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+
+    @staticmethod
+    def from_any(x) -> "MetricsType":
+        return x if isinstance(x, MetricsType) else MetricsType(x)
+
+
+def compute_metrics(
+    metric_types: List[MetricsType],
+    loss_type: LossType,
+    logits: jax.Array,
+    labels: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Per-batch metric sums (device-side). Keys mirror PerfMetrics fields."""
+    out: Dict[str, jax.Array] = {}
+    n = logits.shape[0]
+    out["train_all"] = jnp.asarray(n, jnp.float32)
+    logits32 = logits.astype(jnp.float32)
+    labels32 = labels.astype(jnp.float32)
+    for m in metric_types:
+        m = MetricsType.from_any(m)
+        if m is MetricsType.ACCURACY:
+            pred = jnp.argmax(logits32, axis=-1)
+            if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+                tgt, per_pos = sparse_targets(labels, logits)
+                if per_pos:
+                    # per-position labels (causal LM): credit each
+                    # sample its fraction of correct tokens, so
+                    # train_correct/train_all stays a [0,1] accuracy
+                    correct = (pred == tgt).astype(jnp.float32)
+                    out["train_correct"] = jnp.sum(
+                        jnp.mean(correct.reshape(n, -1), axis=-1)
+                    )
+                else:
+                    out["train_correct"] = jnp.sum(
+                        (pred == tgt).astype(jnp.float32)
+                    )
+            else:
+                tgt = jnp.argmax(labels32, axis=-1)
+                out["train_correct"] = jnp.sum((pred == tgt).astype(jnp.float32))
+        elif m is MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            tgt, per_pos = sparse_targets(labels, logits)
+            logp = jax.nn.log_softmax(logits32, axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            if per_pos:  # mean over positions, summed over batch
+                out["sparse_cce_loss"] = jnp.sum(
+                    jnp.mean(nll.reshape(n, -1), axis=-1)
+                )
+            else:
+                out["sparse_cce_loss"] = jnp.sum(nll)
+        elif m is MetricsType.CATEGORICAL_CROSSENTROPY:
+            logp = jax.nn.log_softmax(logits32, axis=-1)
+            out["cce_loss"] = -jnp.sum(labels32 * logp)
+        elif m is MetricsType.MEAN_SQUARED_ERROR:
+            d = logits32 - labels32.reshape(logits32.shape)
+            out["mse_loss"] = jnp.sum(d * d) / max(1, labels32.size // n)
+        elif m is MetricsType.ROOT_MEAN_SQUARED_ERROR:
+            d = logits32 - labels32.reshape(logits32.shape)
+            out["rmse_loss"] = jnp.sum(
+                jnp.sqrt(jnp.mean(d * d, axis=tuple(range(1, d.ndim))))
+            )
+        elif m is MetricsType.MEAN_ABSOLUTE_ERROR:
+            d = jnp.abs(logits32 - labels32.reshape(logits32.shape))
+            out["mae_loss"] = jnp.sum(jnp.mean(d, axis=tuple(range(1, d.ndim))))
+    return out
+
+
+@dataclass
+class PerfMetrics:
+    """Host-side accumulator across iterations (reference:
+    metrics_functions.h:27-43 + FFModel::update_metrics_task)."""
+
+    sums: Dict[str, float] = field(default_factory=dict)
+
+    def update(self, batch_metrics: Dict[str, jax.Array]) -> None:
+        for k, v in batch_metrics.items():
+            self.sums[k] = self.sums.get(k, 0.0) + float(v)
+
+    def reset(self) -> None:
+        self.sums.clear()
+
+    def report(self) -> Dict[str, float]:
+        n = max(self.sums.get("train_all", 0.0), 1.0)
+        rep = {}
+        if "train_correct" in self.sums:
+            rep["accuracy"] = self.sums["train_correct"] / n
+        for key, name in [
+            ("sparse_cce_loss", "sparse_categorical_crossentropy"),
+            ("cce_loss", "categorical_crossentropy"),
+            ("mse_loss", "mean_squared_error"),
+            ("rmse_loss", "root_mean_squared_error"),
+            ("mae_loss", "mean_absolute_error"),
+        ]:
+            if key in self.sums:
+                rep[name] = self.sums[key] / n
+        rep["samples"] = n
+        return rep
+
+    def __str__(self) -> str:
+        rep = self.report()
+        parts = [f"{k}: {v:.4f}" for k, v in rep.items() if k != "samples"]
+        return f"[samples={int(rep.get('samples', 0))}] " + " ".join(parts)

@@ -1,0 +1,1452 @@
+"""FFModel — the public model-building and training API.
+
+Mirrors the surface of the reference's FFModel
+(reference: include/flexflow/model.h:316-700 layer methods;
+python/flexflow/core/flexflow_cffi.py:784-1900): ``create_tensor`` +
+layer methods build a lazy graph; ``compile`` turns it into a PCG,
+picks a parallelization strategy, and lowers to one jitted SPMD
+program; ``fit``/``eval`` run the training loop.
+
+Differences by design (TPU-native):
+* no init/forward/backward/update verbs per op — one fused train step;
+* the parallelization strategy is sharding degrees over a global mesh,
+  searched by flexflow_tpu.search (Unity algorithm) or data-parallel;
+* NHWC conv layout.
+"""
+
+from __future__ import annotations
+
+import math as _math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.graph import Graph, Node
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import DataType, ParallelTensorShape, Tensor
+from flexflow_tpu.initializers import Initializer
+from flexflow_tpu.losses import LossType
+from flexflow_tpu.metrics import MetricsType, PerfMetrics
+from flexflow_tpu import ops as O
+from flexflow_tpu.optimizers import Optimizer, SGDOptimizer
+
+
+def _merge_matching(new, old):
+    """Recursively keep ``new``'s structure, copying ``old``'s values at
+    key paths present in both with matching array shapes."""
+    if isinstance(new, dict) and isinstance(old, dict):
+        return {
+            k: _merge_matching(v, old[k]) if k in old else v
+            for k, v in new.items()
+        }
+    if hasattr(new, "shape") and hasattr(old, "shape") and new.shape == old.shape:
+        return old
+    return new
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.graph = Graph()
+        self._producer: Dict[int, Tuple[Node, int]] = {}  # tensor.guid -> (node, out_idx)
+        self._input_tensors: List[Tensor] = []
+        self._name_counts: Dict[str, int] = {}
+        self.compiled = None
+        self.strategy = None  # chosen parallelization, set by compile()
+        self.pipeline_proposal = None  # staged-pipeline candidate for
+        # graphs the stacked executor can't run (StagedPipelineProposal)
+        self.params = None
+        self.opt_state = None
+        self.state = None
+        self.optimizer: Optional[Optimizer] = None
+        self._rng_counter = 0
+
+    # ------------------------------------------------------------------
+    def _fresh_name(self, base: str, name: Optional[str]) -> str:
+        if name:
+            return name
+        i = self._name_counts.get(base, 0)
+        self._name_counts[base] = i + 1
+        return f"{base}_{i}"
+
+    def _shape_of(self, t: Tensor) -> ParallelTensorShape:
+        return ParallelTensorShape.make(t.sizes, t.dtype)
+
+    def _add_op(self, op: O.Operator, inputs: Sequence[Tensor]) -> List[Tensor]:
+        node = self.graph.new_node(op)
+        for i, t in enumerate(inputs):
+            src_node, src_idx = self._producer[t.guid]
+            self.graph.add_edge(src_node, node, src_idx, i)
+        outs = []
+        for i, shape in enumerate(op.output_shapes):
+            t = Tensor(shape.sizes, shape.dtype, owner_layer=node, owner_idx=i,
+                       name=f"{op.name}:{i}")
+            self._producer[t.guid] = (node, i)
+            outs.append(t)
+        return outs
+
+    # ------------------------------------------------------------------
+    def create_tensor(self, dims: Sequence[int], dtype="float32", name=None) -> Tensor:
+        """Frontend input tensor (reference: FFModel::create_tensor)."""
+        name = self._fresh_name("input", name)
+        t = Tensor(dims, dtype, name=name)
+        op = O.InputOp(name, ParallelTensorShape.make(t.sizes, t.dtype), tensor_guid=t.guid)
+        node = self.graph.new_node(op)
+        self._producer[t.guid] = (node, 0)
+        self._input_tensors.append(t)
+        return t
+
+    def create_constant(self, value, dtype=None, name=None) -> Tensor:
+        """Compile-time constant tensor (baked into the program; XLA
+        folds it).  Serves imported frontend graphs whose buffers —
+        position ids, token-type ids — are constants, a case the
+        reference routes through host-initialized Legion regions."""
+        arr = np.asarray(value)
+        if dtype is not None:
+            arr = arr.astype(DataType.from_any(dtype).to_numpy())
+        name = self._fresh_name("constant", name)
+        dt = str(arr.dtype)
+        t = Tensor(list(arr.shape), dt, name=name)
+        op = O.ConstantOp(
+            name, ParallelTensorShape.make(t.sizes, t.dtype), value=arr
+        )
+        node = self.graph.new_node(op)
+        self._producer[t.guid] = (node, 0)
+        return t
+
+    # ---- layers (reference: model.h layer-method block) ----------------
+    def dense(self, input: Tensor, out_dim: int, activation=None, use_bias=True,
+              kernel_initializer=None, bias_initializer=None, name=None) -> Tensor:
+        op = O.LinearOp(self._fresh_name("dense", name), [self._shape_of(input)],
+                        out_dim=out_dim, activation=activation, use_bias=use_bias,
+                        kernel_initializer=kernel_initializer,
+                        bias_initializer=bias_initializer)
+        return self._add_op(op, [input])[0]
+
+    def conv2d(self, input: Tensor, out_channels: int, kernel_h: int, kernel_w: int,
+               stride_h: int = 1, stride_w: int = 1, padding_h: int = 0,
+               padding_w: int = 0, activation=None, groups: int = 1, use_bias=True,
+               kernel_initializer=None, bias_initializer=None, name=None) -> Tensor:
+        op = O.Conv2DOp(self._fresh_name("conv2d", name), [self._shape_of(input)],
+                        out_channels=out_channels, kernel_h=kernel_h, kernel_w=kernel_w,
+                        stride_h=stride_h, stride_w=stride_w, padding_h=padding_h,
+                        padding_w=padding_w, groups=groups, activation=activation,
+                        use_bias=use_bias, kernel_initializer=kernel_initializer,
+                        bias_initializer=bias_initializer)
+        return self._add_op(op, [input])[0]
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int, stride_h: int = 1,
+               stride_w: int = 1, padding_h: int = 0, padding_w: int = 0,
+               pool_type: str = "max", activation=None, name=None) -> Tensor:
+        op = O.Pool2DOp(self._fresh_name("pool2d", name), [self._shape_of(input)],
+                        kernel_h=kernel_h, kernel_w=kernel_w, stride_h=stride_h,
+                        stride_w=stride_w, padding_h=padding_h, padding_w=padding_w,
+                        pool_type=pool_type, activation=activation)
+        return self._add_op(op, [input])[0]
+
+    def batch_norm(self, input: Tensor, relu: bool = True, momentum: float = 0.9,
+                   name=None) -> Tensor:
+        op = O.BatchNormOp(self._fresh_name("batchnorm", name), [self._shape_of(input)],
+                           relu=relu, momentum=momentum)
+        return self._add_op(op, [input])[0]
+
+    def layer_norm(self, input: Tensor, axes=(-1,), elementwise_affine=True,
+                   eps=1e-5, name=None) -> Tensor:
+        op = O.LayerNormOp(self._fresh_name("layernorm", name), [self._shape_of(input)],
+                           axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps)
+        return self._add_op(op, [input])[0]
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int,
+                  aggr: str = "none", kernel_initializer=None, name=None) -> Tensor:
+        op = O.EmbeddingOp(self._fresh_name("embedding", name), [self._shape_of(input)],
+                           num_entries=num_entries, out_dim=out_dim, aggr=aggr,
+                           kernel_initializer=kernel_initializer)
+        return self._add_op(op, [input])[0]
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int, kdim: int = 0,
+                            vdim: int = 0, dropout: float = 0.0, bias: bool = False,
+                            causal: bool = False, sp_mode: str = "ring",
+                            kernel_initializer=None,
+                            name=None) -> Tensor:
+        op = O.MultiHeadAttentionOp(
+            self._fresh_name("attention", name),
+            [self._shape_of(query), self._shape_of(key), self._shape_of(value)],
+            embed_dim=embed_dim, num_heads=num_heads, kdim=kdim, vdim=vdim,
+            dropout=dropout, use_bias=bias, causal=causal, sp_mode=sp_mode,
+            kernel_initializer=kernel_initializer)
+        return self._add_op(op, [query, key, value])[0]
+
+    def batch_matmul(self, A: Tensor, B: Tensor, a_seq_length_dim: int = -1,
+                     b_seq_length_dim: int = -1, name=None) -> Tensor:
+        op = O.BatchMatmulOp(self._fresh_name("bmm", name),
+                             [self._shape_of(A), self._shape_of(B)],
+                             a_seq_length_dim=a_seq_length_dim,
+                             b_seq_length_dim=b_seq_length_dim)
+        return self._add_op(op, [A, B])[0]
+
+    def dropout(self, input: Tensor, rate: float = 0.5, seed: int = 0, name=None) -> Tensor:
+        op = O.DropoutOp(self._fresh_name("dropout", name), [self._shape_of(input)],
+                         rate=rate, seed=seed)
+        return self._add_op(op, [input])[0]
+
+    def softmax(self, input: Tensor, axis: int = -1, name=None) -> Tensor:
+        op = O.SoftmaxOp(self._fresh_name("softmax", name), [self._shape_of(input)], axis=axis)
+        return self._add_op(op, [input])[0]
+
+    def concat(self, tensors: Sequence[Tensor], axis: int, name=None) -> Tensor:
+        op = O.ConcatOp(self._fresh_name("concat", name),
+                        [self._shape_of(t) for t in tensors], axis=axis)
+        return self._add_op(op, list(tensors))[0]
+
+    def split(self, input: Tensor, sizes: Union[int, Sequence[int]], axis: int,
+              name=None) -> List[Tensor]:
+        if isinstance(sizes, int):
+            total = input.sizes[axis]
+            assert total % sizes == 0
+            sizes = [total // sizes] * sizes
+        op = O.SplitOp(self._fresh_name("split", name), [self._shape_of(input)],
+                       sizes=tuple(sizes), axis=axis)
+        return self._add_op(op, [input])
+
+    def flat(self, input: Tensor, name=None) -> Tensor:
+        op = O.FlatOp(self._fresh_name("flat", name), [self._shape_of(input)])
+        return self._add_op(op, [input])[0]
+
+    def reshape(self, input: Tensor, shape: Sequence[int], name=None) -> Tensor:
+        op = O.ReshapeOp(self._fresh_name("reshape", name), [self._shape_of(input)],
+                         shape=tuple(shape))
+        return self._add_op(op, [input])[0]
+
+    def transpose(self, input: Tensor, perm: Sequence[int], name=None) -> Tensor:
+        op = O.TransposeOp(self._fresh_name("transpose", name), [self._shape_of(input)],
+                           perm=tuple(perm))
+        return self._add_op(op, [input])[0]
+
+    def reverse(self, input: Tensor, axis: int, name=None) -> Tensor:
+        op = O.ReverseOp(self._fresh_name("reverse", name), [self._shape_of(input)], axis=axis)
+        return self._add_op(op, [input])[0]
+
+    def cast(self, input: Tensor, dtype, name=None) -> Tensor:
+        op = O.CastOp(self._fresh_name("cast", name), [self._shape_of(input)], dtype=dtype)
+        return self._add_op(op, [input])[0]
+
+    def mean(self, input: Tensor, dims: Sequence[int], keepdims: bool = False,
+             name=None) -> Tensor:
+        op = O.MeanOp(self._fresh_name("mean", name), [self._shape_of(input)],
+                      dims=tuple(dims), keepdims=keepdims)
+        return self._add_op(op, [input])[0]
+
+    def top_k(self, input: Tensor, k: int, sorted: bool = True, name=None) -> Tuple[Tensor, Tensor]:
+        op = O.TopKOp(self._fresh_name("topk", name), [self._shape_of(input)], k=k, sorted=sorted)
+        outs = self._add_op(op, [input])
+        return outs[0], outs[1]
+
+    def gather(self, input: Tensor, indices: Tensor, axis: int = 0, name=None) -> Tensor:
+        op = O.GatherOp(self._fresh_name("gather", name),
+                        [self._shape_of(input), self._shape_of(indices)], axis=axis)
+        return self._add_op(op, [input, indices])[0]
+
+    def group_by(self, data: Tensor, assign: Tensor, n_experts: int, alpha: float = 1.0,
+                 name=None) -> List[Tensor]:
+        op = O.GroupByOp(self._fresh_name("group_by", name),
+                         [self._shape_of(data), self._shape_of(assign)],
+                         n_experts=n_experts, alpha=alpha)
+        return self._add_op(op, [data, assign])
+
+    def aggregate(self, gates: Tensor, expert_idx: Tensor, pos: Tensor, valid: Tensor,
+                  expert_out: Tensor, lambda_bal: float = 0.0, name=None) -> Tensor:
+        op = O.AggregateOp(
+            self._fresh_name("aggregate", name),
+            [self._shape_of(t) for t in (gates, expert_idx, pos, valid, expert_out)],
+            lambda_bal=lambda_bal)
+        return self._add_op(op, [gates, expert_idx, pos, valid, expert_out])[0]
+
+    def aggregate_spec(self, gates, expert_idx, pos, valid, expert_out,
+                       lambda_bal: float = 0.0, name=None) -> Tensor:
+        op = O.AggregateSpecOp(
+            self._fresh_name("aggregate_spec", name),
+            [self._shape_of(t) for t in (gates, expert_idx, pos, valid, expert_out)],
+            lambda_bal=lambda_bal)
+        return self._add_op(op, [gates, expert_idx, pos, valid, expert_out])[0]
+
+    def cache(self, input: Tensor, use_cached: bool = False, name=None) -> Tensor:
+        op = O.CacheOp(self._fresh_name("cache", name), [self._shape_of(input)],
+                       use_cached=use_cached)
+        return self._add_op(op, [input])[0]
+
+    # parallel ops (reference: src/parallel_ops/*; inserted by the search
+    # or placed manually for hand-written strategies) -------------------
+    def repartition(self, input: Tensor, dim: int, degree: int, name=None) -> Tensor:
+        from flexflow_tpu.parallel.parallel_ops import RepartitionOp
+
+        op = RepartitionOp(self._fresh_name("repartition", name),
+                           [self._shape_of(input)], dim=dim, degree=degree)
+        return self._add_op(op, [input])[0]
+
+    def combine(self, input: Tensor, dim: int, degree: int = 1, name=None) -> Tensor:
+        from flexflow_tpu.parallel.parallel_ops import CombineOp
+
+        op = CombineOp(self._fresh_name("combine", name),
+                       [self._shape_of(input)], dim=dim, degree=degree)
+        return self._add_op(op, [input])[0]
+
+    def replicate(self, input: Tensor, degree: int, name=None) -> Tensor:
+        from flexflow_tpu.parallel.parallel_ops import ReplicateOp
+
+        op = ReplicateOp(self._fresh_name("replicate", name),
+                         [self._shape_of(input)], degree=degree)
+        return self._add_op(op, [input])[0]
+
+    def reduction(self, input: Tensor, degree: int, name=None) -> Tensor:
+        from flexflow_tpu.parallel.parallel_ops import ReductionOp
+
+        op = ReductionOp(self._fresh_name("reduction", name),
+                         [self._shape_of(input)], degree=degree)
+        return self._add_op(op, [input])[0]
+
+    def node_by_name(self, name: str) -> Node:
+        for node in self.graph.nodes.values():
+            if node.op.name == name:
+                return node
+        raise KeyError(name)
+
+    # elementwise -------------------------------------------------------
+    def _unary(self, t: OperatorType, input: Tensor, name=None, scalar=0.0,
+               base=None, approximate=True):
+        op = O.ElementUnaryOp(self._fresh_name(base or t.value, name),
+                              [self._shape_of(input)], unary_type=t,
+                              scalar=scalar, approximate=approximate)
+        return self._add_op(op, [input])[0]
+
+    def _binary(self, t: OperatorType, a: Tensor, b: Tensor, name=None):
+        op = O.ElementBinaryOp(self._fresh_name(t.value, name),
+                               [self._shape_of(a), self._shape_of(b)], binary_type=t)
+        return self._add_op(op, [a, b])[0]
+
+    def relu(self, x, name=None):
+        return self._unary(OperatorType.RELU, x, name)
+
+    def sigmoid(self, x, name=None):
+        return self._unary(OperatorType.SIGMOID, x, name)
+
+    def tanh(self, x, name=None):
+        return self._unary(OperatorType.TANH, x, name)
+
+    def elu(self, x, name=None):
+        return self._unary(OperatorType.ELU, x, name)
+
+    def gelu(self, x, name=None, approximate=True):
+        """tanh-approximate by default (the TPU-friendly form); pass
+        approximate=False for the exact erf GELU that tf.keras and
+        torch default to."""
+        return self._unary(OperatorType.GELU, x, name, approximate=approximate)
+
+    def exp(self, x, name=None):
+        return self._unary(OperatorType.EXP, x, name)
+
+    def log(self, x, name=None):
+        return self._unary(OperatorType.LOG, x, name)
+
+    def identity(self, x, name=None):
+        return self._unary(OperatorType.IDENTITY, x, name)
+
+    def rsqrt(self, x, name=None):
+        return self._unary(OperatorType.RSQRT, x, name)
+
+    def pow(self, x, exponent: float, name=None):
+        return self._unary(OperatorType.POW, x, name, scalar=exponent)
+
+    def scalar_add(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_ADD, x, name, scalar=scalar)
+
+    def scalar_sub(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_SUB, x, name, scalar=scalar)
+
+    def scalar_multiply(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_MUL, x, name, scalar=scalar)
+
+    def scalar_true_divide(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_TRUE_DIV, x, name, scalar=scalar)
+
+    def add(self, a, b, name=None):
+        return self._binary(OperatorType.EW_ADD, a, b, name)
+
+    def subtract(self, a, b, name=None):
+        return self._binary(OperatorType.EW_SUB, a, b, name)
+
+    def multiply(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MUL, a, b, name)
+
+    def divide(self, a, b, name=None):
+        return self._binary(OperatorType.EW_DIV, a, b, name)
+
+    def max(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MAX, a, b, name)
+
+    def min(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MIN, a, b, name)
+
+    # ------------------------------------------------------------------
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type="sparse_categorical_crossentropy",
+        metrics=("accuracy",),
+        comp_mode: str = "training",
+        strategy: Optional[Dict[int, MachineView]] = None,
+        pipeline=None,
+        block_of: Optional[Dict[int, int]] = None,
+        mesh=None,
+    ):
+        """Pick a parallelization strategy and lower
+        (reference: FFModel::compile model.cc:2587).  ``pipeline`` — a
+        flexflow_tpu.parallel.pipeline.PipelineConfig enables the
+        S-stage microbatched pipeline over a ``pp`` mesh axis (a
+        capability the reference only stubbed: OP_PIPELINE,
+        ffconst.h:148)."""
+        from flexflow_tpu.compiler.lowering import CompiledModel, data_parallel_strategy
+
+        if comp_mode not in ("training", "inference"):
+            raise ValueError(
+                f"comp_mode must be 'training' or 'inference', got {comp_mode!r}"
+            )
+        self.config.comp_mode = comp_mode
+        if self.config.verify:
+            # prove the frontend-built graph well-formed before anything
+            # consumes it (flexflow_tpu/analysis).  The per-rewrite hook
+            # inside the search is armed by optimize_strategy's own
+            # scoped_verify — config.verify never becomes a sticky
+            # process-wide latch.
+            from flexflow_tpu.analysis import assert_graph_ok
+
+            assert_graph_ok(self.graph, context="at compile entry")
+        if self.config.obs_log_file:
+            # FFConfig-gated unified telemetry (flexflow_tpu/obs): the
+            # search, compile, and fit paths below all emit through the
+            # same bus once it is armed
+            from flexflow_tpu.obs.events import BUS as _obs_bus
+
+            _obs_bus.configure(self.config.obs_log_file)
+        self.pipeline_proposal = None  # a stale proposal from an earlier
+        # compile must not hijack this one's lowering
+        self.optimizer = optimizer or SGDOptimizer(
+            lr=self.config.learning_rate, weight_decay=self.config.weight_decay
+        )
+        if pipeline is not None and (
+            pipeline.num_stages < 1
+            or self.config.num_devices % pipeline.num_stages != 0
+        ):
+            raise ValueError(
+                f"pipeline.num_stages={pipeline.num_stages} must divide "
+                f"num_devices={self.config.num_devices}"
+            )
+        if pipeline is not None and mesh is not None:
+            raise ValueError(
+                "mesh= is not supported with pipeline= (the pipelined "
+                "lowering builds its own pp-leading mesh)"
+            )
+        if pipeline is not None and self.config.zero_dp_shard:
+            raise NotImplementedError(
+                "zero_dp_shard is not supported with pipeline= yet — the "
+                "pipelined lowering manages its own per-stage placement; "
+                "silently ignoring the flag would leave optimizer state "
+                "replicated while the user expects 1/N memory"
+            )
+        searched_strategy = False  # did the joint search pick it?
+        searched_strategy_obj = None  # the exact strategy the search
+        # returned (a placement proposal may replace `strategy` below)
+        imported_sync_schedule = None  # __meta__.sync_schedule of an
+        # imported strategy file (already behind the digest gate)
+        if strategy is None:
+            if pipeline is not None:
+                # dp over the devices left after the pp axis is carved off
+                strategy = data_parallel_strategy(
+                    self.graph, self.config.num_devices // pipeline.num_stages
+                )
+            elif self.config.import_strategy_file:
+                from flexflow_tpu.search.strategy_io import import_strategy
+
+                # an imported strategy bypasses the search's always-on
+                # gate — provenance is checked by import_strategy and
+                # the views are linted below, so an illegal file fails
+                # at compile with a finding, not inside XLA
+                from flexflow_tpu.analysis import (
+                    AnalysisError,
+                    emit_findings,
+                    errors_only,
+                    lint_strategy,
+                )
+
+                try:
+                    strategy = import_strategy(
+                        self.config.import_strategy_file, self.graph,
+                        allow_partial=self.config.import_strategy_partial)
+                except AnalysisError as e:
+                    err = AnalysisError(
+                        f"{e}\n(hint: a strategy exported after a "
+                        f"REWRITING search is keyed to the rewritten "
+                        f"graph and cannot re-apply to a fresh frontend "
+                        f"build — use the persistent cost cache "
+                        f"(--cost-cache-file) for cross-process reuse of "
+                        f"rewritten searches, or "
+                        f"--import-strategy-partial / "
+                        f"FFConfig.import_strategy_partial for a "
+                        f"best-effort partial apply)")
+                    err.findings = list(e.findings)
+                    raise err from e
+
+                bad = errors_only(lint_strategy(
+                    self.graph, strategy, self.config.num_devices))
+                if bad:
+                    emit_findings(bad)
+                    raise AnalysisError(
+                        f"imported strategy "
+                        f"{self.config.import_strategy_file!r} is illegal "
+                        f"for this graph/mesh", bad)
+                from flexflow_tpu.search.strategy_io import read_meta
+
+                imported_sync_schedule = read_meta(
+                    self.config.import_strategy_file).get("sync_schedule")
+            elif self.config.only_data_parallel:
+                strategy = data_parallel_strategy(self.graph, self.config.num_devices)
+            else:
+                # the Unity joint search IS the default compile path
+                # (reference: FFModel::compile -> graph_optimize,
+                # model.cc:2587-2655): graph rewrites compete with view
+                # assignment and the best REWRITTEN graph gets lowered —
+                # self.graph is replaced the same way the reference
+                # deserializes the optimized PCG into its operator list
+                # (convert_graph_to_operators, substitution.cc:3014)
+                from flexflow_tpu.search.driver import optimize_strategy
+
+                best_graph, strategy = optimize_strategy(
+                    self.graph, self.config, return_graph=True
+                )
+                self.graph = best_graph
+                searched_strategy = True
+                # the strategy object the driver's sync-schedule gate
+                # ran against — a pipeline/placement proposal below may
+                # REPLACE `strategy`, and the gated schedule must not
+                # follow it onto a strategy it was never linted for
+                searched_strategy_obj = strategy
+                # the search also costs pipelined candidates for
+                # stacked-block graphs (reference gap: OP_PIPELINE is an
+                # enum stub, ffconst.h:148) — a winning PipelineConfig
+                # is adopted exactly as if the user had passed it
+                if (
+                    pipeline is None
+                    and mesh is None
+                    and (self.config.enable_pipeline_search
+                         or self.config.enable_placement_search)
+                    and not self.config.zero_dp_shard
+                    and comp_mode == "training"
+                ):
+                    from flexflow_tpu.search.driver import (
+                        coherent_calibration,
+                    )
+                    from flexflow_tpu.search.pipeline_search import (
+                        propose_pipeline,
+                    )
+                    from flexflow_tpu.search.simulator import Simulator
+
+                    # same cost currency as the flat search that just
+                    # ran: measured calibration included when coherent
+                    sim = Simulator.for_config(
+                        self.config,
+                        calibration=coherent_calibration(self.config),
+                    )
+                    baseline = sim.simulate(self.graph, strategy)
+                    prop = (
+                        propose_pipeline(
+                            self.graph, self.config, sim, baseline
+                        )
+                        if self.config.enable_pipeline_search else None
+                    )
+                    if prop is not None and (
+                        self.config.num_devices % prop.num_stages == 0
+                        and self.config.batch_size % prop.num_microbatches
+                        == 0
+                    ):
+                        pipeline = prop
+                        strategy = data_parallel_strategy(
+                            self.graph,
+                            self.config.num_devices // pipeline.num_stages,
+                        )
+                    elif self.config.enable_placement_search:
+                        # no pipeline won: cost 2-block inter-op placed
+                        # candidates in the placed executor's schedule
+                        # (reference: VERTICAL splits + mapper placement,
+                        # graph.cc:161-295, mapper.cc:371-475); a
+                        # margin-beating placeable winner replaces the
+                        # flat strategy and lowers via the placed path
+                        from flexflow_tpu.search.placement_search import (
+                            propose_placement,
+                        )
+
+                        placed = propose_placement(
+                            self.graph, self.config, baseline,
+                            calibration=coherent_calibration(self.config),
+                        )
+                        if placed is not None:
+                            strategy = placed
+                        elif not _math.isfinite(baseline):
+                            # nothing executable fits: cost the GENERAL
+                            # staged-pipeline shape (any graph cut,
+                            # reference graph.cc:161-295); a winning
+                            # proposal lowers via the heterogeneous
+                            # staged executor
+                            # (compiler/staged_pipeline_lowering.py)
+                            from flexflow_tpu.search.pipeline_search import (
+                                propose_pipeline_general,
+                            )
+
+                            self.pipeline_proposal = (
+                                propose_pipeline_general(
+                                    self.graph, self.config, sim, baseline
+                                )
+                            )
+                            if self.pipeline_proposal is not None:
+                                from flexflow_tpu.utils.logging import (
+                                    SEARCH_LOG,
+                                )
+
+                                p = self.pipeline_proposal
+                                SEARCH_LOG.log(
+                                    f"staged-pipeline candidate: S="
+                                    f"{p.num_stages} M="
+                                    f"{p.num_microbatches} modeled "
+                                    f"{p.cost * 1e3:.3f} ms/iter "
+                                    f"(flat is infeasible)"
+                                )
+        # the chosen strategy is public state: tooling (bench_search,
+        # strategy introspection) reads it back after compile
+        self.strategy = strategy
+        # sync-precision dimension of the strategy (EQuARX compressed
+        # gradient collectives): build the per-weight-group wire map
+        # with the SAME cost model the search ranked with, so execution
+        # runs exactly what the simulation priced.  Public state like
+        # the strategy itself (bench_search reads it back).
+        self.sync_precision_map: Dict[str, str] = {}
+        _sync_sim = None  # shared by the precision map + schedule
+        # builders below: one Simulator.for_config per compile, not three
+        if (
+            comp_mode == "training"
+            and strategy
+            and getattr(self.config, "sync_precision", "fp32") != "fp32"
+        ):
+            from flexflow_tpu.search.driver import coherent_calibration
+            from flexflow_tpu.search.simulator import Simulator
+            from flexflow_tpu.search.sync_precision import (
+                choose_sync_precision,
+            )
+
+            _sync_sim = Simulator.for_config(
+                self.config, calibration=coherent_calibration(self.config)
+            )
+            self.sync_precision_map = choose_sync_precision(
+                self.graph, strategy, _sync_sim.cost
+            )
+        # gradient-sync SCHEDULE (search/sync_schedule.py): bucketed,
+        # issue-ordered collectives the lowering executes inside the
+        # backward (comm/bucketed.py).  The joint search already chose
+        # and legality-gated one for ITS result (driver
+        # _build_sync_schedule); other strategy sources (forced DP,
+        # caller-supplied, imported without one) run the same choice +
+        # always-on gate here.  Public state like the strategy itself.
+        self.sync_schedule = None
+        if (
+            comp_mode == "training"
+            and strategy
+            and pipeline is None
+            and getattr(self.config, "sync_schedule", "off") == "search"
+        ):
+            if imported_sync_schedule is not None:
+                # a schedule persisted next to an imported strategy
+                # (digest gate already passed) — re-lint against THIS
+                # graph before adopting: a hand-edited file must fail
+                # with a finding, not inside XLA
+                from flexflow_tpu.analysis import (
+                    AnalysisError,
+                    emit_findings,
+                    errors_only,
+                    lint_sync_schedule,
+                )
+                from flexflow_tpu.search.sync_schedule import SyncSchedule
+
+                try:
+                    sched = SyncSchedule.from_jsonable(imported_sync_schedule)
+                except ValueError as e:
+                    raise AnalysisError(
+                        f"imported strategy file carries a malformed "
+                        f"sync_schedule: {e}", []) from e
+                from flexflow_tpu.analysis import lint_reduction_plan
+                from flexflow_tpu.search.machine_model import CostModel
+
+                _lint_cm = CostModel(
+                    self.config.machine_spec,
+                    num_devices=self.config.search_devices)
+                bad = errors_only(
+                    lint_sync_schedule(
+                        self.graph, strategy, sched,
+                        self.sync_precision_map)
+                    + lint_reduction_plan(
+                        self.graph, strategy, sched, _lint_cm))
+                if bad:
+                    emit_findings(bad)
+                    raise AnalysisError(
+                        "imported sync_schedule is illegal for this "
+                        "graph/strategy", bad)
+                self.sync_schedule = sched
+            elif searched_strategy and strategy is searched_strategy_obj:
+                from flexflow_tpu.search import driver as _driver
+
+                self.sync_schedule = _driver.LAST_SYNC_SCHEDULE
+            else:
+                # caller-supplied / forced-DP strategies, and searched
+                # strategies later REPLACED by a placement proposal:
+                # run the same choice + always-on gate against the
+                # strategy actually being lowered
+                from flexflow_tpu.search.driver import (
+                    _build_sync_schedule,
+                    coherent_calibration,
+                )
+                from flexflow_tpu.search.simulator import Simulator
+
+                if _sync_sim is None:
+                    _sync_sim = Simulator.for_config(
+                        self.config,
+                        calibration=coherent_calibration(self.config),
+                    )
+                self.sync_schedule = _build_sync_schedule(
+                    self.graph, strategy, _sync_sim, self.config
+                )
+        # predicted step breakdown + strategy-explanation telemetry —
+        # the predicted half of the DriftReport fit() completes.  Only
+        # computed when something will consume it (profiling, the obs
+        # bus, a strategy/trace export): one extra simulate per compile
+        # is cheap but not free.
+        from flexflow_tpu.obs.events import BUS as _obs_bus
+
+        self.predicted_breakdown = None
+        self.drift_report = None
+        if (
+            strategy
+            and pipeline is None
+            and self.pipeline_proposal is None
+            and (
+                self.config.profiling
+                or _obs_bus.enabled
+                or self.config.export_strategy_file
+                or self.config.obs_trace_file
+            )
+        ):
+            from flexflow_tpu.search.driver import coherent_calibration
+            from flexflow_tpu.search.simulator import Simulator as _Sim
+
+            try:
+                _psim = _Sim.for_config(
+                    self.config, calibration=coherent_calibration(self.config)
+                )
+                bd: Dict = {}
+                _sched: list = []
+                _comm: list = []
+                _psim.simulate(self.graph, strategy, breakdown=bd,
+                               schedule=_sched, comm_schedule=_comm,
+                               sync_schedule=self.sync_schedule)
+                bd["calibrated"] = _psim.cost.calibration is not None
+                bd["machine"] = self.config.machine_spec.name
+                self.predicted_breakdown = bd
+                if _obs_bus.enabled:
+                    _obs_bus.emit(
+                        "strategy.table",
+                        rows=_psim.strategy_table_rows(
+                            self.graph, strategy,
+                            self.sync_precision_map,
+                        ),
+                        predicted_s=bd.get("total_s"),
+                        devices=self.config.search_devices,
+                        comp_mode=comp_mode,
+                        # searched=False marks forced-DP / imported /
+                        # caller-supplied strategies so report tooling
+                        # can prefer the joint-search table when both
+                        # were compiled in one run
+                        searched=searched_strategy,
+                    )
+                if self.config.obs_trace_file:
+                    _psim.export_chrome_trace(
+                        self.graph, strategy, self.config.obs_trace_file,
+                        schedule=_sched, comm_schedule=_comm,
+                        total_s=bd.get("total_s"))
+            except Exception:  # telemetry must never fail a compile
+                self.predicted_breakdown = None
+        if self.config.export_strategy_file:
+            from flexflow_tpu.search.strategy_io import export_strategy
+
+            _meta = {}
+            if self.predicted_breakdown:
+                _meta["predicted"] = self.predicted_breakdown
+            if self.sync_schedule is not None:
+                # the searched comm plan persists NEXT to the strategy,
+                # behind the same graph-digest gate import enforces
+                _meta["sync_schedule"] = self.sync_schedule.to_jsonable()
+            export_strategy(
+                self.config.export_strategy_file, self.graph, strategy,
+                meta=_meta or None,
+            )
+        if self.config.export_strategy_computation_graph_file:
+            self.graph.write_dot(
+                self.config.export_strategy_computation_graph_file, strategy
+            )
+        if self.config.export_strategy_task_graph_file:
+            from flexflow_tpu.search.simulator import Simulator
+
+            # for_config: search_devices + comp_mode/zero flags match
+            # what the search itself costed
+            Simulator.for_config(self.config).export_task_graph_dot(
+                self.graph, strategy, self.config.export_strategy_task_graph_file
+            )
+
+        from flexflow_tpu.compiler.placement_lowering import placeable
+
+        if pipeline is None and mesh is None and strategy and placeable(
+                self.graph, strategy, self.config):
+            # mesh is None: a user-supplied mesh commits the whole graph
+            # to one submesh program, which a 2-block placed strategy
+            # cannot honor — fall through to the flat lowering (which
+            # respects mesh=) instead of silently ignoring it
+            # disjoint start_part device blocks that the placed lowering
+            # can express: EXECUTED inter-op placement (reference:
+            # mapper.cc:371-475 places ops on disjoint device sets and
+            # Legion runs them).  Multi-block strategies OUTSIDE its
+            # support (>2 blocks, multi-tensor cuts, grad accumulation)
+            # keep the historical behavior: offsets are inert and the
+            # single SPMD program replicates small-degree ops.
+            from flexflow_tpu.compiler.placement_lowering import (
+                PlacedCompiledModel,
+            )
+
+            self.compiled = PlacedCompiledModel(
+                self.graph,
+                strategy,
+                self.config,
+                LossType.from_any(loss_type),
+                list(metrics),
+                self.optimizer,
+            )
+        elif pipeline is not None:
+            from flexflow_tpu.compiler.pipeline_lowering import PipelinedCompiledModel
+
+            self.compiled = PipelinedCompiledModel(
+                self.graph,
+                strategy,
+                self.config,
+                LossType.from_any(loss_type),
+                list(metrics),
+                self.optimizer,
+                pipeline=pipeline,
+                block_of=block_of,
+            )
+        elif (
+            self.pipeline_proposal is not None
+            and mesh is None
+            and comp_mode == "training"
+        ):
+            # (multi-process raises inside the constructor and falls
+            # back to flat via the except below)
+            # flat is infeasible and the general staged proposal won:
+            # lower it via the heterogeneous staged executor (GPipe over
+            # arbitrary graph cuts — compiler/staged_pipeline_lowering)
+            from flexflow_tpu.compiler.staged_pipeline_lowering import (
+                StagedPipelinedModel,
+            )
+
+            try:
+                self.compiled = StagedPipelinedModel(
+                    self.graph,
+                    self.pipeline_proposal.stage_guids,
+                    self.pipeline_proposal.num_microbatches,
+                    self.config,
+                    LossType.from_any(loss_type),
+                    list(metrics),
+                    self.optimizer,
+                )
+            except (NotImplementedError, ValueError):
+                # stateful stages etc.: keep the flat lowering (the
+                # proposal stays surfaced on self.pipeline_proposal)
+                self.compiled = None
+            if self.compiled is None:
+                self.compiled = CompiledModel(
+                    self.graph, strategy, self.config,
+                    LossType.from_any(loss_type), list(metrics),
+                    self.optimizer, mesh=mesh,
+                    sync_precision=self.sync_precision_map,
+                    sync_schedule=self.sync_schedule,
+                )
+        else:
+            self.compiled = CompiledModel(
+                self.graph,
+                strategy,
+                self.config,
+                LossType.from_any(loss_type),
+                list(metrics),
+                self.optimizer,
+                mesh=mesh,
+                sync_precision=self.sync_precision_map,
+                sync_schedule=self.sync_schedule,
+            )
+        from flexflow_tpu.compiler.staged_pipeline_lowering import (
+            StagedPipelinedModel as _Staged,
+        )
+
+        if self.sync_precision_map and not getattr(
+                self.compiled, "sync_precision", None):
+            # placed/pipelined lowerings manage their own grad paths and
+            # do not run _sync_grads yet — say so rather than silently
+            # training at fp32 while the user expects compression
+            from flexflow_tpu.utils.logging import SEARCH_LOG
+
+            SEARCH_LOG.log(
+                f"sync_precision={self.config.sync_precision!r} chose "
+                f"{len(self.sync_precision_map)} compressed groups but "
+                f"this lowering ({type(self.compiled).__name__}) cannot "
+                f"execute them; gradients sync at fp32"
+            )
+            self.sync_precision_map = {}
+        if self.sync_schedule is not None and getattr(
+                self.compiled, "sync_schedule", None) is None:
+            # same honesty rule for the sync schedule: placed/pipelined
+            # lowerings do not run _sync_grads, so the searched comm
+            # plan cannot execute there — say so instead of silently
+            # falling back to the monolithic sync
+            from flexflow_tpu.utils.logging import SEARCH_LOG
+
+            SEARCH_LOG.log(
+                f"sync_schedule chose {len(self.sync_schedule.buckets)} "
+                f"buckets but this lowering "
+                f"({type(self.compiled).__name__}) cannot execute them; "
+                f"gradients sync monolithically"
+            )
+            self.sync_schedule = None
+
+        self._compile_ctx = dict(
+            strategy=strategy, loss_type=LossType.from_any(loss_type),
+            metrics=list(metrics), pipeline=pipeline, block_of=block_of,
+            mesh=mesh,
+            sync_precision=dict(self.sync_precision_map),
+            sync_schedule=self.sync_schedule,
+            staged=(self.pipeline_proposal
+                    if isinstance(self.compiled, _Staged) else None),
+        )
+        self.params, self.state = self.compiled.init_params(self.config.seed)
+        self.opt_state = self.optimizer.init_state(self.params)
+        self.opt_state = self.compiled.shard_opt_state(self.opt_state)
+        return self.compiled
+
+    def recompile(self):
+        """Re-lower the (possibly altered) graph into a fresh XLA
+        program, carrying params / optimizer state / model state over
+        (reference: dynamic re-optimization, recompile_state.cc — ops
+        altered in place; here the program is rebuilt instead)."""
+        from flexflow_tpu.compiler.lowering import CompiledModel
+
+        ctx = self._compile_ctx
+        if ctx["pipeline"] is not None:
+            from flexflow_tpu.compiler.pipeline_lowering import PipelinedCompiledModel
+
+            self.compiled = PipelinedCompiledModel(
+                self.graph, ctx["strategy"], self.config, ctx["loss_type"],
+                ctx["metrics"], self.optimizer,
+                pipeline=ctx["pipeline"], block_of=ctx["block_of"],
+            )
+        elif ctx.get("staged") is not None:
+            # a staged-pipelined model must RE-lower staged: the flat
+            # strategy it replaced was HBM-infeasible by construction
+            from flexflow_tpu.compiler.staged_pipeline_lowering import (
+                StagedPipelinedModel,
+            )
+
+            staged = ctx["staged"]
+            self.compiled = StagedPipelinedModel(
+                self.graph, staged.stage_guids, staged.num_microbatches,
+                self.config, ctx["loss_type"], ctx["metrics"],
+                self.optimizer,
+            )
+        else:
+            from flexflow_tpu.compiler.placement_lowering import (
+                PlacedCompiledModel,
+                placeable,
+            )
+
+            if ctx.get("mesh") is None and ctx["strategy"] and placeable(
+                    self.graph, ctx["strategy"], self.config):
+                # a placed model must RE-lower placed: flat re-lowering
+                # would silently drop the inter-op placement and carry
+                # submesh-committed params into a global-mesh program
+                self.compiled = PlacedCompiledModel(
+                    self.graph, ctx["strategy"], self.config,
+                    ctx["loss_type"], ctx["metrics"], self.optimizer,
+                )
+            else:
+                self.compiled = CompiledModel(
+                    self.graph, ctx["strategy"], self.config,
+                    ctx["loss_type"], ctx["metrics"], self.optimizer,
+                    mesh=ctx.get("mesh"),
+                    sync_precision=ctx.get("sync_precision"),
+                    sync_schedule=ctx.get("sync_schedule"),
+                )
+        old_params, old_state, old_opt = self.params, self.state, self.opt_state
+        self.params, self.state = self.compiled.init_params(self.config.seed)
+        # shape-checked carry-over: an alter() that changes a weight's
+        # shape keeps the fresh init for that weight
+        self.params = _merge_matching(self.params, old_params or {})
+        self.state = _merge_matching(self.state, old_state or {})
+        # optimizer state must match the NEW param tree structure; re-init
+        # and carry over leaves whose key paths survived the alteration
+        self.opt_state = self.optimizer.init_state(self.params)
+        self.opt_state = _merge_matching(self.opt_state, old_opt)
+        self.opt_state = self.compiled.shard_opt_state(self.opt_state)
+        return self.compiled
+
+    # ------------------------------------------------------------------
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True,
+            callbacks: Sequence = (), recompile_state=None,
+            validation_data=None, validation_split: float = 0.0,
+            checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
+            resume: bool = False):
+        """Training loop (reference: flexflow_cffi.py:1832 fit).
+
+        ``callbacks`` follow the keras callback protocol (duck-typed:
+        on_train_begin/end, on_epoch_begin, on_epoch_end(epoch, logs) —
+        return False from on_epoch_end to stop early).
+
+        ``recompile_state`` — a runtime.recompile.RecompileState checked
+        once per iteration (reference: recompile_on_condition,
+        model.cc:2273); its alter() may mutate op attrs, after which the
+        model re-lowers with params/state carried over.
+
+        ``validation_data=(vx, vy)`` — evaluated after each epoch;
+        ``val_*`` keys join the epoch logs/history so callbacks can
+        monitor them (keras semantics; the reference's keras frontend
+        verifies metrics only on the training set, callbacks.py
+        VerifyMetrics).  ``validation_split=f`` holds out the LAST
+        fraction of (x, y) — taken before any shuffling, keras's exact
+        split formula — as validation_data; mutually exclusive with it.
+
+        ``checkpoint_dir`` — snapshot the full training state (params,
+        optimizer state, rng counter) every ``checkpoint_every`` epochs;
+        with ``resume=True`` training continues from the latest
+        snapshot's next epoch.  Beyond the reference, which has no
+        model checkpointing (SURVEY.md §5); runtime/checkpoint.py."""
+        import jax
+
+        from flexflow_tpu.runtime.dataloader import SingleDataLoader
+
+        assert self.compiled is not None, "call compile() first"
+        if self.config.comp_mode == "inference":
+            raise RuntimeError(
+                "model was compiled with comp_mode='inference' (forward-"
+                "only strategy search, reference COMP_MODE_INFERENCE) — "
+                "recompile with comp_mode='training' to fit()"
+            )
+        if validation_split:
+            # keras semantics: the LAST fraction of the data (before any
+            # shuffling) becomes the validation set
+            if validation_data is not None:
+                raise ValueError(
+                    "pass either validation_data or validation_split, not both"
+                )
+            if not 0.0 < validation_split < 1.0:
+                raise ValueError(f"validation_split={validation_split} not in (0, 1)")
+            xs_all = x if isinstance(x, (list, tuple)) else [x]
+            xs_all = [np.asarray(a) for a in xs_all]
+            y_all = np.asarray(y)
+            n_all = len(y_all)
+            cut = int(n_all * (1.0 - validation_split))  # keras's exact formula
+            if cut == n_all or cut == 0:
+                raise ValueError(
+                    f"validation_split={validation_split} of {n_all} samples "
+                    "leaves an empty train or validation set"
+                )
+            validation_data = ([a[cut:] for a in xs_all]
+                               if len(xs_all) > 1 else xs_all[0][cut:],
+                               y_all[cut:])
+            x = [a[:cut] for a in xs_all] if len(xs_all) > 1 else xs_all[0][:cut]
+            y = y_all[:cut]
+        if validation_data is not None:
+            # fail BEFORE training, not after a wasted epoch
+            if not isinstance(validation_data, (tuple, list)) or len(
+                validation_data
+            ) != 2:
+                raise ValueError(
+                    "validation_data must be an (x, y) pair "
+                    "(sample weights are not supported)"
+                )
+            _vy = np.asarray(validation_data[1])
+            _bs = batch_size or self.config.batch_size
+            if len(_vy) < _bs:
+                raise ValueError(
+                    f"validation set ({len(_vy)} samples) is smaller than "
+                    f"batch_size ({_bs}) — evaluate() runs full batches "
+                    "only, so no validation metric could ever be computed"
+                )
+            if len(_vy) % _bs:
+                print(
+                    f"# warning: validation tail of {len(_vy) % _bs} samples "
+                    f"(< batch_size {_bs}) is dropped each epoch"
+                )
+        ckpt_mgr = None
+        start_epoch = 0
+        if checkpoint_dir is not None:
+            # multi-process runs go down CheckpointManager's coordinated
+            # orbax multihost path (every process calls save/restore on
+            # the same directory; orbax synchronizes the shard writes)
+            from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(checkpoint_dir)
+            if resume and ckpt_mgr.latest_step() is not None:
+                start_epoch = ckpt_mgr.restore(self) + 1
+        elif resume:
+            raise ValueError("resume=True requires checkpoint_dir")
+        for cb in callbacks:
+            # keras callback protocol: bind the model before training
+            # (works for both FFModel.fit and the keras Model.fit path,
+            # which re-binds with the keras wrapper afterwards)
+            if hasattr(cb, "set_model") and getattr(cb, "model", None) is None:
+                cb.set_model(self)
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        batch_size = batch_size or self.config.batch_size
+        epochs = epochs or self.config.epochs
+        loader = SingleDataLoader(
+            self.compiled, [np.asarray(a) for a in xs], np.asarray(y),
+            batch_size, shuffle=shuffle, seed=self.config.seed,
+        )
+        if start_epoch and shuffle:
+            # fast-forward the shuffle stream: a resumed epoch N must see
+            # the N-th permutation, not replay epoch 0's order
+            ff_order = np.arange(loader.num_samples)
+            for _ in range(start_epoch):
+                loader.rng.shuffle(ff_order)
+        if loader.num_batches == 0:
+            raise ValueError(
+                f"no full batch: {loader.num_samples} samples < batch_size {batch_size}"
+            )
+        for cb in callbacks:
+            cb.on_train_begin()
+        profiler = None
+        if self.config.profiling:
+            from flexflow_tpu.runtime.profiler import StepProfiler
+
+            profiler = StepProfiler()
+        metrics = PerfMetrics()
+        history = []
+        t_start = None
+        steps_done = 0
+        steps_at_t0 = 0
+        stop = False
+        # iteration tracing: run config.trace_steps optimizer steps per
+        # compiled call (train_steps scan) — the Legion begin/end_trace
+        # analogue.  Incompatible with per-step profiling/recompile
+        # checks, which need host control between steps.
+        trace_n = max(1, int(getattr(self.config, "trace_steps", 1)))
+        use_trace = (
+            trace_n > 1
+            and profiler is None
+            and recompile_state is None
+            and jax.process_count() == 1
+            and loader.num_batches >= trace_n
+            # multi-mesh compositions (inter-op placement) have no
+            # single traced program — fall back to per-step calls
+            and getattr(self.compiled, "supports_trace", True)
+        )
+        for epoch in range(start_epoch, epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            metrics.reset()
+            acc = None  # device-side metric accumulation; host sync once/epoch
+            batch_iter = (
+                loader.iter_traced(trace_n) if use_trace else
+                (("single", i, l) for i, l in loader)
+            )
+            for kind, inputs, labels in batch_iter:
+                self._rng_counter += 1
+                rng = jax.random.key(self._rng_counter)
+                if profiler is not None:
+                    profiler.start_step()
+                    profiler.start_phase("dispatch")
+                if kind == "stack":
+                    (self.params, self.opt_state, self.state, losses, ms) = (
+                        self.compiled.train_steps(
+                            self.params, self.opt_state, self.state, rng,
+                            inputs, labels
+                        )
+                    )
+                    loss = losses[-1]
+                    # summing the stacked per-step metric trees equals
+                    # the single-step accumulation below
+                    m = jax.tree.map(lambda a: a.sum(axis=0), ms)
+                    n_this = len(losses)
+                else:
+                    (self.params, self.opt_state, self.state, loss, m) = (
+                        self.compiled.train_step(
+                            self.params, self.opt_state, self.state, rng,
+                            inputs, labels
+                        )
+                    )
+                    n_this = 1
+                if profiler is not None:
+                    # host phases: enqueue (dispatch) vs device
+                    # completion (wait) — the measured side of the
+                    # DriftReport; the fence makes the step time real
+                    profiler.end_phase("dispatch")
+                    profiler.start_phase("wait")
+                    float(loss)
+                    profiler.end_phase("wait")
+                    profiler.end_step()
+                if recompile_state is not None and recompile_state.check(self):
+                    # drop the accumulator AND this step's metrics: the
+                    # re-lowered program may emit a different metric tree
+                    acc = None
+                else:
+                    acc = m if acc is None else jax.tree.map(
+                        lambda a, b: a + b, acc, m)
+                steps_done += n_this
+                if t_start is None:
+                    float(loss)  # readback fence (block_until_ready does
+                    # not reliably fence through remote-device tunnels)
+                    t_start = time.perf_counter()  # skip compile time
+                    steps_at_t0 = steps_done
+            if acc is not None:  # None if a recompile landed on the last batch
+                metrics.update(acc)
+            if verbose:
+                print(f"epoch {epoch}: loss={float(loss):.4f} {metrics}")
+            logs = metrics.report()
+            logs["loss"] = float(loss)
+            if validation_data is not None:
+                vx, vy = validation_data
+                val = self.evaluate(x=vx, y=vy, batch_size=batch_size)
+                for k, v in val.items():
+                    if k != "samples":
+                        logs[f"val_{k}"] = v
+                if verbose:
+                    parts = " ".join(
+                        f"{k}: {v:.4f}" for k, v in logs.items()
+                        if k.startswith("val_")
+                    )
+                    print(f"  validation: {parts}")
+            history.append(logs)
+            for cb in callbacks:
+                if cb.on_epoch_end(epoch, logs) is False:
+                    stop = True
+            if ckpt_mgr is not None and (
+                (epoch + 1) % max(1, checkpoint_every) == 0
+                or epoch == epochs - 1 or stop
+            ):
+                ckpt_mgr.save(epoch, self)
+            if stop:
+                break
+        for cb in callbacks:
+            cb.on_train_end()
+        if steps_done == 0:
+            return history
+        float(loss)  # readback fence before reading the clock
+        elapsed = time.perf_counter() - (t_start or time.perf_counter())
+        if steps_done > steps_at_t0 and elapsed > 0:
+            thr = (steps_done - steps_at_t0) * batch_size / elapsed
+            if verbose:
+                print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thr:.2f} samples/s")
+            self.last_throughput = thr
+        if profiler is not None:
+            self._report_profile(profiler, verbose)
+        return history
+
+    def _report_profile(self, profiler, verbose: bool) -> None:
+        """Step-profile reporting through the obs metrics registry +
+        event bus (replacing the ad-hoc ``print(f"PROFILE ...")``-only
+        path), plus the predicted-vs-measured DriftReport when
+        compile() recorded a prediction."""
+        from flexflow_tpu.obs.drift import build_drift_report
+        from flexflow_tpu.obs.events import BUS
+        from flexflow_tpu.obs.metrics import METRICS
+
+        s = profiler.summary()
+        if s.get("steps") and not s.get("includes_compile"):
+            # compile-contaminated stats stay out of the registry the
+            # same way the drift path declines them — a gauge has no
+            # honesty flag to carry the caveat
+            METRICS.gauge("fit.step_mean_s").set(s["mean_s"])
+            METRICS.gauge("fit.step_p95_s").set(s["p95_s"])
+            METRICS.counter("fit.steps").inc(int(s["steps"]))
+            hist = METRICS.histogram("fit.step_s")
+            for t in profiler.step_times[1:]:
+                hist.observe(t)
+        BUS.emit("profile.summary", **s)
+        if verbose:
+            print(f"PROFILE {profiler}")
+        pred = getattr(self, "predicted_breakdown", None)
+        if not pred or not s.get("steps") or s.get("includes_compile"):
+            # a compile-only measurement would compare apples to the
+            # compile step; decline rather than report fiction
+            return
+        report = build_drift_report(
+            pred,
+            measured_step_s=s["mean_s"],
+            measured_phases=profiler.phase_summary(),
+            threshold=self.config.drift_threshold,
+            calibrated=bool(pred.get("calibrated")),
+        )
+        if report is None:
+            return
+        self.drift_report = report
+        BUS.emit("drift.report", **report.to_dict())
+        METRICS.gauge("fit.drift_ratio").set(report.ratio)
+        if report.calibration_stale:
+            BUS.emit("calibration.staleness", ratio=report.ratio,
+                     threshold=report.threshold)
+            from flexflow_tpu.utils.logging import SEARCH_LOG
+
+            lo = 1.0 / (1.0 + report.threshold)
+            hi = 1.0 + report.threshold
+            SEARCH_LOG.log(
+                f"calibration staleness: measured step is "
+                f"{report.ratio:.2f}x the calibrated prediction, "
+                f"outside [{lo:.2f}x, {hi:.2f}x]"
+            )
+            # mark the persisted TABLE stale so the next
+            # optimize_strategy re-probes the drifted records
+            # automatically (driver re-probe policy) instead of ranking
+            # with measurements execution just falsified
+            if self.config.calibration_file:
+                from flexflow_tpu.search.calibration import (
+                    CalibrationTable,
+                )
+
+                if CalibrationTable.mark_stale_file(
+                        self.config.calibration_file, report.ratio):
+                    SEARCH_LOG.log(
+                        f"calibration table "
+                        f"{self.config.calibration_file} marked stale: "
+                        f"the next search re-probes it on the modeled "
+                        f"backend (or falls back to the roofline)"
+                    )
+            # a stale table must also stop seeding future searches: mark
+            # the persistent cost cache, which then refuses to serve its
+            # rows/results until a recalibration rotates the signature
+            from flexflow_tpu.search.cost_cache import (
+                mark_calibration_stale,
+                resolve_cost_cache_path,
+            )
+
+            cache_path = resolve_cost_cache_path(self.config)
+            if cache_path and mark_calibration_stale(cache_path):
+                SEARCH_LOG.log(
+                    f"cost cache {cache_path} marked calibration-stale: "
+                    f"recalibrate or pass --no-cost-cache"
+                )
+        elif report.calibrated and self.config.calibration_file:
+            # drift cleared on a calibrated fit: reset the persisted
+            # staleness state and the auto-re-probe allowance, so the
+            # driver's re-probe cap only counts CONSECUTIVE failures
+            from flexflow_tpu.search.calibration import CalibrationTable
+
+            CalibrationTable.mark_healthy_file(self.config.calibration_file)
+        if verbose:
+            print(f"DRIFT {report}")
+        if self.config.export_strategy_file:
+            from flexflow_tpu.search.strategy_io import attach_meta
+
+            try:
+                attach_meta(self.config.export_strategy_file,
+                            drift=report.to_dict())
+            except (OSError, ValueError):
+                pass
+        BUS.flush()  # writes are block-buffered; a fit boundary is
+        # where tooling tails the log
+
+    def evaluate(self, x=None, y=None, batch_size: Optional[int] = None):
+        """reference: flexflow_cffi.py:1876 eval."""
+        from flexflow_tpu.runtime.dataloader import SingleDataLoader
+
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        batch_size = batch_size or self.config.batch_size
+        loader = SingleDataLoader(
+            self.compiled, [np.asarray(a) for a in xs], np.asarray(y),
+            batch_size, shuffle=False,
+        )
+        metrics = PerfMetrics()
+        total_loss, batches = 0.0, 0
+        for inputs, labels in loader:
+            loss, m = self.compiled.eval_step(
+                self.params, self.state, inputs, labels
+            )
+            total_loss += float(loss)
+            batches += 1
+            metrics.update(m)
+        rep = metrics.report()
+        if batches:  # equal-sized batches: mean of batch means is exact
+            rep["loss"] = total_loss / batches
+        return rep
+
+    def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        """Batched forward pass: one output row per input row (a short
+        tail batch is padded to batch_size and trimmed — the compiled
+        program has static shapes).  The inference verb pairing with
+        compile(comp_mode='inference'); reference models predict via
+        their eval path only."""
+        assert self.compiled is not None, "call compile() first"
+        batch_size = batch_size or self.config.batch_size
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        xs = [np.asarray(a) for a in xs]
+        n = xs[0].shape[0]
+        fwd = self.compiled.forward_fn()
+        outs = []
+        for i in range(0, n, batch_size):
+            batch = [a[i:i + batch_size] for a in xs]
+            got = batch[0].shape[0]
+            if got < batch_size:
+                batch = [
+                    np.concatenate(
+                        [b, np.repeat(b[-1:], batch_size - got, axis=0)],
+                        axis=0,
+                    )
+                    for b in batch
+                ]
+            y = np.asarray(fwd(self.params, self.state, batch))
+            outs.append(y[:got])
+        if outs:
+            return np.concatenate(outs, axis=0)
+        import jax
+
+        zero_batch = [
+            jax.ShapeDtypeStruct((batch_size,) + a.shape[1:], a.dtype)
+            for a in xs
+        ]
+        spec = jax.eval_shape(fwd, self.params, self.state, zero_batch)
+        return np.empty((0,) + tuple(spec.shape[1:]), spec.dtype)
+
+    # ------------------------------------------------------------------
+    def get_weight(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
+        """reference: ParallelTensorBase::get_tensor (parallel_tensor.h:157)."""
+        return np.asarray(self.params[op_name][weight_name])
+
+    def set_weight(self, op_name: str, weight_name: str, value: np.ndarray) -> None:
+        import jax
+
+        old = self.params[op_name][weight_name]
+        assert tuple(old.shape) == tuple(value.shape)
+        self.params[op_name][weight_name] = jax.device_put(
+            value.astype(old.dtype), old.sharding
+        )
+
+    def set_state_var(self, key: str, value: np.ndarray) -> None:
+        """Overwrite one model-state entry (e.g. a batch-norm running
+        statistic, key ``"<op>/running_mean"``)."""
+        import jax
+
+        old = self.state[key]
+        assert tuple(old.shape) == tuple(value.shape), (key, old.shape, value.shape)
+        self.state[key] = jax.device_put(value.astype(old.dtype), old.sharding)

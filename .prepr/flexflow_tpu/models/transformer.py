@@ -1,0 +1,108 @@
+"""Transformer/BERT-style encoder (reference:
+examples/cpp/Transformer/transformer.cc:112-211 — 12 layers of
+MultiHeadAttention + 2-layer FFN with residuals; the OSDI'22 BERT
+benchmark config is batch 8, seq 512, hidden 768, 12 heads).
+
+TPU-native extras over the reference: optional causal masking, flash
+attention (Pallas), and the sequence dim is partitionable (ring/context
+parallelism — the reference cannot split MHA's seq dim, SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import FFModel
+
+
+def encoder_layer(model, t, hidden, num_heads, ff_dim, name, dropout=0.1,
+                  layer_norm=True, causal=False, sp_mode="ring"):
+    """reference: transformer.cc create_attention_encoder.
+    ``sp_mode`` picks the sequence-parallel scheme serving seq-sharded
+    strategies (ops/attention.py: ring | ulysses | auto)."""
+    a = model.multihead_attention(
+        t, t, t, embed_dim=hidden, num_heads=num_heads, dropout=dropout,
+        causal=causal, sp_mode=sp_mode, name=f"{name}_mha",
+    )
+    t = model.add(a, t, name=f"{name}_res1")
+    if layer_norm:
+        t = model.layer_norm(t, name=f"{name}_ln1")
+    f = model.dense(t, ff_dim, activation="relu", name=f"{name}_ff1")
+    f = model.dense(f, hidden, name=f"{name}_ff2")
+    t = model.add(f, t, name=f"{name}_res2")
+    if layer_norm:
+        t = model.layer_norm(t, name=f"{name}_ln2")
+    return t
+
+
+def build_transformer(config: FFConfig, num_layers: int = 12, hidden: int = 512,
+                      num_heads: int = 8, ff_dim: int = 2048, seq_len: int = 512,
+                      dropout: float = 0.0, layer_norm: bool = False,
+                      causal: bool = False, dtype: str = "float32",
+                      sp_mode: str = "ring"):
+    """The reference Transformer example: raw float inputs [B, S, H],
+    per-position dense head back to hidden (transformer.cc:112-211 uses
+    no embedding/LN — dense proxies).
+
+    ``dtype`` sets the activation-stream dtype: ops cast their outputs
+    back to their input dtype, so a "bfloat16" input tensor keeps every
+    inter-op activation at 2 bytes (half the HBM traffic of the default
+    float32 stream) while matmuls still accumulate in f32."""
+    model = FFModel(config)
+    b = config.batch_size
+    x = model.create_tensor([b, seq_len, hidden], dtype=dtype, name="tokens")
+    t = x
+    for i in range(num_layers):
+        t = encoder_layer(model, t, hidden, num_heads, ff_dim, f"layer{i}",
+                          dropout=dropout, layer_norm=layer_norm,
+                          causal=causal, sp_mode=sp_mode)
+    t = model.dense(t, hidden, name="head")
+    return model
+
+
+def build_bert(config: FFConfig, vocab: int = 30522, num_layers: int = 12,
+               hidden: int = 768, num_heads: int = 12, ff_dim: int = 3072,
+               seq_len: int = 512, num_classes: int = 2, dropout: float = 0.1):
+    """BERT-base-style classifier: token embedding + encoder stack +
+    pooled classification head (the osdi22ae bert.sh scenario)."""
+    model = FFModel(config)
+    b = config.batch_size
+    ids = model.create_tensor([b, seq_len], dtype="int32", name="input_ids")
+    t = model.embedding(ids, vocab, hidden, aggr="none", name="tok_embed")
+    t = model.layer_norm(t, name="embed_ln")
+    for i in range(num_layers):
+        t = encoder_layer(model, t, hidden, num_heads, ff_dim, f"layer{i}",
+                          dropout=dropout, layer_norm=True)
+    t = model.mean(t, dims=[1], name="pool")  # mean-pool over seq
+    t = model.dense(t, hidden, activation="tanh", name="pooler")
+    t = model.dense(t, num_classes, name="classifier")
+    return model
+
+
+def build_gpt(config: FFConfig, vocab: int = 32000, num_layers: int = 12,
+              hidden: int = 768, num_heads: int = 12, ff_dim: int = 3072,
+              seq_len: int = 1024, dropout: float = 0.0):
+    """GPT-style causal language model: token + learned positional
+    embeddings, post-LN causal encoder stack (the zoo's shared
+    encoder_layer), untied vocab head;
+    trains with per-token sparse CCE on shifted targets.  Beyond the
+    reference zoo (its Transformer example is a non-causal MSE proxy,
+    transformer.cc:112-211); the causal MHA takes the flash/ring
+    attention paths, so the seq dim is partitionable for long-context
+    training (zigzag ring — parallel/ring_attention.py)."""
+    model = FFModel(config)
+    b = config.batch_size
+    ids = model.create_tensor([b, seq_len], dtype="int32", name="input_ids")
+    t = model.embedding(ids, vocab, hidden, aggr="none", name="tok_embed")
+    pos = model.create_constant(
+        np.arange(seq_len, dtype=np.int32)[None, :].repeat(b, axis=0),
+        name="positions",
+    )
+    p = model.embedding(pos, seq_len, hidden, aggr="none", name="pos_embed")
+    t = model.add(t, p, name="embed_sum")
+    for i in range(num_layers):
+        t = encoder_layer(model, t, hidden, num_heads, ff_dim, f"layer{i}",
+                          dropout=dropout, layer_norm=True, causal=True)
+    t = model.layer_norm(t, name="final_ln")
+    t = model.dense(t, vocab, use_bias=False, name="lm_head")
+    return model

@@ -1,0 +1,37 @@
+"""Unified observability for the search/compile/runtime stack.
+
+Three pieces, all stdlib-light so ``import flexflow_tpu.obs`` stays
+cheap and tooling (tools/ffobs.py) can read artifacts without jax:
+
+* ``events`` — a structured-event bus with a JSONL sink.  Gated by
+  ``FLEXFLOW_TPU_OBS=<path>`` or ``FFConfig.obs_log_file``; every
+  ``emit()`` is a single boolean check when disabled, so the
+  instrumented hot paths (search candidate loops, fit steps) pay
+  near-zero overhead off.
+* ``metrics`` — an in-process registry of counters/gauges/histograms
+  (DP memo hit rates, substitution match counts, fit step stats) that
+  replaces ad-hoc ``print(f"PROFILE ...")`` reporting.
+* ``trace``/``drift`` — Chrome-trace (Perfetto-loadable) export of the
+  SIMULATED task timeline, and ``DriftReport``: predicted-vs-measured
+  step-time comparison that flags calibration staleness.
+
+The reference has no analogue (its search logs through
+RecursiveLogger only); GSPMD-style sharding-decision introspection and
+predicted-timeline artifacts are what operators actually debug with.
+"""
+
+from flexflow_tpu.obs.drift import DriftReport, build_drift_report  # noqa: F401
+from flexflow_tpu.obs.events import BUS, EventBus, validate_event  # noqa: F401
+from flexflow_tpu.obs.metrics import METRICS, MetricsRegistry  # noqa: F401
+from flexflow_tpu.obs.trace import write_chrome_trace  # noqa: F401
+
+__all__ = [
+    "BUS",
+    "EventBus",
+    "METRICS",
+    "MetricsRegistry",
+    "DriftReport",
+    "build_drift_report",
+    "validate_event",
+    "write_chrome_trace",
+]

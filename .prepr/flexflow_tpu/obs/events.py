@@ -1,0 +1,179 @@
+"""Structured-event bus: JSONL sink, env/FFConfig-gated.
+
+Every event is one JSON object per line with at least ``ts`` (unix
+seconds, float) and ``kind`` (a registered name from EVENT_KINDS);
+kind-specific required payload fields are declared alongside so tests
+and ``tools/ffobs.py validate`` can check emitted logs mechanically.
+
+Disabled (the default) the bus costs ONE attribute check per emit —
+instrumentation stays in the hot search loops without a measurable
+tax.  Enable with ``FLEXFLOW_TPU_OBS=/path/to/log.jsonl`` (read at
+import; ``BUS.configure`` re-arms at any time) or
+``FFConfig.obs_log_file`` (applied by ``FFModel.compile``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+SCHEMA_VERSION = 1
+
+# kind -> payload fields that must be present (beyond ts/kind).
+# Extra fields are always allowed; the schema floors, not ceilings.
+EVENT_KINDS = {
+    # bus lifecycle
+    "obs.meta": {"schema", "pid"},
+    # search layer (search/driver.py)
+    "search.begin": {"nodes", "devices"},
+    "search.baseline": {"cost_s"},
+    "search.substitution": {"xfer", "action"},
+    "search.candidate": {"cost_s", "best_s", "improved"},
+    "search.split": {"op", "pre_nodes", "post_nodes"},
+    "search.floor": {"kept_dp", "dp_cost_s", "searched_cost_s"},
+    "search.result": {"cost_s", "rewritten"},
+    "search.perf": {"search_seconds", "calibration_seconds", "full_sims",
+                    "delta_sims"},
+    "search.log": {"msg"},
+    # DP inner loop (search/dp.py)
+    "dp.split": {"op", "pre_nodes", "post_nodes", "cost_s"},
+    "dp.summary": {"memo_hits", "memo_misses"},
+    # calibration / cost-model provenance
+    "calibration.ignored": {"backend", "machine"},
+    "calibration.staleness": {"ratio", "threshold"},
+    # the automatic re-probe policy acting on a drift-stale table:
+    # deferred=False re-probed on the live backend, True fell back to
+    # the roofline (live backend cannot probe for the machine model)
+    "calibration.reprobe": {"backend", "deferred"},
+    # compile-time strategy explanation (model.py)
+    "strategy.table": {"rows"},
+    # static analysis (flexflow_tpu/analysis): one event per finding —
+    # "pass" is the producing pass (invariants/sharding/equivalence/
+    # strategy), "code" the stable finding code (PCG0xx/SHD1xx/…)
+    "analysis.finding": {"pass", "code"},
+    # runtime (model.fit / runtime/profiler.py)
+    "profile.summary": {"steps"},
+    "drift.report": {"predicted_s", "measured_s", "ratio", "stale"},
+    "metrics.snapshot": {"counters"},
+}
+
+_VALID_ACTIONS = frozenset(
+    {"pushed", "pruned", "duplicate", "invalid", "pinned"}
+)
+
+
+def validate_event(obj) -> List[str]:
+    """Schema errors for one decoded JSONL event ([] = valid)."""
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return ["event is not a JSON object"]
+    ts = obj.get("ts")
+    if not isinstance(ts, (int, float)):
+        errors.append("missing/non-numeric 'ts'")
+    kind = obj.get("kind")
+    if not isinstance(kind, str) or not kind:
+        errors.append("missing 'kind'")
+        return errors
+    required = EVENT_KINDS.get(kind)
+    if required is None:
+        errors.append(f"unknown kind {kind!r}")
+        return errors
+    for field in required:
+        if field not in obj:
+            errors.append(f"{kind}: missing field {field!r}")
+    if kind == "search.substitution" and obj.get("action") not in _VALID_ACTIONS:
+        errors.append(
+            f"search.substitution: action {obj.get('action')!r} not in "
+            f"{sorted(_VALID_ACTIONS)}"
+        )
+    return errors
+
+
+class EventBus:
+    """Append-only JSONL event sink.  Thread-safe; ``enabled`` is a
+    plain attribute so the disabled fast path is one load + branch."""
+
+    def __init__(self):
+        self.enabled = False
+        self.path: Optional[str] = None
+        self._sink = None
+        self._lock = threading.Lock()
+        self._atexit_armed = False
+
+    # ------------------------------------------------------------------
+    def configure(self, path: str) -> None:
+        """Open (or switch to) a JSONL sink at ``path`` and enable the
+        bus.  Idempotent for a repeated identical path.  Writes are
+        block-buffered (a per-event flush syscall would tax the chatty
+        per-candidate search events); an atexit hook drains the buffer
+        on normal interpreter exit, and flush()/close() do so on
+        demand."""
+        with self._lock:
+            if not self._atexit_armed:
+                atexit.register(self.flush)
+                self._atexit_armed = True
+            if self._sink is not None and self.path == path:
+                self.enabled = True
+                return
+            if self._sink is not None:
+                self._sink.close()
+            self._sink = open(path, "a")
+            self.path = path
+            self.enabled = True
+        self.emit("obs.meta", schema=SCHEMA_VERSION, pid=os.getpid())
+
+    def close(self) -> None:
+        with self._lock:
+            self.enabled = False
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+            self.path = None
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.flush()
+
+    # ------------------------------------------------------------------
+    def emit(self, kind: str, **payload) -> None:
+        if not self.enabled:
+            return
+        evt = {"ts": time.time(), "kind": kind}
+        evt.update(payload)
+        try:
+            line = json.dumps(evt, default=_jsonable)
+        except (TypeError, ValueError):  # never let telemetry crash work
+            line = json.dumps({"ts": evt["ts"], "kind": kind,
+                               "error": "unserializable payload"})
+        with self._lock:
+            if self._sink is not None:
+                self._sink.write(line + "\n")
+
+
+def _jsonable(obj):
+    """Best-effort coercion for payload values (numpy scalars, views).
+    ``tolist`` first: ``item()`` raises on arrays with size != 1."""
+    for attr in ("tolist", "item"):
+        fn = getattr(obj, attr, None)
+        if fn is not None:
+            try:
+                return fn()
+            except (TypeError, ValueError):
+                continue
+    return repr(obj)
+
+
+BUS = EventBus()
+
+_env = os.environ.get("FLEXFLOW_TPU_OBS", "")
+if _env and _env != "0":
+    try:
+        BUS.configure(_env if _env not in ("1", "true") else "ffobs.jsonl")
+    except OSError:  # unwritable path must not break imports
+        pass
+del _env

@@ -1,0 +1,242 @@
+"""Conv2D and Pool2D — NHWC, the TPU-native layout.
+
+Reference: src/ops/conv_2d.{cc,cu} (cuDNN NCHW), src/ops/pool_2d.*.
+Here a conv is one ``lax.conv_general_dilated`` in NHWC/HWIO; XLA maps
+it onto the MXU and — when a spatial dim is partitioned — inserts halo
+exchanges (the "attribute parallelism" of OptCNN/`--enable-attribute-
+parallel`, reference: config.h:135, comes for free from GSPMD).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import DataType, ParallelTensorShape
+from flexflow_tpu.initializers import (
+    DEFAULT_BIAS_INIT,
+    DEFAULT_WEIGHT_INIT,
+    Initializer,
+)
+from flexflow_tpu.ops.base import (
+    REPLICA_SLOT,
+    LoweringContext,
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    WeightSpec,
+    register_op,
+)
+
+_ACT = {
+    None: lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=True),
+}
+
+
+def _check_activation(op_name: str, activation) -> None:
+    if activation not in _ACT:
+        raise NotImplementedError(
+            f"{op_name} activation {activation!r} not supported; "
+            f"one of {sorted(k for k in _ACT if k)}"
+        )
+
+
+def _out_size(size: int, kernel: int, stride: int, pad: int) -> int:
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+@register_op
+class Conv2DOp(Operator):
+    """Input [N, H, W, Cin] -> output [N, Ho, Wo, Cout].
+
+    attrs: out_channels, kernel_h/w, stride_h/w, padding_h/w, groups,
+    activation, use_bias (reference ctor: conv_2d.cc FFModel::conv2d).
+    """
+
+    op_type = OperatorType.CONV2D
+
+    def __init__(
+        self,
+        name,
+        input_shapes,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int = 1,
+        stride_w: int = 1,
+        padding_h: int = 0,
+        padding_w: int = 0,
+        groups: int = 1,
+        activation: str | None = None,
+        use_bias: bool = True,
+        kernel_initializer: Initializer | None = None,
+        bias_initializer: Initializer | None = None,
+    ):
+        # validate at BUILD time: an unsupported fused activation must
+        # fail when the graph is constructed, not as a KeyError
+        # mid-training — and survive `python -O` (a bare assert would
+        # not), with the exception type frontends advertise
+        _check_activation(type(self).__name__, activation)
+        self._kernel_init = kernel_initializer or DEFAULT_WEIGHT_INIT
+        self._bias_init = bias_initializer or DEFAULT_BIAS_INIT
+        super().__init__(
+            name,
+            input_shapes,
+            out_channels=out_channels,
+            kernel_h=kernel_h,
+            kernel_w=kernel_w,
+            stride_h=stride_h,
+            stride_w=stride_w,
+            padding_h=padding_h,
+            padding_w=padding_w,
+            groups=groups,
+            activation=activation,
+            use_bias=use_bias,
+        )
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        n, h, w, c = self.input_shapes[0].sizes
+        a = self.attrs
+        assert c % a["groups"] == 0 and a["out_channels"] % a["groups"] == 0
+        ho = _out_size(h, a["kernel_h"], a["stride_h"], a["padding_h"])
+        wo = _out_size(w, a["kernel_w"], a["stride_w"], a["padding_w"])
+        return (
+            ParallelTensorShape.make(
+                (n, ho, wo, a["out_channels"]), self.input_shapes[0].dtype
+            ),
+        )
+
+    def weight_specs(self) -> Sequence[WeightSpec]:
+        a = self.attrs
+        cin = self.input_shapes[0].sizes[-1]
+        specs = [
+            WeightSpec(
+                "kernel",
+                (a["kernel_h"], a["kernel_w"], cin // a["groups"], a["out_channels"]),
+                DataType.FLOAT32,
+                self._kernel_init,
+            )
+        ]
+        if a["use_bias"]:
+            specs.append(
+                WeightSpec("bias", (a["out_channels"],), DataType.FLOAT32, self._bias_init)
+            )
+        return specs
+
+    def forward(self, ctx: LoweringContext, inputs, weights):
+        a = self.attrs
+        x = inputs[0].astype(ctx.compute_dtype)
+        k = weights["kernel"].astype(ctx.compute_dtype)
+        # no preferred_element_type: its transpose rule rejects the mixed
+        # bf16/fp32 cotangent; the MXU still accumulates in fp32 before
+        # rounding the output to the compute dtype
+        y = jax.lax.conv_general_dilated(
+            x,
+            k,
+            window_strides=(a["stride_h"], a["stride_w"]),
+            padding=((a["padding_h"], a["padding_h"]), (a["padding_w"], a["padding_w"])),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=a["groups"],
+        ).astype(jnp.float32)
+        if a["use_bias"]:
+            y = y + weights["bias"].astype(jnp.float32)
+        y = _ACT[a["activation"]](y)
+        return [y.astype(inputs[0].dtype)]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        n, h, w, co = mv.dim_degrees
+        r = mv.replica_degree  # in-channel split -> partial sums
+        x_annot = ShardAnnot((n, h, w, r), replica=co, idx=(0, 1, 2, REPLICA_SLOT))
+        out = ShardAnnot(mv.dim_degrees, replica=r, partial=r > 1)
+        wk = ShardAnnot((1, 1, r, co), replica=n * h * w, idx=(-1, -1, REPLICA_SLOT, 3))
+        ws = [wk]
+        if self.attrs["use_bias"]:
+            ws.append(ShardAnnot((co,), replica=n * h * w * r, idx=(3,)))
+        return OpSharding(inputs=(x_annot,), weights=tuple(ws), outputs=(out,))
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return (0, 1, 2, 3)  # sample, both spatial (OptCNN), out-channel
+
+    def max_replica_degree(self) -> int:
+        return self.input_shapes[0].sizes[-1] // self.attrs["groups"]
+
+    def flops(self) -> float:
+        a = self.attrs
+        out = self.output_shapes[0]
+        cin = self.input_shapes[0].sizes[-1]
+        return 2.0 * out.num_elements * a["kernel_h"] * a["kernel_w"] * cin / a["groups"]
+
+
+@register_op
+class Pool2DOp(Operator):
+    """attrs: kernel_h/w, stride_h/w, padding_h/w, pool_type (max|avg),
+    activation. Reference: src/ops/pool_2d.cc."""
+
+    op_type = OperatorType.POOL2D
+
+    def __init__(
+        self,
+        name,
+        input_shapes,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int = 1,
+        stride_w: int = 1,
+        padding_h: int = 0,
+        padding_w: int = 0,
+        pool_type: str = "max",
+        activation: str | None = None,
+    ):
+        if pool_type not in ("max", "avg"):
+            raise NotImplementedError(f"pool_type {pool_type!r}")
+        _check_activation(type(self).__name__, activation)
+        super().__init__(
+            name,
+            input_shapes,
+            kernel_h=kernel_h,
+            kernel_w=kernel_w,
+            stride_h=stride_h,
+            stride_w=stride_w,
+            padding_h=padding_h,
+            padding_w=padding_w,
+            pool_type=pool_type,
+            activation=activation,
+        )
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        n, h, w, c = self.input_shapes[0].sizes
+        a = self.attrs
+        ho = _out_size(h, a["kernel_h"], a["stride_h"], a["padding_h"])
+        wo = _out_size(w, a["kernel_w"], a["stride_w"], a["padding_w"])
+        return (ParallelTensorShape.make((n, ho, wo, c), self.input_shapes[0].dtype),)
+
+    def forward(self, ctx, inputs, weights):
+        a = self.attrs
+        x = inputs[0]
+        window = (1, a["kernel_h"], a["kernel_w"], 1)
+        strides = (1, a["stride_h"], a["stride_w"], 1)
+        pads = ((0, 0), (a["padding_h"], a["padding_h"]), (a["padding_w"], a["padding_w"]), (0, 0))
+        if a["pool_type"] == "max":
+            y = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides, pads)
+        else:
+            s = jax.lax.reduce_window(
+                x.astype(jnp.float32), 0.0, jax.lax.add, window, strides, pads
+            )
+            y = (s / (a["kernel_h"] * a["kernel_w"])).astype(x.dtype)
+        y = _ACT[a["activation"]](y)
+        return [y]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        a = ShardAnnot(mv.dim_degrees, mv.replica_degree)
+        return OpSharding(inputs=(a,), weights=(), outputs=(a,))
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return (0, 1, 2, 3)

@@ -1,0 +1,139 @@
+"""Elementwise unary/binary operators.
+
+Reference: src/ops/element_unary.{cc,cu}, src/ops/element_binary.{cc,cu}.
+On TPU these are VPU ops that XLA fuses into neighbouring matmuls —
+there is deliberately no kernel here, just the math; any dim may be
+partitioned (reference allows the same, ffconst.h unary/binary set).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import ParallelTensorShape
+from flexflow_tpu.ops.base import (
+    LoweringContext,
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    register_op,
+)
+
+_UNARY_FNS = {
+    OperatorType.RELU: jax.nn.relu,
+    OperatorType.SIGMOID: jax.nn.sigmoid,
+    OperatorType.TANH: jnp.tanh,
+    OperatorType.ELU: jax.nn.elu,
+    OperatorType.GELU: lambda x: jax.nn.gelu(x, approximate=True),
+    OperatorType.EXP: jnp.exp,
+    OperatorType.LOG: jnp.log,
+    OperatorType.IDENTITY: lambda x: x,
+    OperatorType.RSQRT: jax.lax.rsqrt,
+}
+
+_SCALAR_FNS = {
+    OperatorType.POW: lambda x, s: jnp.power(x, s),
+    OperatorType.SCALAR_ADD: lambda x, s: x + s,
+    OperatorType.SCALAR_SUB: lambda x, s: x - s,
+    OperatorType.SCALAR_MUL: lambda x, s: x * s,
+    OperatorType.SCALAR_TRUE_DIV: lambda x, s: x / s,
+}
+
+_BINARY_FNS = {
+    OperatorType.EW_ADD: jnp.add,
+    OperatorType.EW_SUB: jnp.subtract,
+    OperatorType.EW_MUL: jnp.multiply,
+    OperatorType.EW_DIV: jnp.divide,
+    OperatorType.EW_MAX: jnp.maximum,
+    OperatorType.EW_MIN: jnp.minimum,
+}
+
+
+class ElementUnaryOp(Operator):
+    """attrs: unary_type (OperatorType), scalar (float, for scalar ops),
+    inplace hint (reference: model.cc:2668-2701 can_inplace)."""
+
+    op_type = OperatorType.IDENTITY  # refined per-instance via attrs
+
+    def __init__(self, name, input_shapes, unary_type: OperatorType,
+                 scalar: float = 0.0, approximate: bool = True):
+        self.op_type = unary_type
+        # ``approximate`` only affects GELU: the tanh approximation is
+        # the TPU-friendly default, but imported models (tf.keras /
+        # torch both default to the exact erf form) need bit-parity
+        # with their source.  It joins the op SIGNATURE only for GELU —
+        # stamping it on every unary op would silently invalidate all
+        # persisted calibration records for them (signature() includes
+        # attrs).
+        extra = (
+            {"approximate": approximate}
+            if unary_type is OperatorType.GELU else {}
+        )
+        super().__init__(name, input_shapes, unary_type=unary_type.value,
+                         scalar=scalar, **extra)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self.input_shapes[0],)
+
+    def forward(self, ctx, inputs, weights):
+        t = OperatorType(self.attrs["unary_type"])
+        x = inputs[0]
+        if t in _SCALAR_FNS:
+            return [_SCALAR_FNS[t](x, self.attrs["scalar"])]
+        if t is OperatorType.GELU:
+            return [jax.nn.gelu(x, approximate=bool(
+                self.attrs.get("approximate", True)))]
+        return [_UNARY_FNS[t](x)]
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+
+class ElementBinaryOp(Operator):
+    """Numpy-broadcasting binary op (reference: element_binary.cc)."""
+
+    op_type = OperatorType.EW_ADD
+
+    def __init__(self, name, input_shapes, binary_type: OperatorType):
+        self.op_type = binary_type
+        super().__init__(name, input_shapes, binary_type=binary_type.value)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        a, b = self.input_shapes
+        out = jnp.broadcast_shapes(a.sizes, b.sizes)
+        return (ParallelTensorShape.make(out, a.dtype),)
+
+    def forward(self, ctx, inputs, weights):
+        t = OperatorType(self.attrs["binary_type"])
+        return [_BINARY_FNS[t](inputs[0], inputs[1])]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        out_sizes = self.output_shapes[0].sizes
+        out_nd = len(out_sizes)
+        ins = []
+        for s in self.input_shapes:
+            degs = [1] * s.ndim
+            idx = [-1] * s.ndim
+            # align from the right (numpy broadcasting)
+            for i in range(1, s.ndim + 1):
+                if s.sizes[-i] == out_sizes[-i]:
+                    degs[-i] = mv.dim_degrees[-i]
+                    idx[-i] = out_nd - i
+            ins.append(ShardAnnot(tuple(degs), mv.replica_degree, idx=tuple(idx)))
+        return OpSharding(
+            inputs=tuple(ins),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+
+register_op(ElementUnaryOp)
+register_op(ElementBinaryOp)

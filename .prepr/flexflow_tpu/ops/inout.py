@@ -1,0 +1,125 @@
+"""Input / Weight / NoOp sentinel operators.
+
+Reference: src/ops/noop.cc (OP_INPUT/OP_WEIGHT/OP_NOOP with
+input_tensor_guid used to match frontend tensors, graph.cc:1639-1648).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import ParallelTensorShape
+from flexflow_tpu.ops.base import (
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    register_op,
+)
+
+
+@register_op
+class InputOp(Operator):
+    """Graph source holding a batch input. ``tensor_guid`` links back to
+    the frontend Tensor so compile can bind feed arrays by position."""
+
+    op_type = OperatorType.INPUT
+    is_gradient_free = True
+
+    def __init__(self, name, shape: ParallelTensorShape, tensor_guid: int = -1):
+        self._shape = shape.drop_parallelism()
+        super().__init__(name, [], tensor_guid=tensor_guid)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self._shape,)
+
+    def forward(self, ctx, inputs, weights):
+        raise RuntimeError("InputOp is bound by the executor, never lowered")
+
+    def signature(self) -> Tuple:
+        return (
+            self.op_type.value,
+            self._shape.sizes,
+            self._shape.dtype.value,
+            self.attrs["tensor_guid"],
+        )
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        return OpSharding(
+            inputs=(),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return (0,) if self._shape.ndim else ()
+
+
+@register_op
+class ConstantOp(Operator):
+    """Compile-time constant tensor — e.g. position ids an imported
+    frontend graph carries as a module buffer (transformers BERT traces
+    `embeddings.position_ids` as get_attr).  The reference has no
+    direct analogue (constants live in Legion regions initialized
+    host-side); here the value is baked into the program and XLA
+    constant-folds around it."""
+
+    op_type = OperatorType.CONSTANT
+    is_gradient_free = True
+
+    def __init__(self, name, shape: ParallelTensorShape, value=None):
+        import numpy as np
+
+        self._shape = shape.drop_parallelism()
+        self._value = np.asarray(value)
+        # attrs keep a hashable fingerprint, not the payload: signatures
+        # and strategy export stay small
+        import hashlib
+
+        digest = hashlib.sha1(self._value.tobytes()).hexdigest()[:16]
+        super().__init__(name, [], value_digest=digest)
+
+    @property
+    def value(self):
+        return self._value
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self._shape,)
+
+    def forward(self, ctx, inputs, weights):
+        import jax.numpy as jnp
+
+        return [jnp.asarray(self._value)]
+
+    def signature(self) -> Tuple:
+        return (
+            self.op_type.value,
+            self._shape.sizes,
+            self._shape.dtype.value,
+            self.attrs["value_digest"],
+        )
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        return OpSharding(
+            inputs=(),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return ()
+
+
+@register_op
+class NoOp(Operator):
+    op_type = OperatorType.NOOP
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self.input_shapes[0],)
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0]]
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))

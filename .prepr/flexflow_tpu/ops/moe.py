@@ -1,0 +1,221 @@
+"""Mixture-of-Experts ops: GroupBy (dispatch), Aggregate (combine),
+AggregateSpec, Cache.
+
+Reference: src/ops/{group_by,aggregate,aggregate_spec,cache}.{cc,cu} and
+examples/cpp/mixture_of_experts/moe.cc.  The reference scatters samples
+into per-expert tensors with a capacity factor alpha
+(group_by.cc, alpha = capacity factor) and places expert subgraphs on
+different devices via the search.
+
+TPU-native re-design: experts are one *batched* tensor [E, cap, D] so
+the expert dim is a real shardable dim (expert parallelism = sharding
+dim 0 over a mesh axis; the dispatch becomes an XLA all-to-all).
+Capacity padding keeps every shape static for XLA — the reference's
+dynamic max_size trick (moe recompile) becomes a plain static bound.
+Dispatch is sort-based (kernels/moe_dispatch.py): stable-sort of the
+token→expert assignment + narrow int scatter of slot indices + one wide
+row gather — the standard TPU MoE formulation (O(T log T), vs O(T·E)
+for the one-hot cumsum alternative).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import DataType, ParallelTensorShape
+from flexflow_tpu.ops.base import (
+    LoweringContext,
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    register_op,
+)
+
+
+@register_op
+class GroupByOp(Operator):
+    """(data [B, D], assign [B, K]) -> (grouped [E, cap, D],
+    expert_idx [B, K], pos [B, K], valid [B, K]).
+
+    cap = ceil(alpha * K * B / E) — alpha is the reference's capacity
+    factor (group_by.cc).  Tokens overflowing an expert's capacity are
+    dropped (valid=0), matching the reference's truncation.
+    """
+
+    op_type = OperatorType.GROUP_BY
+
+    def __init__(self, name, input_shapes, n_experts: int, alpha: float = 1.0):
+        super().__init__(name, input_shapes, n_experts=int(n_experts), alpha=float(alpha))
+
+    @property
+    def capacity(self) -> int:
+        import math
+
+        b = self.input_shapes[0].sizes[0]
+        k = self.input_shapes[1].sizes[1]
+        e = self.attrs["n_experts"]
+        return max(1, math.ceil(self.attrs["alpha"] * k * b / e))
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        data, assign = self.input_shapes
+        b, d = data.sizes
+        k = assign.sizes[1]
+        e = self.attrs["n_experts"]
+        return (
+            ParallelTensorShape.make((e, self.capacity, d), data.dtype),
+            ParallelTensorShape.make((b, k), DataType.INT32),
+            ParallelTensorShape.make((b, k), DataType.INT32),
+            ParallelTensorShape.make((b, k), data.dtype),
+        )
+
+    def forward(self, ctx: LoweringContext, inputs, weights):
+        from flexflow_tpu.kernels.moe_dispatch import moe_dispatch
+
+        data, assign = inputs
+        assign = assign.astype(jnp.int32)
+        b, k = assign.shape
+        e, cap = self.attrs["n_experts"], self.capacity
+        flat_e = assign.reshape(-1)  # [B*K] expert ids, row-major (b major)
+        src = jnp.repeat(data, k, axis=0)  # token (b,k) -> row b
+        grouped, pos_flat, valid_flat = moe_dispatch(src, flat_e, e, cap)
+        return [
+            grouped,
+            assign,
+            jnp.clip(pos_flat, 0, cap - 1).reshape(b, k).astype(jnp.int32),
+            valid_flat.reshape(b, k).astype(data.dtype),
+        ]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        e_deg, cap_deg, d_deg = mv.dim_degrees
+        assert cap_deg == 1, "capacity dim stays whole"
+        data, assign = self.input_shapes
+        b, k = assign.sizes
+        aux = ShardAnnot((1, 1), replica=mv.num_parts)
+        return OpSharding(
+            inputs=(
+                ShardAnnot((1, d_deg), replica=e_deg * mv.replica_degree, idx=(-1, 2)),
+                ShardAnnot((1, 1), replica=mv.num_parts),
+            ),
+            weights=(),
+            outputs=(
+                ShardAnnot(mv.dim_degrees, mv.replica_degree),
+                aux,
+                aux,
+                aux,
+            ),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return (0, 2)  # expert dim (EP) and feature dim
+
+
+@register_op
+class AggregateOp(Operator):
+    """(gates [B,K], expert_idx [B,K], pos [B,K], valid [B,K],
+    expert_out [E, cap, D]) -> [B, D].
+
+    Reference: src/ops/aggregate.cc (weighted combine with
+    load-balancing lambda; the balance loss is exposed via ctx state
+    as ``{name}/aux_loss``).
+    """
+
+    op_type = OperatorType.AGGREGATE
+
+    def __init__(self, name, input_shapes, lambda_bal: float = 0.0):
+        super().__init__(name, input_shapes, lambda_bal=float(lambda_bal))
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        gates = self.input_shapes[0]
+        expert_out = self.input_shapes[4]
+        b = gates.sizes[0]
+        d = expert_out.sizes[2]
+        return (ParallelTensorShape.make((b, d), expert_out.dtype),)
+
+    def forward(self, ctx: LoweringContext, inputs, weights):
+        gates, expert_idx, pos, valid, expert_out = inputs
+        rows = expert_out[expert_idx.astype(jnp.int32), pos.astype(jnp.int32)]  # [B,K,D]
+        w = (gates * valid).astype(rows.dtype)[..., None]
+        out = jnp.sum(rows * w, axis=1)
+        if self.attrs["lambda_bal"] > 0.0:
+            e = expert_out.shape[0]
+            counts = jnp.zeros((e,), jnp.float32).at[expert_idx.reshape(-1)].add(
+                valid.reshape(-1).astype(jnp.float32)
+            )
+            frac = counts / jnp.maximum(jnp.sum(counts), 1.0)
+            ctx.state_out[f"{self.name}/aux_loss"] = (
+                self.attrs["lambda_bal"] * e * jnp.sum(frac * frac)
+            )
+        return [out]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        b_deg, d_deg = mv.dim_degrees
+        parts = mv.num_parts
+        return OpSharding(
+            inputs=(
+                ShardAnnot((1, 1), replica=parts),
+                ShardAnnot((1, 1), replica=parts),
+                ShardAnnot((1, 1), replica=parts),
+                ShardAnnot((1, 1), replica=parts),
+                ShardAnnot((1, 1, d_deg), replica=parts // max(d_deg, 1), idx=(-1, -1, 1)),
+            ),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return (0, 1)
+
+
+@register_op
+class AggregateSpecOp(AggregateOp):
+    """Uniform-weight variant (reference: src/ops/aggregate_spec.cc)."""
+
+    op_type = OperatorType.AGGREGATE_SPEC
+
+    def forward(self, ctx, inputs, weights):
+        gates, expert_idx, pos, valid, expert_out = inputs
+        uniform = jnp.ones_like(gates) / gates.shape[1]
+        return super().forward(ctx, [uniform, expert_idx, pos, valid, expert_out], weights)
+
+
+@register_op
+class CacheOp(Operator):
+    """Cache a tensor across iterations (reference: src/ops/cache.cc —
+    MoE caches expert assignments; a score function drives the
+    recompile trigger, moe.cc:46-92).
+
+    attrs: use_cached — when True, forward returns the cached value
+    (state) instead of the live input; the live input always refreshes
+    the cache.  The per-iteration score (mean abs difference between
+    live and cached) is written to state as ``{name}/score``.
+    """
+
+    op_type = OperatorType.CACHE
+
+    def __init__(self, name, input_shapes, use_cached: bool = False):
+        super().__init__(name, input_shapes, use_cached=bool(use_cached))
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self.input_shapes[0],)
+
+    def state_specs(self):
+        x = self.input_shapes[0]
+        return (("cached", x.sizes, x.dtype.to_numpy(), 0.0),)
+
+    def forward(self, ctx: LoweringContext, inputs, weights):
+        x = inputs[0]
+        cached = ctx.state_in[f"{self.name}/cached"]
+        score = jnp.mean(jnp.abs(x.astype(jnp.float32) - cached.astype(jnp.float32)))
+        ctx.state_out[f"{self.name}/score"] = score
+        ctx.state_out[f"{self.name}/cached"] = x
+        if self.attrs["use_cached"]:
+            return [cached.astype(x.dtype)]
+        return [x]
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))

@@ -1,0 +1,332 @@
+"""Shape-manipulation operators: Reshape, Transpose, Flat, Reverse,
+Concat, Split, Cast.
+
+Reference: src/ops/{reshape,transpose,flat,reverse,concat,split,cast}.*.
+All are XLA-free ops (layout changes fuse away); their real job here is
+degree propagation — which partitions survive the shape change.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import DataType, ParallelTensorShape
+from flexflow_tpu.ops.base import (
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    register_op,
+)
+
+
+@register_op
+class ReshapeOp(Operator):
+    op_type = OperatorType.RESHAPE
+
+    def __init__(self, name, input_shapes, shape: Tuple[int, ...]):
+        super().__init__(name, input_shapes, shape=tuple(int(s) for s in shape))
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        tgt = list(self.attrs["shape"])
+        if -1 in tgt:
+            i = tgt.index(-1)
+            known = 1
+            for s in tgt:
+                if s != -1:
+                    known *= s
+            tgt[i] = x.num_elements // known
+        assert x.num_elements == int(jnp.prod(jnp.array(tgt))), (
+            f"reshape {x.sizes} -> {tgt}"
+        )
+        return (ParallelTensorShape.make(tuple(tgt), x.dtype),)
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0].reshape(self.output_shapes[0].sizes)]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        # Partition survives only on a leading dim of unchanged extent
+        # (reference: reshape.cc handles partition-compat the same way).
+        x = self.input_shapes[0]
+        out = self.output_shapes[0]
+        in_degs = [1] * x.ndim
+        if (
+            x.ndim
+            and out.ndim
+            and x.sizes[0] == out.sizes[0]
+        ):
+            in_degs[0] = mv.dim_degrees[0]
+        return OpSharding(
+            inputs=(ShardAnnot(tuple(in_degs), mv.replica_degree),),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        x, out = self.input_shapes[0], self.output_shapes[0]
+        if x.ndim and out.ndim and x.sizes[0] == out.sizes[0]:
+            return (0,)
+        return ()
+
+
+@register_op
+class TransposeOp(Operator):
+    op_type = OperatorType.TRANSPOSE
+
+    def __init__(self, name, input_shapes, perm: Tuple[int, ...]):
+        super().__init__(name, input_shapes, perm=tuple(int(p) for p in perm))
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        perm = self.attrs["perm"]
+        return (ParallelTensorShape.make(tuple(x.sizes[p] for p in perm), x.dtype),)
+
+    def forward(self, ctx, inputs, weights):
+        return [jnp.transpose(inputs[0], self.attrs["perm"])]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        perm = self.attrs["perm"]
+        in_degs = [1] * len(perm)
+        in_idx = [-1] * len(perm)
+        for out_i, in_i in enumerate(perm):
+            in_degs[in_i] = mv.dim_degrees[out_i]
+            in_idx[in_i] = out_i
+        return OpSharding(
+            inputs=(ShardAnnot(tuple(in_degs), mv.replica_degree, idx=tuple(in_idx)),),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+
+@register_op
+class FlatOp(Operator):
+    """[B, ...] -> [B, prod(...)] (reference: src/ops/flat.cc)."""
+
+    op_type = OperatorType.FLAT
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        feat = 1
+        for s in x.sizes[1:]:
+            feat *= s
+        return (ParallelTensorShape.make((x.sizes[0], feat), x.dtype),)
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0].reshape(self.output_shapes[0].sizes)]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        x = self.input_shapes[0]
+        in_degs = (mv.dim_degrees[0],) + (1,) * (x.ndim - 1)
+        return OpSharding(
+            inputs=(ShardAnnot(in_degs, mv.replica_degree),),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return (0,)
+
+
+@register_op
+class ReverseOp(Operator):
+    op_type = OperatorType.REVERSE
+
+    def __init__(self, name, input_shapes, axis: int):
+        super().__init__(name, input_shapes, axis=int(axis))
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self.input_shapes[0],)
+
+    def forward(self, ctx, inputs, weights):
+        return [jnp.flip(inputs[0], self.attrs["axis"])]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        degs = list(mv.dim_degrees)
+        degs[self.attrs["axis"]] = 1  # reversing a sharded dim would permute shards
+        a = ShardAnnot(tuple(degs), mv.replica_degree)
+        return OpSharding(inputs=(a,), weights=(), outputs=(a,))
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        ax = self.attrs["axis"] % self.output_shapes[0].ndim
+        return tuple(i for i in range(self.output_shapes[0].ndim) if i != ax)
+
+
+@register_op
+class ConcatOp(Operator):
+    op_type = OperatorType.CONCAT
+
+    def __init__(self, name, input_shapes, axis: int):
+        super().__init__(name, input_shapes, axis=int(axis))
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        ax = self.attrs["axis"]
+        first = self.input_shapes[0]
+        total = sum(s.sizes[ax] for s in self.input_shapes)
+        sizes = list(first.sizes)
+        sizes[ax] = total
+        return (ParallelTensorShape.make(tuple(sizes), first.dtype),)
+
+    def forward(self, ctx, inputs, weights):
+        return [jnp.concatenate(inputs, axis=self.attrs["axis"])]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        ax = self.attrs["axis"]
+        assert mv.dim_degrees[ax] == 1, "cannot partition the concat axis"
+        a = ShardAnnot(mv.dim_degrees, mv.replica_degree)
+        return OpSharding(
+            inputs=(a,) * len(self.input_shapes), weights=(), outputs=(a,)
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        ax = self.attrs["axis"] % self.output_shapes[0].ndim
+        return tuple(i for i in range(self.output_shapes[0].ndim) if i != ax)
+
+
+@register_op
+class SplitOp(Operator):
+    op_type = OperatorType.SPLIT
+
+    def __init__(self, name, input_shapes, sizes: Tuple[int, ...], axis: int):
+        super().__init__(
+            name, input_shapes, sizes=tuple(int(s) for s in sizes), axis=int(axis)
+        )
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        ax = self.attrs["axis"]
+        assert sum(self.attrs["sizes"]) == x.sizes[ax]
+        outs = []
+        for sz in self.attrs["sizes"]:
+            sizes = list(x.sizes)
+            sizes[ax] = sz
+            outs.append(ParallelTensorShape.make(tuple(sizes), x.dtype))
+        return tuple(outs)
+
+    def forward(self, ctx, inputs, weights):
+        splits = []
+        off = 0
+        for sz in self.attrs["sizes"][:-1]:
+            off += sz
+            splits.append(off)
+        return list(jnp.split(inputs[0], splits, axis=self.attrs["axis"]))
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        ax = self.attrs["axis"]
+        assert mv.dim_degrees[ax] == 1, "cannot partition the split axis"
+        a = ShardAnnot(mv.dim_degrees, mv.replica_degree)
+        return OpSharding(
+            inputs=(a,),
+            weights=(),
+            outputs=(a,) * len(self.output_shapes),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        ax = self.attrs["axis"] % self.output_shapes[0].ndim
+        return tuple(i for i in range(self.output_shapes[0].ndim) if i != ax)
+
+
+@register_op
+class CastOp(Operator):
+    op_type = OperatorType.CAST
+
+    def __init__(self, name, input_shapes, dtype):
+        super().__init__(name, input_shapes, dtype=DataType.from_any(dtype).value)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        return (ParallelTensorShape.make(x.sizes, DataType(self.attrs["dtype"])),)
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0].astype(DataType(self.attrs["dtype"]).to_numpy())]
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+
+@register_op
+class StackOp(Operator):
+    """K same-shaped inputs -> [K, ...] (TPU-native batched-branch
+    fusion feed; no reference equivalent — the reference realizes
+    branch parallelism by PLACING subgraphs on disjoint GPUs,
+    graph.cc:180-205, which GSPMD cannot express.  Stacking the
+    branches and sharding the new leading dim expresses the same
+    parallelism as pure SPMD)."""
+
+    op_type = OperatorType.STACK
+
+    def __init__(self, name, input_shapes):
+        first = input_shapes[0]
+        for s in input_shapes[1:]:
+            assert s.sizes == first.sizes and s.dtype == first.dtype
+        super().__init__(name, input_shapes)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        return (
+            ParallelTensorShape.make(
+                (len(self.input_shapes),) + x.sizes, x.dtype
+            ),
+        )
+
+    def forward(self, ctx, inputs, weights):
+        return [jnp.stack(inputs, axis=0)]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        # inputs unconstrained: GSPMD moves each branch's tensor to
+        # wherever the sharded stack places it (like parallel ops)
+        out = ShardAnnot(mv.dim_degrees, mv.replica_degree)
+        return OpSharding(
+            inputs=(None,) * len(self.input_shapes),
+            weights=(),
+            outputs=(out,),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+    def flops(self) -> float:
+        return 0.0
+
+
+@register_op
+class UnstackOp(Operator):
+    """[K, ...] -> K outputs [...] (inverse of StackOp).  The view
+    ranges over the OUTPUT dims; the branch dim is gathered."""
+
+    op_type = OperatorType.UNSTACK
+
+    def __init__(self, name, input_shapes):
+        super().__init__(name, input_shapes)
+
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        x = self.input_shapes[0]
+        k = x.sizes[0]
+        return tuple(
+            ParallelTensorShape.make(x.sizes[1:], x.dtype) for _ in range(k)
+        )
+
+    def forward(self, ctx, inputs, weights):
+        x = inputs[0]
+        return [x[i] for i in range(x.shape[0])]
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        out = ShardAnnot(mv.dim_degrees, mv.replica_degree)
+        in_a = ShardAnnot((1,) + mv.dim_degrees, mv.replica_degree)
+        return OpSharding(
+            inputs=(in_a,),
+            weights=(),
+            outputs=(out,) * len(self.output_shapes),
+        )
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+    def flops(self) -> float:
+        return 0.0

@@ -1,0 +1,23 @@
+from flexflow_tpu.parallel.mesh import (
+    annot_partition_spec,
+    build_mesh,
+    prime_factors,
+    view_slot_axes,
+)
+from flexflow_tpu.parallel.pipeline import (
+    PipelineConfig,
+    merge_microbatches,
+    pipeline_spmd,
+    split_microbatches,
+)
+
+__all__ = [
+    "annot_partition_spec",
+    "build_mesh",
+    "prime_factors",
+    "view_slot_axes",
+    "PipelineConfig",
+    "pipeline_spmd",
+    "split_microbatches",
+    "merge_microbatches",
+]

@@ -1,0 +1,150 @@
+"""Device-mesh construction and canonical axis assignment.
+
+The TPU replacement for the reference's device-placement machinery
+(MachineView strided boxes + FFMapper decoding,
+reference: src/mapper/mapper.cc:371-475): build ONE global
+``jax.sharding.Mesh`` whose axes are the *prime factors* of the device
+count, then map every op's abstract partition degrees onto concrete
+axis names with one deterministic rule.  Because the rule is
+deterministic, two ops that split the same logical dim by the same
+degree land on the same axes — so a data-parallel chain compiles with
+zero resharding, exactly like same-MachineView ops sharing a Legion
+index space in the reference.
+
+Physical placement within the mesh (which chip is neighbour to which)
+is delegated to jax's device ordering, which already lays slices out
+along the ICI torus.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.ops.base import REPLICA_SLOT, ShardAnnot
+
+
+def prime_factors(n: int) -> List[int]:
+    out: List[int] = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def mesh_axis_sizes(num_devices: int) -> List[Tuple[str, int]]:
+    factors = prime_factors(num_devices) or [1]
+    return [(f"x{i}", f) for i, f in enumerate(factors)]
+
+
+def build_mesh(devices: Optional[Sequence] = None):
+    """Build the global mesh over ``devices`` (default: all)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    axes = mesh_axis_sizes(len(devices))
+    names = tuple(n for n, _ in axes)
+    shape = tuple(s for _, s in axes)
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def assign_slot_axes(
+    slot_degrees: Sequence[int], pool_sizes: Sequence[int]
+) -> List[Tuple[int, ...]]:
+    """THE canonical slot→axis assignment rule, shared by the lowering
+    (view_slot_axes below) and the cost model's DCN classifier
+    (search/machine_model.py _slot_axes): slots are visited in order;
+    each slot of degree d consumes, for every prime factor of d, the
+    first unused pool axis of that size.  Returns per-slot tuples of
+    pool-axis INDICES; raises ValueError if a degree does not factor
+    into the remaining pool."""
+    used = [False] * len(pool_sizes)
+    out: List[Tuple[int, ...]] = []
+    for d in slot_degrees:
+        taken: List[int] = []
+        for p in prime_factors(d):
+            for i, size in enumerate(pool_sizes):
+                if not used[i] and size == p:
+                    used[i] = True
+                    taken.append(i)
+                    break
+            else:
+                raise ValueError(
+                    f"degree {d} does not factor into mesh axes {list(pool_sizes)}"
+                )
+        out.append(tuple(taken))
+    return out
+
+
+def place_zero_factors(
+    extents: Sequence[int], factor_sizes: Sequence[int]
+) -> List[Tuple[int, int]]:
+    """THE greedy placement rule for ZeRO-1 optimizer-state sharding,
+    shared by the execution lowering (compiler/lowering.py
+    _zero_augmented) and the search's memory model
+    (search/machine_model.py op_memory) so feasibility is judged by
+    exactly what execution will do: weight dims are visited
+    largest-remaining-extent first, replication factors in pool order,
+    and a factor lands on the first visited dim it divides evenly.
+    Returns (dim, factor_index) placements; factors that fit nowhere
+    are simply not placed (that share of the state stays replicated)."""
+    remaining = list(range(len(factor_sizes)))
+    ext = list(extents)
+    out: List[Tuple[int, int]] = []
+    for d in sorted(range(len(ext)), key=lambda i: -ext[i]):
+        for fi in list(remaining):
+            if ext[d] > 1 and ext[d] % factor_sizes[fi] == 0:
+                out.append((d, fi))
+                ext[d] //= factor_sizes[fi]
+                remaining.remove(fi)
+    return out
+
+
+def view_slot_axes(
+    mv: MachineView, axis_pool: Sequence[Tuple[str, int]]
+) -> Dict[int, Tuple[str, ...]]:
+    """Assign mesh axes to the view's slots (output dims + replica slot).
+
+    Deterministic (assign_slot_axes): slots are visited in order
+    (0..ndim-1 then REPLICA_SLOT).  Raises if the view does not factor
+    into the pool (the search only generates views whose total parts
+    divide the device count).
+    """
+    degrees = list(mv.dim_degrees) + [mv.replica_degree]
+    idx = assign_slot_axes(degrees, [s for _, s in axis_pool])
+    slots: Dict[int, Tuple[str, ...]] = {
+        i: tuple(axis_pool[j][0] for j in idx[i])
+        for i in range(len(mv.dim_degrees))
+    }
+    slots[REPLICA_SLOT] = tuple(axis_pool[j][0] for j in idx[-1])
+    return slots
+
+
+def annot_partition_spec(annot: ShardAnnot, slot_axes: Dict[int, Tuple[str, ...]]):
+    """Lower a ShardAnnot to a PartitionSpec using the op's slot→axes map."""
+    from jax.sharding import PartitionSpec
+
+    entries = []
+    for dim, (deg, slot) in enumerate(zip(annot.degrees, annot.parallel_idx())):
+        if deg <= 1 or slot == -1:
+            entries.append(None)
+            continue
+        axes = slot_axes.get(slot, ())
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)

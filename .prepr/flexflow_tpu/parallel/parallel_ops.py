@@ -1,0 +1,150 @@
+"""Parallel operators: Repartition / Combine / Replicate / Reduction.
+
+Reference: src/parallel_ops/{partition,combine,replicate,reduction}.cc —
+the four PCG operators with which FlexFlow expresses ALL parallelism
+(SURVEY.md §2.3); the search inserts/removes them and the Legion
+runtime moves data to satisfy them.
+
+TPU-native lowering: each is an *identity* computation plus a sharding
+constraint on its output — GSPMD turns the constraint delta into the
+right collective over ICI:
+
+    Repartition -> all_to_all / slice     (degree change on a dim)
+    Combine     -> all_gather             (degree -> 1)
+    Replicate   -> broadcast (no-op spec) (tensor unsharded over axes)
+    Reduction   -> psum / reduce_scatter  (partial-sum -> reduced)
+
+Their *inputs* are deliberately unconstrained (annot None): the
+producer's own constraint governs the source sharding, and the delta
+IS the data movement.  Cost is attributed by the simulator
+(flexflow_tpu.search.simulator.estimate_xfer_cost), mirroring
+simulator.cc:556-731.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.core.optype import OperatorType
+from flexflow_tpu.core.ptensor import ParallelTensorShape
+from flexflow_tpu.ops.base import (
+    Operator,
+    OpSharding,
+    ShardAnnot,
+    register_op,
+)
+
+
+class _ParallelOpBase(Operator):
+    def infer(self) -> Sequence[ParallelTensorShape]:
+        return (self.input_shapes[0],)
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0]]
+
+    def flops(self) -> float:
+        return 0.0
+
+    def splittable_output_dims(self) -> Tuple[int, ...]:
+        return tuple(range(self.output_shapes[0].ndim))
+
+
+@register_op
+class RepartitionOp(_ParallelOpBase):
+    """Change partition degree along ``dim`` to ``degree``
+    (reference: partition.cc create_input_partition:142-155)."""
+
+    op_type = OperatorType.REPARTITION
+
+    def __init__(self, name, input_shapes, dim: int, degree: int):
+        super().__init__(name, input_shapes, dim=int(dim), degree=int(degree))
+
+    def fixed_machine_view(self) -> Optional[MachineView]:
+        degs = [1] * self.output_shapes[0].ndim
+        degs[self.attrs["dim"]] = self.attrs["degree"]
+        return MachineView(dim_degrees=tuple(degs))
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        assert mv.dim_degrees[self.attrs["dim"]] == self.attrs["degree"], (
+            f"{self.name}: view {mv} disagrees with repartition degree "
+            f"{self.attrs['degree']} on dim {self.attrs['dim']}"
+        )
+        return OpSharding(
+            inputs=(None,),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+
+@register_op
+class CombineOp(_ParallelOpBase):
+    """Gather shards along ``dim`` back to ``degree`` (usually 1)
+    (reference: combine.cc)."""
+
+    op_type = OperatorType.COMBINE
+
+    def __init__(self, name, input_shapes, dim: int, degree: int = 1):
+        super().__init__(name, input_shapes, dim=int(dim), degree=int(degree))
+
+    def fixed_machine_view(self) -> Optional[MachineView]:
+        degs = [1] * self.output_shapes[0].ndim
+        degs[self.attrs["dim"]] = self.attrs["degree"]
+        return MachineView(dim_degrees=tuple(degs))
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        assert mv.dim_degrees[self.attrs["dim"]] == self.attrs["degree"]
+        return OpSharding(
+            inputs=(None,),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )
+
+
+@register_op
+class ReplicateOp(_ParallelOpBase):
+    """Replicate the tensor ``degree`` ways (reference: replicate.cc
+    aliased partition :107-118; backward sums replica grads — here
+    autodiff of the broadcast does exactly that)."""
+
+    op_type = OperatorType.REPLICATE
+
+    def __init__(self, name, input_shapes, degree: int):
+        super().__init__(name, input_shapes, degree=int(degree))
+
+    def fixed_machine_view(self) -> Optional[MachineView]:
+        return MachineView(
+            dim_degrees=(1,) * self.output_shapes[0].ndim,
+            replica_degree=self.attrs["degree"],
+        )
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        assert mv.replica_degree == self.attrs["degree"], (
+            f"{self.name}: view {mv} disagrees with replicate degree "
+            f"{self.attrs['degree']}"
+        )
+        return OpSharding(
+            inputs=(None,),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, replica=mv.replica_degree),),
+        )
+
+
+@register_op
+class ReductionOp(_ParallelOpBase):
+    """Sum ``degree`` partial replicas (reference: reduction.cc fwd
+    kernel sums replicas locally :22-45).  The producer's output is in
+    partial-sum state (unconstrained); constraining this op's output
+    forces GSPMD to materialize the psum here."""
+
+    op_type = OperatorType.REDUCTION
+
+    def __init__(self, name, input_shapes, degree: int):
+        super().__init__(name, input_shapes, degree=int(degree))
+
+    def propagate(self, mv: MachineView) -> OpSharding:
+        return OpSharding(
+            inputs=(None,),
+            weights=(),
+            outputs=(ShardAnnot(mv.dim_degrees, mv.replica_degree),),
+        )

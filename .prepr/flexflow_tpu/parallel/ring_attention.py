@@ -1,0 +1,301 @@
+"""Ring attention — sequence/context parallelism.
+
+The reference cannot partition MHA's sequence dim at all
+(reference: substitution.cc:2599-2654 only sample-dim repartition and
+head-split; SURVEY.md §5 calls out the gap).  Here the seq dim is a
+first-class mesh axis: Q stays resident per shard while K/V blocks
+rotate around the ring via ``lax.ppermute`` over ICI neighbours, with
+online-softmax merging across steps — attention memory per chip stays
+O(S/n), enabling long-context training.
+
+Implemented at the shard_map level (XLA-level blockwise attention per
+step; the Pallas flash kernel accelerates the inner block on TPU).
+Causal masking is handled per (q-shard, kv-shard) pair: full blocks
+below the diagonal, masked diagonal blocks, skipped blocks above.
+Causal rings default to the ZIGZAG schedule (device i holds sequence
+chunks i and 2n-1-i), which removes the contiguous layout's straggler
+— every device computes exactly two half-chunk attentions per ring
+step, ~2x faster causal long-context than the naive ring.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attn(q, k, v, scale, mask_mode, q_off, k_off):
+    """One blockwise attention step returning (acc, m, l) in fp32.
+
+    q: [B, Sq, H, D], k/v: [B, Sk, H, D].
+    mask_mode: 0 = full (no mask), 1 = causal within the pair (ring
+    pairs with mask_mode 1 always have q_off == k_off and Sq == Sk, so
+    the global mask rows+q_off >= cols+k_off reduces to local causal).
+
+    Runs the Pallas flash kernel's partial-out path, so the [Sq, Sk]
+    score block never hits HBM; falls back to einsum inside
+    flash_attention_partial when shapes don't tile.
+    """
+    from flexflow_tpu.kernels.flash_attention import flash_attention_partial
+
+    assert mask_mode in (0, 1)
+    if mask_mode == 1:
+        assert q.shape[1] == k.shape[1]
+    return flash_attention_partial(q, k, v, causal=mask_mode == 1, scale=scale)
+
+
+def _merge(acc1, m1, l1, acc2, m2, l2):
+    """Numerically-stable combine of two online-softmax partials."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return acc1 * a1 + acc2 * a2, m, l1 * a1 + l2 * a2
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    seq_axis: "str | Tuple[str, ...]",
+    causal: bool = False,
+    scale: Optional[float] = None,
+    batch_axes: Tuple[str, ...] = (),
+    schedule: str = "auto",
+) -> jax.Array:
+    """Global-view ring attention: q/k/v [B, S, H, D] (self-attention:
+    Sk == Sq) sharded on dim 1 over ``seq_axis`` of ``mesh`` (and
+    optionally on dim 0 over ``batch_axes``); returns [B, S, H, D] with
+    the same sharding.  Composable under jit (uses shard_map internally).
+
+    ``schedule``: "contiguous" | "zigzag" | "auto".  With contiguous
+    shards, causal masking is load-IMBALANCED: at ring step s only
+    devices i >= s have below-diagonal work, so the last device
+    computes a full block every step and skipping buys no wall time.
+    "zigzag" re-orders the sequence so device i holds chunks
+    (i, 2n-1-i) of a 2n-chunking — every device then does exactly two
+    half-blocks per step (~2x faster causal rings).  "auto" picks
+    zigzag for causal multi-device rings when the length divides."""
+    from flexflow_tpu.comm.compat import shard_map
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    assert q.shape[1] == k.shape[1], "ring attention requires Sk == Sq"
+    # a seq degree that does not exist as one mesh axis (the mesh is
+    # built from prime factors, so degree 4 on 8 devices is two axes)
+    # rides the PRODUCT ring: ppermute/axis_index over an axis-name
+    # tuple use linearized indices consistent with PartitionSpec order
+    # collectives and PartitionSpec accept the (possibly length-1)
+    # axis-name tuple uniformly — no str/tuple dual form needed
+    axes = (seq_axis,) if isinstance(seq_axis, str) else tuple(seq_axis)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    if n == 1:
+        from flexflow_tpu.kernels.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    b_spec = None
+    if batch_axes:
+        b_spec = batch_axes[0] if len(batch_axes) == 1 else tuple(batch_axes)
+    spec = P(b_spec, axes, None, None)
+
+    assert schedule in ("auto", "contiguous", "zigzag"), schedule
+    if schedule == "auto":
+        schedule = (
+            "zigzag" if causal and q.shape[1] % (2 * n) == 0 else "contiguous"
+        )
+    if schedule == "zigzag":
+        assert causal, "zigzag scheduling only applies to causal attention"
+        assert q.shape[1] % (2 * n) == 0, (q.shape, n)
+        return _zigzag_ring(q, k, v, mesh, axes, n, scale, spec)
+
+    s_local = q.shape[1] // n
+
+    def local_fn(q_l, k_l, v_l):
+        # q_l, k_l, v_l: [B, S/n, H, D] local shards
+        idx = jax.lax.axis_index(axes)
+        q_off = idx * s_local
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def compute(k_cur, v_cur, step_i, acc, m, l):
+            src_idx = (idx - step_i) % n  # whose kv block we hold now
+            k_off = src_idx * s_local
+            if causal:
+                # 3-way: kv fully after q -> skip; fully before -> full;
+                # same shard -> diagonal mask
+                def full_fn(_):
+                    return _block_attn(q_l, k_cur, v_cur, scale, 0, 0, 0)
+
+                def diag_fn(_):
+                    return _block_attn(q_l, k_cur, v_cur, scale, 1, q_off, k_off)
+
+                def skip_fn(_):
+                    return (
+                        jnp.zeros_like(acc),
+                        jnp.full_like(m, -1e30),
+                        jnp.zeros_like(l),
+                    )
+
+                branch = jnp.where(src_idx < idx, 0, jnp.where(src_idx == idx, 1, 2))
+                acc2, m2, l2 = jax.lax.switch(
+                    branch, [full_fn, diag_fn, skip_fn], None
+                )
+            else:
+                acc2, m2, l2 = _block_attn(q_l, k_cur, v_cur, scale, 0, 0, 0)
+            return _merge(acc, m, l, acc2, m2, l2)
+
+        b, sl, h, d = q_l.shape
+        acc = jnp.zeros((b, h, sl, d), jnp.float32)
+        m = jnp.full((b, h, sl, 1), -1e30, jnp.float32)
+        l = jnp.zeros((b, h, sl, 1), jnp.float32)
+        # step 0 on the resident kv block, then n-1 rotate-and-compute
+        # steps — no trailing rotation whose result nobody reads
+        acc, m, l = compute(k_l, v_l, 0, acc, m, l)
+
+        def step(carry, step_i):
+            k_cur, v_cur, acc, m, l = carry
+            k_cur = jax.lax.ppermute(k_cur, axes, perm)
+            v_cur = jax.lax.ppermute(v_cur, axes, perm)
+            acc, m, l = compute(k_cur, v_cur, step_i, acc, m, l)
+            return (k_cur, v_cur, acc, m, l), None
+
+        if n > 1:
+            (_, _, acc, m, l), _ = jax.lax.scan(
+                step, (k_l, v_l, acc, m, l), jnp.arange(1, n)
+            )
+        out = acc / jnp.maximum(l, 1e-30)
+        return out.transpose(0, 2, 1, 3).astype(q_l.dtype)  # [B, S/n, H, D]
+
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+
+
+def _zigzag_ring(q, k, v, mesh, axes, n, scale, spec):
+    """Load-balanced causal ring: the sequence is viewed as 2n chunks
+    and device i holds chunks (i, 2n-1-i).  With global chunk ids, the
+    four (q-half, kv-half) sub-blocks per ring step resolve so that
+    EVERY device computes exactly two half-chunk attentions per step
+    (one diagonal extra on the resident step) — the contiguous
+    schedule's straggler (last device below-diagonal at every step)
+    disappears.
+
+    The contiguous->zigzag exchange happens INSIDE shard_map as two
+    half-chunk ppermutes each way (device i's contiguous chunks
+    (2i, 2i+1) route to their zigzag owners; bijective per half since
+    even chunks map to even-or-mirrored targets).  Each q/k/v/out
+    tensor moves at most one half-chunk per device — a fraction of one
+    ring rotation — and per-chip memory stays O(S/n), which a global
+    gather could not guarantee (GSPMD may materialize it as an
+    all-gather)."""
+    from flexflow_tpu.comm.compat import shard_map
+
+    S = q.shape[1]
+    s2 = S // (2 * n)
+
+    def _fwd_owner(c):  # zigzag owner device of global chunk c
+        return c if c < n else 2 * n - 1 - c
+
+    # ppermute A carries each device's EARLY contiguous half (chunk 2i),
+    # B the LATE half (chunk 2i+1); both maps are bijections
+    perm_a = [(i, _fwd_owner(2 * i)) for i in range(n)]
+    perm_b = [(i, _fwd_owner(2 * i + 1)) for i in range(n)]
+    perm_a_inv = [(d, s) for s, d in perm_a]
+    perm_b_inv = [(d, s) for s, d in perm_b]
+    # chunk id delivered via A to each destination device
+    recv_a = [0] * n
+    for src, dst in perm_a:
+        recv_a[dst] = 2 * src
+
+    def local_fn(q_l, k_l, v_l):
+        idx = jax.lax.axis_index(axes)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        b, _, h, d = q_l.shape
+        # True where the A-delivered chunk is this device's EARLY
+        # zigzag chunk (global id == idx); else A carried the late one
+        a_is_early = jnp.take(jnp.asarray(recv_a), idx) == idx
+
+        def to_zig(x):
+            ra = jax.lax.ppermute(x[:, :s2], axes, perm_a)
+            rb = jax.lax.ppermute(x[:, s2:], axes, perm_b)
+            early = jnp.where(a_is_early, ra, rb)
+            late = jnp.where(a_is_early, rb, ra)
+            return jnp.concatenate([early, late], axis=1)
+
+        q_l, k_l, v_l = to_zig(q_l), to_zig(k_l), to_zig(v_l)
+        q0, q1 = q_l[:, :s2], q_l[:, s2:]  # global chunks idx, 2n-1-idx
+
+        zero = (
+            jnp.zeros((b, h, s2, d), jnp.float32),
+            jnp.full((b, h, s2, 1), -1e30, jnp.float32),
+            jnp.zeros((b, h, s2, 1), jnp.float32),
+        )
+
+        def att(qc, kc, vc, diag):
+            return _block_attn(qc, kc, vc, scale, 1 if diag else 0, 0, 0)
+
+        # resident step (kv chunks == own chunks): early half attends
+        # its diagonal; late half attends the early chunk fully plus its
+        # own diagonal
+        acc0 = _merge(*zero, *att(q0, k_l[:, :s2], v_l[:, :s2], True))
+        acc1 = _merge(
+            *att(q1, k_l[:, :s2], v_l[:, :s2], False),
+            *att(q1, k_l[:, s2:], v_l[:, s2:], True),
+        )
+
+        def step(carry, _):
+            k_cur, v_cur, a0, a1, src = carry
+            k_cur = jax.lax.ppermute(k_cur, axes, perm)
+            v_cur = jax.lax.ppermute(v_cur, axes, perm)
+            src = (src - 1) % n  # device whose chunks we now hold
+            k0, k1 = k_cur[:, :s2], k_cur[:, s2:]
+            v0, v1 = v_cur[:, :s2], v_cur[:, s2:]
+
+            def before(_):
+                # src < idx: early q attends src's early chunk; late q
+                # attends it too (always below diagonal)
+                return (
+                    att(q0, k0, v0, False),
+                    att(q1, k0, v0, False),
+                )
+
+            def after(_):
+                # src > idx: early q sees nothing; late q (chunk
+                # 2n-1-idx) attends BOTH of src's chunks (idx < src and
+                # 2n-1-idx > 2n-1-src)
+                t = _merge(*att(q1, k0, v0, False), *att(q1, k1, v1, False))
+                return (zero, t)
+
+            p0, p1 = jax.lax.cond(src < idx, before, after, None)
+            a0 = _merge(*a0, *p0)
+            a1 = _merge(*a1, *p1)
+            return (k_cur, v_cur, a0, a1, src), None
+
+        (_, _, acc0, acc1, _), _ = jax.lax.scan(
+            step, (k_l, v_l, acc0, acc1, idx), None, length=n - 1
+        )
+
+        def fin(t):
+            acc, m, l = t
+            out = acc / jnp.maximum(l, 1e-30)
+            return out.transpose(0, 2, 1, 3).astype(q_l.dtype)
+
+        out = jnp.concatenate([fin(acc0), fin(acc1)], axis=1)
+        # inverse exchange: return each zigzag half along the route it
+        # arrived by; receivers get their contiguous (early, late) halves
+        oa = jnp.where(a_is_early, out[:, :s2], out[:, s2:])
+        ob = jnp.where(a_is_early, out[:, s2:], out[:, :s2])
+        e = jax.lax.ppermute(oa, axes, perm_a_inv)
+        l_ = jax.lax.ppermute(ob, axes, perm_b_inv)
+        return jnp.concatenate([e, l_], axis=1)
+
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)

@@ -1,0 +1,3 @@
+from flexflow_tpu.runtime.dataloader import SingleDataLoader
+
+__all__ = ["SingleDataLoader"]

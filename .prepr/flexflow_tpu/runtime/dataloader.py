@@ -1,0 +1,146 @@
+"""Host→device data loading.
+
+Reference: python/flexflow_dataloader.{h,cc,cu} SingleDataLoader — full
+numpy arrays staged in zero-copy memory, then per-batch index-launch
+copies to each device.  TPU-native: per-batch ``jax.device_put`` with
+the input's NamedSharding — each host only materializes the shards the
+mesh places locally, which is the same "index-sharded load under
+control replication" behaviour (flexflow_dataloader.h:102).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class SingleDataLoader:
+    """Iterates (inputs, labels) device-placed batches over full arrays."""
+
+    def __init__(
+        self,
+        compiled,
+        xs: Sequence[np.ndarray],
+        y: np.ndarray,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+    ):
+        import jax
+
+        self.compiled = compiled
+        self.xs = [np.ascontiguousarray(a) for a in xs]
+        self.y = np.ascontiguousarray(y)
+        n = self.xs[0].shape[0]
+        for a in self.xs:
+            assert a.shape[0] == n, "all inputs must share the sample dim"
+        assert self.y.shape[0] == n
+        self.num_samples = n
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = np.random.default_rng(seed)
+        self.drop_remainder = drop_remainder
+        self._in_shardings = [
+            compiled.input_sharding(i) for i in range(len(self.xs))
+        ]
+        self._label_sharding = compiled.batch_sharding()
+        self._jax = jax
+
+    @property
+    def num_batches(self) -> int:
+        if self.drop_remainder:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    @staticmethod
+    def _gather(a: np.ndarray, idx: np.ndarray) -> np.ndarray:
+        """Shuffled-row gather; threaded native path for large batches
+        (native/src/dataloader.cpp ffn_gather_rows, the analog of the
+        reference's C++ index-copy dataloader tasks)."""
+        row_bytes = a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
+        if row_bytes * len(idx) >= 1 << 20:  # 1 MiB: threads pay off
+            try:
+                from flexflow_tpu import native
+
+                out = native.gather_rows(a, idx)
+                if out is not None:
+                    return out
+            except ImportError:
+                pass
+        return a[idx]
+
+    def _place(self, array: np.ndarray, idx: np.ndarray, sharding):
+        """Single host: gather + device_put. Multi-host: every process
+        holds the SAME shuffled order (seeded rng), gathers ONLY its
+        slice of the batch rows, and assembles the global jax.Array from
+        process-local rows (the reference's index-sharded load under
+        control replication, flexflow_dataloader.h:102)."""
+        jax = self._jax
+        n = jax.process_count()
+        if n <= 1:
+            return jax.device_put(self._gather(array, idx), sharding)
+        assert len(idx) % n == 0, (
+            f"multi-host batch size {len(idx)} must divide evenly over "
+            f"{n} processes"
+        )
+        per = len(idx) // n
+        lo = jax.process_index() * per
+        local = self._gather(array, idx[lo:lo + per])
+        return jax.make_array_from_process_local_data(sharding, local)
+
+    def __iter__(self):
+        order = np.arange(self.num_samples)
+        if self.shuffle:
+            self.rng.shuffle(order)
+        bs = self.batch_size
+        for b in range(self.num_batches):
+            idx = order[b * bs : (b + 1) * bs]
+            inputs = [
+                self._place(a, idx, sh)
+                for a, sh in zip(self.xs, self._in_shardings)
+            ]
+            labels = self._place(self.y, idx, self._label_sharding)
+            yield inputs, labels
+
+    def iter_traced(self, n: int):
+        """Yield ('stack', inputs, labels) with a leading [n] step axis
+        for CompiledModel.train_steps (the iteration-trace analogue),
+        then any trailing batches that don't fill a stack as
+        ('single', inputs, labels).  Single-process only."""
+        jax = self._jax
+        order = np.arange(self.num_samples)
+        if self.shuffle:
+            self.rng.shuffle(order)
+        bs = self.batch_size
+        # stacks use FULL batches only — with drop_remainder=False the
+        # final partial batch goes through the 'single' path below
+        stacks = (self.num_samples // bs) // n
+        st_in_sh = [
+            self.compiled.stacked_input_sharding(i) for i in range(len(self.xs))
+        ]
+        st_lb_sh = self.compiled.stacked_batch_sharding()
+        for s in range(stacks):
+            idx = order[s * n * bs : (s + 1) * n * bs]
+            inputs = [
+                jax.device_put(
+                    self._gather(a, idx).reshape((n, bs) + a.shape[1:]), sh
+                )
+                for a, sh in zip(self.xs, st_in_sh)
+            ]
+            labels = jax.device_put(
+                self._gather(self.y, idx).reshape((n, bs) + self.y.shape[1:]),
+                st_lb_sh,
+            )
+            yield "stack", inputs, labels
+        for b in range(stacks * n, self.num_batches):
+            idx = order[b * bs : (b + 1) * bs]
+            yield (
+                "single",
+                [
+                    self._place(a, idx, sh)
+                    for a, sh in zip(self.xs, self._in_shardings)
+                ],
+                self._place(self.y, idx, self._label_sharding),
+            )

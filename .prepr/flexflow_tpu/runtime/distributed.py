@@ -1,0 +1,101 @@
+"""Multi-host distributed runtime.
+
+Reference parity (SURVEY.md §2.4): the reference scales across nodes
+via Legion/Realm with a GASNet conduit (CMakeLists.txt:38-41) plus
+per-MachineView NCCL communicators (model.cc:2903-2940).  TPU-native,
+both collapse into ONE mechanism: `jax.distributed` connects the hosts,
+every host sees the global device set, and the same jitted SPMD program
+runs on each host with XLA routing collectives over ICI (intra-slice)
+or DCN (inter-slice).  There are no communicators to manage — this
+module is the bootstrap glue:
+
+* ``initialize()`` — one call per host process (the analog of
+  ``Runtime::start`` + GASNet join, cpp_driver.cc:26-46);
+* ``global_mesh()`` — a Mesh over ALL hosts' devices, with the
+  data-parallel axis outermost so dp gradient reduction rides DCN only
+  once per step while tp/sp collectives stay on intra-slice ICI;
+* ``local_batch_slice()`` — which rows of the global batch this host
+  must materialize (the reference's index-sharded dataloader under
+  control replication, flexflow_dataloader.h:102);
+* ``host_local_array()`` — assemble a globally-sharded jax.Array from
+  per-host local rows (jax.make_array_from_process_local_data).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Connect this host to the job (no-op on single-process runs).
+
+    With no arguments, jax auto-detects TPU pod environment variables;
+    pass explicit values for CPU/GPU clusters or tests.
+    """
+    import jax
+
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_initialized() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def global_mesh(dcn_axis: str = "dp"):
+    """Mesh over every device of every host.  The leading axis spans
+    hosts (DCN); remaining axes factor the per-host devices (ICI) —
+    `jax.sharding` then emits hierarchical collectives automatically."""
+    import jax
+    from jax.sharding import Mesh
+
+    from flexflow_tpu.parallel.mesh import mesh_axis_sizes
+
+    n_proc = jax.process_count()
+    devices = np.asarray(jax.devices())
+    per_host = len(devices) // max(n_proc, 1)
+    if n_proc <= 1:
+        from flexflow_tpu.parallel.mesh import build_mesh
+
+        return build_mesh(list(devices))
+    from flexflow_tpu.parallel.mesh import prime_factors
+
+    # prime-factored host axes: view->axis assignment matches degrees
+    # against prime-sized axes, so a composite 'dp' axis (4, 6, ... hosts)
+    # would be unmatchable
+    host_factors = prime_factors(n_proc)
+    host_axes = [(f"{dcn_axis}{i}", p) for i, p in enumerate(host_factors)]
+    rest = mesh_axis_sizes(per_host)
+    names = tuple(a for a, _ in host_axes) + tuple(a for a, _ in rest)
+    shape = tuple(s for _, s in host_axes) + tuple(s for _, s in rest)
+    return Mesh(devices.reshape(shape), names)
+
+
+def local_batch_slice(global_batch: int) -> Tuple[int, int]:
+    """[start, stop) rows of the global batch this host feeds (the
+    dp axis is host-major in global_mesh)."""
+    import jax
+
+    n = max(jax.process_count(), 1)
+    assert global_batch % n == 0, (global_batch, n)
+    per = global_batch // n
+    return jax.process_index() * per, (jax.process_index() + 1) * per
+
+
+def host_local_array(local_rows: np.ndarray, mesh, pspec):
+    """Build the global batch array from this host's local rows."""
+    import jax
+
+    sharding = jax.sharding.NamedSharding(mesh, pspec)
+    return jax.make_array_from_process_local_data(sharding, local_rows)

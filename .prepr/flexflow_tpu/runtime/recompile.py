@@ -1,0 +1,45 @@
+"""Dynamic re-optimization (reference: src/recompile/recompile_state.cc,
+include/flexflow/recompile.h:26-44 — user trigger()/alter() closures
+checked per training iteration; used by MoE to flip to cached expert
+assignments mid-training, examples/cpp/mixture_of_experts/moe.cc:73-92).
+
+TPU-native twist: "altering" the model changes the PCG (e.g. a CacheOp's
+``use_cached`` attr), so the altered model is re-lowered into a fresh
+XLA program while parameters, optimizer state, and model state carry
+over — the analog of the reference mutating operators in place.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class RecompileState:
+    """Holds the trigger/alter pair; ``alter`` fires at most once
+    (reference: recompile.h RecompileState::alter_flag)."""
+
+    def __init__(self, trigger: Callable[["object"], bool],
+                 alter: Callable[["object"], None]):
+        self._trigger = trigger
+        self._alter = alter
+        self.altered = False
+
+    def check(self, model) -> bool:
+        """Run once per iteration; returns True when the model was
+        altered + recompiled this call."""
+        if self.altered:
+            return False
+        if not self._trigger(model):
+            return False
+        self._alter(model)
+        self.altered = True
+        model.recompile()
+        return True
+
+
+def cache_score(model, cache_op_name: str) -> float:
+    """The per-iteration cache score of a CacheOp (mean |live - cached|;
+    reference: src/ops/cache.cc score function + moe.cc:73-84 trigger)."""
+    import numpy as np
+
+    return float(np.asarray(model.state[f"{cache_op_name}/score"]))

@@ -1,0 +1,18 @@
+"""Auto-parallelization search — the Unity algorithm re-built for TPU
+meshes (reference: L3/L4 of SURVEY.md §1: simulator + DP search +
+substitution engine + search driver)."""
+
+from flexflow_tpu.search.machine_model import CostModel
+from flexflow_tpu.search.simulator import Simulator
+from flexflow_tpu.search.views import candidate_views
+from flexflow_tpu.search.dp import SearchHelper
+from flexflow_tpu.search.driver import optimize_strategy, mcmc_optimize
+
+__all__ = [
+    "CostModel",
+    "Simulator",
+    "candidate_views",
+    "SearchHelper",
+    "optimize_strategy",
+    "mcmc_optimize",
+]

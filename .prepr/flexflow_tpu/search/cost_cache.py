@@ -1,0 +1,380 @@
+"""Persistent cost cache — warm starts for the strategy search.
+
+The reference's measured-cost cache lives for one process
+(ProfilingRecord hash map, simulator.cc:515-554); every bench sweep,
+CI run, or repeat compile here used to re-derive identical per-node
+cost rows and re-run identical searches from scratch.  This module
+persists two layers, both keyed under ONE ``signature`` that
+fingerprints the whole cost surface (machine spec, device count,
+calibration-table content, precision/sharding mode flags, schema
+version):
+
+* **Row cache** — ``Simulator._node_costs`` rows ``(fwd_s, full_s,
+  sync_s, mem_bytes)`` per (op structural digest, machine view).  The
+  native DP digests (`search/dp.py _node_digest`) are baked from these
+  rows, so serving them from disk warms both engines.
+* **Search-result cache** — ``optimize_strategy``'s final
+  ``(best_graph, strategy, cost)`` per (graph structural digest,
+  search-knob tuple).  The search is a deterministic pure function of
+  (graph, knobs, cost surface); repeated searches — bench sweeps
+  across the model zoo, re-runs after unrelated code edits, CI —
+  return the stored result instead of re-searching.  Graphs are
+  pickled (operator descriptors are plain immutable python objects);
+  anything unpicklable silently skips storing.
+
+Invalidation is WHOLESALE on signature change: a recalibration, a
+different machine model, or a bumped ``SCHEMA_VERSION`` abandons every
+stored row.  A ``calibration_stale`` flag (set when a measured
+DriftReport flags the calibration table, obs/drift.py) makes the cache
+refuse to serve until the table is re-probed — a stale surface must
+not keep seeding searches.
+
+Knobs: ``FFConfig.cost_cache_file`` / ``--cost-cache-file`` /
+``--no-cost-cache``; env ``FLEXFLOW_TPU_COST_CACHE`` (path, or ``0``
+to disable) when the config leaves it unset.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import pickle
+import sys
+from typing import Dict, Optional, Tuple
+
+from flexflow_tpu.obs.metrics import METRICS
+
+SCHEMA_VERSION = 1
+
+_ROW_HITS = METRICS.counter("cost_cache.row_hits")
+_ROW_MISSES = METRICS.counter("cost_cache.row_misses")
+_RESULT_HITS = METRICS.counter("cost_cache.result_hits")
+_RESULT_MISSES = METRICS.counter("cost_cache.result_misses")
+
+RowKey = Tuple[str, Tuple[int, ...], int]
+
+
+def resolve_cost_cache_path(config) -> Optional[str]:
+    """The on-disk cache path for a config, or None when disabled.
+    Explicit ``cost_cache_file`` wins; empty string disables; unset
+    falls back to the FLEXFLOW_TPU_COST_CACHE environment variable
+    (its value ``0``/empty likewise disables)."""
+    path = getattr(config, "cost_cache_file", None)
+    if path is None:
+        path = os.environ.get("FLEXFLOW_TPU_COST_CACHE") or None
+    if not path or path == "0":
+        return None
+    return path
+
+
+def calibration_digest(calibration) -> Optional[str]:
+    """Content fingerprint of a CalibrationTable — the cache must
+    invalidate when any measured record changes, not merely when the
+    file path does."""
+    if calibration is None:
+        return None
+    h = hashlib.sha256()
+    h.update(repr(getattr(calibration, "backend", None)).encode())
+    for k, v in sorted(calibration._t.items()):
+        h.update(repr((k, v)).encode())
+    for k, v in sorted(calibration._clusters.items()):
+        h.update(repr((k, v)).encode())
+    return h.hexdigest()[:16]
+
+
+def cost_signature(cost_model) -> str:
+    """Fingerprint of everything a cost row / search result depends on
+    besides the (op, view) key itself — the ``calibration_signature``
+    axis of the cache key."""
+    m = cost_model.machine
+    parts = {
+        "schema": SCHEMA_VERSION,
+        "python_hash_stable": True,
+        "machine": [
+            m.num_devices, m.devices_per_host, m.peak_flops,
+            m.hbm_bandwidth, m.hbm_capacity, m.ici_bandwidth,
+            m.ici_latency, list(m.ici_torus), m.dcn_bandwidth,
+            m.dcn_latency, m.reshard_overhead_s, m.name, m.platform,
+        ],
+        "num_devices": cost_model.num_devices,
+        "zero_dp_shard": cost_model.zero_dp_shard,
+        "inference": cost_model.inference,
+        "sync_precision": cost_model.sync_precision,
+        "network": cost_model.network is not None,
+        "calibration": calibration_digest(cost_model.calibration),
+    }
+    return hashlib.sha256(
+        json.dumps(parts, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def stable_graph_digest(graph) -> str:
+    """Process-stable structural digest of a PCG (graph.hash() uses
+    python tuple hashing, which PYTHONHASHSEED randomizes across
+    processes — unusable as a persistent key).  Hashes the topo-ordered
+    op signatures plus position-indexed edges.  InputOp signatures
+    embed the frontend's GLOBAL tensor_guid counter (process-lifetime,
+    build-order dependent); the digest replaces it with the input's
+    rank of appearance, which carries the same distinctness."""
+    order = graph.topo_order()
+    pos = {n.guid: i for i, n in enumerate(order)}
+    input_rank: Dict[object, int] = {}
+    h = hashlib.blake2b(digest_size=16)
+    for node in order:
+        op = node.op
+        if op.op_type.value == "input":
+            shape = op.output_shapes[0]
+            h.update(repr((
+                "input", shape.sizes, shape.dtype.value,
+                input_rank.setdefault(
+                    op.attrs.get("tensor_guid"), len(input_rank)),
+            )).encode())
+        else:
+            h.update(graph._sig_repr(node).encode())
+        for e in sorted(
+            (pos[e.src], e.src_idx, e.dst_idx)
+            for e in graph.in_edges[node.guid]
+        ):
+            h.update(repr(e).encode())
+        h.update(b";")
+    return h.hexdigest()
+
+
+class CostCache:
+    """One on-disk cache file (JSON rows + pickled search results in a
+    sidecar), bound to a single cost ``signature``.  Load once per
+    search/bench process; ``save()`` persists atomically when dirty."""
+
+    def __init__(self, path: str, signature: str):
+        self.path = path
+        self.signature = signature
+        self.rows: Dict[RowKey, Tuple[float, float, float, float]] = {}
+        self.results: Dict[str, tuple] = {}
+        self.stale = False
+        self.invalidated = False  # file existed with another signature
+        self._dirty = False
+        self.row_hits = 0
+        self.row_misses = 0
+        self.result_hits = 0
+        self.result_misses = 0
+        self._load()
+
+    # ------------------------------------------------------------------
+    @property
+    def result_path(self) -> str:
+        return self.path + ".results.pkl"
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            self.invalidated = True
+            return
+        if data.get("signature") != self.signature or \
+                data.get("schema") != SCHEMA_VERSION:
+            # wholesale invalidation: the cost surface moved (new
+            # calibration, different machine/flags, or schema bump)
+            self.invalidated = True
+            return
+        if data.get("calibration_stale"):
+            # a measured DriftReport flagged the calibration this cache
+            # was keyed by: refuse to serve anything derived from it
+            self.stale = True
+            print(
+                "flexflow_tpu cost cache: calibration flagged STALE by a "
+                "measured drift report — recalibrate (--calibrate / "
+                "bench_search.py --calibrate) or pass --no-cost-cache; "
+                "refusing to serve cached rows",
+                file=sys.stderr,
+            )
+            return
+        for r in data.get("rows", []):
+            self.rows[(r["sig"], tuple(r["degrees"]), int(r["replica"]))] = (
+                tuple(float(x) for x in r["row"])
+            )
+        if os.path.exists(self.result_path):
+            try:
+                with open(self.result_path, "rb") as f:
+                    blob = pickle.load(f)
+                if blob.get("signature") == self.signature:
+                    self.results = blob.get("results", {})
+            except Exception:
+                # a corrupt/unreadable result sidecar only costs a
+                # recompute, never a failure
+                self.results = {}
+
+    def save(self) -> None:
+        if not self._dirty or self.stale:
+            return
+        # a drift check may have marked the ON-DISK file stale after we
+        # loaded it (model.fit in this or another process): rewriting
+        # would silently un-mark it and resurrect rows derived from a
+        # flagged calibration table — honor the mark instead
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    if json.load(f).get("calibration_stale"):
+                        self.stale = True
+                        return
+            except (OSError, ValueError):
+                pass
+        rows = [
+            {"sig": k[0], "degrees": list(k[1]), "replica": k[2],
+             "row": list(v)}
+            for k, v in sorted(self.rows.items())
+        ]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"schema": SCHEMA_VERSION, "signature": self.signature,
+                 "calibration_stale": False, "rows": rows},
+                f,
+            )
+        os.replace(tmp, self.path)
+        try:
+            tmp = self.result_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(
+                    {"signature": self.signature, "results": self.results},
+                    f, protocol=4,
+                )
+            os.replace(tmp, self.result_path)
+        except Exception:
+            # unpicklable payloads (exotic op attributes) degrade to a
+            # row-only cache
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        self._dirty = False
+
+    # ---- row layer ----------------------------------------------------
+    @staticmethod
+    def row_key(op, mv) -> RowKey:
+        return (
+            repr(op.signature()),
+            tuple(mv.dim_degrees),
+            int(mv.replica_degree),
+        )
+
+    def get(self, op, mv) -> Optional[Tuple[float, float, float, float]]:
+        if self.stale:
+            return None
+        hit = self.rows.get(self.row_key(op, mv))
+        if hit is None:
+            self.row_misses += 1
+            _ROW_MISSES.inc()
+            return None
+        self.row_hits += 1
+        _ROW_HITS.inc()
+        return hit
+
+    def put(self, op, mv, row: Tuple[float, float, float, float]) -> None:
+        if self.stale:
+            return
+        if not all(isinstance(x, (int, float)) for x in row):
+            return
+        self.rows[self.row_key(op, mv)] = tuple(float(x) for x in row)
+        self._dirty = True
+
+    # ---- search-result layer -----------------------------------------
+    @staticmethod
+    def search_key(graph, config) -> str:
+        # custom substitution rules are part of the search function:
+        # fingerprint the FILE CONTENT, not just its presence — edited
+        # rules must not be shadowed by a result cached under old ones
+        sub_digest = None
+        if config.substitution_json:
+            try:
+                with open(config.substitution_json, "rb") as f:
+                    sub_digest = hashlib.sha256(f.read()).hexdigest()[:12]
+            except OSError:
+                sub_digest = "unreadable"
+        knobs = (
+            config.search_devices, config.search_budget,
+            config.search_alpha, config.base_optimize_threshold,
+            config.search_improvement_margin,
+            sub_digest,
+        )
+        return stable_graph_digest(graph) + ":" + hashlib.sha256(
+            repr(knobs).encode()).hexdigest()[:12]
+
+    def get_search_result(self, graph, config):
+        """The stored search payload for (graph digest, knobs) under
+        this cost surface, or None.  The payload shape is the driver's
+        (orig_topo_guids, best_graph_or_None, strategy, cost)."""
+        if self.stale:
+            return None
+        blob = self.results.get(self.search_key(graph, config))
+        if blob is None:
+            self.result_misses += 1
+            _RESULT_MISSES.inc()
+            return None
+        try:
+            payload = pickle.loads(blob)
+        except Exception:
+            self.result_misses += 1
+            _RESULT_MISSES.inc()
+            return None
+        self.result_hits += 1
+        _RESULT_HITS.inc()
+        return payload
+
+    def drop_search_result(self, graph, config) -> bool:
+        """Evict the stored result for (graph, knobs) — the driver calls
+        this when a served payload fails the static-analysis gate
+        (corrupt pickle, illegal strategy), so a bad entry costs one
+        recompute instead of being served forever.  Returns True when an
+        entry was dropped."""
+        key = self.search_key(graph, config)
+        if key in self.results:
+            del self.results[key]
+            self._dirty = True
+            return True
+        return False
+
+    def put_search_result(self, graph, config, payload,
+                          cost: float) -> None:
+        if self.stale or not math.isfinite(cost):
+            return
+        try:
+            blob = pickle.dumps(payload, protocol=4)
+        except Exception:
+            return  # unpicklable op payloads: result layer declines
+        self.results[self.search_key(graph, config)] = blob
+        self._dirty = True
+
+
+def load_for_simulator(config, sim) -> Optional[CostCache]:
+    """Attach-or-None: resolve the configured path and bind a CostCache
+    to the simulator's exact cost surface."""
+    path = resolve_cost_cache_path(config)
+    if path is None:
+        return None
+    cache = CostCache(path, cost_signature(sim.cost))
+    sim.cost_cache = cache
+    return cache
+
+
+def mark_calibration_stale(path: str) -> bool:
+    """Flip the on-disk ``calibration_stale`` flag — called when a
+    measured DriftReport flags the calibration table (the PR-2
+    follow-up: staleness must gate the cache, not just warn).  Returns
+    True when a cache file was marked."""
+    if not path or not os.path.exists(path):
+        return False
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        data["calibration_stale"] = True
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+        return True
+    except (OSError, ValueError):
+        return False

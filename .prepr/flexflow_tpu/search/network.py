@@ -1,0 +1,296 @@
+"""Network topology & routing model — the NetworkedMachineModel.
+
+Reference parity (src/runtime/network.cc:47-103 Dijkstra / weighted-ECMP
+routing, simulator.h:160-594 — topology generators, nominal comm devices
+expanding logical p2p into physical multi-hop routes, traffic matrices),
+re-parameterized for TPU fabrics:
+
+* **ICI torus**: per-axis bidirectional wraparound links with
+  dimension-ordered routing — the actual TPU interconnect, replacing the
+  reference's flat/fat-tree NIC topologies as the primary generator;
+* **DCN**: a big-switch host-level layer for multi-slice;
+* **contention**: flows are expanded onto physical links; transfer time
+  = max per-link (load/bandwidth) + path latency — the axis-aware
+  contention model SURVEY.md §7 hard-part (b) calls for, replacing the
+  flat max-over-pairs estimate.
+
+Used by CostModel when constructed with ``network=``: collectives are
+costed by routing their actual ring/pairwise traffic over the torus.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Link = Tuple[int, int]  # directed (src_node, dst_node)
+
+
+@dataclass
+class Topology:
+    """Directed link graph over device/host nodes."""
+
+    num_nodes: int
+    bandwidth: Dict[Link, float] = field(default_factory=dict)  # bytes/s
+    latency: Dict[Link, float] = field(default_factory=dict)  # s
+    torus_dims: Tuple[int, ...] = ()  # set by the torus generator
+    adjacency: Dict[int, List[int]] = field(default_factory=dict)
+
+    def add_link(self, a: int, b: int, bandwidth: float, latency: float,
+                 bidirectional: bool = True) -> None:
+        self.bandwidth[(a, b)] = bandwidth
+        self.latency[(a, b)] = latency
+        self.adjacency.setdefault(a, []).append(b)
+        if bidirectional:
+            self.bandwidth[(b, a)] = bandwidth
+            self.latency[(b, a)] = latency
+            self.adjacency.setdefault(b, []).append(a)
+
+    def neighbors(self, a: int) -> List[int]:
+        return self.adjacency.get(a, [])
+
+    # ---- generators (reference: simulator.h:413-488) ---------------------
+    @staticmethod
+    def torus(dims: Sequence[int], bandwidth: float, latency: float) -> "Topology":
+        """ICI k-D torus: wraparound neighbor links along each axis.
+        1-sized axes are skipped; a 2-length axis gets a single link
+        (no distinct wraparound)."""
+        dims = tuple(int(d) for d in dims if d > 1) or (1,)
+        n = 1
+        for d in dims:
+            n *= d
+        topo = Topology(num_nodes=n, torus_dims=dims)
+
+        def flat(coord):
+            out = 0
+            for c, d in zip(coord, dims):
+                out = out * d + c
+            return out
+
+        for coord in itertools.product(*[range(d) for d in dims]):
+            for ax, d in enumerate(dims):
+                if d <= 1:
+                    continue
+                nxt = list(coord)
+                nxt[ax] = (coord[ax] + 1) % d
+                if d == 2 and coord[ax] == 1:
+                    continue  # avoid double-adding the single 2-ring link
+                topo.add_link(flat(coord), flat(tuple(nxt)), bandwidth, latency)
+        return topo
+
+    @staticmethod
+    def fully_connected(n: int, bandwidth: float, latency: float) -> "Topology":
+        topo = Topology(num_nodes=n)
+        for a in range(n):
+            for b in range(a + 1, n):
+                topo.add_link(a, b, bandwidth, latency)
+        return topo
+
+    @staticmethod
+    def big_switch(n: int, bandwidth: float, latency: float) -> "Topology":
+        """Hosts hanging off one switch (node id n) — the DCN model."""
+        topo = Topology(num_nodes=n + 1)
+        for a in range(n):
+            topo.add_link(a, n, bandwidth, latency)
+        return topo
+
+
+class RoutingStrategy:
+    def route(self, topo: Topology, src: int, dst: int) -> List[List[Link]]:
+        """List of parallel paths (each a link list); flow splits evenly."""
+        raise NotImplementedError
+
+
+class ShortestPathRouting(RoutingStrategy):
+    """Latency-weighted Dijkstra, single path
+    (reference: network.cc WeightedShortestPathRoutingStrategy)."""
+
+    def route(self, topo, src, dst):
+        if src == dst:
+            return [[]]
+        dist = {src: 0.0}
+        prev: Dict[int, int] = {}
+        pq = [(0.0, src)]
+        while pq:
+            d, u = heapq.heappop(pq)
+            if u == dst:
+                break
+            if d > dist.get(u, math.inf):
+                continue
+            for v in topo.neighbors(u):
+                nd = d + topo.latency[(u, v)]
+                if nd < dist.get(v, math.inf):
+                    dist[v] = nd
+                    prev[v] = u
+                    heapq.heappush(pq, (nd, v))
+        if dst not in dist:
+            raise ValueError(f"no route {src}->{dst}")
+        path: List[Link] = []
+        v = dst
+        while v != src:
+            u = prev[v]
+            path.append((u, v))
+            v = u
+        path.reverse()
+        return [path]
+
+
+def _minimal_torus_route(topo: Topology, src: int, dst: int,
+                         axis_order: Sequence[int]) -> List[Link]:
+    """Minimal torus walk traversing axes in ``axis_order``, taking the
+    shorter wraparound direction per axis (the ONE implementation shared
+    by dimension-ordered and ECMP routing)."""
+    dims = topo.torus_dims
+
+    def coords(x):
+        out = []
+        for d in reversed(dims):
+            out.append(x % d)
+            x //= d
+        return list(reversed(out))
+
+    def flat(coord):
+        out = 0
+        for c, d in zip(coord, dims):
+            out = out * d + c
+        return out
+
+    cur = coords(src)
+    tgt = coords(dst)
+    path: List[Link] = []
+    for ax in axis_order:
+        d = dims[ax]
+        while cur[ax] != tgt[ax]:
+            fwd_hops = (tgt[ax] - cur[ax]) % d
+            step = 1 if fwd_hops <= d - fwd_hops else -1
+            nxt = list(cur)
+            nxt[ax] = (cur[ax] + step) % d
+            path.append((flat(cur), flat(nxt)))
+            cur = nxt
+    return path
+
+
+class DimensionOrderedRouting(RoutingStrategy):
+    """TPU ICI routing: traverse torus axes in order, taking the shorter
+    wraparound direction per axis — deterministic and minimal."""
+
+    def route(self, topo, src, dst):
+        dims = topo.torus_dims
+        assert dims, "dimension-ordered routing needs a torus topology"
+        return [_minimal_torus_route(topo, src, dst, range(len(dims)))]
+
+
+class WeightedECMPRouting(RoutingStrategy):
+    """Split flow across all per-axis-order variants of the minimal
+    route (reference: network.cc weighted-ECMP) — on a torus the
+    axis-permutation paths are link-disjoint in their first hops, which
+    spreads contention."""
+
+    def route(self, topo, src, dst):
+        dims = topo.torus_dims
+        if not dims or src == dst:
+            return DimensionOrderedRouting().route(topo, src, dst) if dims \
+                else ShortestPathRouting().route(topo, src, dst)
+        paths = []
+        seen = set()
+        for perm in itertools.permutations(range(len(dims))):
+            # reorder axis traversal by permuting the dim order
+            p = _minimal_torus_route(topo, src, dst, perm)
+            key = tuple(p)
+            if key not in seen:
+                seen.add(key)
+                paths.append(p)
+            if len(paths) >= 4:
+                break
+        return paths or DimensionOrderedRouting().route(topo, src, dst)
+
+
+@dataclass
+class NetworkedMachineModel:
+    """Topology + routing + traffic-matrix evaluation
+    (reference: machine_model.cc:965 NetworkedMachineModel)."""
+
+    topology: Topology
+    routing: RoutingStrategy = field(default_factory=ShortestPathRouting)
+
+    def p2p_time(self, src: int, dst: int, nbytes: float) -> float:
+        return self.traffic_time([(src, dst, nbytes)])
+
+    def traffic_time(self, flows: Sequence[Tuple[int, int, float]]) -> float:
+        """Finish time of concurrent flows: expand each onto its route,
+        accumulate per-link load, return max(load/bw) + worst path
+        latency (bandwidth-sharing contention model)."""
+        load: Dict[Link, float] = {}
+        worst_latency = 0.0
+        for src, dst, nbytes in flows:
+            if src == dst or nbytes <= 0:
+                continue
+            paths = self.routing.route(self.topology, src, dst)
+            share = nbytes / len(paths)
+            for path in paths:
+                lat = 0.0
+                for link in path:
+                    load[link] = load.get(link, 0.0) + share
+                    lat += self.topology.latency[link]
+                worst_latency = max(worst_latency, lat)
+        t = 0.0
+        for link, b in load.items():
+            t = max(t, b / self.topology.bandwidth[link])
+        return t + worst_latency
+
+    # ---- collectives routed over the fabric ------------------------------
+    def ring_allreduce_time(self, devices: Sequence[int], nbytes: float) -> float:
+        """Ring allreduce: 2(n-1) steps, each device sends nbytes/n to
+        its ring successor; contention-evaluated on the real links."""
+        n = len(devices)
+        if n <= 1 or nbytes <= 0:
+            return 0.0
+        chunk = nbytes / n
+        flows = [
+            (devices[i], devices[(i + 1) % n], chunk) for i in range(n)
+        ]
+        step = self.traffic_time(flows)
+        return 2 * (n - 1) * step
+
+    def allgather_time(self, devices: Sequence[int], nbytes_shard: float) -> float:
+        n = len(devices)
+        if n <= 1 or nbytes_shard <= 0:
+            return 0.0
+        flows = [
+            (devices[i], devices[(i + 1) % n], nbytes_shard) for i in range(n)
+        ]
+        return (n - 1) * self.traffic_time(flows)
+
+    def all_to_all_time(self, devices: Sequence[int], nbytes_shard: float) -> float:
+        n = len(devices)
+        if n <= 1 or nbytes_shard <= 0:
+            return 0.0
+        per_pair = nbytes_shard / n
+        flows = [
+            (a, b, per_pair) for a in devices for b in devices if a != b
+        ]
+        return self.traffic_time(flows)
+
+
+def ici_network(machine, routing: Optional[RoutingStrategy] = None,
+                num_devices: Optional[int] = None) -> NetworkedMachineModel:
+    """The standard ICI torus network for a MachineSpec: torus dims from
+    spec.ici_torus when they cover ``num_devices``, else a near-square
+    2-D factorization (v5e-style), else a 1-D ring."""
+    n = num_devices or machine.num_devices
+    dims = machine.ici_torus
+    prod = 1
+    for d in dims:
+        prod *= d
+    if not dims or prod != n:
+        side = int(math.isqrt(n))
+        while side > 1 and n % side:
+            side -= 1
+        dims = (side, n // side) if side > 1 else (n,)
+    topo = Topology.torus(dims, machine.ici_bandwidth, machine.ici_latency)
+    return NetworkedMachineModel(
+        topo, routing or DimensionOrderedRouting()
+    )

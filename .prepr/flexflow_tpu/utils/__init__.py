@@ -1,0 +1,1 @@
+"""Utility subpackage (reference: include/flexflow/utils/)."""

@@ -1,0 +1,42 @@
+// Native batch-assembly kernels for the host dataloader.
+//
+// TPU-native counterpart of the reference's C++/CUDA dataloader tasks
+// (reference: python/flexflow_dataloader.{h,cc,cu} — full arrays staged
+// once, then per-batch index-copy tasks).  On TPU the device transfer
+// is jax.device_put; what remains host-side — gathering shuffled rows
+// into a contiguous batch — is this multithreaded gather.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// dst[i, :] = src[indices[i], :] for i in [0, n_rows); rows are
+// row_bytes wide. Threaded for large batches.
+void ffn_gather_rows(uint8_t* dst, const uint8_t* src, const int64_t* indices,
+                     int64_t n_rows, int64_t row_bytes, int32_t n_threads) {
+  if (n_threads <= 1 || n_rows < 2 * n_threads) {
+    for (int64_t i = 0; i < n_rows; ++i)
+      std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n_rows + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(n_rows, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i)
+        std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                    static_cast<size_t>(row_bytes));
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

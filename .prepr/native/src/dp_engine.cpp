@@ -1,0 +1,895 @@
+// Native DP search engine: the full graph_cost recursion in C++.
+//
+// TPU-native counterpart of the reference's C++ search core
+// (reference: src/runtime/graph.cc:79-295 SearchHelper::graph_cost —
+// sequence splits at bottlenecks, nonsequence component splits over
+// SEQUENTIAL/VERTICAL resource partitions, leaf enumeration, dp_state
+// memoization at graph.cc:1356).  Python digests the graph once per
+// search (union candidate views per node with per-budget index lists,
+// per-edge xfer matrices over the view product) and every recursive
+// subproblem then runs natively over node BITMASKS — no per-leaf
+// marshalling, no Python recursion overhead.
+//
+// Semantics intentionally mirror flexflow_tpu/search/dp.py SearchHelper
+// in the DEFAULT cost currency (placement_overlap=False), where
+// * every op occupies all device timelines => ONE compute timeline,
+// * per-device memory = the sum over ops (all devices hold all bytes),
+// * weight syncs ride per-device COMM timelines over the view's
+//   first `parts` devices,
+// * start_part offsets are cost-inert (tests assert this), so the
+//   engine drops them entirely.
+// The overlap-aware planning mode and calibration fusion clusters stay
+// on the Python path (flexflow_tpu/search/dp.py decides eligibility).
+
+#include <algorithm>
+#include <array>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+const double kInf = std::numeric_limits<double>::infinity();
+constexpr int kMaskWords = 4;  // up to 256 nodes
+using Mask = std::array<uint64_t, kMaskWords>;
+
+inline bool mask_get(const Mask& m, int i) {
+  return (m[i >> 6] >> (i & 63)) & 1u;
+}
+inline void mask_set(Mask& m, int i) { m[i >> 6] |= uint64_t(1) << (i & 63); }
+inline void mask_clear(Mask& m, int i) {
+  m[i >> 6] &= ~(uint64_t(1) << (i & 63));
+}
+inline int mask_count(const Mask& m) {
+  int c = 0;
+  for (uint64_t w : m) c += __builtin_popcountll(w);
+  return c;
+}
+inline Mask mask_and(const Mask& a, const Mask& b) {
+  Mask r;
+  for (int i = 0; i < kMaskWords; ++i) r[i] = a[i] & b[i];
+  return r;
+}
+inline Mask mask_minus(const Mask& a, const Mask& b) {
+  Mask r;
+  for (int i = 0; i < kMaskWords; ++i) r[i] = a[i] & ~b[i];
+  return r;
+}
+inline bool mask_empty(const Mask& m) {
+  for (uint64_t w : m)
+    if (w) return false;
+  return true;
+}
+
+struct DpView {
+  double fwd = 0, full = 0, sync = 0, mem = 0;
+  int32_t parts = 1;
+  bool valid = true;
+};
+
+struct DpEdge {
+  int32_t src = 0, dst = 0;
+  bool has_grad = true;
+  std::vector<double> xfer;  // [src_view * n_dst_views + dst_view]
+};
+
+// fixed assignment: sorted (node, view) pairs
+using Fixed = std::vector<std::pair<int32_t, int32_t>>;
+
+struct MemoKey {
+  Mask mask;
+  int32_t budget;
+  Fixed fixed;
+  bool operator==(const MemoKey& o) const {
+    return mask == o.mask && budget == o.budget && fixed == o.fixed;
+  }
+};
+
+struct MemoKeyHash {
+  size_t operator()(const MemoKey& k) const {
+    uint64_t h = 1469598103934665603ull;
+    auto mix = [&h](uint64_t v) {
+      h ^= v;
+      h *= 1099511628211ull;
+    };
+    for (uint64_t w : k.mask) mix(w);
+    mix(static_cast<uint64_t>(k.budget));
+    for (auto& p : k.fixed) {
+      mix(static_cast<uint64_t>(p.first) << 32 |
+          static_cast<uint32_t>(p.second));
+    }
+    return static_cast<size_t>(h);
+  }
+};
+
+struct MemoVal {
+  double cost = kInf;
+  std::vector<int16_t> assign;  // full-length; -1 outside mask
+};
+
+struct DpCtx {
+  int32_t n = 0, num_devices = 0;
+  double mem_cap = kInf;
+  bool include_update = true;
+  int32_t leaf_threshold = 4;
+  int32_t max_tries = 2;
+
+  std::vector<std::vector<DpView>> views;   // per node (union)
+  std::vector<int32_t> fixed_view;          // op-pinned view idx or -1
+  std::vector<int32_t> trivial_idx;         // trivial view idx per node
+  std::vector<int32_t> guid_rank;           // guid-sort rank per node
+
+  std::vector<DpEdge> edges;
+  std::vector<std::vector<int32_t>> in_edges, out_edges;
+
+  std::vector<int32_t> budgets;             // sorted distinct budgets
+  std::vector<int32_t> cands;               // _sub_budgets candidates
+  // per (node * n_budgets + slot): index lists into views[node]
+  std::vector<int32_t> cand_off, cand_idx;
+  std::vector<int32_t> bview_off, bview_idx;
+  std::vector<int32_t> default_idx;         // per (node, budget slot)
+
+  std::unordered_map<MemoKey, MemoVal, MemoKeyHash> memo;
+  int32_t greedy_hits = 0;
+
+  // scratch
+  std::vector<double> ready, comm;
+
+  int budget_slot(int32_t b) const {
+    for (size_t i = 0; i < budgets.size(); ++i)
+      if (budgets[i] == b) return static_cast<int>(i);
+    return -1;
+  }
+  const int32_t* cand_list(int node, int slot, int* count) const {
+    size_t at = static_cast<size_t>(node) * budgets.size() + slot;
+    *count = cand_off[at + 1] - cand_off[at];
+    return cand_idx.data() + cand_off[at];
+  }
+  const int32_t* bview_list(int node, int slot, int* count) const {
+    size_t at = static_cast<size_t>(node) * budgets.size() + slot;
+    *count = bview_off[at + 1] - bview_off[at];
+    return bview_idx.data() + bview_off[at];
+  }
+};
+
+// ---------------------------------------------------------------------------
+// masked event simulation (single compute timeline; see header comment)
+double dp_simulate(DpCtx* c, const Mask& mask,
+                   const std::vector<int16_t>& assign) {
+  c->ready.assign(static_cast<size_t>(c->n), 0.0);
+  c->comm.assign(static_cast<size_t>(c->num_devices), 0.0);
+  double avail = 0.0, end_comm = 0.0, mem_total = 0.0;
+  for (int i = 0; i < c->n; ++i) {
+    if (!mask_get(mask, i)) continue;
+    int16_t vi = assign[i];
+    if (vi < 0 || static_cast<size_t>(vi) >= c->views[i].size()) return kInf;
+    const DpView& v = c->views[i][vi];
+    if (!v.valid) return kInf;
+    double start = avail;
+    for (int32_t ei : c->in_edges[i]) {
+      const DpEdge& e = c->edges[ei];
+      if (!mask_get(mask, e.src)) continue;
+      size_t nd = c->views[e.dst].size();
+      double x = e.xfer[static_cast<size_t>(assign[e.src]) * nd + vi];
+      if (x == kInf) return kInf;
+      if (c->include_update && e.has_grad) x *= 2.0;
+      double t = c->ready[e.src] + x;
+      if (t > start) start = t;
+    }
+    double dur = c->include_update ? v.full : v.fwd;
+    double finish = start + dur;
+    avail = finish;
+    c->ready[i] = finish;
+    mem_total += v.mem;
+    if (c->include_update && v.sync > 0.0) {
+      double s = finish;
+      int parts = std::min(v.parts, c->num_devices);
+      for (int d = 0; d < parts; ++d)
+        if (c->comm[d] > s) s = c->comm[d];
+      double f = s + v.sync;
+      for (int d = 0; d < parts; ++d) c->comm[d] = f;
+      if (f > end_comm) end_comm = f;
+    }
+  }
+  if (mem_total > c->mem_cap) return kInf;
+  return std::max(avail, end_comm);
+}
+
+// ---------------------------------------------------------------------------
+// masked graph helpers
+
+Mask ancestors(DpCtx* c, const Mask& mask, int node) {
+  Mask out{};
+  std::vector<int32_t> stack;
+  for (int32_t ei : c->in_edges[node])
+    if (mask_get(mask, c->edges[ei].src)) stack.push_back(c->edges[ei].src);
+  while (!stack.empty()) {
+    int g = stack.back();
+    stack.pop_back();
+    if (mask_get(out, g)) continue;
+    mask_set(out, g);
+    for (int32_t ei : c->in_edges[g])
+      if (mask_get(mask, c->edges[ei].src)) stack.push_back(c->edges[ei].src);
+  }
+  return out;
+}
+
+std::vector<Mask> components(DpCtx* c, const Mask& mask) {
+  std::vector<Mask> out;
+  Mask left = mask;
+  std::vector<int32_t> stack;
+  while (!mask_empty(left)) {
+    int seed = -1;
+    for (int i = 0; i < c->n; ++i)
+      if (mask_get(left, i)) {
+        seed = i;
+        break;
+      }
+    Mask comp{};
+    stack.push_back(seed);
+    while (!stack.empty()) {
+      int g = stack.back();
+      stack.pop_back();
+      if (!mask_get(left, g)) continue;
+      mask_clear(left, g);
+      mask_set(comp, g);
+      for (int32_t ei : c->in_edges[g])
+        if (mask_get(left, c->edges[ei].src))
+          stack.push_back(c->edges[ei].src);
+      for (int32_t ei : c->out_edges[g])
+        if (mask_get(left, c->edges[ei].dst))
+          stack.push_back(c->edges[ei].dst);
+    }
+    out.push_back(comp);
+  }
+  return out;
+}
+
+// bottleneck nodes of the masked graph in topo order (node index order
+// IS topo order): on every source->sink path, excluding sources/sinks
+// (mirror of Graph.bottlenecks / graph_algos.cpp, masked)
+std::vector<int> bottlenecks(DpCtx* c, const Mask& mask) {
+  int n = c->n;
+  std::vector<Mask> dom(n), pdom(n);
+  Mask srcs{}, sinks{};
+  for (int i = 0; i < n; ++i) {
+    if (!mask_get(mask, i)) continue;
+    bool has_in = false, has_out = false;
+    for (int32_t ei : c->in_edges[i])
+      if (mask_get(mask, c->edges[ei].src)) has_in = true;
+    for (int32_t ei : c->out_edges[i])
+      if (mask_get(mask, c->edges[ei].dst)) has_out = true;
+    if (!has_in) mask_set(srcs, i);
+    if (!has_out) mask_set(sinks, i);
+  }
+  // dominators forward in topo order
+  for (int i = 0; i < n; ++i) {
+    if (!mask_get(mask, i)) continue;
+    Mask d{};
+    bool first = true;
+    for (int32_t ei : c->in_edges[i]) {
+      int s = c->edges[ei].src;
+      if (!mask_get(mask, s)) continue;
+      if (first) {
+        d = dom[s];
+        first = false;
+      } else {
+        d = mask_and(d, dom[s]);
+      }
+    }
+    mask_set(d, i);
+    dom[i] = d;
+  }
+  // post-dominators in reverse topo order
+  for (int i = n - 1; i >= 0; --i) {
+    if (!mask_get(mask, i)) continue;
+    Mask d{};
+    bool first = true;
+    for (int32_t ei : c->out_edges[i]) {
+      int t = c->edges[ei].dst;
+      if (!mask_get(mask, t)) continue;
+      if (first) {
+        d = pdom[t];
+        first = false;
+      } else {
+        d = mask_and(d, pdom[t]);
+      }
+    }
+    mask_set(d, i);
+    pdom[i] = d;
+  }
+  Mask common_dom{}, common_pdom{};
+  bool first = true;
+  for (int i = 0; i < n; ++i)
+    if (mask_get(sinks, i)) {
+      common_dom = first ? dom[i] : mask_and(common_dom, dom[i]);
+      first = false;
+    }
+  first = true;
+  for (int i = 0; i < n; ++i)
+    if (mask_get(srcs, i)) {
+      common_pdom = first ? pdom[i] : mask_and(common_pdom, pdom[i]);
+      first = false;
+    }
+  Mask cands = mask_and(common_dom, common_pdom);
+  cands = mask_minus(cands, srcs);
+  cands = mask_minus(cands, sinks);
+  std::vector<int> out;
+  for (int i = 0; i < n; ++i)
+    if (mask_get(cands, i)) out.push_back(i);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// the DP recursion (mirrors dp.py SearchHelper)
+
+struct CostResult {
+  double cost = kInf;
+  std::vector<int16_t> assign;
+};
+
+Fixed restrict_fixed(const Fixed& fixed, const Mask& mask) {
+  Fixed out;
+  for (auto& p : fixed)
+    if (mask_get(mask, p.first)) out.push_back(p);
+  return out;
+}
+
+CostResult graph_cost(DpCtx* c, const Mask& mask, const Fixed& fixed,
+                      int32_t budget);
+
+double graph_cost_only(DpCtx* c, const Mask& mask, const Fixed& fixed,
+                       int32_t budget) {
+  return graph_cost(c, mask, fixed, budget).cost;
+}
+
+void default_assign(DpCtx* c, const Mask& mask, const Fixed& fixed,
+                    int slot, std::vector<int16_t>* assign) {
+  assign->assign(static_cast<size_t>(c->n), -1);
+  for (auto& p : fixed) (*assign)[p.first] = static_cast<int16_t>(p.second);
+  for (int i = 0; i < c->n; ++i) {
+    if (!mask_get(mask, i) || (*assign)[i] >= 0) continue;
+    if (c->fixed_view[i] >= 0) {
+      (*assign)[i] = static_cast<int16_t>(c->fixed_view[i]);
+    } else {
+      (*assign)[i] = static_cast<int16_t>(
+          c->default_idx[static_cast<size_t>(i) * c->budgets.size() + slot]);
+    }
+  }
+}
+
+CostResult leaf_cost(DpCtx* c, const Mask& mask, const Fixed& fixed,
+                     int32_t budget) {
+  int slot = c->budget_slot(budget);
+  std::vector<int16_t> base(static_cast<size_t>(c->n), -1);
+  Mask fixed_mask{};
+  for (auto& p : fixed) {
+    base[p.first] = static_cast<int16_t>(p.second);
+    mask_set(fixed_mask, p.first);
+  }
+  std::vector<int> free;
+  for (int i = 0; i < c->n; ++i)
+    if (mask_get(mask, i) && !mask_get(fixed_mask, i)) free.push_back(i);
+  // guid order (dp.py sorts free nodes by guid; tie-breaking parity)
+  std::sort(free.begin(), free.end(), [c](int a, int b) {
+    return c->guid_rank[a] < c->guid_rank[b];
+  });
+
+  CostResult r;
+  if (free.empty()) {
+    r.cost = dp_simulate(c, mask, base);
+    r.assign = base;
+    return r;
+  }
+  bool use_bviews = false;
+  double combos = 1;
+  for (int i : free) {
+    int cnt;
+    c->cand_list(i, slot, &cnt);
+    combos *= std::max(cnt, 1);
+    if (combos > 262144.0) break;
+  }
+  if (combos > 262144.0) {
+    use_bviews = true;
+    combos = 1;
+    for (int i : free) {
+      int cnt;
+      c->bview_list(i, slot, &cnt);
+      combos *= std::max(cnt, 1);
+      if (combos > 262144.0) break;
+    }
+  }
+  auto list_for = [&](int node, int* cnt) {
+    return use_bviews ? c->bview_list(node, slot, cnt)
+                      : c->cand_list(node, slot, cnt);
+  };
+  if (combos > 262144.0) {
+    // greedy fallback (dp.py _greedy_cost): topo order, each free node
+    // takes the view minimizing the simulated partial assignment,
+    // not-yet-assigned nodes at their default (fixed or trivial) view
+    c->greedy_hits += 1;
+    std::vector<int16_t> cur = base;
+    for (int i = 0; i < c->n; ++i) {
+      if (!mask_get(mask, i) || cur[i] >= 0) continue;
+      cur[i] = static_cast<int16_t>(
+          c->fixed_view[i] >= 0 ? c->fixed_view[i] : c->trivial_idx[i]);
+    }
+    for (int i = 0; i < c->n; ++i) {  // topo order
+      if (!mask_get(mask, i) || mask_get(fixed_mask, i)) continue;
+      int cnt;
+      const int32_t* lst = c->cand_list(i, slot, &cnt);
+      double best_c = kInf;
+      int16_t best_v = cur[i];
+      for (int k = 0; k < cnt; ++k) {
+        cur[i] = static_cast<int16_t>(lst[k]);
+        double cc = dp_simulate(c, mask, cur);
+        if (cc < best_c) {
+          best_c = cc;
+          best_v = cur[i];
+        }
+      }
+      cur[i] = best_v;
+    }
+    r.cost = dp_simulate(c, mask, cur);
+    r.assign = cur;
+    return r;
+  }
+  // brute force over the view product (odometer in free-list order)
+  std::vector<int> odo(free.size(), 0);
+  std::vector<int16_t> cur = base;
+  std::vector<const int32_t*> lists(free.size());
+  std::vector<int> counts(free.size());
+  for (size_t k = 0; k < free.size(); ++k) {
+    lists[k] = list_for(free[k], &counts[k]);
+    if (counts[k] == 0) {  // no candidates: fall back to default view
+      r.cost = kInf;
+      r.assign = base;
+      return r;
+    }
+    cur[free[k]] = static_cast<int16_t>(lists[k][0]);
+  }
+  while (true) {
+    double cc = dp_simulate(c, mask, cur);
+    if (cc < r.cost) {
+      r.cost = cc;
+      r.assign = cur;
+    }
+    size_t k = 0;
+    for (; k < free.size(); ++k) {
+      odo[k]++;
+      if (odo[k] < counts[k]) {
+        cur[free[k]] = static_cast<int16_t>(lists[k][odo[k]]);
+        break;
+      }
+      odo[k] = 0;
+      cur[free[k]] = static_cast<int16_t>(lists[k][0]);
+    }
+    if (k == free.size()) break;
+  }
+  if (r.assign.empty()) r.assign = base;
+  return r;
+}
+
+// budget split pairs (dp.py _sub_budgets)
+std::vector<std::pair<int32_t, int32_t>> sub_budgets(DpCtx* c,
+                                                     int32_t budget) {
+  std::vector<std::pair<int32_t, int32_t>> out;
+  for (int32_t a : c->cands) {
+    if (a >= budget) continue;
+    int32_t rest = budget - a, b = 0;
+    for (int32_t d : c->cands)
+      if (d <= rest && d > b) b = d;
+    if (b >= 1) out.emplace_back(a, b);
+  }
+  return out;
+}
+
+CostResult component_cost(DpCtx* c, const Mask& mask, const Fixed& fixed,
+                          int32_t budget, const std::vector<Mask>& comps,
+                          bool cost_only, double* out_cost) {
+  // sort comps by (-size, min node)
+  std::vector<int> order(comps.size());
+  for (size_t i = 0; i < comps.size(); ++i) order[i] = static_cast<int>(i);
+  auto comp_key = [&](int i) {
+    int sz = mask_count(comps[i]);
+    int mn = c->n;
+    for (int j = 0; j < c->n; ++j)
+      if (mask_get(comps[i], j)) {
+        mn = j;
+        break;
+      }
+    return std::make_pair(-sz, mn);
+  };
+  std::sort(order.begin(), order.end(),
+            [&](int a, int b) { return comp_key(a) < comp_key(b); });
+  Mask first = comps[order[0]];
+  Mask rest = mask_minus(mask, first);
+
+  Fixed f_first = restrict_fixed(fixed, first);
+  Fixed f_rest = restrict_fixed(fixed, rest);
+
+  double c_seq = graph_cost_only(c, first, f_first, budget) +
+                 graph_cost_only(c, rest, f_rest, budget);
+  double best_c = c_seq;
+  // plan: (mask_a, budget_a, mask_b, budget_b)
+  Mask pa = first, pb = rest;
+  int32_t ba = budget, bb = budget;
+  for (auto& ab : sub_budgets(c, budget)) {
+    for (int flip = 0; flip < 2; ++flip) {
+      const Mask& ga = flip ? rest : first;
+      const Mask& gb = flip ? first : rest;
+      double ca = graph_cost_only(c, ga, restrict_fixed(fixed, ga), ab.first);
+      if (ca >= best_c) continue;
+      double cb =
+          graph_cost_only(c, gb, restrict_fixed(fixed, gb), ab.second);
+      double par = std::max(ca, cb);
+      if (par < best_c) {
+        best_c = par;
+        pa = ga;
+        pb = gb;
+        ba = ab.first;
+        bb = ab.second;
+      }
+    }
+  }
+  if (cost_only) {
+    *out_cost = best_c;
+    return CostResult{};
+  }
+  CostResult ra = graph_cost(c, pa, restrict_fixed(fixed, pa), ba);
+  CostResult rb = graph_cost(c, pb, restrict_fixed(fixed, pb), bb);
+  CostResult r;
+  r.cost = best_c;
+  r.assign.assign(static_cast<size_t>(c->n), -1);
+  for (int i = 0; i < c->n; ++i) {
+    if (mask_get(pa, i) && !ra.assign.empty()) r.assign[i] = ra.assign[i];
+    if (mask_get(pb, i) && !rb.assign.empty()) r.assign[i] = rb.assign[i];
+  }
+  *out_cost = best_c;
+  return r;
+}
+
+bool interior_split(DpCtx* c, const Mask& mask, const Fixed& fixed,
+                    int32_t budget, CostResult* out) {
+  Mask srcs{}, sinks{};
+  for (int i = 0; i < c->n; ++i) {
+    if (!mask_get(mask, i)) continue;
+    bool has_in = false, has_out = false;
+    for (int32_t ei : c->in_edges[i])
+      if (mask_get(mask, c->edges[ei].src)) has_in = true;
+    for (int32_t ei : c->out_edges[i])
+      if (mask_get(mask, c->edges[ei].dst)) has_out = true;
+    if (!has_in) mask_set(srcs, i);
+    if (!has_out) mask_set(sinks, i);
+  }
+  Mask bounds = srcs;
+  for (int w = 0; w < kMaskWords; ++w) bounds[w] |= sinks[w];
+  Mask interior = mask_minus(mask, bounds);
+  if (mask_empty(interior) || mask_empty(bounds)) return false;
+  auto comps = components(c, interior);
+  if (comps.size() < 2) return false;
+
+  Mask fixed_mask{};
+  for (auto& p : fixed) mask_set(fixed_mask, p.first);
+  std::vector<int> unfixed;
+  for (int i = 0; i < c->n; ++i)
+    if (mask_get(bounds, i) && !mask_get(fixed_mask, i)) unfixed.push_back(i);
+  std::sort(unfixed.begin(), unfixed.end(), [c](int a, int b) {
+    return c->guid_rank[a] < c->guid_rank[b];
+  });
+  int slot = c->budget_slot(budget);
+  std::vector<const int32_t*> lists(unfixed.size());
+  std::vector<int> counts(unfixed.size());
+  double combos = 1;
+  for (size_t k = 0; k < unfixed.size(); ++k) {
+    lists[k] = c->bview_list(unfixed[k], slot, &counts[k]);
+    combos *= std::max(counts[k], 1);
+  }
+  if (combos > 256.0) {
+    for (size_t k = 0; k < unfixed.size(); ++k)
+      counts[k] = std::min(counts[k], 1);
+  }
+  double best_c = kInf;
+  std::vector<int16_t> best_assign;
+  std::vector<int> odo(unfixed.size(), 0);
+  while (true) {
+    Fixed f2 = fixed;
+    for (size_t k = 0; k < unfixed.size(); ++k) {
+      if (counts[k] > 0)
+        f2.emplace_back(unfixed[k], lists[k][odo[k]]);
+    }
+    std::sort(f2.begin(), f2.end());
+    Fixed f2_in = restrict_fixed(f2, interior);
+    double c_in;
+    component_cost(c, interior, f2_in, budget, comps, true, &c_in);
+    if (c_in < best_c) {
+      double dummy;
+      CostResult rin =
+          component_cost(c, interior, f2_in, budget, comps, false, &dummy);
+      std::vector<int16_t> assign(static_cast<size_t>(c->n), -1);
+      for (auto& p : f2)
+        if (mask_get(mask, p.first))
+          assign[p.first] = static_cast<int16_t>(p.second);
+      for (int i = 0; i < c->n; ++i)
+        if (mask_get(interior, i) && !rin.assign.empty())
+          assign[i] = rin.assign[i];
+      double cc = dp_simulate(c, mask, assign);
+      if (cc < best_c) {
+        best_c = cc;
+        best_assign = assign;
+      }
+    }
+    size_t k = 0;
+    for (; k < unfixed.size(); ++k) {
+      odo[k]++;
+      if (odo[k] < std::max(counts[k], 1)) break;
+      odo[k] = 0;
+    }
+    if (k == unfixed.size() || unfixed.empty()) break;
+  }
+  if (best_c < kInf) {
+    out->cost = best_c;
+    out->assign = std::move(best_assign);
+    return true;
+  }
+  return false;
+}
+
+CostResult graph_cost_uncached(DpCtx* c, const Mask& mask, const Fixed& fixed,
+                               int32_t budget) {
+  int n_nodes = mask_count(mask);
+  int n_free = n_nodes - static_cast<int>(fixed.size());
+  if (n_nodes <= c->leaf_threshold || n_free <= 2)
+    return leaf_cost(c, mask, fixed, budget);
+
+  auto comps = components(c, mask);
+  if (comps.size() > 1) {
+    double cost;
+    CostResult r = component_cost(c, mask, fixed, budget, comps, false, &cost);
+    return r;
+  }
+
+  Mask fixed_mask{};
+  for (auto& p : fixed) mask_set(fixed_mask, p.first);
+  std::vector<int> bns;
+  for (int b : bottlenecks(c, mask))
+    if (!mask_get(fixed_mask, b)) bns.push_back(b);
+  bool large = n_nodes > 6 * c->leaf_threshold;
+  std::vector<int> tries;
+  if (large && !bns.empty()) {
+    tries.push_back(bns[bns.size() / 2]);
+  } else if (!bns.empty()) {
+    // _pick_bottlenecks: k evenly spaced + the middle, dedup, cap k+1
+    int k = c->max_tries;
+    if (static_cast<int>(bns.size()) <= k) {
+      tries = bns;
+    } else {
+      std::vector<int> idxs;
+      for (int i = 0; i < k; ++i)
+        idxs.push_back(static_cast<int>(
+            std::lround(double(i) * (bns.size() - 1) / (k - 1))));
+      idxs.push_back(static_cast<int>(bns.size() / 2));
+      std::sort(idxs.begin(), idxs.end());
+      idxs.erase(std::unique(idxs.begin(), idxs.end()), idxs.end());
+      for (size_t i = 0; i < idxs.size() && i < static_cast<size_t>(k + 1);
+           ++i)
+        tries.push_back(bns[idxs[i]]);
+    }
+  }
+
+  int slot = c->budget_slot(budget);
+  double best_c = kInf;
+  int best_bn = -1, best_v = -1;
+  Mask best_pre{}, best_post{};
+  for (int bn : tries) {
+    Mask anc = ancestors(c, mask, bn);
+    Mask pre = anc;
+    mask_set(pre, bn);
+    Mask post = mask_minus(mask, anc);  // keeps bn
+    if (mask_count(pre) <= 1 || mask_count(post) <= 1) continue;
+    int cnt;
+    const int32_t* bl = c->bview_list(bn, slot, &cnt);
+    for (int k = 0; k < cnt; ++k) {
+      Fixed f2 = fixed;
+      f2.emplace_back(bn, bl[k]);
+      std::sort(f2.begin(), f2.end());
+      double c_pre = graph_cost_only(c, pre, restrict_fixed(f2, pre), budget);
+      if (c_pre >= best_c) continue;
+      double c_post =
+          graph_cost_only(c, post, restrict_fixed(f2, post), budget);
+      double total = c_pre + c_post;
+      if (total < best_c) {
+        best_c = total;
+        best_bn = bn;
+        best_v = bl[k];
+        best_pre = pre;
+        best_post = post;
+      }
+    }
+  }
+  if (best_bn >= 0) {
+    Fixed f2 = fixed;
+    f2.emplace_back(best_bn, best_v);
+    std::sort(f2.begin(), f2.end());
+    CostResult ra =
+        graph_cost(c, best_pre, restrict_fixed(f2, best_pre), budget);
+    CostResult rb =
+        graph_cost(c, best_post, restrict_fixed(f2, best_post), budget);
+    CostResult r;
+    r.cost = best_c;
+    r.assign.assign(static_cast<size_t>(c->n), -1);
+    for (int i = 0; i < c->n; ++i) {
+      if (mask_get(best_pre, i) && !ra.assign.empty())
+        r.assign[i] = ra.assign[i];
+      if (mask_get(best_post, i) && !rb.assign.empty())
+        r.assign[i] = rb.assign[i];
+    }
+    r.assign[best_bn] = static_cast<int16_t>(best_v);
+    return r;
+  }
+
+  CostResult r;
+  if (interior_split(c, mask, fixed, budget, &r)) return r;
+  return leaf_cost(c, mask, fixed, budget);
+}
+
+CostResult graph_cost(DpCtx* c, const Mask& mask, const Fixed& fixed,
+                      int32_t budget) {
+  MemoKey key{mask, budget, restrict_fixed(fixed, mask)};
+  auto hit = c->memo.find(key);
+  if (hit != c->memo.end()) {
+    CostResult r;
+    r.cost = hit->second.cost;
+    r.assign = hit->second.assign;
+    return r;
+  }
+  CostResult r = graph_cost_uncached(c, mask, key.fixed, budget);
+  // _finish: ground the composed strategy in the simulator, then floor
+  // against the batch-parallel default (dp.py:219-234)
+  if (!r.assign.empty()) {
+    r.cost = dp_simulate(c, mask, r.assign);
+  }
+  int slot = c->budget_slot(budget);
+  std::vector<int16_t> dflt;
+  default_assign(c, mask, key.fixed, slot, &dflt);
+  double c_dp = dp_simulate(c, mask, dflt);
+  if (c_dp < r.cost) {
+    r.cost = c_dp;
+    r.assign = dflt;
+  }
+  MemoVal mv;
+  mv.cost = r.cost;
+  mv.assign = r.assign;
+  c->memo.emplace(std::move(key), std::move(mv));
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+DpCtx* ffn_dp_create(int32_t num_nodes, int32_t num_devices, double mem_cap,
+                     int32_t include_update, int32_t leaf_threshold,
+                     int32_t max_tries) {
+  if (num_nodes > kMaskWords * 64) return nullptr;
+  DpCtx* c = new DpCtx();
+  c->n = num_nodes;
+  c->num_devices = num_devices;
+  c->mem_cap = mem_cap;
+  c->include_update = include_update != 0;
+  c->leaf_threshold = leaf_threshold;
+  c->max_tries = max_tries;
+  c->views.resize(num_nodes);
+  c->fixed_view.assign(num_nodes, -1);
+  c->trivial_idx.assign(num_nodes, 0);
+  c->guid_rank.assign(num_nodes, 0);
+  c->in_edges.resize(num_nodes);
+  c->out_edges.resize(num_nodes);
+  return c;
+}
+
+void ffn_dp_destroy(DpCtx* c) { delete c; }
+
+void ffn_dp_add_view(DpCtx* c, int32_t node, double fwd, double full,
+                     double sync, double mem, int32_t parts, int32_t valid) {
+  DpView v;
+  v.fwd = fwd;
+  v.full = full;
+  v.sync = sync;
+  v.mem = mem;
+  v.parts = parts;
+  v.valid = valid != 0;
+  c->views[node].push_back(v);
+}
+
+// bulk upload: node_off is an n+1 prefix array into the flat arrays
+// (per-view ctypes calls dominated the per-graph digest cost)
+void ffn_dp_set_views(DpCtx* c, const int32_t* node_off, const double* fwd,
+                      const double* full, const double* sync,
+                      const double* mem, const int32_t* parts,
+                      const uint8_t* valid) {
+  for (int i = 0; i < c->n; ++i) {
+    c->views[i].clear();
+    c->views[i].reserve(node_off[i + 1] - node_off[i]);
+    for (int32_t k = node_off[i]; k < node_off[i + 1]; ++k) {
+      DpView v;
+      v.fwd = fwd[k];
+      v.full = full[k];
+      v.sync = sync[k];
+      v.mem = mem[k];
+      v.parts = parts[k];
+      v.valid = valid[k] != 0;
+      c->views[i].push_back(v);
+    }
+  }
+}
+
+void ffn_dp_set_node_meta(DpCtx* c, const int32_t* fixed_view,
+                          const int32_t* trivial_idx,
+                          const int32_t* guid_rank) {
+  for (int i = 0; i < c->n; ++i) {
+    c->fixed_view[i] = fixed_view[i];
+    c->trivial_idx[i] = trivial_idx[i];
+    c->guid_rank[i] = guid_rank[i];
+  }
+}
+
+void ffn_dp_set_budgets(DpCtx* c, const int32_t* budgets, int32_t nb,
+                        const int32_t* cands, int32_t nc) {
+  c->budgets.assign(budgets, budgets + nb);
+  c->cands.assign(cands, cands + nc);
+}
+
+// cand_off/bview_off: length n*nb+1 prefix arrays; default_idx: n*nb
+void ffn_dp_set_lists(DpCtx* c, const int32_t* cand_off,
+                      const int32_t* cand_idx, int32_t n_ci,
+                      const int32_t* bview_off, const int32_t* bview_idx,
+                      int32_t n_bi, const int32_t* default_idx) {
+  size_t no = static_cast<size_t>(c->n) * c->budgets.size() + 1;
+  c->cand_off.assign(cand_off, cand_off + no);
+  c->cand_idx.assign(cand_idx, cand_idx + n_ci);
+  c->bview_off.assign(bview_off, bview_off + no);
+  c->bview_idx.assign(bview_idx, bview_idx + n_bi);
+  c->default_idx.assign(default_idx, default_idx + no - 1);
+}
+
+void ffn_dp_add_edge(DpCtx* c, int32_t src, int32_t dst, int32_t has_grad,
+                     const double* xfer) {
+  DpEdge e;
+  e.src = src;
+  e.dst = dst;
+  e.has_grad = has_grad != 0;
+  e.xfer.assign(xfer,
+                xfer + c->views[src].size() * c->views[dst].size());
+  int32_t idx = static_cast<int32_t>(c->edges.size());
+  c->edges.push_back(std::move(e));
+  c->in_edges[dst].push_back(idx);
+  c->out_edges[src].push_back(idx);
+}
+
+// mask_words: 4 x u64 node bitmask; fixed_*: n_fixed pairs;
+// out_assign: length num_nodes int32 (view idx per node, -1 outside).
+double ffn_dp_graph_cost(DpCtx* c, const uint64_t* mask_words,
+                         const int32_t* fixed_nodes,
+                         const int32_t* fixed_views, int32_t n_fixed,
+                         int32_t budget, int32_t* out_assign) {
+  Mask mask{};
+  for (int i = 0; i < kMaskWords; ++i) mask[i] = mask_words[i];
+  Fixed fixed;
+  for (int32_t i = 0; i < n_fixed; ++i)
+    fixed.emplace_back(fixed_nodes[i], fixed_views[i]);
+  std::sort(fixed.begin(), fixed.end());
+  CostResult r = graph_cost(c, mask, fixed, budget);
+  if (out_assign) {
+    for (int i = 0; i < c->n; ++i)
+      out_assign[i] = r.assign.empty() ? -1 : r.assign[i];
+  }
+  return r.cost;
+}
+
+int32_t ffn_dp_greedy_hits(DpCtx* c) { return c->greedy_hits; }
+
+}  // extern "C"

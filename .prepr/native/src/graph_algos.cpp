@@ -1,0 +1,155 @@
+// Native PCG graph algorithms (bitset dataflow).
+//
+// TPU-native counterpart of the reference's C++ graph core
+// (reference: src/runtime/graph.cc:580 find_bottleneck_node,
+// include/flexflow/dominators.h — dominator/post-dominator machinery
+// used to pick sequence-split points during the Unity search).
+// Semantics mirror flexflow_tpu/core/graph.py (dominators(),
+// bottlenecks(), weakly_connected_components()) exactly; the Python
+// layer maps node guids onto dense indices before calling in.
+
+#include <cstddef>
+#include <cstdint>
+#include <queue>
+#include <vector>
+
+namespace {
+
+using Bits = std::vector<uint64_t>;
+
+inline void bits_set(Bits& b, int32_t i) { b[i >> 6] |= 1ull << (i & 63); }
+inline bool bits_get(const Bits& b, int32_t i) {
+  return (b[i >> 6] >> (i & 63)) & 1;
+}
+inline void bits_and(Bits& a, const Bits& b) {
+  for (size_t w = 0; w < a.size(); ++w) a[w] &= b[w];
+}
+
+struct Adj {
+  std::vector<std::vector<int32_t>> out, in;
+  Adj(int32_t n, const int32_t* edges, int32_t m) : out(n), in(n) {
+    for (int32_t e = 0; e < m; ++e) {
+      out[edges[2 * e]].push_back(edges[2 * e + 1]);
+      in[edges[2 * e + 1]].push_back(edges[2 * e]);
+    }
+  }
+};
+
+// Kahn topo order with min-index tie-break (matches the Python heap).
+bool topo_order(const Adj& adj, std::vector<int32_t>* order) {
+  int32_t n = static_cast<int32_t>(adj.out.size());
+  std::vector<int32_t> indeg(n, 0);
+  for (int32_t v = 0; v < n; ++v)
+    indeg[v] = static_cast<int32_t>(adj.in[v].size());
+  std::priority_queue<int32_t, std::vector<int32_t>, std::greater<int32_t>> pq;
+  for (int32_t v = 0; v < n; ++v)
+    if (indeg[v] == 0) pq.push(v);
+  order->clear();
+  while (!pq.empty()) {
+    int32_t v = pq.top();
+    pq.pop();
+    order->push_back(v);
+    for (int32_t w : adj.out[v])
+      if (--indeg[w] == 0) pq.push(w);
+  }
+  return static_cast<int32_t>(order->size()) == n;
+}
+
+// dom(v) = nodes on every path from any source to v (multi-source DAG).
+void dominators(const Adj& adj, const std::vector<int32_t>& order,
+                std::vector<Bits>* dom) {
+  int32_t n = static_cast<int32_t>(adj.out.size());
+  size_t words = static_cast<size_t>((n + 63) / 64);
+  dom->assign(n, Bits(words, 0));
+  for (int32_t v : order) {
+    Bits& d = (*dom)[v];
+    if (adj.in[v].empty()) {
+      // source: dom = {v}
+    } else {
+      d = (*dom)[adj.in[v][0]];
+      for (size_t k = 1; k < adj.in[v].size(); ++k) bits_and(d, (*dom)[adj.in[v][k]]);
+    }
+    bits_set(d, v);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Topo order (Kahn, min-index ties). Returns n on success, -1 on cycle.
+int32_t ffn_graph_topo(int32_t n, const int32_t* edges, int32_t m,
+                       int32_t* out) {
+  Adj adj(n, edges, m);
+  std::vector<int32_t> order;
+  if (!topo_order(adj, &order)) return -1;
+  for (int32_t i = 0; i < n; ++i) out[i] = order[i];
+  return n;
+}
+
+// Bottlenecks: nodes on EVERY source->sink path, excluding sources and
+// sinks, in topo order. Returns count (or -1 on cycle).
+int32_t ffn_graph_bottlenecks(int32_t n, const int32_t* edges, int32_t m,
+                              int32_t* out) {
+  Adj adj(n, edges, m);
+  std::vector<int32_t> order;
+  if (!topo_order(adj, &order)) return -1;
+
+  std::vector<Bits> dom;
+  dominators(adj, order, &dom);
+  // post-dominators = dominators on the reversed graph
+  Adj radj(n, nullptr, 0);
+  radj.out = adj.in;
+  radj.in = adj.out;
+  std::vector<int32_t> rorder(order.rbegin(), order.rend());
+  std::vector<Bits> pdom;
+  dominators(radj, rorder, &pdom);
+
+  const size_t words = static_cast<size_t>((n + 63) / 64);
+  Bits common(words, ~0ull);
+  bool any_sink = false, any_src = false;
+  for (int32_t v = 0; v < n; ++v) {
+    if (adj.out[v].empty()) { bits_and(common, dom[v]); any_sink = true; }
+  }
+  for (int32_t v = 0; v < n; ++v) {
+    if (adj.in[v].empty()) { bits_and(common, pdom[v]); any_src = true; }
+  }
+  if (!any_sink || !any_src) return 0;
+
+  int32_t count = 0;
+  for (int32_t v : order) {
+    if (adj.in[v].empty() || adj.out[v].empty()) continue;  // src/sink
+    if (bits_get(common, v)) out[count++] = v;
+  }
+  return count;
+}
+
+// Weakly connected components. labels[v] = component id, ids assigned in
+// order of each component's smallest node index. Returns component count.
+int32_t ffn_graph_components(int32_t n, const int32_t* edges, int32_t m,
+                             int32_t* labels) {
+  std::vector<int32_t> parent(n);
+  for (int32_t v = 0; v < n; ++v) parent[v] = v;
+  // union-find with path halving
+  auto find = [&](int32_t x) {
+    while (parent[x] != x) {
+      parent[x] = parent[parent[x]];
+      x = parent[x];
+    }
+    return x;
+  };
+  for (int32_t e = 0; e < m; ++e) {
+    int32_t a = find(edges[2 * e]), b = find(edges[2 * e + 1]);
+    if (a != b) parent[a] = b;
+  }
+  std::vector<int32_t> remap(n, -1);
+  int32_t next = 0;
+  for (int32_t v = 0; v < n; ++v) {
+    int32_t r = find(v);
+    if (remap[r] < 0) remap[r] = next++;
+    labels[v] = remap[r];
+  }
+  return next;
+}
+
+}  // extern "C"

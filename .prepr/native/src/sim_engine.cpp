@@ -1,0 +1,246 @@
+// Native event-driven strategy-simulation engine.
+//
+// TPU-native counterpart of the reference's C++ simulator core
+// (reference: src/runtime/simulator.cc:796-1186 simulate_runtime —
+// per-device timelines, dependency-ordered task placement, and a
+// post-pass for weight-gradient synchronization).  The search's inner
+// loop evaluates thousands of candidate strategies per leaf; this
+// engine runs the evaluation — and the leaf brute-force / greedy
+// enumeration around it — natively, with the Python layer supplying a
+// pre-digested graph (per-(node,view) costs + device sets, per-edge
+// view-pair xfer matrices).
+//
+// Semantics intentionally mirror flexflow_tpu/search/simulator.py
+// Simulator.simulate so the Python fallback and the native path are
+// interchangeable (tests assert equality).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+namespace {
+
+struct View {
+  double fwd = 0.0;        // forward-only duration
+  double full = 0.0;       // fwd+bwd duration
+  double sync = 0.0;       // weight-gradient sync cost
+  double mem = 0.0;        // per-device bytes this view places
+  std::vector<int32_t> devices;       // compute-timeline device ids
+  std::vector<int32_t> comm_devices;  // sync comm-group device ids
+  bool valid = true;       // invalid views poison the strategy (inf)
+};
+
+struct Edge {
+  int32_t src = 0;
+  int32_t dst = 0;
+  // false when the source is an input/constant: no cotangent flows
+  // back, so training charges the forward reshard only (no 2x)
+  bool has_grad = true;
+  // xfer[s * n_dst_views + d] for src view-choice s, dst view-choice d
+  std::vector<double> xfer;
+};
+
+struct SimGraph {
+  int32_t num_devices = 0;
+  std::vector<std::vector<View>> nodes;  // topo order; index = node id
+  std::vector<int32_t> default_view;     // used when assignment[i] < 0
+  std::vector<Edge> edges;
+  std::vector<std::vector<int32_t>> in_edges;  // node -> edge indices
+  double mem_cap = std::numeric_limits<double>::infinity();
+  // scratch reused across simulate calls
+  std::vector<double> ready, avail, comm, mem;
+};
+
+const double kInf = std::numeric_limits<double>::infinity();
+
+double simulate(SimGraph* g, const int32_t* assign, int include_update) {
+  const size_t n = g->nodes.size();
+  g->ready.assign(n, 0.0);
+  g->avail.assign(static_cast<size_t>(g->num_devices), 0.0);
+  g->comm.assign(static_cast<size_t>(g->num_devices), 0.0);
+  g->mem.assign(static_cast<size_t>(g->num_devices), 0.0);
+
+  double end_time = 0.0;
+  double end_comm = 0.0;
+  double mem_peak = 0.0;
+
+  for (size_t i = 0; i < n; ++i) {
+    int32_t vi = assign[i] >= 0 ? assign[i] : g->default_view[i];
+    if (vi < 0 || static_cast<size_t>(vi) >= g->nodes[i].size()) return kInf;
+    const View& v = g->nodes[i][vi];
+    if (!v.valid) return kInf;
+
+    double start = 0.0;
+    for (int32_t ei : g->in_edges[i]) {
+      const Edge& e = g->edges[ei];
+      int32_t si = assign[e.src] >= 0 ? assign[e.src] : g->default_view[e.src];
+      size_t n_dst = g->nodes[e.dst].size();
+      double x = e.xfer[static_cast<size_t>(si) * n_dst + vi];
+      if (x == kInf) return kInf;
+      // training pays every sharding boundary twice: the activation
+      // reshards forward and its gradient pays the inverse reshard
+      // (matrices are baked at 1x; python simulate applies the same
+      // factor so the two engines stay bit-identical); gradient-free
+      // source edges (inputs/constants) pay the forward reshard only
+      if (include_update && e.has_grad) x *= 2.0;
+      double t = g->ready[e.src] + x;
+      if (t > start) start = t;
+    }
+    for (int32_t d : v.devices) {
+      if (g->avail[d] > start) start = g->avail[d];
+    }
+    double dur = include_update ? v.full : v.fwd;
+    double finish = start + dur;
+    for (int32_t d : v.devices) {
+      g->avail[d] = finish;
+      g->mem[d] += v.mem;
+      if (g->mem[d] > mem_peak) mem_peak = g->mem[d];
+    }
+    g->ready[i] = finish;
+    if (finish > end_time) end_time = finish;
+    if (include_update && v.sync > 0.0) {
+      // weight-grad allreduce scheduled on per-device COMM timelines
+      // (reference: simulator.cc:1062-1186 device-availability
+      // scheduling of NCCL allreduces): ready when the op's compute
+      // completes; same-device syncs serialize on the shared links,
+      // disjoint-device syncs overlap; comm overlaps later compute
+      // (async collectives over ICI).
+      double s = finish;
+      for (int32_t d : v.comm_devices) {
+        if (g->comm[d] > s) s = g->comm[d];
+      }
+      double f = s + v.sync;
+      for (int32_t d : v.comm_devices) g->comm[d] = f;
+      if (f > end_comm) end_comm = f;
+    }
+  }
+
+  if (mem_peak > g->mem_cap) return kInf;
+  if (end_comm > end_time) end_time = end_comm;
+  return end_time;
+}
+
+}  // namespace
+
+extern "C" {
+
+SimGraph* ffn_sim_create(int32_t num_nodes, int32_t num_devices) {
+  SimGraph* g = new SimGraph();
+  g->num_devices = num_devices;
+  g->nodes.resize(num_nodes);
+  g->default_view.assign(num_nodes, 0);
+  g->in_edges.resize(num_nodes);
+  return g;
+}
+
+void ffn_sim_destroy(SimGraph* g) { delete g; }
+
+// Register one candidate view for node `i`.
+// devices: `n_devices` compute-timeline device ids; comm_devices:
+// `n_comm` sync comm-group device ids; valid=0 marks a poisoned view.
+void ffn_sim_set_mem_cap(SimGraph* g, double cap) { g->mem_cap = cap; }
+
+void ffn_sim_add_view(SimGraph* g, int32_t i, double fwd, double full,
+                      double sync, double mem, const int32_t* devices,
+                      int32_t n_devices, const int32_t* comm_devices,
+                      int32_t n_comm, int32_t valid) {
+  View v;
+  v.fwd = fwd;
+  v.full = full;
+  v.sync = sync;
+  v.mem = mem;
+  v.valid = valid != 0;
+  v.devices.assign(devices, devices + n_devices);
+  v.comm_devices.assign(comm_devices, comm_devices + n_comm);
+  g->nodes[i].push_back(std::move(v));
+}
+
+void ffn_sim_set_default_view(SimGraph* g, int32_t i, int32_t view) {
+  g->default_view[i] = view;
+}
+
+// xfer: row-major [n_views(src)][n_views(dst)] matrix of seconds.
+void ffn_sim_add_edge(SimGraph* g, int32_t src, int32_t dst,
+                      const double* xfer, int32_t has_grad) {
+  Edge e;
+  e.src = src;
+  e.dst = dst;
+  e.has_grad = has_grad != 0;
+  e.xfer.assign(xfer, xfer + g->nodes[src].size() * g->nodes[dst].size());
+  int32_t idx = static_cast<int32_t>(g->edges.size());
+  g->edges.push_back(std::move(e));
+  g->in_edges[dst].push_back(idx);
+}
+
+double ffn_sim_simulate(SimGraph* g, const int32_t* assign,
+                        int32_t include_update) {
+  return simulate(g, assign, include_update);
+}
+
+// Exhaustive search over the view products of `free_nodes`
+// (reference analog: SearchHelper leaf enumeration, graph.cc:141-159).
+// assign: in = base assignment (fixed nodes set, free nodes ignored);
+//         out = best assignment found.  Returns best cost.
+double ffn_sim_brute_force(SimGraph* g, const int32_t* free_nodes,
+                           int32_t n_free, int32_t* assign,
+                           int32_t include_update) {
+  std::vector<int32_t> cur(assign, assign + g->nodes.size());
+  std::vector<int32_t> best(cur);
+  std::vector<int32_t> odo(static_cast<size_t>(n_free), 0);
+  for (int32_t k = 0; k < n_free; ++k) cur[free_nodes[k]] = 0;
+  double best_cost = kInf;
+  while (true) {
+    double c = simulate(g, cur.data(), include_update);
+    if (c < best_cost) {
+      best_cost = c;
+      best = cur;
+    }
+    int32_t k = 0;
+    for (; k < n_free; ++k) {
+      int32_t node = free_nodes[k];
+      odo[k]++;
+      if (static_cast<size_t>(odo[k]) < g->nodes[node].size()) {
+        cur[node] = odo[k];
+        break;
+      }
+      odo[k] = 0;
+      cur[node] = 0;
+    }
+    if (k == n_free) break;
+  }
+  std::memcpy(assign, best.data(), best.size() * sizeof(int32_t));
+  return best_cost;
+}
+
+// Greedy topo-order assignment (fallback for odd topologies; analog of
+// the Python _greedy_cost).  free mask: 1 = choose this node's view.
+// enum_counts[i]: how many leading views of node i are candidates (a
+// trailing default view used for not-yet-assigned nodes is excluded).
+double ffn_sim_greedy(SimGraph* g, const uint8_t* is_free,
+                      const int32_t* enum_counts, int32_t* assign,
+                      int32_t include_update) {
+  const size_t n = g->nodes.size();
+  std::vector<int32_t> cur(assign, assign + n);
+  for (size_t i = 0; i < n; ++i) {
+    if (!is_free[i]) continue;
+    double best_c = kInf;
+    int32_t best_v = cur[i];
+    size_t n_enum = std::min(static_cast<size_t>(enum_counts[i]),
+                             g->nodes[i].size());
+    for (size_t v = 0; v < n_enum; ++v) {
+      cur[i] = static_cast<int32_t>(v);
+      double c = simulate(g, cur.data(), include_update);
+      if (c < best_c) {
+        best_c = c;
+        best_v = static_cast<int32_t>(v);
+      }
+    }
+    cur[i] = best_v;
+  }
+  std::memcpy(assign, cur.data(), n * sizeof(int32_t));
+  return simulate(g, cur.data(), include_update);
+}
+
+}  // extern "C"

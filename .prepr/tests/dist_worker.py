@@ -1,0 +1,71 @@
+"""Worker for test_distributed.py: one host process of a 2-host job.
+
+Runs the full public training path (FFModel compile/fit) over a
+global mesh spanning both processes; prints the final loss for the
+parent test to compare against the single-process run.
+"""
+
+import sys
+
+port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
+
+import os  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu.comm.compat import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(2)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import flexflow_tpu as ff  # noqa: E402
+from flexflow_tpu.runtime import distributed as D  # noqa: E402
+
+
+def main():
+    D.initialize(f"127.0.0.1:{port}", nproc, pid)
+    assert jax.process_count() == nproc
+    mesh = D.global_mesh()
+    n_devices = nproc * 2
+
+    cfg = ff.FFConfig(batch_size=16, epochs=3, num_devices=n_devices,
+                      only_data_parallel=True, compute_dtype="float32", seed=3)
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([16, 8])
+    t = model.dense(x, 16, activation="relu", name="fc1")
+    t = model.dense(t, 4, name="fc2")
+    model.compile(loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], mesh=mesh)
+
+    rng = np.random.default_rng(7)
+    centers = rng.normal(size=(4, 8)) * 3
+    y = rng.integers(0, 4, 64)
+    xs = (centers[y] + rng.normal(size=(64, 8))).astype(np.float32)
+    if ckpt_dir is None:
+        hist = model.fit(x=xs, y=y.astype(np.int32), verbose=False,
+                         shuffle=True)
+    else:
+        # multihost checkpoint/resume through the coordinated orbax
+        # path: 2 epochs with snapshots, then a FRESH model resumes the
+        # third — must equal 3 straight epochs (exact state restore
+        # incl. rng counter and shuffle fast-forward)
+        model.fit(x=xs, y=y.astype(np.int32), verbose=False, shuffle=True,
+                  epochs=2, checkpoint_dir=ckpt_dir, checkpoint_every=1)
+        model2 = ff.FFModel(cfg)
+        x2 = model2.create_tensor([16, 8])
+        t2 = model2.dense(x2, 16, activation="relu", name="fc1")
+        t2 = model2.dense(t2, 4, name="fc2")
+        model2.compile(loss_type="sparse_categorical_crossentropy",
+                       metrics=["accuracy"], mesh=mesh)
+        hist = model2.fit(x=xs, y=y.astype(np.int32), verbose=False,
+                          shuffle=True, epochs=3, checkpoint_dir=ckpt_dir,
+                          resume=True)
+    print(f"FINAL_LOSS {hist[-1]['loss']:.8f} ACC {hist[-1]['accuracy']:.6f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Accuracy regression tier (reference: tests/accuracy_tests.sh runs
+the example models with `-a` for N epochs and a ModelVerification
+callback asserts the reached accuracy — keras/callbacks.py
+VerifyMetrics).  CI-speed form: reduced model/dataset sizes, the same
+train-to-threshold discipline, on the 8-virtual-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras import datasets
+
+
+def test_alexnet_mlp_reaches_accuracy():
+    """The reference's alexnet accuracy gate (accuracy_tests.sh:10) at
+    CI scale: a conv+MLP net on synthetic CIFAR-shaped blobs must reach
+    >=90% train accuracy in a few epochs."""
+    cfg = ff.FFConfig(batch_size=32, epochs=6, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32",
+                      seed=11)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([32, 16, 16, 3], name="image")
+    t = m.conv2d(x, 16, 5, 5, 1, 1, 2, 2, activation="relu", name="conv1")
+    t = m.pool2d(t, 2, 2, 2, 2, name="pool1")
+    t = m.conv2d(t, 32, 3, 3, 1, 1, 1, 1, activation="relu", name="conv2")
+    t = m.pool2d(t, 2, 2, 2, 2, name="pool2")
+    t = m.flat(t, name="flat")
+    t = m.dense(t, 128, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.02),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    n, classes = 512, 4
+    centers = rng.normal(size=(classes, 16 * 16 * 3)).astype(np.float32)
+    y = rng.integers(0, classes, n).astype(np.int32)
+    xs = (centers[y] * 1.5 + rng.normal(size=(n, 16 * 16 * 3))
+          ).reshape(n, 16, 16, 3).astype(np.float32)
+    hist = m.fit(x=xs, y=y, verbose=False)
+    assert hist[-1]["accuracy"] >= 0.9, hist[-1]
+
+
+def test_keras_mnist_reaches_accuracy():
+    """The reference's keras-MNIST accuracy gate (accuracy_tests.sh
+    keras tier, callbacks.VerifyMetrics) through OUR keras frontend and
+    dataset loader (real MNIST when cached locally, deterministic
+    synthetic with the real shapes otherwise)."""
+    from flexflow_tpu import keras
+
+    (x_train, y_train), _ = datasets.mnist.load_data()
+    x_train = (x_train[:1024].astype(np.float32) / 255.0).reshape(-1, 784)
+    y_train = y_train[:1024].astype(np.int32)
+
+    model = keras.Sequential([
+        keras.layers.Dense(64, activation="relu", input_shape=(784,)),
+        keras.layers.Dense(10),
+    ])
+    cfg = ff.FFConfig(batch_size=64, epochs=8, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    cb = keras.callbacks.VerifyMetrics(metric="accuracy", threshold=0.85)
+    hist = model.fit(x_train, y_train, verbose=False, callbacks=[cb])
+    assert hist[-1]["accuracy"] >= 0.85, hist[-1]
+
+
+def test_real_digits_accuracy():
+    """REAL-data accuracy regression with zero egress: sklearn's
+    bundled UCI digits (1797 genuine 8x8 scans) trained through the
+    normal compile path must reach >=90% held-out TEST accuracy — the
+    role of the reference's fetched-MNIST gate
+    (reference: tests/accuracy_tests.sh:10-14,
+    examples/python/keras/accuracy.py)."""
+    (xtr, ytr), (xte, yte) = datasets.digits.load_data()
+    assert len(xtr) + len(xte) == 1797  # the real dataset, not blobs
+    xtr = (xtr / 16.0).reshape(len(xtr), 64).astype(np.float32)
+    xte = (xte / 16.0).reshape(len(xte), 64).astype(np.float32)
+
+    cfg = ff.FFConfig(batch_size=32, epochs=20, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32",
+                      seed=3)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([32, 64], name="pix")
+    t = m.dense(x, 64, activation="relu", name="fc1")
+    t = m.dense(t, 10, name="fc2")
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x=xtr, y=ytr.astype(np.int32), verbose=False)
+    logs = m.evaluate(x=xte, y=yte.astype(np.int32))
+    assert logs["accuracy"] >= 0.90, logs
+
+
+def test_real_mnist_accuracy_when_cached():
+    """With a real mnist.npz present the keras gate must hit the
+    reference's threshold; without it the loader now WARNS loudly and
+    this test skips rather than 'passing' on blobs."""
+    import os
+    import warnings
+
+    from flexflow_tpu.keras.datasets import _data_dir
+
+    if not os.path.exists(os.path.join(_data_dir(), "mnist.npz")):
+        # also pin the honesty contract: the fallback must warn
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            datasets.mnist.load_data()
+        assert any("SYNTHETIC" in str(x.message) for x in w)
+        pytest.skip("no real mnist.npz cached (zero-egress environment)")
+
+    (xtr, ytr), (xte, yte) = datasets.mnist.load_data()
+    xtr = (xtr / 255.0).reshape(len(xtr), 784).astype(np.float32)
+    xte = (xte / 255.0).reshape(len(xte), 784).astype(np.float32)
+    cfg = ff.FFConfig(batch_size=64, epochs=3, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32")
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([64, 784], name="pix")
+    t = m.dense(x, 128, activation="relu", name="fc1")
+    t = m.dense(t, 10, name="fc2")
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x=xtr[:20000], y=ytr[:20000].astype(np.int32), verbose=False)
+    logs = m.evaluate(x=xte, y=yte.astype(np.int32))
+    assert logs["accuracy"] >= 0.90, logs
+
+
+def test_real_digits_cnn_accuracy():
+    """REAL pixels through the CONV path: a small Conv2D+pool CNN on
+    the bundled UCI digits (8x8 grayscale scans) must reach >=90%
+    held-out accuracy — the reference's CNN accuracy gate shape
+    (reference: tests/accuracy_tests.sh:10-14 trains CNNs on fetched
+    MNIST/CIFAR; zero-egress here, so the genuine offline 1797-scan
+    dataset plays that role)."""
+    (xtr, ytr), (xte, yte) = datasets.digits.load_data()
+    assert len(xtr) + len(xte) == 1797
+    xtr = (xtr / 16.0).reshape(len(xtr), 8, 8, 1).astype(np.float32)
+    xte = (xte / 16.0).reshape(len(xte), 8, 8, 1).astype(np.float32)
+
+    cfg = ff.FFConfig(batch_size=32, epochs=25, num_devices=8,
+                      only_data_parallel=True, compute_dtype="float32",
+                      seed=5)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([32, 8, 8, 1], name="pix")
+    t = m.conv2d(x, 16, 3, 3, padding_h=1, padding_w=1,
+                 activation="relu", name="c1")
+    t = m.pool2d(t, 2, 2, stride_h=2, stride_w=2, name="p1")
+    t = m.conv2d(t, 32, 3, 3, padding_h=1, padding_w=1,
+                 activation="relu", name="c2")
+    t = m.flat(t, name="flatten")
+    t = m.dense(t, 10, name="head")
+    m.compile(optimizer=ff.AdamOptimizer(alpha=1e-3),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x=xtr, y=ytr.astype(np.int32), verbose=False)
+    logs = m.evaluate(x=xte, y=yte.astype(np.int32))
+    assert logs["accuracy"] >= 0.90, logs

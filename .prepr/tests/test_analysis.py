@@ -1,0 +1,771 @@
+"""Static-analysis subsystem tests (flexflow_tpu/analysis + tools/fflint).
+
+Contract under test (ISSUE 4):
+* seeded corruptions are each caught by the RIGHT pass with a distinct
+  finding code (mutation-style tests);
+* every registered GraphXfer carries a passing executable equivalence
+  proof (the substitution test suite runs the invariant checker
+  unconditionally through it);
+* FLEXFLOW_TPU_VERIFY=1 searches choose strategies bit-identical to
+  unverified runs;
+* strategy import refuses digest/coverage mismatches;
+* cost-cache-served search results are gated (bad entries evicted);
+* tools/fflint.py is tier-1-fast and exits 0 on the committed
+  artifacts and the full registry.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.analysis import (
+    AnalysisError,
+    GraphInvariantError,
+    check_graph,
+    lint_strategy,
+    set_verify,
+    verification_enabled,
+)
+from flexflow_tpu.compiler.lowering import data_parallel_strategy
+from flexflow_tpu.core.graph import Edge, Graph, Node
+from flexflow_tpu.core.machine import MachineView
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def small_model(batch=8, in_dim=16):
+    cfg = ff.FFConfig(batch_size=batch, num_devices=8,
+                      only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([batch, in_dim], name="ta_x")
+    a = m.dense(x, 16, name="ta_fc1")
+    b = m.dense(x, 16, name="ta_fc2")
+    t = m.add(a, b, name="ta_add")
+    m.dense(t, 4, name="ta_head")
+    return m
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: seeded corruptions, each caught with its code
+
+
+def test_clean_graph_has_no_findings():
+    m = small_model()
+    assert check_graph(m.graph) == []
+
+
+def test_mutation_cycle_pcg001():
+    m = small_model()
+    g = m.graph.copy()
+    head = m.node_by_name("ta_head")
+    fc1 = m.node_by_name("ta_fc1")
+    e = Edge(head.guid, fc1.guid, 0, 0)
+    g.out_edges[head.guid] = g.out_edges[head.guid] + [e]
+    g.in_edges[fc1.guid] = g.in_edges[fc1.guid] + [e]
+    assert "PCG001" in codes(check_graph(g))
+
+
+def test_mutation_guid_mismatch_pcg002():
+    m = small_model()
+    g = m.graph.copy()
+    fc1 = m.node_by_name("ta_fc1")
+    g.nodes[fc1.guid] = Node(fc1.guid + 100, fc1.op)
+    assert "PCG002" in codes(check_graph(g))
+
+
+def test_mutation_guid_above_next_guid_pcg002():
+    m = small_model()
+    g = m.graph.copy()
+    g._next_guid = min(g.nodes)  # later splices would re-allocate guids
+    assert "PCG002" in codes(check_graph(g))
+
+
+def test_mutation_dangling_edge_pcg003():
+    m = small_model()
+    g = m.graph.copy()
+    fc1 = m.node_by_name("ta_fc1")
+    ghost = 9999
+    e = Edge(ghost, fc1.guid, 0, 0)
+    g.in_edges[fc1.guid] = g.in_edges[fc1.guid] + [e]
+    assert "PCG003" in codes(check_graph(g))
+
+
+def test_mutation_mirror_asymmetry_pcg004():
+    m = small_model()
+    g = m.graph.copy()
+    fc1 = m.node_by_name("ta_fc1")
+    head = m.node_by_name("ta_head")
+    e = Edge(fc1.guid, head.guid, 0, 0)
+    g.out_edges[fc1.guid] = g.out_edges[fc1.guid] + [e]  # out only
+    assert "PCG004" in codes(check_graph(g))
+
+
+def test_mutation_duplicate_edge_pcg005():
+    m = small_model()
+    g = m.graph.copy()
+    fc1 = m.node_by_name("ta_fc1")
+    e = g.in_edges[fc1.guid][0]
+    g.in_edges[fc1.guid] = g.in_edges[fc1.guid] + [e]
+    g.out_edges[e.src] = g.out_edges[e.src] + [e]
+    assert "PCG005" in codes(check_graph(g))
+
+
+def test_mutation_missing_input_slot_pcg006():
+    m = small_model()
+    g = m.graph.copy()
+    add = m.node_by_name("ta_add")
+    e = next(x for x in g.in_edges[add.guid] if x.dst_idx == 1)
+    g.in_edges[add.guid] = [x for x in g.in_edges[add.guid] if x is not e]
+    g.out_edges[e.src] = [x for x in g.out_edges[e.src] if x is not e]
+    assert "PCG006" in codes(check_graph(g))
+
+
+def test_mutation_src_idx_out_of_range_pcg007():
+    m = small_model()
+    g = m.graph.copy()
+    fc1 = m.node_by_name("ta_fc1")
+    e = g.in_edges[fc1.guid][0]
+    bad = Edge(e.src, e.dst, 5, e.dst_idx)  # InputOp has 1 output
+    g.in_edges[fc1.guid] = [bad]
+    g.out_edges[e.src] = [bad if x is e else x for x in g.out_edges[e.src]]
+    assert "PCG007" in codes(check_graph(g))
+
+
+def test_mutation_shape_disagreement_pcg008():
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 16], name="sh_x")
+    a = m.dense(x, 16, name="sh_wide")
+    m.dense(x, 8, name="sh_narrow")
+    m.dense(a, 4, name="sh_head")  # expects the [8, 16] producer
+    g = m.graph.copy()
+    head = m.node_by_name("sh_head")
+    narrow = m.node_by_name("sh_narrow")
+    e = g.in_edges[head.guid][0]
+    bad = Edge(narrow.guid, head.guid, 0, e.dst_idx)  # [8, 8] != [8, 16]
+    g.in_edges[head.guid] = [bad]
+    g.out_edges[e.src] = [x for x in g.out_edges[e.src] if x is not e]
+    g.out_edges[narrow.guid] = g.out_edges[narrow.guid] + [bad]
+    assert "PCG008" in codes(check_graph(g))
+
+
+def test_mutation_view_rank_shd101():
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    fc1 = m.node_by_name("ta_fc1")
+    s[fc1.guid] = MachineView.trivial(3)  # rank-2 output
+    assert "SHD101" in codes(lint_strategy(m.graph, s, 8))
+
+
+def test_mutation_indivisible_dim_shd102():
+    m = small_model(batch=6)  # 6 % 4 != 0, 4 divides 8
+    s = data_parallel_strategy(m.graph, 8)
+    fc1 = m.node_by_name("ta_fc1")
+    s[fc1.guid] = MachineView(dim_degrees=(4, 1))
+    found = codes(lint_strategy(m.graph, s, 8))
+    assert "SHD102" in found and "SHD103" not in found
+
+
+def test_mutation_capacity_overflow_shd103():
+    m = small_model(batch=24)  # 24 % 3 == 0, 3 does not divide 8
+    s = data_parallel_strategy(m.graph, 8)
+    fc1 = m.node_by_name("ta_fc1")
+    s[fc1.guid] = MachineView(dim_degrees=(3, 1))
+    found = codes(lint_strategy(m.graph, s, 8))
+    assert "SHD103" in found and "SHD102" not in found
+
+
+def test_mutation_fixed_view_violation_shd104():
+    cfg = ff.FFConfig(batch_size=16, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([16, 8], name="sv_x")
+    t = m.repartition(x, dim=0, degree=4, name="sv_rep")
+    m.dense(t, 8, name="sv_fc")
+    s = data_parallel_strategy(m.graph, 8)
+    rep = m.node_by_name("sv_rep")
+    s[rep.guid] = MachineView.trivial(2)  # pin says dim0 degree 4
+    assert "SHD104" in codes(lint_strategy(m.graph, s, 8))
+
+
+def test_mutation_unsplittable_dim_shd106():
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 16], name="sv_x")
+    m.softmax(x, name="sv_sm")
+    s = data_parallel_strategy(m.graph, 8)
+    sm = m.node_by_name("sv_sm")
+    # the softmax axis needs the full row — splitting it is illegal
+    # (propagate would silently drop the split: exactly the
+    # search/lowering drift the linter pins down)
+    s[sm.guid] = MachineView(dim_degrees=(1, 2))
+    assert "SHD106" in codes(lint_strategy(m.graph, s, 8))
+
+
+def test_mutation_missing_view_shd109():
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    del s[m.node_by_name("ta_fc1").guid]
+    assert "SHD109" in codes(lint_strategy(m.graph, s, 8))
+
+
+def test_clean_strategy_has_no_findings():
+    m = small_model()
+    assert lint_strategy(m.graph, data_parallel_strategy(m.graph, 8), 8) == []
+
+
+# ---------------------------------------------------------------------------
+# reduction-plan mutations (SHD13x + STR206): seeded corruptions of the
+# staged hierarchical plans, each caught with its code
+
+
+def _two_slice_cm(n=8, gap=10.0):
+    import dataclasses
+
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.machine_model import CostModel
+
+    base = MachineSpec.tpu_v5e(n)
+    spec = dataclasses.replace(
+        base, devices_per_host=n // 2,
+        dcn_bandwidth=base.ici_bandwidth / gap)
+    return CostModel(spec, num_devices=n)
+
+
+def _planned_schedule(m, s, cm, precision="fp32", cross_precision=None):
+    import math
+
+    from flexflow_tpu.search.reduction_plan import (
+        ReductionPlan,
+        canonical_stages,
+    )
+    from flexflow_tpu.search.sync_schedule import (
+        build_bucketed_schedule,
+        synced_weight_groups,
+    )
+
+    synced = synced_weight_groups(m.graph, s, cm)
+    pmap = {node.op.name: precision for node, _mv, _parts in synced}
+    sched = build_bucketed_schedule(synced, pmap, math.inf)
+    plan = ReductionPlan(
+        "staged_l1", canonical_stages(1, cross_precision or precision))
+    import dataclasses
+
+    buckets = [dataclasses.replace(b, plan=plan) for b in sched.buckets]
+    from flexflow_tpu.search.sync_schedule import SyncSchedule
+
+    return SyncSchedule(buckets, dict(sched.meta))
+
+
+def test_clean_reduction_plan_has_no_findings():
+    from flexflow_tpu.analysis import lint_reduction_plan
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    sched = _planned_schedule(m, s, cm)
+    assert lint_reduction_plan(m.graph, s, sched, cm) == []
+
+
+def test_mutation_noncanonical_stages_shd130():
+    import dataclasses
+
+    from flexflow_tpu.analysis import lint_reduction_plan
+    from flexflow_tpu.search.reduction_plan import ReductionPlan
+    from flexflow_tpu.search.sync_schedule import SyncSchedule
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    sched = _planned_schedule(m, s, cm)
+    # drop the trailing all_gather: the bracketing is broken
+    b = sched.buckets[0]
+    broken = ReductionPlan("x", b.plan.stages[:-1])
+    mut = SyncSchedule([dataclasses.replace(b, plan=broken)])
+    assert "SHD130" in codes(lint_reduction_plan(m.graph, s, mut, cm))
+
+
+def test_mutation_level_coverage_shd131():
+    import dataclasses
+
+    from flexflow_tpu.analysis import lint_reduction_plan
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.machine_model import CostModel
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    # 3-level machine: DP-8 groups span level 2, but the plan stops at 1
+    spec3 = dataclasses.replace(
+        MachineSpec.tpu_v5e(8), devices_per_host=2,
+        slice_levels=((4, 5e9, 5e-6), (8, 1e9, 2e-5)))
+    cm3 = CostModel(spec3, num_devices=8)
+    sched = _planned_schedule(m, s, cm3)
+    assert "SHD131" in codes(lint_reduction_plan(m.graph, s, sched, cm3))
+
+
+def test_mutation_no_spanning_group_shd132():
+    import dataclasses
+
+    from flexflow_tpu.analysis import lint_reduction_plan
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.machine_model import CostModel
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    sched = _planned_schedule(m, s, cm)
+    # 12-device 2-slice machine: the strategy's power-of-two replica
+    # degrees do not factor into the (2, 2, 3) axis pool, so no group
+    # provably crosses the slice boundary — the plan has no wire to ride
+    spec12 = dataclasses.replace(
+        MachineSpec.tpu_v5e(12), devices_per_host=4)
+    cm12 = CostModel(spec12, num_devices=12)
+    assert "SHD132" in codes(lint_reduction_plan(m.graph, s, sched, cm12))
+
+
+def test_mutation_precision_contradiction_shd133():
+    from flexflow_tpu.analysis import lint_reduction_plan
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    # int8 cross stage on an fp32 bucket contradicts the precision map
+    sched = _planned_schedule(m, s, cm, precision="fp32",
+                              cross_precision="int8")
+    assert "SHD133" in codes(lint_reduction_plan(m.graph, s, sched, cm))
+
+
+def test_fflint_persisted_plan_str206(tmp_path):
+    """Stdlib-only seeded corruptions of a persisted reduction plan:
+    each malformation exits 1 with STR206."""
+    from tools.fflint import main
+
+    from flexflow_tpu.search.strategy_io import attach_meta, export_strategy
+
+    m = small_model()
+    s = data_parallel_strategy(m.graph, 8)
+    cm = _two_slice_cm()
+    sched = _planned_schedule(m, s, cm)
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, s)
+    attach_meta(p, sync_schedule=sched.to_jsonable())
+    assert main(["strategy", p]) == 0
+    with open(p) as f:
+        clean = json.load(f)
+
+    def corrupted(mutate):
+        data = json.loads(json.dumps(clean))
+        plan = data["__meta__"]["sync_schedule"]["buckets"][0]["plan"]
+        mutate(plan)
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump(data, f)
+        return main(["strategy", bad])
+
+    # unknown stage kind / negative level / unknown precision /
+    # compressed RS stage / two cross allreduces: all STR206
+    assert corrupted(
+        lambda pl: pl["stages"][0].update(kind="teleport")) == 1
+    assert corrupted(
+        lambda pl: pl["stages"][0].update(level=-1)) == 1
+    assert corrupted(
+        lambda pl: pl["stages"][1].update(precision="fp8")) == 1
+    assert corrupted(
+        lambda pl: pl["stages"][0].update(precision="int8")) == 1
+    assert corrupted(
+        lambda pl: pl["stages"].append(
+            dict(kind="allreduce", level=1, precision="fp32"))) == 1
+    assert corrupted(lambda pl: pl.pop("stages")) == 1
+
+
+# ---------------------------------------------------------------------------
+# substitution soundness: the registry's executable proof + the
+# unconditional invariant run over every rewrite
+
+
+def test_registry_equivalence_proof():
+    """Every registered GraphXfer (all partition/replicate degrees,
+    fusions, chain simplifications, BatchEmbeddingsXfer) matches a
+    proof graph, rewrites it into a well-formed PCG, and preserves the
+    value of every surviving node."""
+    from flexflow_tpu.analysis.equivalence import verify_registry
+
+    findings = verify_registry(num_devices=8)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_equivalence_catches_semantics_change():
+    """A rewrite that splices out a relu (changing the function) must
+    fail the numeric proof with EQV301."""
+    from flexflow_tpu.analysis.equivalence import verify_rewrite
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.substitution import GraphXfer, _bypass_node
+
+    def matcher(graph, node):
+        return (node.op.op_type is OperatorType.RELU
+                and graph.in_edges[node.guid]
+                and graph.out_edges[node.guid])
+
+    def apply_fn(graph, node):
+        g = graph.copy()
+        if _bypass_node(g, node.guid) is None:
+            return None
+        return g
+
+    bad = GraphXfer(name="drop_relu_unsound", matcher=matcher,
+                    apply_fn=apply_fn)
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 16], name="eq_x")
+    t = m.dense(x, 16, name="eq_fc")
+    t = m.relu(t, name="eq_act")
+    m.dense(t, 4, name="eq_head")
+    matches = bad.find_matches(m.graph)
+    assert matches
+    findings = verify_rewrite(m.graph, bad, matches[0])
+    assert "EQV301" in codes(findings), [str(f) for f in findings]
+
+
+def test_verify_hook_catches_corrupting_rewrite():
+    """Under FLEXFLOW_TPU_VERIFY semantics, GraphXfer.apply runs the
+    invariant checker and a splice that leaves a consumer reading a
+    deleted guid raises at the rewrite."""
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.substitution import GraphXfer
+
+    def matcher(graph, node):
+        return node.op.op_type is OperatorType.RELU
+
+    def apply_fn(graph, node):
+        g = graph.copy()
+        # raw (un-audited) surgery: drop the node but leave its out
+        # edges dangling in the consumers' in-lists
+        for e in list(g.in_edges[node.guid]):
+            g.out_edges[e.src] = [x for x in g.out_edges[e.src]
+                                  if x is not e]
+        g.in_edges.pop(node.guid)
+        g.out_edges.pop(node.guid)
+        g.nodes.pop(node.guid)
+        g._invalidate()
+        return g
+
+    corrupt = GraphXfer(name="corrupting_rewrite", matcher=matcher,
+                        apply_fn=apply_fn)
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 16], name="vh_x")
+    t = m.relu(x, name="vh_act")
+    m.dense(t, 4, name="vh_head")
+    match = corrupt.find_matches(m.graph)[0]
+    was = verification_enabled()
+    set_verify(True)
+    try:
+        with pytest.raises(GraphInvariantError) as ei:
+            corrupt.apply(m.graph, match)
+        assert "PCG003" in {f.code for f in ei.value.findings}
+    finally:
+        set_verify(was)
+    # with verification off the same apply silently returns the corrupt
+    # graph — exactly what the checker exists to catch
+    g_bad = corrupt.apply(m.graph, match)
+    assert g_bad is not None and "PCG003" in codes(check_graph(g_bad))
+
+
+# ---------------------------------------------------------------------------
+# FLEXFLOW_TPU_VERIFY end-to-end: verified searches are bit-identical
+
+
+@pytest.mark.parametrize("model_name", ["mlp", "bert"])
+def test_verified_search_bit_identical(model_name):
+    from flexflow_tpu.models import build_transformer
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    def build():
+        cfg = ff.FFConfig(batch_size=8, num_devices=8, search_budget=4,
+                          cost_cache_file="")
+        if model_name == "bert":
+            m = build_transformer(cfg, num_layers=1, hidden=64, num_heads=4,
+                                  ff_dim=128, seq_len=16)
+        else:
+            m = ff.FFModel(cfg)
+            x = m.create_tensor([8, 256], name="vs_x")
+            t = m.dense(x, 256, activation="relu", name="vs_fc1")
+            m.dense(t, 16, name="vs_head")
+        return m.graph, cfg
+
+    g1, cfg1 = build()
+    was = verification_enabled()
+    set_verify(False)
+    try:
+        bg1, s1 = optimize_strategy(g1, cfg1, return_graph=True)
+        g2, cfg2 = build()
+        set_verify(True)
+        bg2, s2 = optimize_strategy(g2, cfg2, return_graph=True)
+    finally:
+        set_verify(was)
+    # the process-stable digest (graph.hash() keys InputOp signatures by
+    # the frontend's global tensor-guid counter, which moves between
+    # builds) and the topo-ordered view sequence must be bit-identical
+    from flexflow_tpu.search.cost_cache import stable_graph_digest
+
+    assert stable_graph_digest(bg1) == stable_graph_digest(bg2)
+    v1 = [s1[n.guid] for n in bg1.topo_order()]
+    v2 = [s2[n.guid] for n in bg2.topo_order()]
+    assert v1 == v2
+
+
+# ---------------------------------------------------------------------------
+# strategy_io provenance
+
+
+def test_export_embeds_digest_and_roundtrips(tmp_path):
+    from flexflow_tpu.search.cost_cache import stable_graph_digest
+    from flexflow_tpu.search.strategy_io import (
+        export_strategy,
+        import_strategy,
+        read_meta,
+    )
+
+    m = small_model()
+    dp = data_parallel_strategy(m.graph, 8)
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, dp)
+    assert read_meta(p)["graph_digest"] == stable_graph_digest(m.graph)
+    assert import_strategy(p, m.graph) == dp
+
+
+def test_import_rejects_wrong_graph_digest(tmp_path):
+    from flexflow_tpu.search.strategy_io import export_strategy, import_strategy
+
+    m = small_model()
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, data_parallel_strategy(m.graph, 8))
+    other = small_model(in_dim=32)  # same op names, different graph
+    with pytest.raises(AnalysisError) as ei:
+        import_strategy(p, other.graph)
+    assert "digest" in str(ei.value)
+    assert "STR201" in {f.code for f in ei.value.findings}
+
+
+def test_import_rejects_partial_and_unknown(tmp_path):
+    from flexflow_tpu.search.strategy_io import export_strategy, import_strategy
+
+    m = small_model()
+    dp = data_parallel_strategy(m.graph, 8)
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, dp)
+    with open(p) as f:
+        data = json.load(f)
+    # drop one op (partial map) and add an alien one — without touching
+    # the digest, so coverage is the failing check
+    data.pop("ta_fc1")
+    data["not_in_graph"] = {"dims": [1, 1], "replica": 1, "start": 0}
+    with open(p, "w") as f:
+        json.dump(data, f)
+    with pytest.raises(AnalysisError) as ei:
+        import_strategy(p, m.graph)
+    assert "STR202" in {f.code for f in ei.value.findings}
+    # allow_partial is the DELIBERATE escape hatch (the historical
+    # best-effort behavior, opt-in instead of silent): every check
+    # downgrades to a warning and matching names are applied
+    got = import_strategy(p, m.graph, allow_partial=True)
+    assert m.node_by_name("ta_fc1").guid not in got and got
+
+
+def test_import_allow_partial_spans_graphs(tmp_path):
+    """The rewritten-search export scenario: a file keyed to a
+    different graph digest imports best-effort under allow_partial
+    (strict mode refuses with STR201 — cross-process reuse of rewritten
+    searches is the cost cache's job)."""
+    from flexflow_tpu.search.strategy_io import export_strategy, import_strategy
+
+    m = small_model()
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, data_parallel_strategy(m.graph, 8))
+    other = small_model(in_dim=32)
+    got = import_strategy(p, other.graph, allow_partial=True)
+    assert set(got) == {n.guid for n in other.graph.topo_order()}
+
+
+# ---------------------------------------------------------------------------
+# cost-cache gate: a poisoned served result is refused and evicted
+
+
+def test_cache_served_result_is_gated(tmp_path):
+    import pickle
+
+    from flexflow_tpu.search.cost_cache import CostCache, cost_signature
+    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.simulator import Simulator
+
+    path = str(tmp_path / "cache.json")
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, search_budget=4,
+                      cost_cache_file=path)
+    m = small_model()
+    g = m.graph
+    sim = Simulator.for_config(cfg)
+    cache = sim.cost_cache
+    assert cache is not None
+    # poison: an illegal strategy (rank-mismatched trivial views) for
+    # this exact (graph digest, knobs) key
+    topo = [n.guid for n in g.topo_order()]
+    bad_strategy = {guid: MachineView.trivial(7) for guid in topo}
+    cache.put_search_result(g, cfg, (topo, None, bad_strategy, 0.001), 0.001)
+    cache.save()
+    del cache, sim
+
+    bg, strategy = optimize_strategy(g, cfg, return_graph=True)
+    assert lint_strategy(bg, strategy, 8) == []  # gate forced a re-search
+    # and the poisoned entry was evicted from the persisted cache
+    cache2 = CostCache(path, cost_signature(
+        Simulator.for_config(
+            ff.FFConfig(batch_size=8, num_devices=8, search_budget=4,
+                        cost_cache_file="")).cost))
+    got = cache2.get_search_result(g, cfg)
+    if got is not None:  # the re-search stored its own (legal) result
+        _topo, _bg, served_strategy, _cost = got
+        assert all(len(v.dim_degrees) != 7 for v in served_strategy.values())
+
+
+# ---------------------------------------------------------------------------
+# ffobs schema + fflint CLI (tier-1, fast)
+
+
+def test_obs_schema_knows_analysis_finding():
+    from flexflow_tpu.obs.events import validate_event
+
+    ok = {"ts": 1.0, "kind": "analysis.finding", "pass": "invariants",
+          "code": "PCG001", "msg": "x", "op": None, "severity": "error"}
+    assert validate_event(ok) == []
+    assert validate_event({"ts": 1.0, "kind": "analysis.finding"}) != []
+
+
+def test_findings_flow_through_bus(tmp_path):
+    from flexflow_tpu.obs.events import BUS, validate_event
+
+    log = str(tmp_path / "obs.jsonl")
+    BUS.configure(log)
+    try:
+        m = small_model()
+        s = data_parallel_strategy(m.graph, 8)
+        s[m.node_by_name("ta_fc1").guid] = MachineView.trivial(3)
+        from flexflow_tpu.analysis import emit_findings
+
+        emit_findings(lint_strategy(m.graph, s, 8))
+        BUS.flush()
+    finally:
+        BUS.close()
+    events = [json.loads(line) for line in open(log)]
+    af = [e for e in events if e["kind"] == "analysis.finding"]
+    assert af and af[0]["code"] == "SHD101"
+    assert all(validate_event(e) == [] for e in events)
+
+
+def test_fflint_strategy_and_cache(tmp_path):
+    from tools.fflint import main
+
+    m = small_model()
+    from flexflow_tpu.search.strategy_io import export_strategy
+
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, data_parallel_strategy(m.graph, 8))
+    assert main(["strategy", p]) == 0
+    with open(p) as f:
+        data = json.load(f)
+    # a digest-less legacy file is a WARNING (imports with a warning
+    # too — one severity per finding code, CLI and runtime agreeing)
+    legacy = dict(data)
+    legacy.pop("__meta__")
+    lp = str(tmp_path / "legacy.json")
+    with open(lp, "w") as f:
+        json.dump(legacy, f)
+    assert main(["strategy", lp]) == 0
+    # malformed views are errors
+    data["ta_fc1"] = {"dims": [0, "x"], "replica": 1}
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump(data, f)
+    assert main(["strategy", bad]) == 1
+    committed = os.path.join(REPO, "COST_CACHE.json")
+    if os.path.exists(committed):
+        assert main(["cache", committed]) == 0
+    corrupt = str(tmp_path / "cc.json")
+    with open(corrupt, "w") as f:
+        json.dump({"schema": 99, "signature": "zz", "rows": [{"bad": 1}]}, f)
+    assert main(["cache", corrupt]) == 1
+
+
+def test_fflint_registry_exits_zero():
+    """The CI contract: the full rewrite registry carries passing
+    proofs through the CLI entry point."""
+    from tools.fflint import main
+
+    assert main(["registry", "--devices", "8"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# driver gate: optimize_strategy output always passes the lint
+
+
+def test_optimize_strategy_output_passes_lint():
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, search_budget=4,
+                      cost_cache_file="")
+    m = small_model()
+    bg, s = optimize_strategy(m.graph, cfg, return_graph=True)
+    assert check_graph(bg) == []
+    assert lint_strategy(bg, s, 8) == []
+
+
+def test_config_verify_is_scoped_not_sticky():
+    """FFConfig.verify arms the checker for ITS search only — a later
+    verify=False search in the same process must not keep paying (or
+    raising) for verification it did not ask for."""
+    from flexflow_tpu.analysis import CHECK_STATS
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    was = verification_enabled()
+    set_verify(False)
+    try:
+        cfg_v = ff.FFConfig(batch_size=8, num_devices=8, search_budget=2,
+                            cost_cache_file="", verify=True)
+        m = small_model()
+        optimize_strategy(m.graph, cfg_v, return_graph=True)
+        assert not verification_enabled()  # restored after the call
+        before = CHECK_STATS["checks"]
+        cfg_p = ff.FFConfig(batch_size=8, num_devices=8, search_budget=2,
+                            cost_cache_file="", verify=False)
+        optimize_strategy(small_model().graph, cfg_p, return_graph=True)
+        assert CHECK_STATS["checks"] == before  # unverified run: no checks
+    finally:
+        set_verify(was)
+
+
+def test_compile_verify_knob_runs_checker():
+    from flexflow_tpu.analysis import CHECK_STATS
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, search_budget=2,
+                      compute_dtype="float32", cost_cache_file="",
+                      verify=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 16], name="cv_x")
+    t = m.dense(x, 16, activation="relu", name="cv_fc")
+    m.dense(t, 4, name="cv_head")
+    was = verification_enabled()
+    before = CHECK_STATS["checks"]
+    try:
+        m.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+    finally:
+        set_verify(was)
+    assert CHECK_STATS["checks"] > before
+    xd = np.random.default_rng(0).normal(size=(16, 16)).astype(np.float32)
+    y = np.zeros(16, dtype=np.int32)
+    m.fit(x=xd, y=y, verbose=False)

@@ -1,0 +1,242 @@
+"""Checkpoint/resume round-trips (capability the reference lacks —
+SURVEY.md §5 'Checkpoint / resume: minimal')."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+
+def _make_model(seed=0):
+    cfg = ff.FFConfig(batch_size=8, num_devices=1, only_data_parallel=True,
+                      seed=seed)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 16])
+    h = m.dense(x, 32, activation="relu")
+    out = m.dense(h, 4)
+    m.compile(optimizer=ff.AdamOptimizer(alpha=1e-2),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    return m
+
+
+def _train_a_bit(m, steps=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(24, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=(24,)).astype(np.int32)
+    m.fit(x, y, batch_size=8, epochs=steps, verbose=False)
+    return x, y
+
+
+@pytest.mark.parametrize("use_orbax", [False, True])
+def test_save_restore_roundtrip(tmp_path, use_orbax):
+    try:
+        import orbax.checkpoint  # noqa: F401
+    except ImportError:
+        if use_orbax:
+            pytest.skip("orbax not installed")
+    m = _make_model()
+    x, y = _train_a_bit(m)
+    mgr = CheckpointManager(str(tmp_path), use_orbax=use_orbax)
+    mgr.save(7, m)
+    assert mgr.all_steps() == [7]
+
+    # fresh model with different init; restore must reproduce weights
+    m2 = _make_model(seed=123)
+    before = m2.get_weight("dense_0")
+    step = mgr.restore(m2)
+    assert step == 7
+    after = m2.get_weight("dense_0")
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(after, m.get_weight("dense_0"), rtol=1e-6)
+    # optimizer slots restored too (Adam m/v are arrays in the state tree)
+    import jax
+
+    leaves1 = jax.tree.leaves(m.opt_state)
+    leaves2 = jax.tree.leaves(m2.opt_state)
+    assert len(leaves1) == len(leaves2)
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_async_save_overlaps_and_roundtrips(tmp_path):
+    """async_save=True: save() returns before the snapshot is on disk
+    (host copy only — donation-safe), training continues meanwhile, and
+    wait()/restore() join the background write.  The restored state
+    must equal the state AT SAVE TIME, not the later-trained state."""
+    m = _make_model()
+    _train_a_bit(m, steps=2)
+    saved_params = {op: {w: np.asarray(a) for w, a in ws.items()}
+                    for op, ws in m.params.items()}
+    mgr = CheckpointManager(str(tmp_path), async_save=True, use_orbax=False)
+    mgr.save(7, m)
+    _train_a_bit(m, steps=2, seed=9)  # train OVER the in-flight save
+    mgr.wait()
+    assert mgr.all_steps() == [7]
+    m2 = _make_model(seed=1)
+    step = mgr.restore(m2)
+    assert step == 7
+    for op, ws in saved_params.items():
+        for w, a in ws.items():
+            np.testing.assert_array_equal(a, np.asarray(m2.params[op][w]))
+    # a second async save joins the first and supersedes it
+    mgr.save(8, m)
+    mgr.wait()
+    assert mgr.latest_step() == 8
+
+
+def test_resume_training_continues(tmp_path):
+    m = _make_model()
+    x, y = _train_a_bit(m, steps=2)
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(2, m)
+
+    m2 = _make_model(seed=9)
+    mgr.restore(m2)
+    # training continues without error and changes weights
+    w0 = m2.get_weight("dense_1")
+    m2.fit(x, y, batch_size=8, epochs=1, verbose=False)
+    assert not np.allclose(w0, m2.get_weight("dense_1"))
+
+
+def test_restore_before_first_step_multidevice(tmp_path):
+    """Restoring into a freshly-compiled multi-device model must not pin
+    optimizer slots to one device (they are uncommitted until step 1)."""
+    import jax
+
+    n = len(jax.devices())
+    if n < 2:
+        pytest.skip("needs multi-device mesh")
+
+    def make():
+        cfg = ff.FFConfig(batch_size=8, num_devices=n, only_data_parallel=True)
+        m = ff.FFModel(cfg)
+        x = m.create_tensor([8, 16])
+        h = m.dense(x, 32, activation="relu")
+        m.dense(h, 4)
+        m.compile(optimizer=ff.AdamOptimizer(alpha=1e-2),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        return m
+
+    m = make()
+    x, y = _train_a_bit(m, steps=1)
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(1, m)
+    m2 = make()
+    mgr.restore(m2)
+    m2.fit(x, y, batch_size=8, epochs=1, verbose=False)  # must not raise
+
+
+def test_retention_gc(tmp_path):
+    m = _make_model()
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, use_orbax=False)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, m)
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest_step() == 4
+
+
+def test_shape_mismatch_rejected(tmp_path):
+    m = _make_model()
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(1, m)
+    cfg = ff.FFConfig(batch_size=8, num_devices=1, only_data_parallel=True)
+    m2 = ff.FFModel(cfg)
+    x = m2.create_tensor([8, 16])
+    m2.dense(x, 8)  # different architecture
+    m2.compile(loss_type="mean_squared_error", metrics=["mean_squared_error"])
+    with pytest.raises(Exception):
+        mgr.restore(m2)
+
+
+def test_fit_checkpoint_dir_and_resume(tmp_path):
+    """fit(checkpoint_dir=...) snapshots each epoch; a new fit with
+    resume=True restores the latest snapshot and continues from the
+    NEXT epoch — interrupted training picks up where it left off."""
+    d = str(tmp_path / "ckpt")
+    rng = np.random.RandomState(0)
+    x = rng.randn(24, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=(24,)).astype(np.int32)
+
+    m1 = _make_model()
+    m1.fit(x, y, batch_size=8, epochs=3, verbose=False, checkpoint_dir=d)
+    mgr = CheckpointManager(d)
+    assert mgr.latest_step() == 2  # epochs 0..2 saved (every=1)
+
+    # fresh model, same topology: resume continues at epoch 3
+    m2 = _make_model()
+    hist = m2.fit(x, y, batch_size=8, epochs=5, verbose=False,
+                  checkpoint_dir=d, resume=True)
+    assert len(hist) == 2  # epochs 3 and 4 only
+    assert mgr.latest_step() == 4
+
+    # resume with everything already trained: no epochs run
+    m3 = _make_model()
+    hist3 = m3.fit(x, y, batch_size=8, epochs=5, verbose=False,
+                   checkpoint_dir=d, resume=True)
+    assert hist3 == []
+
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        m3.fit(x, y, batch_size=8, epochs=1, verbose=False, resume=True)
+
+
+def test_keras_model_checkpoint_callback(tmp_path):
+    from flexflow_tpu import keras
+
+    d = str(tmp_path / "kc")
+    model = keras.Sequential([
+        keras.layers.Dense(16, activation="relu", input_shape=(8,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"],
+                  config=ff.FFConfig(batch_size=8, num_devices=1,
+                                     only_data_parallel=True))
+    rng = np.random.RandomState(1)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(16,)).astype(np.int32)
+    model.fit(x, y, epochs=2,
+              callbacks=[keras.callbacks.ModelCheckpoint(d)])
+    assert CheckpointManager(d).latest_step() == 1
+
+    # every > epochs: the final epoch is still snapshotted (train-end)
+    d2 = str(tmp_path / "kc2")
+    model.fit(x, y, epochs=2,
+              callbacks=[keras.callbacks.ModelCheckpoint(d2, every=5)])
+    assert CheckpointManager(d2).latest_step() == 1
+
+    # the keras fit path forwards checkpoint kwargs to FFModel.fit
+    d3 = str(tmp_path / "kc3")
+    model.fit(x, y, epochs=2, checkpoint_dir=d3)
+    h = model.fit(x, y, epochs=3, checkpoint_dir=d3, resume=True)
+    assert len(h) == 1  # epoch 2 only
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    """Interrupt+resume must be EQUIVALENT to an uninterrupted run:
+    the shuffle stream is fast-forwarded (a resumed epoch N sees the
+    N-th permutation, not epoch 0's) and the dropout rng counter is
+    restored, so final parameters match bit-for-bit."""
+    import jax
+
+    d = str(tmp_path / "eq")
+    rng = np.random.RandomState(3)
+    x = rng.randn(24, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=(24,)).astype(np.int32)
+
+    straight = _make_model()
+    straight.fit(x, y, batch_size=8, epochs=2, verbose=False)
+
+    part1 = _make_model()
+    part1.fit(x, y, batch_size=8, epochs=1, verbose=False, checkpoint_dir=d)
+    part2 = _make_model()
+    part2.fit(x, y, batch_size=8, epochs=2, verbose=False,
+              checkpoint_dir=d, resume=True)
+
+    a = jax.tree_util.tree_leaves(straight.params)
+    b = jax.tree_util.tree_leaves(part2.params)
+    for u, v in zip(a, b):
+        np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                   rtol=0, atol=0)

@@ -1,0 +1,80 @@
+"""Example-script smoke tier: every runnable script in examples/
+executes end-to-end at CI size in a fresh process (role of the
+reference's tests/multi_gpu_tests.sh, which runs its ~30 example
+scripts with --only-data-parallel — success = trains without crash).
+
+Builders are unit-tested in test_models.py; this tier catches what
+those cannot — rot in the scripts themselves (imports, arg parsing,
+run_example glue).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (script, extra argv).  Scripts sized for CPU internally; batch/epochs
+# kept minimal here.  Excluded: inception (220-node graph takes minutes
+# to compile on a 1-core CI host; covered by
+# test_models.test_inception_builds and the search-scale gate) and
+# pytorch_bert (HF trace + import covered directly by
+# test_frontends.test_huggingface_bert_import_parity_and_training).
+_SCRIPTS = [
+    ("alexnet.py", ["-b", "8", "-e", "1"]),
+    ("mlp_unify.py", ["-b", "16", "-e", "1"]),
+    ("transformer.py", ["-b", "4", "-e", "1"]),
+    ("gpt.py", ["-b", "4", "-e", "1"]),
+    ("dlrm.py", ["-b", "8", "-e", "1"]),
+    ("xdl.py", ["-b", "8", "-e", "1"]),
+    ("candle_uno.py", ["-b", "8", "-e", "1"]),
+    ("moe.py", ["-b", "8", "-e", "1"]),
+    ("keras_mnist_mlp.py", ["-b", "16", "-e", "1"]),
+    ("pytorch_import.py", ["-b", "8", "-e", "1"]),
+    ("resnet.py", ["-b", "4", "-e", "1"]),
+    ("onnx_import.py", ["-b", "16", "-e", "1"]),
+    ("placed_dlrm.py", ["-b", "32", "-e", "1"]),
+    ("staged_pipeline.py", ["-b", "16", "-e", "1"]),
+    ("tf_keras_import.py", ["-b", "8", "-e", "1"]),
+    ("digits_accuracy.py", ["-b", "32", "-e", "12"]),
+    ("keras_cifar10_cnn.py", ["-b", "16", "-e", "1"]),
+    ("keras_reuters_mlp.py", ["-b", "16", "-e", "1"]),
+    ("ulysses_sp.py", ["-b", "8", "-e", "1"]),
+]
+
+_BOOT = (
+    # version-drift handling lives in ONE place (comm/compat.py); the
+    # subprocess has the repo on PYTHONPATH, so the shared helper works
+    "from flexflow_tpu.comm.compat import force_cpu_devices\n"
+    "force_cpu_devices(8)\n"
+    "import runpy, sys\n"
+    "sys.argv = sys.argv[1:]\n"  # the script must see ITS OWN argv
+    "runpy.run_path(sys.argv[0], run_name='__main__')"
+)
+
+
+@pytest.mark.parametrize("script,argv", _SCRIPTS,
+                         ids=[s for s, _ in _SCRIPTS])
+def test_example_script_runs(script, argv):
+    if script == "pytorch_import.py":
+        pytest.importorskip("torch")
+    path = os.path.join(_REPO, "examples", script)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_REPO, env.get("PYTHONPATH")) if p
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _BOOT, path, *argv,
+         "--only-data-parallel"],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )

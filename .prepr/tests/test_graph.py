@@ -1,0 +1,119 @@
+"""PCG graph-algorithm unit tests (role of reference tests/unit/test_dominators.cc)."""
+
+import pytest
+
+from flexflow_tpu.core.graph import Graph
+
+
+class FakeOp:
+    def __init__(self, name):
+        self.name = name
+        self.op_type = name
+
+    def signature(self):
+        return ("fake", self.name)
+
+
+def chain(names):
+    g = Graph()
+    nodes = [g.new_node(FakeOp(n)) for n in names]
+    for a, b in zip(nodes, nodes[1:]):
+        g.add_edge(a, b)
+    return g, nodes
+
+
+def test_topo_order_chain():
+    g, nodes = chain(["a", "b", "c", "d"])
+    assert [n.op.name for n in g.topo_order()] == ["a", "b", "c", "d"]
+
+
+def test_diamond_dominators_and_bottlenecks():
+    #    a
+    #   / \
+    #  b   c
+    #   \ /
+    #    d --- e
+    g = Graph()
+    a, b, c, d, e = (g.new_node(FakeOp(x)) for x in "abcde")
+    g.add_edge(a, b)
+    g.add_edge(a, c)
+    g.add_edge(b, d)
+    g.add_edge(c, d)
+    g.add_edge(d, e)
+    dom = g.dominators()
+    assert dom[d.guid] == {a.guid, d.guid}
+    assert dom[e.guid] == {a.guid, d.guid, e.guid}
+    bn = [n.op.name for n in g.bottlenecks()]
+    assert bn == ["d"]  # a is source, e is sink, b/c not on all paths
+
+
+def test_split_at_bottleneck():
+    g = Graph()
+    a, b, c, d = (g.new_node(FakeOp(x)) for x in "abcd")
+    g.add_edge(a, b)
+    g.add_edge(b, c)
+    g.add_edge(c, d)
+    first, second = g.split_at_node(b)
+    assert {n.op.name for n in first.nodes.values()} == {"a", "b"}
+    assert {n.op.name for n in second.nodes.values()} == {"b", "c", "d"}
+    # b is the source of the suffix
+    assert [n.op.name for n in second.sources()] == ["b"]
+
+
+def test_split_crossing_edge_rejected():
+    g = Graph()
+    a, b, c = (g.new_node(FakeOp(x)) for x in "abc")
+    g.add_edge(a, b)
+    g.add_edge(a, c)
+    g.add_edge(b, c)
+    with pytest.raises(ValueError):
+        g.split_at_node(b)
+
+
+def test_hash_stable_under_renumbering():
+    g1, _ = chain(["a", "b", "c"])
+    g2 = Graph()
+    n3 = g2.new_node(FakeOp("c"))
+    n1 = g2.new_node(FakeOp("a"))
+    n2 = g2.new_node(FakeOp("b"))
+    g2.add_edge(n1, n2)
+    g2.add_edge(n2, n3)
+    assert g1.hash() == g2.hash()
+    g3, _ = chain(["a", "b", "x"])
+    assert g1.hash() != g3.hash()
+
+
+def test_components_and_horizontal_split():
+    g = Graph()
+    a, b = (g.new_node(FakeOp(x)) for x in "ab")
+    c, d = (g.new_node(FakeOp(x)) for x in "cd")
+    g.add_edge(a, b)
+    g.add_edge(c, d)
+    comps = g.weakly_connected_components()
+    assert len(comps) == 2
+    ga, gb = g.split_horizontal()
+    assert ga.num_nodes == 2 and gb.num_nodes == 2
+
+
+def test_cycle_detection():
+    g = Graph()
+    a, b = (g.new_node(FakeOp(x)) for x in "ab")
+    g.add_edge(a, b)
+    g.add_edge(b, a)
+    with pytest.raises(ValueError):
+        g.topo_order()
+
+
+def test_dot_export():
+    g, _ = chain(["x", "y"])
+    dot = g.to_dot()
+    assert "digraph PCG" in dot and "x" in dot and "->" in dot
+
+
+def test_machine_view():
+    from flexflow_tpu.core.machine import MachineView
+
+    mv = MachineView.data_parallel(3, 8)
+    assert mv.num_parts == 8
+    assert mv.dim_degrees == (8, 1, 1)
+    assert MachineView.trivial(2).is_trivial

@@ -1,0 +1,244 @@
+"""Keras frontend tests (reference: examples/python/keras smoke scripts +
+accuracy.py VerifyMetrics protocol)."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ffpkg
+from flexflow_tpu import keras
+from flexflow_tpu.config import FFConfig
+
+
+def blobs(n=256, d=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)) * 3
+    y = rng.integers(0, classes, size=n)
+    x = centers[y] + rng.normal(size=(n, d))
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def cfg(**kw):
+    kw.setdefault("batch_size", 32)
+    kw.setdefault("num_devices", 8)
+    kw.setdefault("only_data_parallel", True)
+    kw.setdefault("compute_dtype", "float32")
+    return FFConfig(**kw)
+
+
+def test_sequential_trains_with_verify_metrics():
+    model = keras.Sequential([
+        keras.layers.Dense(64, activation="relu", input_shape=(16,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer=keras.optimizers.SGD(0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg())
+    x, y = blobs()
+    hist = model.fit(x, y, epochs=8, verbose=False,
+                     callbacks=[keras.callbacks.VerifyMetrics("accuracy", 0.85)])
+    assert hist[-1]["accuracy"] > 0.85
+    rep = model.evaluate(x, y)
+    assert rep["accuracy"] > 0.85
+    pred = model.predict(x)
+    assert pred.shape == (256, 4)
+
+
+def test_functional_model_merge_layers():
+    a = keras.Input((16,))
+    b = keras.Input((16,))
+    h1 = keras.layers.Dense(32, activation="relu")(a)
+    h2 = keras.layers.Dense(32, activation="relu")(b)
+    merged = keras.layers.Concatenate(axis=-1)([h1, h2])
+    out = keras.layers.Dense(4)(keras.layers.Add()([merged, merged]))
+    model = keras.Model([a, b], out)
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg())
+    x, y = blobs()
+    hist = model.fit([x, x], y, epochs=4, verbose=False)
+    assert hist[-1]["accuracy"] > 0.5
+
+
+def test_sequential_cnn_and_summary():
+    model = keras.Sequential([
+        keras.layers.Conv2D(8, 3, padding="same", activation="relu",
+                            input_shape=(8, 8, 3)),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.BatchNormalization(),
+        keras.layers.Flatten(),
+        keras.layers.Dropout(0.1),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg(batch_size=16))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 4, 64).astype(np.int32)
+    model.fit(x, y, epochs=2, verbose=False)
+    s = model.summary()
+    assert "conv2d" in s and "flatten" in s
+
+
+def test_early_stopping_and_lr_schedule():
+    model = keras.Sequential([
+        keras.layers.Dense(32, activation="relu", input_shape=(16,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer=keras.optimizers.SGD(0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg())
+    x, y = blobs()
+    sched = keras.callbacks.LearningRateScheduler(
+        lambda e: 0.05 if e < 2 else 0.01)
+    stop = keras.callbacks.EarlyStopping(monitor="loss", patience=0,
+                                         min_delta=10.0)  # forces early stop
+    hist = model.fit(x, y, epochs=10, verbose=False, callbacks=[sched, stop])
+    assert len(hist) < 10  # stopped early
+    assert model.ffmodel.optimizer.lr in (0.05, 0.01)
+
+
+def test_verify_metrics_fails_on_bad_threshold():
+    model = keras.Sequential([
+        keras.layers.Dense(4, input_shape=(16,)),
+    ])
+    model.compile(optimizer=keras.optimizers.SGD(1e-6),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg())
+    x, y = blobs()
+    with pytest.raises(AssertionError):
+        model.fit(x, y, epochs=1, verbose=False,
+                  callbacks=[keras.callbacks.VerifyMetrics("accuracy", 0.999)])
+
+
+def test_functional_input_binding_order():
+    """fit([xa, xb]) must bind arrays by Model(inputs=[a, b]) position,
+    even when topo discovery reaches b first."""
+    a = keras.Input((4,))
+    b = keras.Input((4,))
+    # b's branch is discovered first in the output expression
+    hb = keras.layers.Dense(8, name="db")(b)
+    ha = keras.layers.Dense(8, name="da")(a)
+    out = keras.layers.Dense(2)(keras.layers.Concatenate()([hb, ha]))
+    model = keras.Model([a, b], out)
+    model.compile(optimizer="sgd", loss="mean_squared_error",
+                  metrics=["mean_squared_error"], config=cfg(batch_size=8))
+    xa = np.zeros((8, 4), np.float32)
+    xb = np.ones((8, 4), np.float32) * 100.0
+    # zero input a through zero da weights: prediction must depend on xb
+    model.set_weights("da", {"kernel": np.zeros((4, 8), np.float32),
+                             "bias": np.zeros((8,), np.float32)})
+    p1 = model.predict([xa, xb])
+    p2 = model.predict([xa, np.zeros_like(xb)])
+    assert not np.allclose(p1, p2), "xb was not bound to input b"
+    p3 = model.predict([np.ones_like(xa) * 7, xb])
+    np.testing.assert_allclose(p1, p3, rtol=1e-5, atol=1e-5)
+
+
+def test_auto_names_are_per_model():
+    m1 = keras.Sequential([keras.layers.Dense(4, input_shape=(4,)),
+                           keras.layers.Dense(4)])
+    m1.compile(optimizer="sgd", loss="mean_squared_error",
+               metrics=["mean_squared_error"], config=cfg(batch_size=8))
+    m2 = keras.Sequential([keras.layers.Dense(4, input_shape=(4,)),
+                           keras.layers.Dense(4)])
+    m2.compile(optimizer="sgd", loss="mean_squared_error",
+               metrics=["mean_squared_error"], config=cfg(batch_size=8))
+    assert set(m1.ffmodel.params) == set(m2.ffmodel.params)
+
+
+def test_embedding_sequential():
+    model = keras.Sequential([
+        keras.layers.InputLayer((8,), dtype="int32"),
+        keras.layers.Embedding(50, 16),
+        keras.layers.Flatten(),
+        keras.layers.Dense(2),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg())
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 50, size=(128, 8)).astype(np.int32)
+    y = (x.sum(axis=1) % 2).astype(np.int32)
+    hist = model.fit(x, y, epochs=3, verbose=False)
+    assert "accuracy" in hist[-1]
+
+
+def test_fit_validation_data_and_early_stopping_on_val():
+    """fit(validation_data=...) evaluates each epoch, joins val_* into
+    the history, and EarlyStopping can monitor val_loss (keras
+    semantics; the reference verifies metrics on the training set
+    only)."""
+    import numpy as np
+
+    from flexflow_tpu import keras
+
+    rng = np.random.default_rng(0)
+    xtr = rng.normal(size=(64, 16)).astype(np.float32)
+    ytr = rng.integers(0, 4, 64).astype(np.int32)
+    xva = rng.normal(size=(32, 16)).astype(np.float32)
+    yva = rng.integers(0, 4, 32).astype(np.int32)
+    model = keras.Sequential([
+        keras.layers.Dense(32, activation="relu", input_shape=(16,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(
+        xtr, ytr, epochs=3, batch_size=16, verbose=False,
+        validation_data=(xva, yva),
+        callbacks=[keras.callbacks.EarlyStopping(monitor="val_loss",
+                                                 patience=1)],
+    )
+    assert all("val_accuracy" in h and "val_loss" in h for h in hist)
+    assert "val_sparse" not in "".join(hist[0])  # only compiled metrics
+
+
+def test_fit_validation_data_validated_up_front():
+    """A malformed or too-small validation set must fail BEFORE the
+    first epoch trains, not after."""
+    import numpy as np
+    import pytest
+
+    from flexflow_tpu import keras
+
+    rng = np.random.default_rng(0)
+    xtr = rng.normal(size=(32, 16)).astype(np.float32)
+    ytr = rng.integers(0, 4, 32).astype(np.int32)
+    model = keras.Sequential([
+        keras.layers.Dense(8, activation="relu", input_shape=(16,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    with pytest.raises(ValueError, match="pair"):
+        model.fit(xtr, ytr, epochs=1, batch_size=16, verbose=False,
+                  validation_data=(xtr, ytr, ytr))
+    with pytest.raises(ValueError, match="smaller than"):
+        model.fit(xtr, ytr, epochs=1, batch_size=16, verbose=False,
+                  validation_data=(xtr[:4], ytr[:4]))
+
+
+def test_fit_validation_split():
+    """validation_split=f holds out the LAST fraction (keras
+    semantics) and reports val_* like validation_data does."""
+    import numpy as np
+    import pytest
+
+    from flexflow_tpu import keras
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(80, 16)).astype(np.float32)
+    y = rng.integers(0, 4, 80).astype(np.int32)
+    model = keras.Sequential([
+        keras.layers.Dense(16, activation="relu", input_shape=(16,)),
+        keras.layers.Dense(4),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(x, y, epochs=2, batch_size=16, verbose=False,
+                     validation_split=0.2)
+    assert all("val_loss" in h for h in hist)
+    # 80 * 0.2 = 16 held out -> 64 trained
+    assert hist[-1]["samples"] == 64
+    with pytest.raises(ValueError, match="not both"):
+        model.fit(x, y, epochs=1, batch_size=16, verbose=False,
+                  validation_split=0.2, validation_data=(x[:16], y[:16]))

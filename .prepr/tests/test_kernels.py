@@ -1,0 +1,634 @@
+"""Flash attention (interpret mode) and ring attention correctness —
+the new long-context capabilities (SURVEY.md §5/§7 stage 8)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.kernels.flash_attention import (
+    _flash_forward,
+    _xla_attention,
+    flash_attention,
+)
+
+
+def qkv(B=2, S=128, H=4, D=32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_xla(causal):
+    q, k, v = qkv()
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ref = _xla_attention(q, k, v, causal, scale)
+    out = _flash_forward(q, k, v, causal, scale, 64, 64, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_grad_matches():
+    q, k, v = qkv(S=64)
+
+    def f_flash(q):
+        return flash_attention(q, k, v, causal=True).sum()
+
+    def f_ref(q):
+        return _xla_attention(q, k, v, True, 1.0 / math.sqrt(q.shape[-1])).sum()
+
+    g1 = jax.grad(f_flash)(q)
+    g2 = jax.grad(f_ref)(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(mesh8, causal):
+    from flexflow_tpu.parallel.ring_attention import ring_attention
+
+    q, k, v = qkv(B=2, S=64, H=4, D=16)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ref = _xla_attention(q, k, v, causal, scale)
+    # ring over the first mesh axis (degree 2)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, mesh8, "x0", causal=causal)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_multi_axis_matches_full(mesh8, causal):
+    """A seq degree with no single mesh axis (the mesh is built from
+    prime factors, so degree 4 on 8 devices spans two axes) rides the
+    PRODUCT ring: ppermute/axis_index over an axis-name tuple."""
+    from flexflow_tpu.parallel.ring_attention import ring_attention
+
+    q, k, v = qkv(B=2, S=64, H=4, D=16)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ref = _xla_attention(q, k, v, causal, scale)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(
+            q, k, v, mesh8, ("x0", "x1"), causal=causal)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mha_seq_degree4_rides_product_ring():
+    """End-to-end: a strategy sharding MHA's seq dim with degree 4
+    (two mesh axes) stays on the ring path — no degrade warning — and
+    matches the data-parallel numerics."""
+    import warnings
+
+    def build(strategy_fn=None):
+        cfg = ff.FFConfig(batch_size=8, epochs=1, num_devices=8,
+                          compute_dtype="float32", only_data_parallel=True,
+                          seed=5)
+        m = ff.FFModel(cfg)
+        x = m.create_tensor([8, 16, 32])
+        t = m.multihead_attention(x, x, x, embed_dim=32, num_heads=4,
+                                  causal=True, name="mha")
+        t = m.mean(t, dims=[1], name="pool")
+        t = m.dense(t, 4, name="out")
+        strategy = strategy_fn(m) if strategy_fn else None
+        m.compile(strategy=strategy,
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        return m
+
+    def seq4(m):
+        s = {}
+        for node in m.graph.topo_order():
+            nd = node.op.output_shapes[0].ndim
+            s[node.guid] = MachineView.data_parallel(nd, 2)
+        s[m.node_by_name("mha").guid] = MachineView(dim_degrees=(2, 4, 1))
+        return s
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16, 32)).astype(np.float32)
+    m1 = build()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        m2 = build(seq4)
+        l2 = m2.compiled.forward_fn()(m2.params, m2.state, [jnp.asarray(x)])
+    l1 = m1.compiled.forward_fn()(m1.params, m1.state, [jnp.asarray(x)])
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mha_sequence_parallel_end_to_end():
+    """MHA with the seq dim sharded in the strategy → ring attention path,
+    numerics match the data-parallel run."""
+
+    def build(strategy_fn=None):
+        cfg = ff.FFConfig(batch_size=8, epochs=1, num_devices=8,
+                          compute_dtype="float32", only_data_parallel=True, seed=5)
+        m = ff.FFModel(cfg)
+        x = m.create_tensor([8, 16, 32])
+        t = m.multihead_attention(x, x, x, embed_dim=32, num_heads=4,
+                                  causal=True, name="mha")
+        t = m.mean(t, dims=[1], name="pool")
+        t = m.dense(t, 4, name="out")
+        strategy = strategy_fn(m) if strategy_fn else None
+        m.compile(strategy=strategy, loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        return m
+
+    def seq_parallel(m):
+        s = {}
+        for node in m.graph.topo_order():
+            nd = node.op.output_shapes[0].ndim
+            s[node.guid] = MachineView.data_parallel(nd, 2)
+        s[m.node_by_name("mha").guid] = MachineView(dim_degrees=(2, 2, 1))
+        return s
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16, 32)).astype(np.float32)
+    m1 = build()
+    m2 = build(seq_parallel)
+    l1 = m1.compiled.forward_fn()(m1.params, m1.state, [jnp.asarray(x)])
+    l2 = m2.compiled.forward_fn()(m2.params, m2.state, [jnp.asarray(x)])
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=2e-4, atol=2e-4)
+
+
+def test_mha_sp_fallback_warns():
+    """A seq-sharded strategy that cannot take the ring-attention path
+    (here: cross-attention, Sk != Sq) must warn loudly instead of
+    silently all-gathering K/V."""
+    cfg = ff.FFConfig(batch_size=8, epochs=1, num_devices=8,
+                      compute_dtype="float32", only_data_parallel=True, seed=5)
+    m = ff.FFModel(cfg)
+    q = m.create_tensor([8, 16, 32])
+    kv = m.create_tensor([8, 8, 32])
+    t = m.multihead_attention(q, kv, kv, embed_dim=32, num_heads=4, name="xattn")
+    t = m.mean(t, dims=[1], name="pool")
+    m.dense(t, 4, name="out")
+    strategy = {}
+    for node in m.graph.topo_order():
+        nd = node.op.output_shapes[0].ndim
+        strategy[node.guid] = MachineView.data_parallel(nd, 2)
+    strategy[m.node_by_name("xattn").guid] = MachineView(dim_degrees=(2, 2, 1))
+    rng = np.random.default_rng(0)
+    xq = jnp.asarray(rng.normal(size=(8, 16, 32)).astype(np.float32))
+    xkv = jnp.asarray(rng.normal(size=(8, 8, 32)).astype(np.float32))
+    with pytest.warns(RuntimeWarning, match="degrades"):
+        m.compile(strategy=strategy,
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.compiled.forward_fn()(m.params, m.state, [xq, xkv])
+
+
+def test_moe_dispatch_sort_based_matches_cumsum_semantics():
+    """Sort-based dispatch (kernels/moe_dispatch.py) must match the
+    arrival-order cumsum definition (reference: group_by.cc)."""
+    import jax
+    from flexflow_tpu.kernels.moe_dispatch import moe_dispatch
+
+    rng = np.random.default_rng(0)
+    T, D, E, cap = 96, 8, 5, 9  # cap small enough to force drops
+    src = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
+    flat = jnp.asarray(rng.integers(0, E, T).astype(np.int32))
+    grouped, pos, valid = moe_dispatch(src, flat, E, cap)
+
+    onehot = jax.nn.one_hot(flat, E, dtype=jnp.int32)
+    pos_ref = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+    valid_ref = pos_ref < cap
+    assert np.array_equal(np.asarray(pos), np.asarray(pos_ref))
+    assert np.array_equal(np.asarray(valid), np.asarray(valid_ref))
+    g_ref = jnp.zeros((E, cap, D), src.dtype).at[
+        flat, jnp.clip(pos_ref, 0, cap - 1)
+    ].add(src * valid_ref[:, None])
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(g_ref), rtol=1e-6)
+    # dropped tokens must receive zero gradient
+    grads = jax.grad(lambda s: moe_dispatch(s, flat, E, cap)[0].sum())(src)
+    dropped = ~np.asarray(valid)
+    assert np.all(np.asarray(grads)[dropped] == 0)
+    assert np.all(np.asarray(grads)[~dropped] == 1)
+
+
+def test_moe_dispatch_out_of_range_ids_dropped():
+    from flexflow_tpu.kernels.moe_dispatch import moe_dispatch
+
+    src = jnp.ones((4, 3), jnp.float32)
+    flat = jnp.asarray([0, -1, 7, 1], jnp.int32)  # two out-of-range ids
+    grouped, pos, valid = moe_dispatch(src, flat, n_experts=2, capacity=2)
+    assert np.array_equal(np.asarray(valid), [True, False, False, True])
+    assert float(np.asarray(grouped).sum()) == 6.0  # only 2 valid rows
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (64, 128)])
+def test_flash_blocked_backward_matches_xla(causal, sq, sk):
+    """The blocked Pallas backward (dq + dk/dv kernels over saved
+    logsumexp) must match XLA attention gradients for all inputs
+    (VERDICT r3 ask #4: grads match XLA to 1e-3)."""
+    rng = np.random.default_rng(1)
+    B, H, D = 2, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, sq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, sk, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, sk, H, D)), jnp.float32)
+    scale = 1.0 / math.sqrt(D)
+
+    def f_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, causal=causal,
+                                               block_q=32, block_k=32)))
+
+    def f_ref(q, k, v):
+        return jnp.sum(jnp.sin(_xla_attention(q, k, v, causal, scale)))
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_flash_partial_chunked_backward_matches():
+    """flash_attention_partial's chunked recompute backward ==
+    full-matrix partial gradients (ring attention's building block)."""
+    from flexflow_tpu.kernels.flash_attention import (
+        _xla_attention_partial,
+        flash_attention_partial,
+    )
+
+    rng = np.random.default_rng(2)
+    B, S, H, D = 2, 128, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    scale = 1.0 / math.sqrt(D)
+    for causal in (False, True):
+        def f_part(q, k, v):
+            acc, m, l = flash_attention_partial(q, k, v, causal=causal,
+                                                block_q=32, block_k=32)
+            return jnp.sum(jnp.sin(acc / l)) + 0.01 * jnp.sum(m)
+
+        def f_ref(q, k, v):
+            acc, m, l = _xla_attention_partial(q, k, v, causal, scale)
+            return jnp.sum(jnp.sin(acc / l)) + 0.01 * jnp.sum(m)
+
+        g1 = jax.grad(f_part, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+
+
+def test_flash_backward_memory_subquadratic():
+    """Backward peak temp memory must scale ~O(S·block), not O(S²):
+    doubling S through the blocked train-like vjp must grow XLA's
+    temp allocation far less than 4x (the full-probs recompute of
+    round 2 scaled quadratically).  Uses compiled memory analysis on
+    the CPU backend."""
+    def temp_bytes(S):
+        B, H, D = 1, 1, 32
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=False,
+                                           block_q=32, block_k=32))
+
+        grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+        sd = jax.ShapeDtypeStruct((B, S, H, D), jnp.float32)
+        compiled = jax.jit(grad_fn).lower(sd, sd, sd).compile()
+        mem = compiled.memory_analysis()
+        return mem.temp_size_in_bytes
+
+    t1, t2 = temp_bytes(512), temp_bytes(1024)
+    # quadratic would be ~4x; blocked should be ~2x (allow slack)
+    assert t2 < t1 * 3.0, (t1, t2)
+
+
+def test_pick_block_divisor_aware():
+    """Default large blocks (speed-tuned on v5e) must degrade to the
+    largest power-of-two divisor for odd lengths, not bail to the
+    materializing fallback."""
+    from flexflow_tpu.kernels.flash_attention import _pick_block
+
+    assert _pick_block(4096, 512) == 512
+    assert _pick_block(256, 512) == 256
+    assert _pick_block(384, 512) == 128  # 384 = 3*128
+    assert _pick_block(96, 512) == 32
+    # no power-of-two divisor >= 8: untileable -> None (XLA fallback)
+    assert _pick_block(100, 512) is None
+    assert _pick_block(7, 512) is None
+    assert _pick_block(1024, 1024) == 1024
+
+
+def test_mha_flash_dispatch_heuristic():
+    """The MHA op must route short sequences to the fused XLA path and
+    long ones to the Pallas flash kernel (measured crossover ~sk=512):
+    verified by intercepting which kernel entry the op calls."""
+    import importlib
+
+    fa = importlib.import_module("flexflow_tpu.kernels.flash_attention")
+
+    calls = []
+    orig = fa.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(a[0].shape)
+        return orig(*a, **kw)
+
+    cfg = ff.FFConfig(batch_size=2, num_devices=1, only_data_parallel=True)
+
+    def run(seq):
+        import numpy as np
+
+        model = ff.FFModel(cfg)
+        x = model.create_tensor([2, seq, 32], name="x")
+        model.multihead_attention(x, x, x, embed_dim=32, num_heads=2)
+        model.compile(loss_type="mean_squared_error", metrics=[])
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2, seq, 32)).astype(np.float32)
+        Y = rng.normal(size=(2, seq, 32)).astype(np.float32)
+        model.fit(x=X, y=Y, epochs=1, verbose=False)
+
+    fa.flash_attention = spy
+    try:
+        run(64)
+        assert calls == [], "short seq must use the XLA path"
+        run(512)
+        assert calls, "sk>=512 must dispatch to the flash kernel"
+    finally:
+        fa.flash_attention = orig
+
+
+def test_ring_attention_zigzag_matches_contiguous(mesh8):
+    """The zigzag schedule (device i holds chunks i and 2n-1-i — the
+    load-balanced causal ring; every device does exactly two half-chunk
+    attentions per step instead of the contiguous schedule's
+    full-block straggler) must be numerically identical to the
+    contiguous schedule and to the reference attention."""
+    from flexflow_tpu.parallel.ring_attention import ring_attention
+
+    q, k, v = qkv(B=2, S=64, H=4, D=16)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ref = _xla_attention(q, k, v, True, scale)
+    zig = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh8, ("x0", "x1"), causal=True, schedule="zigzag"))(q, k, v)
+    cont = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh8, ("x0", "x1"), causal=True,
+        schedule="contiguous"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(zig), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(zig), np.asarray(cont),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_multi_axis_grad_matches(mesh8):
+    """Backward through the product ring (shard_map autodiff transposes
+    the multi-axis ppermute) matches the reference attention's grads."""
+    from flexflow_tpu.parallel.ring_attention import ring_attention
+
+    q, k, v = qkv(B=2, S=64, H=4, D=16)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def loss_ring(q, k, v):
+        out = ring_attention(q, k, v, mesh8, ("x0", "x1"), causal=True)
+        return jnp.sum(out * out)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(_xla_attention(q, k, v, True, scale)))
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(mesh8, causal):
+    """The all-to-all SP scheme (head exchange, full sequence per
+    device) must match full attention exactly like the ring does —
+    including causal, which needs no zigzag because every device sees
+    the whole sequence."""
+    from flexflow_tpu.parallel.ulysses import ulysses_attention
+
+    q, k, v = qkv(B=2, S=64, H=4, D=16)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    ref = _xla_attention(q, k, v, causal, scale)
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, mesh8, "x0", causal=causal)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    # product-axis degree 4 (no single mesh axis) rides the same path
+    out4 = jax.jit(
+        lambda q, k, v: ulysses_attention(
+            q, k, v, mesh8, ("x0", "x1"), causal=causal)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_attention_grad_matches(mesh8):
+    from flexflow_tpu.parallel.ulysses import ulysses_attention
+
+    q, k, v = qkv(B=2, S=64, H=4, D=16)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def f_u(q):
+        return ulysses_attention(q, k, v, mesh8, ("x0", "x1"),
+                                 causal=True).sum()
+
+    def f_ref(q):
+        return _xla_attention(q, k, v, True, scale).sum()
+
+    g1 = jax.jit(jax.grad(f_u))(q)
+    g2 = jax.grad(f_ref)(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mha_sp_mode_ulysses_end_to_end():
+    """sp_mode="ulysses" on a seq-sharded MHA strategy executes the
+    all-to-all path end-to-end with data-parallel numerics; the cost
+    model charges it fewer wire bytes than the ring."""
+    def build(sp_mode, strategy_fn=None):
+        cfg = ff.FFConfig(batch_size=8, epochs=1, num_devices=8,
+                          compute_dtype="float32", only_data_parallel=True,
+                          seed=5)
+        m = ff.FFModel(cfg)
+        x = m.create_tensor([8, 16, 32])
+        t = m.multihead_attention(x, x, x, embed_dim=32, num_heads=4,
+                                  causal=True, sp_mode=sp_mode, name="mha")
+        t = m.mean(t, dims=[1], name="pool")
+        t = m.dense(t, 4, name="out")
+        strategy = strategy_fn(m) if strategy_fn else None
+        m.compile(strategy=strategy,
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        return m
+
+    def seq4(m):
+        s = {}
+        for node in m.graph.topo_order():
+            nd = node.op.output_shapes[0].ndim
+            s[node.guid] = MachineView.data_parallel(nd, 2)
+        s[m.node_by_name("mha").guid] = MachineView(dim_degrees=(2, 4, 1))
+        return s
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16, 32)).astype(np.float32)
+    m1 = build("ring")
+    m2 = build("ulysses", seq4)
+    l1 = m1.compiled.forward_fn()(m1.params, m1.state, [jnp.asarray(x)])
+    l2 = m2.compiled.forward_fn()(m2.params, m2.state, [jnp.asarray(x)])
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=2e-4, atol=2e-4)
+
+    # cost model: ulysses bytes = (2/n) * ring bytes at the same view
+    mv = MachineView(dim_degrees=(2, 4, 1))
+    ring_op = m1.node_by_name("mha").op
+    uly_op = m2.node_by_name("mha").op
+    rb, rn, _ = ring_op.ring_comm_bytes(mv)
+    ub, un, _ = uly_op.ring_comm_bytes(mv)
+    assert rn == un == 4
+    # 4*(n-1)/n vs 2*(n-1) per shard -> ulysses/ring = 2/n = 1/2 at n=4
+    assert ub == pytest.approx(rb * 2.0 / 4.0)
+
+
+def test_mha_sp_mode_ulysses_falls_back_when_heads_indivisible():
+    """heads=3 does not divide seq degree 4: the ulysses request must
+    fall back to the ring (still correct), not crash."""
+    from flexflow_tpu.ops.attention import MultiHeadAttentionOp
+    from flexflow_tpu.core.ptensor import ParallelTensorShape
+
+    sh = ParallelTensorShape.make((8, 16, 33))
+    op = MultiHeadAttentionOp("mha", [sh, sh, sh], embed_dim=33,
+                              num_heads=3, sp_mode="ulysses")
+    assert not op._use_ulysses(4)
+    assert op._use_ulysses(3)
+
+
+@pytest.mark.parametrize("dt,tol", [(jnp.float32, 1e-5),
+                                    (jnp.bfloat16, 2e-2)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_xla_attention_compact_vjp_matches_autodiff(dt, tol, causal):
+    """_xla_attention's custom VJP (residuals: q/k/v + probs at
+    q.dtype, instead of autodiff's fp32 logits + fp32 probs) must match
+    the plain-autodiff einsum reference: exactly in fp32 (the residual
+    cast is the identity), to bf16 round-off under a bf16 stream."""
+    def ref(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * 0.25
+        if causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            m = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(m, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 32, 4, 16)), dt)
+               for _ in range(3))
+    o_ref = ref(q, k, v).astype(jnp.float32)
+    o_new = _xla_attention(q, k, v, causal, 0.25).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(o_new), np.asarray(o_ref),
+                               rtol=0, atol=1e-7)
+
+    for arg in range(3):
+        g_ref = jax.grad(
+            lambda *a: jnp.sum(ref(*a).astype(jnp.float32)), argnums=arg
+        )(q, k, v).astype(jnp.float32)
+        g_new = jax.grad(
+            lambda *a: jnp.sum(
+                _xla_attention(*a, causal, 0.25).astype(jnp.float32)),
+            argnums=arg,
+        )(q, k, v).astype(jnp.float32)
+        scale = max(float(jnp.max(jnp.abs(g_ref))), 1.0)
+        np.testing.assert_allclose(np.asarray(g_new) / scale,
+                                   np.asarray(g_ref) / scale,
+                                   rtol=0, atol=tol)
+
+    # the dropout branch stays on plain autodiff and still works
+    out_do = _xla_attention(q, k, v, causal, 0.25, dropout_rate=0.5,
+                            dropout_rng=jax.random.key(0))
+    assert out_do.shape == q.shape and bool(jnp.all(jnp.isfinite(
+        out_do.astype(jnp.float32))))
+
+
+def test_xla_attention_compact_vjp_fully_masked_rows():
+    """Causal cross-attention with Sq > Sk fully masks the first
+    Sq-Sk query rows; their q/k gradients must be zero exactly as the
+    where-mask VJP gives in plain autodiff (the saved probs for those
+    rows are uniform 1/Sk, NOT zero — the backward must re-zero them)."""
+    def ref(q, k, v):
+        sq, sk = q.shape[1], k.shape[1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * 0.25
+        m = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(m, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(2, 24, 4, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 16, 4, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 16, 4, 16)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(_xla_attention(q, k, v, True, 0.25)),
+        np.asarray(ref(q, k, v)), rtol=0, atol=1e-6)
+    for arg in range(3):
+        g_ref = jax.grad(lambda *a: jnp.sum(ref(*a)), argnums=arg)(q, k, v)
+        g_new = jax.grad(
+            lambda *a: jnp.sum(_xla_attention(*a, True, 0.25)),
+            argnums=arg)(q, k, v)
+        np.testing.assert_allclose(np.asarray(g_new), np.asarray(g_ref),
+                                   rtol=0, atol=1e-5)
+    # the fully-masked rows' q-grad is exactly zero
+    gq = jax.grad(lambda q: jnp.sum(_xla_attention(q, k, v, True, 0.25)))(q)
+    assert float(jnp.max(jnp.abs(gq[:, : 24 - 16]))) == 0.0
+
+
+@pytest.mark.parametrize("dt,tol", [(jnp.float32, 1e-5),
+                                    (jnp.bfloat16, 2e-2)])
+@pytest.mark.parametrize("causal,sq", [(False, 32), (True, 32), (True, 40)])
+def test_xla_attention_dropout_compact_vjp_matches_autodiff(dt, tol, causal,
+                                                            sq):
+    """The dropout branch's compact VJP (residuals: q/k/v + probs at
+    q.dtype + bool mask) must match plain autodiff of the same
+    mask-fixed computation — the BERT-family training regime."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(2, sq, 4, 16)), dt)
+    k = jnp.asarray(rng.normal(size=(2, 32, 4, 16)), dt)
+    v = jnp.asarray(rng.normal(size=(2, 32, 4, 16)), dt)
+    keep = 0.8
+    mask = jax.random.bernoulli(jax.random.key(9), keep, (2, 4, sq, 32))
+
+    def ref(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * 0.25
+        if causal:
+            sq_, sk_ = logits.shape[-2], logits.shape[-1]
+            cm = jnp.tril(jnp.ones((sq_, sk_), bool), k=sk_ - sq_)
+            logits = jnp.where(cm, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        d = jnp.where(mask, p.astype(jnp.float32) / keep, 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", d.astype(q.dtype), v)
+
+    from flexflow_tpu.kernels.flash_attention import _attn_core_dropout
+
+    o_ref = ref(q, k, v).astype(jnp.float32)
+    o_new = _attn_core_dropout(q, k, v, mask, causal, 0.25,
+                               keep).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(o_new), np.asarray(o_ref),
+                               rtol=0, atol=1e-6)
+    for arg in range(3):
+        g_ref = jax.grad(
+            lambda *a: jnp.sum(ref(*a).astype(jnp.float32)), argnums=arg
+        )(q, k, v).astype(jnp.float32)
+        g_new = jax.grad(
+            lambda *a: jnp.sum(_attn_core_dropout(
+                *a, mask, causal, 0.25, keep).astype(jnp.float32)),
+            argnums=arg)(q, k, v).astype(jnp.float32)
+        s = max(float(jnp.max(jnp.abs(g_ref))), 1.0)
+        np.testing.assert_allclose(np.asarray(g_new) / s,
+                                   np.asarray(g_ref) / s,
+                                   rtol=0, atol=tol)

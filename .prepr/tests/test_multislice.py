@@ -1,0 +1,202 @@
+"""Multislice (DCN) cost-model awareness.
+
+The reference prices inter-node communication with a separate
+inter-node bandwidth (reference: src/runtime/machine_model.cc:66-68
+inter-node 12/num_nodes vs intra-node 20) and its EnhancedMachineModel
+routes NIC/UPI paths by device placement.  The TPU analogue: ICI
+within a slice, DCN between slices — and whether a collective crosses
+DCN depends on WHICH mesh axes it rides.  Under the lowering's
+deterministic axis assignment (parallel/mesh.py view_slot_axes), the
+first view slots take the outermost (strided, slice-crossing) axes, so
+a 2-way data-parallel gradient sync on a 2-slice machine rides DCN
+while a within-slice tensor-parallel psum does not — the scaling-book
+multislice recipe (DP over DCN, MP within a slice).
+"""
+
+import dataclasses
+
+import flexflow_tpu as ff
+from flexflow_tpu.compiler.lowering import data_parallel_strategy
+from flexflow_tpu.core.machine import MachineSpec, MachineView
+from flexflow_tpu.search.dp import SearchHelper
+from flexflow_tpu.search.machine_model import CostModel
+from flexflow_tpu.search.simulator import Simulator
+
+
+def _machines():
+    one_slice = MachineSpec.tpu_v5e(8)  # devices_per_host=8: pure ICI
+    two_slice = dataclasses.replace(one_slice, devices_per_host=4)
+    return one_slice, two_slice
+
+
+def _linear_model(batch=8, dim=1024):
+    cfg = ff.FFConfig(batch_size=batch, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([batch, dim])
+    t = m.dense(x, dim, activation="relu", name="fc1")
+    m.dense(t, dim, name="fc2")
+    return m
+
+
+def test_outer_axis_collectives_priced_at_dcn():
+    """DP-2 weight sync rides the outermost mesh axis (x0, stride 4),
+    which crosses the slice boundary of a 2x4 machine — it must be
+    priced at DCN bandwidth there, and at ICI on a single slice."""
+    one, two = _machines()
+    cm_one, cm_two = CostModel(one), CostModel(two)
+    m = _linear_model()
+    op = m.node_by_name("fc1").op
+    dp2 = MachineView(dim_degrees=(2, 1))
+    sync_one = cm_one.weight_sync_cost(op, dp2)
+    sync_two = cm_two.weight_sync_cost(op, dp2)
+    assert sync_one > 0.0
+    # DCN is ~14x slower than one ICI link in the default spec
+    assert sync_two > sync_one * 5, (sync_one, sync_two)
+
+
+def test_inner_axis_collectives_stay_on_ici():
+    """Under a dp2 x tp4 view (slots (2, 4)), dim 0 consumes the
+    outer slice-crossing axis (stride 4) and dim 1 the two inner axes
+    (strides 2, 1 — span 4 fits one slice).  A combine over dim 1
+    therefore costs the SAME on one slice and on 2x4; the same combine
+    over dim 0 rides the outer axis and must be priced at DCN."""
+    from flexflow_tpu.core.ptensor import ParallelTensorShape
+    from flexflow_tpu.ops.base import ShardAnnot
+
+    one, two = _machines()
+    cm_one, cm_two = CostModel(one), CostModel(two)
+    shape = ParallelTensorShape.make((64, 4096), "float32")
+    src = ShardAnnot((2, 4))
+    inner_one = cm_one.xfer_cost(shape, src, ShardAnnot((2, 1)))
+    inner_two = cm_two.xfer_cost(shape, src, ShardAnnot((2, 1)))
+    assert inner_two == inner_one, (inner_one, inner_two)
+    outer_one = cm_one.xfer_cost(shape, src, ShardAnnot((1, 4)))
+    outer_two = cm_two.xfer_cost(shape, src, ShardAnnot((1, 4)))
+    assert outer_two > outer_one * 5, (outer_one, outer_two)
+    # a weight-sharding TP-4 view syncs nothing on either machine
+    m = _linear_model()
+    op = m.node_by_name("fc1").op
+    tp4 = MachineView(dim_degrees=(1, 4))
+    assert cm_two.weight_sync_cost(op, tp4) == cm_one.weight_sync_cost(op, tp4)
+
+
+def test_combine_retaining_outer_axis_stays_on_ici():
+    """(8,1) -> (2,1): the retained dst degree keeps the slot's
+    first-assigned OUTER axis x0; the 4-way gather rides only the inner
+    tail axes x1,x2 (span 4 = one slice), so both machines price it the
+    same.  Charging DCN here (the slot's full axis set) would bias the
+    search away from combines that execution performs entirely on ICI."""
+    from flexflow_tpu.core.ptensor import ParallelTensorShape
+    from flexflow_tpu.ops.base import ShardAnnot
+
+    one, two = _machines()
+    cm_one, cm_two = CostModel(one), CostModel(two)
+    shape = ParallelTensorShape.make((64, 4096), "float32")
+    t_one = cm_one.xfer_cost(shape, ShardAnnot((8, 1)), ShardAnnot((2, 1)))
+    t_two = cm_two.xfer_cost(shape, ShardAnnot((8, 1)), ShardAnnot((2, 1)))
+    assert t_two == t_one, (t_one, t_two)
+
+
+def test_cost_model_uses_search_device_count():
+    """--search-num-nodes-style overrides search a machine larger or
+    smaller than the spec's chip count; the slot->axis pool must factor
+    the SEARCH device count (what strategies lower onto).  A dp2 view
+    on an 8-device search of a 16-chip 2-slice spec spans 8 devices —
+    one full slice, pure ICI."""
+    big = dataclasses.replace(MachineSpec.tpu_v5e(16), devices_per_host=8)
+    cm = CostModel(big, num_devices=8)
+    # the classifier returns the crossed link LEVEL (0 = within-slice,
+    # falsy — the historical False)
+    assert cm._spans_dcn((2, 1, 1), [0]) == 0
+    # the same view searched over all 16 chips crosses slices
+    cm16 = CostModel(big)
+    assert cm16._spans_dcn((2, 1, 1), [0]) == 1
+
+
+def test_mixed_prime_combine_matches_retained_axes_by_size():
+    """12 devices (pool 2,2,3), 8 per domain.  A slot of degree 6 owns
+    axes (stride 6, size 2) and (stride 1, size 3); combining 6 -> 3
+    retains the SIZE-3 axis (take-first matches by factor size, not
+    position), so the gather rides the stride-6 size-2 axis spanning
+    all 12 devices — it must be priced at DCN."""
+    from flexflow_tpu.core.ptensor import ParallelTensorShape
+    from flexflow_tpu.ops.base import ShardAnnot
+
+    spec12 = dataclasses.replace(MachineSpec.tpu_v5e(12), devices_per_host=8)
+    spec12_flat = dataclasses.replace(spec12, devices_per_host=12)
+    cm_multi = CostModel(spec12, num_devices=12)
+    cm_flat = CostModel(spec12_flat, num_devices=12)
+    shape = ParallelTensorShape.make((48, 4096), "float32")
+    t_multi = cm_multi.xfer_cost(shape, ShardAnnot((6, 1)), ShardAnnot((3, 1)))
+    t_flat = cm_flat.xfer_cost(shape, ShardAnnot((6, 1)), ShardAnnot((3, 1)))
+    assert t_multi > t_flat * 5, (t_flat, t_multi)
+
+
+def test_unaligned_span_crosses_domain_boundary():
+    """12 devices, 8 per domain: a degree-3 group (stride-1 axis, span
+    3) fits inside 8 but does NOT divide it — the aligned 3-blocks are
+    [0,3) [3,6) [6,9) [9,12) and [6,9) straddles the domain boundary,
+    so the gather must be priced at DCN despite span < domain."""
+    from flexflow_tpu.core.ptensor import ParallelTensorShape
+    from flexflow_tpu.ops.base import ShardAnnot
+
+    spec12 = dataclasses.replace(MachineSpec.tpu_v5e(12), devices_per_host=8)
+    spec12_flat = dataclasses.replace(spec12, devices_per_host=12)
+    cm_multi = CostModel(spec12, num_devices=12)
+    cm_flat = CostModel(spec12_flat, num_devices=12)
+    shape = ParallelTensorShape.make((48, 4096), "float32")
+    t_multi = cm_multi.xfer_cost(shape, ShardAnnot((3, 1)), ShardAnnot((1, 1)))
+    t_flat = cm_flat.xfer_cost(shape, ShardAnnot((3, 1)), ShardAnnot((1, 1)))
+    assert t_multi > t_flat * 5, (t_flat, t_multi)
+
+
+def test_dp8_sync_crosses_dcn_on_two_slices():
+    """Full 8-way DP sync spans both slices on the 2x4 machine (size
+    heuristic and axis rule agree here)."""
+    one, two = _machines()
+    m = _linear_model()
+    op = m.node_by_name("fc1").op
+    dp8 = MachineView(dim_degrees=(8, 1))
+    assert CostModel(two).weight_sync_cost(op, dp8) > \
+        CostModel(one).weight_sync_cost(op, dp8) * 5
+
+
+def test_search_still_beats_dp_on_two_slices_and_dcn_only_hurts():
+    """End-to-end sanity on the searched strategy: the 2-slice machine
+    can never be simulated cheaper than the single slice (DCN only adds
+    cost), and the search still finds something at least as good as
+    pure DP under the multislice pricing."""
+    one, two = _machines()
+    m = _linear_model(batch=8, dim=2048)
+    sim_one = Simulator(one, num_devices=8)
+    sim_two = Simulator(two, num_devices=8)
+    c_one, _ = SearchHelper(sim_one, 8).graph_cost(m.graph)
+    c_two, strat_two = SearchHelper(sim_two, 8).graph_cost(m.graph)
+    assert c_two >= c_one * 0.999, (c_one, c_two)
+    dp_two = sim_two.simulate(m.graph, data_parallel_strategy(m.graph, 8))
+    assert c_two <= dp_two * 1.001, (c_two, dp_two)
+
+
+def test_seq_parallel_mha_charges_ring_comm():
+    """A view splitting MHA's sequence dim executes as ring attention
+    (K/V shards make n-1 ppermute hops); the cost model must charge
+    that wire time — otherwise the search ranks sequence parallelism
+    as free compute-splitting and prefers it over batch splitting even
+    when the ring traffic dominates."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.machine import MachineSpec, MachineView
+    from flexflow_tpu.search.machine_model import CostModel
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 512, 256])
+    m.multihead_attention(x, x, x, embed_dim=256, num_heads=8, name="mha")
+    op = m.node_by_name("mha").op
+    cm = CostModel(MachineSpec.tpu_v5e(8), num_devices=8)
+    c_batch = cm.op_cost(op, MachineView(dim_degrees=(8, 1, 1)))
+    c_seq = cm.op_cost(op, MachineView(dim_degrees=(1, 8, 1)))
+    assert c_seq > c_batch * 1.5, (c_batch, c_seq)
+    # inference charges half the ring traffic (no backward re-rotation)
+    c_seq_fwd = cm.op_cost(op, MachineView(dim_degrees=(1, 8, 1)),
+                           backward=False)
+    assert c_seq_fwd < c_seq, (c_seq_fwd, c_seq)

@@ -1,0 +1,302 @@
+"""Native C++ runtime components vs their Python fallbacks.
+
+The native engine (native/src/*.cpp via ctypes) must be semantically
+interchangeable with the pure-Python paths — these tests assert
+equality on the same inputs (reference analog: tests/unit/*.cc
+exercise the C++ graph algorithms directly)."""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu import native
+from flexflow_tpu.core.graph import Graph
+from flexflow_tpu.core.machine import MachineSpec, MachineView
+from flexflow_tpu.search.dp import SearchHelper
+from flexflow_tpu.search.simulator import Simulator
+from flexflow_tpu.search.views import candidate_views
+
+pytestmark = pytest.mark.skipif(
+    native.get_lib() is None, reason="native library not available"
+)
+
+
+def build_model_graph(num_devices=8):
+    cfg = ff.FFConfig(batch_size=32, num_devices=num_devices,
+                      compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor([32, 64])
+    t = model.dense(x, 128, activation="relu")
+    t = model.dense(t, 128, activation="relu")
+    a = model.dense(t, 64)
+    b = model.dense(t, 64)
+    t = model.add(a, b)
+    t = model.dense(t, 8)
+    t = model.softmax(t)
+    return model.graph
+
+
+def make_sim(num_devices=8):
+    return Simulator(MachineSpec(num_devices=num_devices))
+
+
+# ---------------------------------------------------------------------------
+# graph algorithms
+# ---------------------------------------------------------------------------
+
+
+def random_dag(rng, n=40, p=0.15):
+    g = Graph()
+    nodes = []
+
+    class _FakeOp:
+        def __init__(self, i):
+            self.name = f"n{i}"
+
+        def signature(self):
+            return ("fake", self.name)
+
+    for i in range(n):
+        nodes.append(g.new_node(_FakeOp(i)))
+    for i in range(n):
+        for j in range(i + 1, n):
+            if rng.random() < p:
+                g.add_edge(nodes[i], nodes[j])
+    return g
+
+
+def test_bottlenecks_native_matches_python(monkeypatch):
+    rng = np.random.default_rng(0)
+    for trial in range(10):
+        g = random_dag(rng, n=30, p=0.12)
+        native_result = [n.guid for n in g.bottlenecks()]
+        monkeypatch.setattr(Graph, "_native_call", lambda self, fn: None)
+        python_result = [n.guid for n in g.bottlenecks()]
+        monkeypatch.undo()
+        assert native_result == python_result, f"trial {trial}"
+
+
+def test_components_native_matches_python(monkeypatch):
+    rng = np.random.default_rng(1)
+    for trial in range(10):
+        g = random_dag(rng, n=25, p=0.05)
+        native_result = g.weakly_connected_components()
+        monkeypatch.setattr(Graph, "_native_call", lambda self, fn: None)
+        python_result = g.weakly_connected_components()
+        monkeypatch.undo()
+        assert native_result == python_result, f"trial {trial}"
+
+
+def test_graph_topo_native():
+    edges = [(0, 1), (0, 2), (1, 3), (2, 3)]
+    order = native.graph_topo(4, edges)
+    assert order == [0, 1, 2, 3]
+    with pytest.raises(ValueError):
+        native.graph_topo(2, [(0, 1), (1, 0)])
+
+
+# ---------------------------------------------------------------------------
+# simulation engine
+# ---------------------------------------------------------------------------
+
+
+def test_native_simulate_matches_python():
+    g = build_model_graph()
+    sim = make_sim()
+    topo = g.topo_order()
+    node_views = {}
+    for node in topo:
+        views = candidate_views(node.op, 8, max_views=8)
+        if not views:
+            views = [node.op.fixed_machine_view()
+                     or MachineView.trivial(node.op.output_shapes[0].ndim)]
+        node_views[node.guid] = views
+    ns, index = sim.build_native(g, node_views)
+
+    rng = np.random.default_rng(2)
+    for _ in range(50):
+        assign = {}
+        native_assign = [0] * len(topo)
+        for node in topo:
+            vi = int(rng.integers(0, len(node_views[node.guid])))
+            assign[node.guid] = node_views[node.guid][vi]
+            native_assign[index[node.guid]] = vi
+        for include_update in (True, False):
+            py = sim.simulate(g, assign, include_update=include_update)
+            nat = ns.simulate(native_assign, include_update=include_update)
+            if math.isinf(py):
+                assert math.isinf(nat)
+            else:
+                assert abs(py - nat) <= 1e-12 + 1e-9 * abs(py), (py, nat)
+
+
+def test_native_brute_force_matches_python_leaf():
+    g = build_model_graph()
+    sim_native = make_sim()
+    helper = SearchHelper(sim_native, num_devices=8, leaf_threshold=16,
+                          max_views_per_op=4)
+    free = [g.nodes[x] for x in sorted(g.nodes)]
+    choices = [helper._views(n, 8) or
+               [n.op.fixed_machine_view()
+                or MachineView.trivial(n.op.output_shapes[0].ndim)]
+               for n in free]
+    nat = helper._native_leaf(g, {}, free, choices)
+    assert nat is not None
+    n_cost, n_strategy = nat
+
+    # the equivalent Python product loop
+    best = (math.inf, {})
+    sim_py = make_sim()
+    for combo in itertools.product(*choices):
+        strategy = {n.guid: v for n, v in zip(free, combo)}
+        c = sim_py.simulate(g, strategy)
+        if c < best[0]:
+            best = (c, strategy)
+    assert abs(n_cost - best[0]) <= 1e-12 + 1e-9 * abs(best[0])
+
+
+def test_search_helper_end_to_end_native():
+    g = build_model_graph()
+    sim = make_sim()
+    helper = SearchHelper(sim, num_devices=8)
+    cost, strategy = helper.graph_cost(g)
+    assert math.isfinite(cost) and cost > 0
+    assert len(strategy) > 0
+
+
+# ---------------------------------------------------------------------------
+# dataloader gather
+# ---------------------------------------------------------------------------
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(3)
+    for shape, dtype in [((1000, 64), np.float32), ((512, 8, 8, 3), np.float32),
+                         ((2048,), np.int32)]:
+        a = rng.normal(size=shape).astype(dtype)
+        idx = rng.integers(0, shape[0], size=300)
+        out = native.gather_rows(a, idx)
+        np.testing.assert_array_equal(out, a[idx])
+
+
+def test_native_dp_matches_python_dp():
+    """The full native graph_cost recursion (dp_engine.cpp) must return
+    the SAME cost as the pure-Python SearchHelper on identical graphs —
+    the two engines are interchangeable implementations of one
+    algorithm (reference keeps this loop in C++, graph.cc:79-295)."""
+    from flexflow_tpu.models import build_dlrm, build_transformer
+
+    builders = [
+        ("mlp", lambda c: None),  # placeholder replaced below
+        ("dlrm", build_dlrm),
+        ("bert2", lambda c: build_transformer(
+            c, num_layers=2, hidden=256, num_heads=4, ff_dim=512,
+            seq_len=64)),
+    ]
+    for name, build in builders:
+        cfg = ff.FFConfig(batch_size=64, num_devices=8)
+        if name == "mlp":
+            g = build_model_graph()
+        else:
+            g = build(cfg).graph
+        h_native = SearchHelper(Simulator.for_config(cfg), 8)
+        c_native, s_native = h_native.graph_cost(g)
+        ctx = getattr(g, "_ndp_ctx", None)
+        assert ctx not in (None, "ineligible") and ctx[1] is not None, (
+            f"{name}: native DP did not engage")
+        g._ndp_ctx = "ineligible"  # force the Python path
+        h_py = SearchHelper(Simulator.for_config(cfg), 8)
+        c_py, s_py = h_py.graph_cost(g)
+        assert c_native == pytest.approx(c_py, rel=1e-9), (
+            name, c_native, c_py)
+        assert len(s_native) == len(s_py) == g.num_nodes
+        # both strategies ground to the same simulated cost
+        sim = Simulator.for_config(cfg)
+        assert sim.simulate(g, s_native) == pytest.approx(
+            sim.simulate(g, s_py), rel=1e-9)
+
+
+def test_native_dp_respects_fixed_views():
+    """Pinned boundary views survive the native path bit-identically."""
+    g = build_model_graph()
+    cfg = ff.FFConfig(batch_size=32, num_devices=8)
+    h = SearchHelper(Simulator.for_config(cfg), 8)
+    node = g.topo_order()[2]
+    pin = MachineView.data_parallel(
+        node.op.output_shapes[0].ndim, 4)
+    cost, strat = h.graph_cost(g, fixed={node.guid: pin})
+    assert strat[node.guid] == pin
+    assert math.isfinite(cost)
+
+
+def test_native_simulate_matches_python_with_clusters():
+    """Fusion-cluster ratios are per-(member, own-view) quantities that
+    bake into the native cost rows — a cluster-bearing calibration
+    table must no longer force the python engine, and the two engines
+    must agree bit-for-bit on random (incl. non-uniform-chain)
+    assignments."""
+    from flexflow_tpu.search.calibration import CalibrationTable, find_clusters
+
+    g = build_model_graph()
+    chains = find_clusters(g)
+    assert chains, "model graph must contain a fusable chain"
+    producer, chain = chains[0]
+    ops = [producer.op] + [c.op for c in chain]
+
+    table = CalibrationTable()
+    table.backend = "cpu"
+    # inject fused measurements at a few of the producer's views: half
+    # the (arbitrary) lone-sum scale, so the ratio engages
+    for mv in candidate_views(producer.op, 8, max_views=8):
+        table.put_cluster(ops, mv, 1e-5)
+    sim = Simulator(MachineSpec(num_devices=8), calibration=table)
+
+    topo = g.topo_order()
+    node_views = {}
+    for node in topo:
+        views = candidate_views(node.op, 8, max_views=8)
+        if not views:
+            views = [node.op.fixed_machine_view()
+                     or MachineView.trivial(node.op.output_shapes[0].ndim)]
+        node_views[node.guid] = views
+    built = sim.build_native(g, node_views)
+    assert built is not None, (
+        "cluster-bearing table must not decline the native digest")
+    ns, index = built
+
+    rng = np.random.default_rng(7)
+    checked_scaled = False
+    for _ in range(60):
+        assign = {}
+        native_assign = [0] * len(topo)
+        for node in topo:
+            vi = int(rng.integers(0, len(node_views[node.guid])))
+            assign[node.guid] = node_views[node.guid][vi]
+            native_assign[index[node.guid]] = vi
+        if sim._cluster_ratio(
+                [producer] + list(chain), assign[producer.guid]) is not None:
+            checked_scaled = True
+        for include_update in (True, False):
+            py = sim.simulate(g, assign, include_update=include_update)
+            nat = ns.simulate(native_assign, include_update=include_update)
+            if math.isinf(py):
+                assert math.isinf(nat)
+            else:
+                assert abs(py - nat) <= 1e-12 + 1e-9 * abs(py), (py, nat)
+    assert checked_scaled, "no draw exercised a measured cluster view"
+
+    # the full native DP recursion must also engage and agree
+    h_native = SearchHelper(
+        Simulator(MachineSpec(num_devices=8), calibration=table), 8)
+    c_native, s_native = h_native.graph_cost(g)
+    ctx = getattr(g, "_ndp_ctx", None)
+    assert ctx not in (None, "ineligible") and ctx[1] is not None, (
+        "native DP must engage with a cluster-bearing table")
+    g._ndp_ctx = "ineligible"
+    h_py = SearchHelper(
+        Simulator(MachineSpec(num_devices=8), calibration=table), 8)
+    c_py, _ = h_py.graph_cost(g)
+    assert c_native == pytest.approx(c_py, rel=1e-9), (c_native, c_py)

@@ -1,0 +1,185 @@
+"""ONNX importer tests on REAL .onnx files.
+
+The environment has no ``onnx`` package, so the files are built and
+serialized by the vendored wire-format codec
+(flexflow_tpu/frontends/onnx_minimal.py), written to disk as genuine
+protobuf .onnx bytes, re-loaded through ``ONNXModel`` (which exercises
+the same reader), and checked for forward parity against a torch
+implementation of the same graph — the align-test protocol the
+reference applies to its ONNX examples
+(reference: python/flexflow/onnx/model.py:74-287,
+examples/python/onnx/).
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from flexflow_tpu.frontends import ONNXModel  # noqa: E402
+from flexflow_tpu.frontends.onnx_minimal import (  # noqa: E402
+    TensorProto,
+    helper,
+    load,
+    numpy_helper,
+    save,
+)
+
+
+def _value_info(name, shape):
+    return helper.make_tensor_value_info(name, TensorProto.FLOAT, shape)
+
+
+def _import_file(path, input_shapes, loss="mean_squared_error"):
+    cfg = ff.FFConfig(batch_size=input_shapes[0][0], num_devices=1,
+                      only_data_parallel=True, compute_dtype="float32",
+                      # the MLP graph ends in Softmax while the CCE loss
+                      # applies log-softmax itself (the reference fuses
+                      # softmax into the loss) — gradients through the
+                      # double softmax are small, so train hot
+                      learning_rate=0.2)
+    model = ff.FFModel(cfg)
+    om = ONNXModel(path)
+    inputs = {
+        vi.name: model.create_tensor(list(shape))
+        for vi, shape in zip(om.model.graph.input, input_shapes)
+    }
+    outs = om.apply(model, inputs)
+    assert len(outs) >= 1
+    model.compile(loss_type=loss, metrics=[])
+    n = om.transfer_onnx_weights(model)
+    assert n > 0
+    return model, om
+
+
+def _forward(model, xs):
+    fwd = model.compiled.forward_fn()
+    out = fwd(model.params, model.state,
+              [np.asarray(x, np.float32) for x in xs])
+    return np.asarray(out if not isinstance(out, (list, tuple)) else out[0])
+
+
+def test_onnx_roundtrip_wire_format(tmp_path):
+    """Serialized bytes re-parse to the same graph and tensors."""
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    node = helper.make_node("Conv", ["x", "w"], ["y"], name="c",
+                            kernel_shape=[3, 3], strides=[1, 1],
+                            pads=[1, 1, 1, 1])
+    g = helper.make_graph([node], "g", [_value_info("x", (1, 3, 8, 8))],
+                          [_value_info("y", (1, 4, 8, 8))],
+                          [numpy_helper.from_array(w, "w")])
+    m = helper.make_model(g)
+    path = str(tmp_path / "rt.onnx")
+    save(m, path)
+    m2 = load(path)
+    assert [n.op_type for n in m2.graph.node] == ["Conv"]
+    a = {x.name: x for x in m2.graph.node[0].attribute}
+    assert list(a["kernel_shape"].ints) == [3, 3]
+    assert list(a["pads"].ints) == [1, 1, 1, 1]
+    got = numpy_helper.to_array(m2.graph.initializer[0])
+    np.testing.assert_array_equal(got, w)
+    assert m2.graph.input[0].name == "x"
+    dims = [d.dim_value
+            for d in m2.graph.input[0].type.tensor_type.shape.dim]
+    assert dims == [1, 3, 8, 8]
+
+
+def test_onnx_cnn_forward_parity_and_training(tmp_path):
+    """Conv->Relu->MaxPool->Flatten->Gemm CNN: forward parity 1e-5 vs
+    torch, then trains through the normal compile path."""
+    rng = np.random.default_rng(1)
+    B, C, H = 4, 3, 8
+    wc = rng.normal(size=(8, C, 3, 3)).astype(np.float32) * 0.2
+    bc = rng.normal(size=(8,)).astype(np.float32) * 0.1
+    wl = rng.normal(size=(10, 8 * 4 * 4)).astype(np.float32) * 0.1
+    bl = rng.normal(size=(10,)).astype(np.float32) * 0.1
+    nodes = [
+        helper.make_node("Conv", ["x", "wc", "bc"], ["h1"], name="conv1",
+                         kernel_shape=[3, 3], strides=[1, 1],
+                         pads=[1, 1, 1, 1]),
+        helper.make_node("Relu", ["h1"], ["h2"], name="relu1"),
+        helper.make_node("MaxPool", ["h2"], ["h3"], name="pool1",
+                         kernel_shape=[2, 2], strides=[2, 2]),
+        helper.make_node("Flatten", ["h3"], ["h4"], name="flat"),
+        helper.make_node("Gemm", ["h4", "wl", "bl"], ["y"], name="fc",
+                         transB=1),
+    ]
+    g = helper.make_graph(
+        nodes, "cnn", [_value_info("x", (B, C, H, H))],
+        [_value_info("y", (B, 10))],
+        [numpy_helper.from_array(a, n) for a, n in
+         ((wc, "wc"), (bc, "bc"), (wl, "wl"), (bl, "bl"))],
+    )
+    path = str(tmp_path / "cnn.onnx")
+    save(helper.make_model(g), path)
+
+    model, _ = _import_file(path, [(B, C, H, H)],
+                            loss="sparse_categorical_crossentropy")
+    x = rng.normal(size=(B, C, H, H)).astype(np.float32)
+    got = _forward(model, [x])
+
+    with torch.no_grad():
+        t = torch.from_numpy(x)
+        t = F.relu(F.conv2d(t, torch.from_numpy(wc), torch.from_numpy(bc),
+                            padding=1))
+        t = F.max_pool2d(t, 2, 2)
+        # the importer runs NHWC-natively: its Flatten sees NHWC order,
+        # and the transferred fc kernel is permuted to match — parity is
+        # on the MODEL function, so flatten the torch activations the
+        # same way the exported graph's semantics define (NCHW)
+        want = F.linear(t.flatten(1), torch.from_numpy(wl),
+                        torch.from_numpy(bl)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    labels = rng.integers(0, 10, size=(64,)).astype(np.int32)
+    xs = rng.normal(size=(64, C, H, H)).astype(np.float32)
+    hist = model.fit(x=xs, y=labels, epochs=2, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
+    assert hist[-1]["loss"] < hist[0]["loss"] * 1.5  # training is sane
+
+
+def test_onnx_mlp_forward_parity_and_training(tmp_path):
+    """Gemm->Relu->Gemm->Softmax MLP (MatMul+Add decomposition included):
+    parity vs torch and a decreasing loss through fit()."""
+    rng = np.random.default_rng(2)
+    B, D, Hd, O = 8, 16, 32, 4
+    w1 = rng.normal(size=(D, Hd)).astype(np.float32) * 0.3
+    b1 = rng.normal(size=(Hd,)).astype(np.float32) * 0.1
+    w2 = rng.normal(size=(Hd, O)).astype(np.float32) * 0.3
+    b2 = rng.normal(size=(O,)).astype(np.float32) * 0.1
+    nodes = [
+        # exporter-style decomposition: MatMul + Add(bias)
+        helper.make_node("MatMul", ["x", "w1"], ["h1"], name="mm1"),
+        helper.make_node("Add", ["h1", "b1"], ["h2"], name="add1"),
+        helper.make_node("Relu", ["h2"], ["h3"], name="relu"),
+        helper.make_node("Gemm", ["h3", "w2", "b2"], ["h4"], name="fc2"),
+        helper.make_node("Softmax", ["h4"], ["y"], name="sm", axis=-1),
+    ]
+    g = helper.make_graph(
+        nodes, "mlp", [_value_info("x", (B, D))], [_value_info("y", (B, O))],
+        [numpy_helper.from_array(a, n) for a, n in
+         ((w1, "w1"), (b1, "b1"), (w2, "w2"), (b2, "b2"))],
+    )
+    path = str(tmp_path / "mlp.onnx")
+    save(helper.make_model(g), path)
+
+    model, _ = _import_file(path, [(B, D)],
+                            loss="categorical_crossentropy")
+    x = rng.normal(size=(B, D)).astype(np.float32)
+    got = _forward(model, [x])
+    with torch.no_grad():
+        t = torch.from_numpy(x)
+        t = F.relu(t @ torch.from_numpy(w1) + torch.from_numpy(b1))
+        t = t @ torch.from_numpy(w2) + torch.from_numpy(b2)
+        want = F.softmax(t, dim=-1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    xs = rng.normal(size=(64, D)).astype(np.float32)
+    # learnable labels (a function of the input), so the loss can move
+    labels = np.eye(O, dtype=np.float32)[xs[:, :O].argmax(axis=1)]
+    hist = model.fit(x=xs, y=labels, epochs=5, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]

@@ -1,0 +1,176 @@
+"""Per-op numerics parity vs PyTorch CPU — role of the reference's
+align/ harness (align/align_test.py: forward outputs compared with
+torch.testing.assert_close)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+from flexflow_tpu.core.ptensor import ParallelTensorShape
+from flexflow_tpu.ops import (
+    BatchMatmulOp,
+    Conv2DOp,
+    EmbeddingOp,
+    GroupByOp,
+    AggregateOp,
+    LayerNormOp,
+    LinearOp,
+    LoweringContext,
+    MultiHeadAttentionOp,
+    Pool2DOp,
+    SoftmaxOp,
+    TopKOp,
+)
+
+RTOL, ATOL = 2e-3, 2e-3
+
+
+def ctx32(train=False):
+    return LoweringContext(compute_dtype=jnp.float32, train=train)
+
+
+def shape(*sizes, dtype="float32"):
+    return ParallelTensorShape.make(sizes, dtype)
+
+
+def test_linear_matches_torch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    op = LinearOp("l", [shape(8, 16)], out_dim=32, activation="relu")
+    k = rng.normal(size=(16, 32)).astype(np.float32)
+    b = rng.normal(size=(32,)).astype(np.float32)
+    y = op.forward(ctx32(), [jnp.asarray(x)], {"kernel": jnp.asarray(k), "bias": jnp.asarray(b)})[0]
+    ref = F.relu(torch.from_numpy(x) @ torch.from_numpy(k) + torch.from_numpy(b))
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_conv2d_matches_torch():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    op = Conv2DOp("c", [shape(2, 8, 8, 3)], out_channels=4, kernel_h=3, kernel_w=3,
+                  stride_h=2, stride_w=2, padding_h=1, padding_w=1)
+    k = rng.normal(size=(3, 3, 3, 4)).astype(np.float32)
+    b = rng.normal(size=(4,)).astype(np.float32)
+    y = op.forward(ctx32(), [jnp.asarray(x)], {"kernel": jnp.asarray(k), "bias": jnp.asarray(b)})[0]
+    ref = F.conv2d(
+        torch.from_numpy(x).permute(0, 3, 1, 2),
+        torch.from_numpy(k).permute(3, 2, 0, 1),
+        torch.from_numpy(b), stride=2, padding=1,
+    ).permute(0, 2, 3, 1)
+    assert y.shape == tuple(ref.shape)
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_pool2d_matches_torch():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    for pool_type, tfn in [("max", F.max_pool2d), ("avg", F.avg_pool2d)]:
+        op = Pool2DOp("p", [shape(2, 8, 8, 3)], kernel_h=2, kernel_w=2,
+                      stride_h=2, stride_w=2, pool_type=pool_type)
+        y = op.forward(ctx32(), [jnp.asarray(x)], {})[0]
+        ref = tfn(torch.from_numpy(x).permute(0, 3, 1, 2), 2, 2).permute(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_layernorm_matches_torch():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 10, 16)).astype(np.float32)
+    op = LayerNormOp("ln", [shape(4, 10, 16)], axes=(-1,))
+    g = rng.normal(size=(16,)).astype(np.float32)
+    b = rng.normal(size=(16,)).astype(np.float32)
+    y = op.forward(ctx32(), [jnp.asarray(x)], {"gamma": jnp.asarray(g), "beta": jnp.asarray(b)})[0]
+    ref = F.layer_norm(torch.from_numpy(x), (16,), torch.from_numpy(g), torch.from_numpy(b))
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_softmax_and_topk():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(4, 10)).astype(np.float32)
+    op = SoftmaxOp("s", [shape(4, 10)])
+    y = op.forward(ctx32(), [jnp.asarray(x)], {})[0]
+    np.testing.assert_allclose(
+        np.asarray(y), F.softmax(torch.from_numpy(x), dim=-1).numpy(), rtol=RTOL, atol=ATOL
+    )
+    tk = TopKOp("t", [shape(4, 10)], k=3)
+    vals, idx = tk.forward(ctx32(), [jnp.asarray(x)], {})
+    tv, ti = torch.topk(torch.from_numpy(x), 3)
+    np.testing.assert_allclose(np.asarray(vals), tv.numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_array_equal(np.asarray(idx), ti.numpy())
+
+
+def test_embedding_aggr():
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 20, size=(4, 5)).astype(np.int32)
+    table = rng.normal(size=(20, 8)).astype(np.float32)
+    for aggr, reduce in [("none", None), ("sum", "sum"), ("avg", "mean")]:
+        op = EmbeddingOp("e", [shape(4, 5, dtype="int32")], num_entries=20, out_dim=8, aggr=aggr)
+        y = op.forward(ctx32(), [jnp.asarray(ids)], {"table": jnp.asarray(table)})[0]
+        ref = torch.from_numpy(table)[torch.from_numpy(ids).long()]
+        if reduce == "sum":
+            ref = ref.sum(dim=1)
+        elif reduce == "mean":
+            ref = ref.mean(dim=1)
+        np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_batch_matmul():
+    rng = np.random.default_rng(6)
+    a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    b = rng.normal(size=(3, 5, 6)).astype(np.float32)
+    op = BatchMatmulOp("bmm", [shape(3, 4, 5), shape(3, 5, 6)])
+    y = op.forward(ctx32(), [jnp.asarray(a), jnp.asarray(b)], {})[0]
+    np.testing.assert_allclose(
+        np.asarray(y), (torch.from_numpy(a) @ torch.from_numpy(b)).numpy(),
+        rtol=RTOL, atol=ATOL,
+    )
+
+
+def test_attention_matches_torch():
+    rng = np.random.default_rng(7)
+    B, S, E, H = 2, 6, 16, 4
+    x = rng.normal(size=(B, S, E)).astype(np.float32)
+    op = MultiHeadAttentionOp(
+        "mha", [shape(B, S, E)] * 3, embed_dim=E, num_heads=H, use_flash=False
+    )
+    dk = E // H
+    wq = rng.normal(size=(E, H, dk)).astype(np.float32) * 0.1
+    wk = rng.normal(size=(E, H, dk)).astype(np.float32) * 0.1
+    wv = rng.normal(size=(E, H, dk)).astype(np.float32) * 0.1
+    wo = rng.normal(size=(H, dk, E)).astype(np.float32) * 0.1
+    weights = {n: jnp.asarray(w) for n, w in
+               [("wq", wq), ("wk", wk), ("wv", wv), ("wo", wo)]}
+    y = op.forward(ctx32(), [jnp.asarray(x)] * 3, weights)[0]
+
+    mha = torch.nn.MultiheadAttention(E, H, bias=False, batch_first=True)
+    with torch.no_grad():
+        # torch packs qkv weights [3E, E] (out_features, in_features)
+        mha.in_proj_weight.copy_(torch.from_numpy(
+            np.concatenate([
+                wq.reshape(E, E).T, wk.reshape(E, E).T, wv.reshape(E, E).T
+            ], axis=0)
+        ))
+        mha.out_proj.weight.copy_(torch.from_numpy(wo.reshape(E, E).T))
+    ref, _ = mha(torch.from_numpy(x), torch.from_numpy(x), torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(y), ref.detach().numpy(), rtol=5e-3, atol=5e-3)
+
+
+def test_moe_group_by_aggregate_roundtrip():
+    """Dispatch then combine with gates=1 and ample capacity reproduces
+    the input (reference semantics: group_by.cc + aggregate.cc)."""
+    rng = np.random.default_rng(8)
+    B, D, E = 8, 4, 4
+    data = rng.normal(size=(B, D)).astype(np.float32)
+    assign = rng.integers(0, E, size=(B, 1)).astype(np.int32)
+    gb = GroupByOp("gb", [shape(B, D), shape(B, 1, dtype="int32")], n_experts=E, alpha=float(E))
+    grouped, eidx, pos, valid = gb.forward(ctx32(), [jnp.asarray(data), jnp.asarray(assign)], {})
+    assert np.all(np.asarray(valid) == 1.0)
+    gates = np.ones((B, 1), np.float32)
+    ag = AggregateOp("ag", [shape(B, 1), shape(B, 1, dtype="int32"),
+                            shape(B, 1, dtype="int32"), shape(B, 1),
+                            shape(E, gb.capacity, D)])
+    out = ag.forward(ctx32(), [jnp.asarray(gates), eidx, pos, valid, grouped], {})[0]
+    np.testing.assert_allclose(np.asarray(out), data, rtol=RTOL, atol=ATOL)

@@ -1,0 +1,71 @@
+"""Shape-algebra unit tests (role of reference tests/unit/test_parallel_config.cc)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.core.ptensor import (
+    DataType,
+    ParallelDim,
+    ParallelTensorShape,
+    replica_dim,
+)
+
+
+def test_basic_shape():
+    s = ParallelTensorShape.make([64, 128], "float32")
+    assert s.sizes == (64, 128)
+    assert s.degrees == (1, 1)
+    assert s.num_elements == 64 * 128
+    assert s.num_bytes == 64 * 128 * 4
+    assert s.total_degree == 1
+
+
+def test_partitioned_dims():
+    s = ParallelTensorShape.make(
+        [64, 128], "bfloat16", degrees=[4, 2], axes=[("x0", "x1"), ("x2",)]
+    )
+    assert s.shard_sizes == (16, 64)
+    assert s.total_degree == 8
+    assert s.shard_bytes == 16 * 64 * 2
+    assert s.used_axes() == ("x0", "x1", "x2")
+
+
+def test_replica_dim():
+    s = ParallelTensorShape.make([32, 32]).with_replica(4, ("x0", "x1"))
+    assert s.replica_degree == 4
+    assert s.total_degree == 4
+    assert s.sizes == (32, 32)  # replicas invisible logically
+    s2 = s.with_replica(1)
+    assert s2.replica_degree == 1
+
+
+def test_invalid_degree():
+    with pytest.raises(ValueError):
+        ParallelDim(size=10, degree=3)
+    with pytest.raises(ValueError):
+        replica_dim(4).__class__(size=3, degree=4, is_replica=True)
+
+
+def test_partition_spec():
+    from jax.sharding import PartitionSpec as P
+
+    s = ParallelTensorShape.make(
+        [64, 128, 32], degrees=[4, 1, 2], axes=[("x0", "x1"), (), ("x2",)]
+    )
+    assert s.partition_spec() == P(("x0", "x1"), None, "x2")
+    # replicated tensor → empty spec
+    r = ParallelTensorShape.make([8, 8]).with_replica(8, ("x0", "x1", "x2"))
+    assert r.partition_spec() == P()
+
+
+def test_drop_parallelism_and_logical_eq():
+    s = ParallelTensorShape.make([64, 128], degrees=[4, 2], axes=[("a",), ("b",)])
+    d = s.drop_parallelism()
+    assert d.degrees == (1, 1)
+    assert d.logical_eq(s)
+
+
+def test_dtype():
+    assert DataType.from_any("float32") is DataType.FLOAT32
+    assert DataType.from_any(np.float32) is DataType.FLOAT32
+    assert DataType.BFLOAT16.itemsize == 2

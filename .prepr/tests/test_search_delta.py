@@ -1,0 +1,390 @@
+"""Delta simulation + persistent cost cache.
+
+The delta layer's contract is EXACT equivalence: a delta-served
+``simulate`` returns the same float the full event-driven walk would
+(reference: simulator.h SIMULATE_DELTA re-simulates only perturbed
+tasks).  These tests drive randomized substitution sequences over zoo
+graphs and assert bit equality, cap the number of full simulations a
+canned search may run (counter-based — no wall-clock flakiness), and
+exercise the cost cache's signature/staleness invalidation rules.
+"""
+
+import math
+import random
+
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.compiler.lowering import data_parallel_strategy
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.search.calibration import CalibrationTable, find_clusters
+from flexflow_tpu.search.cost_cache import (
+    CostCache,
+    cost_signature,
+    mark_calibration_stale,
+    resolve_cost_cache_path,
+    stable_graph_digest,
+)
+from flexflow_tpu.search.driver import LAST_SEARCH_STATS, optimize_strategy
+from flexflow_tpu.search.simulator import Simulator
+from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+from flexflow_tpu.search.views import candidate_views
+
+
+def _dlrm_graph(cfg=None):
+    from flexflow_tpu.models import build_dlrm
+
+    cfg = cfg or ff.FFConfig(batch_size=64, num_devices=8)
+    return build_dlrm(
+        cfg, embedding_sizes=(1000,) * 4, embedding_dim=16,
+        bot_mlp=(32, 16), top_mlp=(32, 1)).graph
+
+
+def _bert_graph(cfg=None):
+    from flexflow_tpu.models import build_transformer
+
+    cfg = cfg or ff.FFConfig(batch_size=8, num_devices=8)
+    return build_transformer(
+        cfg, num_layers=2, hidden=128, num_heads=4, ff_dim=256,
+        seq_len=32).graph
+
+
+def _cnn_graph(cfg=None):
+    from flexflow_tpu.models import build_alexnet
+
+    cfg = cfg or ff.FFConfig(batch_size=16, num_devices=8)
+    return build_alexnet(cfg, num_classes=10, image=32).graph
+
+
+def _fake_calibration(graph, n=8) -> CalibrationTable:
+    """Deterministic synthetic measurements INCLUDING fusion-cluster
+    records, so the delta path's chain-dirty logic is exercised the
+    way a real TPU-probed table exercises it."""
+    table = CalibrationTable()
+    table.backend = "tpu"
+    rng = random.Random(7)
+    for node in graph.topo_order():
+        for mv in candidate_views(node.op, n)[:4]:
+            table.put(node.op, mv, 1e-4 * (1 + rng.random()))
+    for producer, chain in find_clusters(graph):
+        ops = [producer.op] + [c.op for c in chain]
+        for mv in candidate_views(producer.op, n)[:4]:
+            table.put_cluster(ops, mv, 5e-5 * (1 + rng.random()))
+    return table
+
+
+@pytest.mark.parametrize("builder", [_dlrm_graph, _bert_graph, _cnn_graph])
+def test_delta_equals_full_across_random_substitutions(builder):
+    """Property: for randomized substitution sequences, the delta-served
+    cost is bit-identical (same float, not approximately) to the full
+    simulation of the same (graph, strategy)."""
+    graph = builder()
+    n = 8
+    sim = Simulator(ff.FFConfig(num_devices=n).machine_spec, num_devices=n,
+                    calibration=_fake_calibration(graph, n))
+    xfers = generate_all_pcg_xfers(n)
+    rng = random.Random(0)
+
+    parent = graph
+    strat = dict(data_parallel_strategy(parent, n))
+    checked = 0
+    for step in range(40):
+        assert sim.set_baseline(parent, strat) is not None
+        # a few children per baseline, like one best-first pop
+        children = []
+        for _ in range(4):
+            xf = rng.choice(xfers)
+            matches = xf.find_matches(parent)
+            if not matches:
+                continue
+            g2 = xf.apply(parent, rng.choice(matches))
+            if g2 is None:
+                continue
+            # estimate-style strategy: carried views + defaults
+            s2 = {}
+            for guid, node in g2.nodes.items():
+                v = strat.get(guid)
+                if v is None:
+                    v = node.op.fixed_machine_view() or MachineView.trivial(
+                        node.op.output_shapes[0].ndim)
+                s2[guid] = v
+            delta_cost = sim.simulate(g2, s2)
+            full_cost = sim._simulate_full(g2, s2, True)
+            assert delta_cost == full_cost or (
+                math.isnan(delta_cost) and math.isnan(full_cost)), (
+                f"step {step}: delta {delta_cost!r} != full {full_cost!r} "
+                f"after {xf.name}")
+            checked += 1
+            children.append((g2, s2, delta_cost))
+        # also perturb a view on the unchanged structure (the re-viewed
+        # strategy path of the generic diff)
+        node = rng.choice(parent.topo_order())
+        views = candidate_views(node.op, n)
+        if views and node.op.fixed_machine_view() is None:
+            s3 = dict(strat)
+            s3[node.guid] = rng.choice(views)
+            assert sim.simulate(parent, s3) == sim._simulate_full(
+                parent, s3, True)
+            checked += 1
+        live = [c for c in children if math.isfinite(c[2])]
+        if live:
+            parent, strat, _ = rng.choice(live)
+    assert checked >= 40  # the walk must have really exercised deltas
+    assert sim.delta_sims > 0
+
+
+def test_canned_search_full_sim_budget():
+    """Regression: the tier-1 estimates of a canned search must ride
+    the delta path — the number of FULL simulate() derivations stays
+    capped while delta re-costs dominate.  Counter-based: no wall-clock
+    flakiness."""
+    from flexflow_tpu.search import simulator as sim_mod
+
+    if sim_mod.DELTA_CHECK:
+        pytest.skip("FLEXFLOW_TPU_DELTA_CHECK doubles every delta into "
+                    "a full sim; the counter cap is meaningless")
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, search_budget=16)
+    graph = _dlrm_graph(cfg)
+    from flexflow_tpu.search.dp import SearchHelper
+
+    sim = Simulator.for_config(cfg)
+    helper = SearchHelper(sim, cfg.search_devices)
+    from flexflow_tpu.search.driver import _UnityOptimizer, _load_xfers
+
+    opt = _UnityOptimizer(helper, cfg, _load_xfers(cfg, 8))
+    opt._score_edges(graph)
+    opt.sequence_optimize(graph, {})
+    total = sim.full_sims + sim.delta_sims
+    assert sim.delta_sims > 0
+    # pre-delta, EVERY candidate estimate was a full simulation; now
+    # full sims are snapshots/merges/DP-revalidations only.  The cap is
+    # ~3x the observed count — far below the estimate volume.
+    assert sim.full_sims <= max(200, total // 3), (
+        sim.full_sims, sim.delta_sims)
+
+
+def test_mcmc_rides_delta():
+    from flexflow_tpu.search.driver import mcmc_optimize
+
+    cfg = ff.FFConfig(batch_size=64, num_devices=8)
+    graph = _dlrm_graph(cfg)
+    import flexflow_tpu.search.driver as drv
+
+    before = None
+    strategy = mcmc_optimize(graph, cfg, iterations=60, seed=1)
+    assert strategy  # sanity; the delta counters live on the sim inside
+    del before, drv
+
+
+# ---------------------------------------------------------------------------
+# persistent cost cache
+
+
+def _search_cfg(tmp_path, **kw):
+    return ff.FFConfig(
+        batch_size=64, num_devices=8, search_budget=8,
+        cost_cache_file=str(tmp_path / "cost_cache.json"), **kw)
+
+
+def test_cost_cache_round_trip_and_result_serve(tmp_path):
+    cfg = _search_cfg(tmp_path)
+    g1 = _dlrm_graph(cfg)
+    bg1, s1 = optimize_strategy(g1, cfg, return_graph=True)
+    assert LAST_SEARCH_STATS["result_cache_hit"] is False
+    cost1 = Simulator.for_config(cfg).simulate(bg1, s1)
+
+    cfg2 = _search_cfg(tmp_path)
+    g2 = _dlrm_graph(cfg2)
+    bg2, s2 = optimize_strategy(g2, cfg2, return_graph=True)
+    assert LAST_SEARCH_STATS["result_cache_hit"] is True
+    cost2 = Simulator.for_config(cfg2).simulate(bg2, s2)
+    assert cost1 == cost2
+
+
+def test_cost_cache_rows_survive_and_match(tmp_path):
+    cfg = _search_cfg(tmp_path)
+    g = _dlrm_graph(cfg)
+    sim = Simulator.for_config(cfg)
+    assert sim.cost_cache is not None
+    node = g.topo_order()[3]
+    mv = candidate_views(node.op, 8)[0]
+    row = sim._node_costs(node, mv)
+    sim.cost_cache.save()
+
+    sim2 = Simulator.for_config(_search_cfg(tmp_path))
+    assert sim2.cost_cache.rows, "rows must persist"
+    assert sim2._node_costs(node, mv) == row
+    assert sim2.cost_cache.row_hits >= 1
+
+
+def test_cost_cache_invalidated_on_calibration_signature_change(tmp_path):
+    """Flipping the calibration signature must force a full recompute:
+    the old rows/results are abandoned wholesale."""
+    cfg = _search_cfg(tmp_path)
+    g = _dlrm_graph(cfg)
+    optimize_strategy(g, cfg, return_graph=True)
+
+    # a new measured record changes the table content => new signature
+    cal = _fake_calibration(g)
+    cfg2 = _search_cfg(tmp_path)
+    sim_plain = Simulator.for_config(cfg2)
+    sim_cal = Simulator.for_config(cfg2, calibration=cal)
+    assert cost_signature(sim_plain.cost) != cost_signature(sim_cal.cost)
+    assert sim_cal.cost_cache.invalidated
+    assert not sim_cal.cost_cache.rows
+    assert sim_cal.cost_cache.get_search_result(g, cfg2) is None
+
+
+def test_cost_cache_disabled_by_empty_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("FLEXFLOW_TPU_COST_CACHE",
+                       str(tmp_path / "env_cache.json"))
+    # explicit empty string (--no-cost-cache) beats the env default
+    cfg = ff.FFConfig(batch_size=64, num_devices=8, search_budget=4,
+                      cost_cache_file="")
+    assert resolve_cost_cache_path(cfg) is None
+    g = _dlrm_graph(cfg)
+    optimize_strategy(g, cfg, return_graph=True)
+    assert not (tmp_path / "env_cache.json").exists()
+    # and the env default applies when the config leaves it unset
+    cfg2 = ff.FFConfig(batch_size=64, num_devices=8, search_budget=4)
+    assert resolve_cost_cache_path(cfg2) == str(tmp_path / "env_cache.json")
+
+
+def test_no_cost_cache_flag_parses():
+    cfg = ff.FFConfig.parse_args(["--no-cost-cache"])
+    assert cfg.cost_cache_file == ""
+    assert resolve_cost_cache_path(cfg) is None
+    cfg2 = ff.FFConfig.parse_args(["--cost-cache-file", "/tmp/x.json"])
+    assert cfg2.cost_cache_file == "/tmp/x.json"
+
+
+def test_calibration_stale_flag_refuses_to_serve(tmp_path, capsys):
+    cfg = _search_cfg(tmp_path)
+    g = _dlrm_graph(cfg)
+    optimize_strategy(g, cfg, return_graph=True)
+    path = resolve_cost_cache_path(cfg)
+    assert mark_calibration_stale(path)
+
+    sim = Simulator.for_config(_search_cfg(tmp_path))
+    cache = sim.cost_cache
+    assert cache.stale
+    assert not cache.rows
+    assert cache.get_search_result(g, cfg) is None
+    node = g.topo_order()[0]
+    cache.put(node.op, MachineView.trivial(2), (1.0, 2.0, 0.0, 0.0))
+    assert not cache.rows  # refuses new rows too until recalibration
+    err = capsys.readouterr().err
+    assert "no-cost-cache" in err and "recalibrate" in err.lower()
+
+
+def test_stable_graph_digest_ignores_tensor_guid_counter():
+    g1, g2 = _bert_graph(), _bert_graph()  # global tensor guids differ
+    assert stable_graph_digest(g1) == stable_graph_digest(g2)
+    assert stable_graph_digest(g1) != stable_graph_digest(_dlrm_graph())
+
+
+def test_search_key_depends_on_knobs():
+    g = _dlrm_graph()
+    a = CostCache.search_key(g, ff.FFConfig(num_devices=8, search_budget=8))
+    b = CostCache.search_key(g, ff.FFConfig(num_devices=8, search_budget=9))
+    assert a != b
+
+
+# ---------------------------------------------------------------------------
+# satellite: pooled-comm breakdown flag
+
+
+def test_breakdown_pooled_comm_flags():
+    from flexflow_tpu.search.taskgraph_sim import LogicalTaskGraphSimulator
+
+    cfg = ff.FFConfig(batch_size=64, num_devices=8)
+    g = _dlrm_graph(cfg)
+    strat = data_parallel_strategy(g, 8)
+
+    bd = {}
+    Simulator.for_config(cfg).simulate(g, strat, breakdown=bd)
+    assert bd["pooled_comm"] is False
+
+    bd2 = {}
+    lsim = LogicalTaskGraphSimulator(cfg.machine_spec, num_devices=8)
+    lsim.simulate(g, strat, breakdown=bd2)
+    if lsim.cost.network is not None:
+        assert bd2["pooled_comm"] is True
+        # the flag says WHY there are no per-collective records
+        assert bd2["comm_end_s"] >= 0.0
+    else:  # no topology: the event-sim fallback ran instead
+        assert bd2["pooled_comm"] is False
+
+
+# ---------------------------------------------------------------------------
+# satellite: delta-aware find_matches (rescan only the dirty region)
+
+
+def test_delta_find_matches_identical_to_full_scan():
+    """Property: for every registered GraphXfer, matches computed
+    incrementally from the parent's matches + the changed-guid seeds
+    equal the full rescan, in the same topo order — on a graph big
+    enough that the dirty region actually shrinks the scan."""
+    from flexflow_tpu.models import build_inception_v3
+    from flexflow_tpu.search import substitution as S
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8)
+    g = build_inception_v3(cfg).graph
+    xfers = generate_all_pcg_xfers(8)
+    payload = {}
+    for xi, xf in enumerate(xfers):
+        if hasattr(xf, "find_matches_delta"):
+            payload[xi] = [n.guid for n in xf.find_matches(g)]
+    rng = random.Random(3)
+    applied = 0
+    for xi, xf in enumerate(xfers):
+        if not hasattr(xf, "matcher"):
+            continue
+        ms = xf.find_matches(g)
+        if not ms:
+            continue
+        child = xf.apply(g, rng.choice(ms))
+        if child is None:
+            continue
+        applied += 1
+        b0 = (S._DELTA_SCANS.value, S._DELTA_SKIPPED.value)
+        for xj, xf2 in enumerate(xfers):
+            if not hasattr(xf2, "find_matches_delta"):
+                continue
+            delta = xf2.find_matches_delta(child, payload.get(xj))
+            full = xf2.find_matches(child)
+            assert [n.guid for n in delta] == [n.guid for n in full], (
+                xf.name, xf2.name)
+        b1 = (S._DELTA_SCANS.value, S._DELTA_SKIPPED.value)
+        assert b1[0] > b0[0], "dirty region never small enough to pay"
+        assert b1[1] > b0[1], "no nodes skipped: region degenerated"
+        if applied >= 6:
+            break
+    assert applied >= 4
+
+
+def test_delta_find_matches_falls_back_without_seeds():
+    g = _bert_graph()
+    xfers = [x for x in generate_all_pcg_xfers(8) if hasattr(x, "matcher")]
+    xf = next(x for x in xfers if x.find_matches(g))
+    # no parent matches and no _changed_vs: identical to the full scan
+    assert [n.guid for n in xf.find_matches_delta(g, None)] == \
+        [n.guid for n in xf.find_matches(g)]
+
+
+def test_search_perf_reports_match_shrink():
+    """The satellite's proof counter: a search over a big graph must
+    report dirty-region rescans with most match work skipped."""
+    from flexflow_tpu.models import build_inception_v3
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, search_budget=4,
+                      search_timeout_s=60, base_optimize_threshold=300,
+                      cost_cache_file="")
+    g = build_inception_v3(cfg).graph
+    optimize_strategy(g, cfg, return_graph=True)
+    stats = dict(LAST_SEARCH_STATS)
+    assert stats["match_delta_scans"] > 0, stats
+    # most match work is served from the parent (measured ~90% on
+    # inception; 2x is the regression floor, not the typical shrink)
+    assert stats["match_nodes_skipped"] > 2 * stats[
+        "match_nodes_rescanned"], stats

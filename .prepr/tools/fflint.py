@@ -1,0 +1,459 @@
+#!/usr/bin/env python
+"""fflint — static-analysis linter for flexflow_tpu artifacts and the
+rewrite registry (flexflow_tpu/analysis as a CI-friendly CLI).
+
+Subcommands:
+
+  fflint strategy FILE...     lint exported strategy files (STR2xx):
+                              provenance digest present, views
+                              well-formed — stdlib-only, no jax
+  fflint cache FILE...        lint persistent cost-cache files (CCH4xx):
+                              schema/signature shape, row
+                              well-formedness, staleness — stdlib-only
+  fflint registry [--devices N]
+                              prove the substitution registry: graph
+                              invariants (PCG0xx) + numeric equivalence
+                              (EQV3xx) for every registered GraphXfer;
+                              imports the package (needs jax)
+  fflint all [--root DIR]     the CI entry point: lint every committed
+                              COST_CACHE*.json / *strategy*.json under
+                              DIR (default .) plus the full registry
+  fflint pre-commit [--skip-registry]
+                              the git hook gate: lint the STAGED
+                              artifact files + prove the registry
+                              (.githooks/pre-commit runs this; enable
+                              with `git config core.hooksPath .githooks`)
+
+Exit codes: 0 clean, 1 findings, 2 usage/unreadable input.  Artifact
+subcommands never import jax, so they run anywhere the files land
+(same discipline as tools/ffobs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+from typing import List, Tuple
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+META_KEY = "__meta__"  # mirrors search/strategy_io.py (stdlib path)
+CACHE_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.SCHEMA_VERSION
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f), None
+    except OSError as e:
+        return None, f"unreadable: {e}"
+    except ValueError as e:
+        return None, f"not JSON: {e}"
+
+
+# ---------------------------------------------------------------------------
+# strategy files (stdlib)
+
+
+def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
+    """(severity, code, message) findings for one exported strategy
+    file.  Graph-side checks (digest match, coverage, view legality
+    against the op) need the graph and run at import time
+    (search/strategy_io.import_strategy) — this lints what a file alone
+    can prove."""
+    data, err = _load_json(path)
+    if err:
+        return [("error", "STR200", err)]
+    if not isinstance(data, dict):
+        return [("error", "STR200", "top level is not a JSON object")]
+    out: List[Tuple[str, str, str]] = []
+    meta = data.get(META_KEY)
+    if not isinstance(meta, dict) or not meta.get("graph_digest"):
+        # warn, matching import_strategy's severity for the same code:
+        # legacy pre-digest files import (with a warning), so they must
+        # not fail CI either
+        out.append((
+            "warn", "STR203",
+            "no __meta__.graph_digest — import cannot prove the file "
+            "matches its target graph (re-export with this tree)"))
+    if isinstance(meta, dict) and "sync_schedule" in meta:
+        out += _lint_sync_schedule_meta(meta["sync_schedule"])
+    views = {k: v for k, v in data.items() if k != META_KEY}
+    if not views:
+        out.append(("error", "STR202", "file names no ops at all"))
+    for name, v in sorted(views.items()):
+        if not isinstance(v, dict):
+            out.append(("error", "STR204", f"op {name!r}: entry is not an "
+                        "object"))
+            continue
+        dims = v.get("dims")
+        # an empty dims list is legal: a scalar-output op's trivial view
+        if (not isinstance(dims, list)
+                or any(not isinstance(d, int) or d < 1 for d in dims)):
+            out.append(("error", "STR204",
+                        f"op {name!r}: malformed dims {dims!r}"))
+        rep = v.get("replica", 1)
+        if not isinstance(rep, int) or rep < 1:
+            out.append(("error", "STR204",
+                        f"op {name!r}: malformed replica {rep!r}"))
+        start = v.get("start", 0)
+        if not isinstance(start, int) or start < 0:
+            out.append(("error", "STR204",
+                        f"op {name!r}: malformed start {start!r}"))
+    return out
+
+
+_SCHEDULE_SCHEMA = 1  # mirrors search/sync_schedule.SCHEDULE_SCHEMA
+_BUCKET_PRECISIONS = ("fp32", "bf16", "int8")
+
+
+def _lint_sync_schedule_meta(sched) -> List[Tuple[str, str, str]]:
+    """STR205: structural lint of a persisted ``__meta__.sync_schedule``
+    (the searched comm plan, search/sync_schedule.py).  Graph-side
+    legality (coverage, issue order vs readiness, precision coherence —
+    SHD12x) needs the graph and runs at import/compile time."""
+    out: List[Tuple[str, str, str]] = []
+    if not isinstance(sched, dict):
+        return [("error", "STR205", "sync_schedule is not an object")]
+    if sched.get("schema") != _SCHEDULE_SCHEMA:
+        out.append(("error", "STR205",
+                    f"sync_schedule schema {sched.get('schema')!r} unknown "
+                    f"(known: {_SCHEDULE_SCHEMA})"))
+    buckets = sched.get("buckets")
+    if not isinstance(buckets, list) or not buckets:
+        return out + [("error", "STR205", "sync_schedule has no buckets")]
+    seen_ops = set()
+    for i, b in enumerate(buckets):
+        if not isinstance(b, dict):
+            out.append(("error", "STR205",
+                        f"sync_schedule buckets[{i}] is not an object"))
+            continue
+        if not isinstance(b.get("name"), str) or not b.get("name"):
+            out.append(("error", "STR205",
+                        f"sync_schedule buckets[{i}] has no name"))
+        if b.get("precision", "fp32") not in _BUCKET_PRECISIONS:
+            out.append(("error", "STR205",
+                        f"sync_schedule buckets[{i}] precision "
+                        f"{b.get('precision')!r} unknown"))
+        ops = b.get("ops")
+        if (not isinstance(ops, list) or not ops
+                or any(not isinstance(o, str) for o in ops)):
+            out.append(("error", "STR205",
+                        f"sync_schedule buckets[{i}] has malformed ops "
+                        f"{str(ops)[:80]}"))
+            continue
+        for o in ops:
+            if o in seen_ops:
+                out.append(("error", "STR205",
+                            f"sync_schedule covers op {o!r} twice — its "
+                            f"gradient would sync twice"))
+            seen_ops.add(o)
+        if b.get("plan") is not None:
+            out += _lint_reduction_plan_meta(b["plan"], i)
+    return out
+
+
+_PLAN_STAGE_KINDS = ("reduce_scatter", "allreduce", "all_gather")
+# mirrors search/reduction_plan.STAGE_KINDS (stdlib path)
+
+
+def _lint_reduction_plan_meta(plan, bi: int) -> List[Tuple[str, str, str]]:
+    """STR206: structural lint of a persisted per-bucket reduction plan
+    (the staged hierarchical comm shape, search/reduction_plan.py).
+    Machine-side legality (level coverage vs the topology the groups
+    span — SHD13x) needs the graph + machine model and runs at
+    import/compile time."""
+    where = f"sync_schedule buckets[{bi}] plan"
+    out: List[Tuple[str, str, str]] = []
+    if not isinstance(plan, dict):
+        return [("error", "STR206", f"{where} is not an object")]
+    if not isinstance(plan.get("name"), str) or not plan.get("name"):
+        out.append(("error", "STR206", f"{where} has no name"))
+    stages = plan.get("stages")
+    if not isinstance(stages, list) or not stages:
+        return out + [("error", "STR206", f"{where} has no stages")]
+    ar_levels = []
+    for j, s in enumerate(stages):
+        if not isinstance(s, dict):
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] is not an object"))
+            continue
+        kind = s.get("kind")
+        if kind not in _PLAN_STAGE_KINDS:
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] kind {kind!r} unknown "
+                        f"(known: {list(_PLAN_STAGE_KINDS)})"))
+        level = s.get("level")
+        if not isinstance(level, int) or level < 0:
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] malformed level {level!r}"))
+        prec = s.get("precision", "fp32")
+        if prec not in _BUCKET_PRECISIONS:
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] precision {prec!r} unknown"))
+        elif kind != "allreduce" and prec != "fp32":
+            out.append(("error", "STR206",
+                        f"{where} stages[{j}] compresses a {kind} stage "
+                        f"— only the cross-level allreduce may"))
+        if kind == "allreduce":
+            ar_levels.append(level)
+    if len(ar_levels) != 1:
+        out.append(("error", "STR206",
+                    f"{where} must have exactly one cross-level "
+                    f"allreduce stage (found {len(ar_levels)})"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cost-cache files (stdlib)
+
+
+def lint_cache_file(path: str) -> List[Tuple[str, str, str]]:
+    data, err = _load_json(path)
+    if err:
+        return [("error", "CCH400", err)]
+    if not isinstance(data, dict):
+        return [("error", "CCH400", "top level is not a JSON object")]
+    out: List[Tuple[str, str, str]] = []
+    if data.get("schema") not in CACHE_SCHEMA_VERSIONS:
+        out.append(("error", "CCH401",
+                    f"unknown schema {data.get('schema')!r} (known: "
+                    f"{list(CACHE_SCHEMA_VERSIONS)})"))
+    sig = data.get("signature")
+    if (not isinstance(sig, str) or len(sig) != 16
+            or any(c not in "0123456789abcdef" for c in sig)):
+        out.append(("error", "CCH401",
+                    f"malformed cost-surface signature {sig!r} (expect 16 "
+                    "hex chars)"))
+    if data.get("calibration_stale"):
+        out.append(("warn", "CCH403",
+                    "calibration_stale is set: the cache refuses to serve "
+                    "until recalibration (drift gate, obs/drift.py)"))
+    rows = data.get("rows", [])
+    if not isinstance(rows, list):
+        return out + [("error", "CCH402", "rows is not a list")]
+    seen = set()
+    for i, r in enumerate(rows):
+        ok = (
+            isinstance(r, dict)
+            and isinstance(r.get("sig"), str)
+            and isinstance(r.get("degrees"), list)
+            and all(isinstance(d, int) and d >= 1 for d in r["degrees"])
+            and isinstance(r.get("replica"), int) and r["replica"] >= 1
+            and isinstance(r.get("row"), list) and len(r["row"]) == 4
+            and all(isinstance(x, (int, float)) and math.isfinite(x)
+                    and x >= 0 for x in r["row"])
+        )
+        if not ok:
+            out.append(("error", "CCH402", f"rows[{i}] malformed: "
+                        f"{str(r)[:120]}"))
+            continue
+        key = (r["sig"], tuple(r["degrees"]), r["replica"])
+        if key in seen:
+            out.append(("error", "CCH402",
+                        f"rows[{i}] duplicates key for degrees "
+                        f"{r['degrees']} replica {r['replica']}"))
+        seen.add(key)
+    sidecar = path + ".results.pkl"
+    if os.path.exists(sidecar) and os.path.getsize(sidecar) == 0:
+        out.append(("error", "CCH404", f"empty results sidecar {sidecar}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rewrite registry (imports flexflow_tpu — jax required)
+
+
+def lint_registry(num_devices: int) -> List[Tuple[str, str, str]]:
+    from flexflow_tpu.analysis.equivalence import verify_registry
+
+    return [(f.severity, f.code, f.message) for f in verify_registry(
+        num_devices=num_devices)]
+
+
+# ---------------------------------------------------------------------------
+
+
+def _report(path: str, findings: List[Tuple[str, str, str]]) -> int:
+    errors = 0
+    for sev, code, msg in findings:
+        print(f"{path}: {sev.upper()} [{code}] {msg}")
+        if sev == "error":
+            errors += 1
+    return errors
+
+
+def cmd_strategy(args) -> int:
+    errors = 0
+    for path in args.files:
+        errors += _report(path, lint_strategy_file(path))
+    print(f"fflint strategy: {len(args.files)} file(s), {errors} error(s)")
+    return 1 if errors else 0
+
+
+def cmd_cache(args) -> int:
+    errors = 0
+    for path in args.files:
+        errors += _report(path, lint_cache_file(path))
+    print(f"fflint cache: {len(args.files)} file(s), {errors} error(s)")
+    return 1 if errors else 0
+
+
+def cmd_registry(args) -> int:
+    findings = lint_registry(args.devices)
+    errors = _report("registry", findings)
+    print(f"fflint registry: {args.devices}-device rewrite registry, "
+          f"{errors} error(s)")
+    return 1 if errors else 0
+
+
+def _staged_blobs(root: str, tmpdir: str) -> Optional[List[Tuple[str, str]]]:
+    """``(repo-relative path, staged-blob temp file under tmpdir)`` for
+    every artifact path staged for commit, or None when git is
+    unavailable / not a repository — pre-commit then lints the whole
+    tree like ``all``.  The lint must read the STAGED content
+    (``git show :path``), not the working tree: a file fixed after
+    ``git add`` would otherwise let the corrupt staged blob land (and
+    vice versa)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--cached", "--name-only", "--diff-filter=d"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: List[Tuple[str, str]] = []
+    for rel in proc.stdout.splitlines():
+        if not rel or not rel.endswith(".json"):
+            continue
+        base = os.path.basename(rel)
+        if not (base.startswith("COST_CACHE") or "strategy" in base.lower()):
+            continue
+        blob = subprocess.run(
+            ["git", "show", f":{rel}"], cwd=root, capture_output=True,
+            timeout=30)
+        if blob.returncode != 0:
+            continue
+        # mirror the repo-relative path: same-basename artifacts in
+        # different directories must not overwrite each other's blobs
+        tmp = os.path.join(tmpdir, rel)
+        os.makedirs(os.path.dirname(tmp) or tmpdir, exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(blob.stdout)
+        out.append((rel, tmp))
+    return out
+
+
+def cmd_precommit(args) -> int:
+    """The git pre-commit gate (ROADMAP PR 4 follow-up): lint the
+    STAGED artifact blobs (cost caches / strategy files — stdlib, fast)
+    and prove the rewrite registry (``fflint registry`` — imports jax).
+    Install via the committed hook file:
+
+        git config core.hooksPath .githooks
+
+    Skip once with ``git commit --no-verify``; skip the slow registry
+    proof with ``--skip-registry`` (artifact lints still run)."""
+    import tempfile
+
+    errors = 0
+    # the staged blobs live in one throwaway dir — the hook runs on
+    # every commit, so leaking it would accumulate unboundedly
+    with tempfile.TemporaryDirectory(prefix="fflint_staged_") as tmpdir:
+        staged = _staged_blobs(args.root, tmpdir)
+        if staged is None:
+            print("fflint pre-commit: no git staging info — linting the "
+                  "whole tree")
+            staged = [
+                (p, p) for p in sorted(glob.glob(
+                    os.path.join(args.root, "**", "*.json"),
+                    recursive=True))
+                if os.path.basename(p).startswith("COST_CACHE")
+                or "strategy" in os.path.basename(p).lower()
+            ]
+        caches = [(rel, p) for rel, p in staged
+                  if os.path.basename(rel).startswith("COST_CACHE")]
+        strategies = [(rel, p) for rel, p in staged
+                      if "strategy" in os.path.basename(rel).lower()]
+        for rel, path in caches:
+            errors += _report(rel, lint_cache_file(path))
+        for rel, path in strategies:
+            errors += _report(rel, lint_strategy_file(path))
+    if not args.skip_registry:
+        errors += _report("registry", lint_registry(args.devices))
+    print(f"fflint pre-commit: {len(caches)} cache file(s), "
+          f"{len(strategies)} strategy file(s)"
+          + ("" if args.skip_registry else
+             f", registry @ {args.devices} devices")
+          + f" — {errors} error(s)")
+    return 1 if errors else 0
+
+
+def cmd_all(args) -> int:
+    errors = 0
+    caches = sorted(glob.glob(
+        os.path.join(args.root, "**", "COST_CACHE*.json"), recursive=True))
+    strategies = sorted(
+        p for p in glob.glob(os.path.join(args.root, "**", "*.json"),
+                             recursive=True)
+        if "strategy" in os.path.basename(p).lower()
+    )
+    for path in caches:
+        errors += _report(path, lint_cache_file(path))
+    for path in strategies:
+        errors += _report(path, lint_strategy_file(path))
+    findings = lint_registry(args.devices)
+    errors += _report("registry", findings)
+    print(f"fflint all: {len(caches)} cache file(s), "
+          f"{len(strategies)} strategy file(s), registry @ "
+          f"{args.devices} devices — {errors} error(s)")
+    return 1 if errors else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fflint", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("strategy", help="lint exported strategy files")
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_strategy)
+    p = sub.add_parser("cache", help="lint persistent cost-cache files")
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_cache)
+    p = sub.add_parser("registry",
+                       help="numeric-equivalence proof of the rewrite "
+                            "registry (imports jax)")
+    p.add_argument("--devices", type=int, default=8)
+    p.set_defaults(fn=cmd_registry)
+    p = sub.add_parser("all", help="lint committed artifacts + registry")
+    p.add_argument("--root", default=".")
+    p.add_argument("--devices", type=int, default=8)
+    p.set_defaults(fn=cmd_all)
+    p = sub.add_parser("pre-commit",
+                       help="git pre-commit gate: lint STAGED artifact "
+                            "files + prove the rewrite registry "
+                            "(install: git config core.hooksPath "
+                            ".githooks)")
+    p.add_argument("--root", default=".")
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--skip-registry", action="store_true",
+                   help="artifact lints only (skips the jax-importing "
+                        "registry proof)")
+    p.set_defaults(fn=cmd_precommit)
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code else 0
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,383 @@
+#!/usr/bin/env python
+"""ffobs — render flexflow_tpu telemetry (JSONL event logs) as a
+strategy-explanation report.
+
+The obs event bus (flexflow_tpu/obs, enabled via FLEXFLOW_TPU_OBS or
+FFConfig.obs_log_file / --obs-log) records why the search chose what
+it chose — substitutions applied/rejected, DP splits and memo hit
+rates, the champion-vs-DP floor decision, the final per-node view
+table with its predicted compute/sync breakdown — and what execution
+then measured (profile summaries, predicted-vs-measured DriftReports).
+This tool turns that log back into something a human debugs with.
+
+Stdlib-only on the hot path (no jax import), so it runs anywhere the
+log file lands.
+
+Usage:
+  ffobs.py report <log.jsonl> [--top N]   strategy-explanation report
+  ffobs.py validate <log.jsonl>           schema-check every line
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter, defaultdict
+from typing import List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def read_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{lineno}: not JSON: {e}")
+    return events
+
+
+def _ms(v: Optional[float]) -> str:
+    if v is None:
+        return "—"
+    try:
+        if v != v or v in (float("inf"), float("-inf")):
+            return str(v)
+        return f"{v * 1e3:.4f}"
+    except TypeError:
+        return str(v)
+
+
+def _view_str(view: dict) -> str:
+    dims = "x".join(str(d) for d in view.get("dims", []))
+    s = dims or "1"
+    if view.get("replica", 1) != 1:
+        s += f" r{view['replica']}"
+    if view.get("start", 0):
+        s += f" @{view['start']}"
+    return s
+
+
+def last_run(events: List[dict]) -> List[dict]:
+    """Events of the most recent run only: the JSONL sink appends
+    across runs (crash-safe), and each run opens with an ``obs.meta``
+    — counting sections would otherwise aggregate every past run."""
+    for i in range(len(events) - 1, -1, -1):
+        if events[i].get("kind") == "obs.meta":
+            return events[i:]
+    return events
+
+
+def render_report(events: List[dict], top: int = 10,
+                  all_runs: bool = False) -> str:
+    runs = sum(1 for e in events if e.get("kind") == "obs.meta")
+    if not all_runs:
+        events = last_run(events)
+    lines: List[str] = ["# ffobs strategy-explanation report", ""]
+    if runs > 1:
+        lines.append(
+            f"({runs} runs in this log; reporting "
+            + ("ALL of them summed" if all_runs else "the LAST only —")
+            + (" use --all-runs for the aggregate)" if not all_runs
+               else ")"))
+        lines.append("")
+
+    # ---- search outer loop ------------------------------------------------
+    begins = [e for e in events if e.get("kind") == "search.begin"]
+    baselines = [e for e in events if e.get("kind") == "search.baseline"]
+    results = [e for e in events if e.get("kind") == "search.result"]
+    floors = [e for e in events if e.get("kind") == "search.floor"]
+    if begins:
+        b = begins[-1]
+        lines.append(
+            f"Search: {b.get('nodes')} nodes on {b.get('devices')} devices "
+            f"(budget {b.get('budget')}, timeout {b.get('timeout_s')}s, "
+            f"calibrated={b.get('calibrated')})"
+        )
+    if baselines:
+        lines.append(
+            f"Baseline DP-search cost: {_ms(baselines[-1].get('cost_s'))} ms")
+    if floors:
+        fl = floors[-1]
+        verdict = ("kept plain data parallelism (win below margin)"
+                   if fl.get("kept_dp") else "accepted searched strategy")
+        lines.append(
+            f"Champion-vs-DP floor: {verdict} — DP "
+            f"{_ms(fl.get('dp_cost_s'))} ms vs searched "
+            f"{_ms(fl.get('searched_cost_s'))} ms"
+        )
+    if results:
+        r = results[-1]
+        lines.append(
+            f"Result: {_ms(r.get('cost_s'))} ms/iter, "
+            f"rewritten={r.get('rewritten')}, {r.get('nodes')} nodes"
+        )
+    lines.append("")
+
+    # ---- substitution provenance -----------------------------------------
+    subs = [e for e in events if e.get("kind") == "search.substitution"]
+    if subs:
+        by_action = Counter(e.get("action") for e in subs)
+        lines.append(
+            "Substitution candidates: "
+            + ", ".join(f"{a}={n}" for a, n in sorted(by_action.items()))
+        )
+        by_xfer = defaultdict(Counter)
+        for e in subs:
+            by_xfer[e.get("xfer")][e.get("action")] += 1
+        pushed = sorted(
+            by_xfer.items(), key=lambda kv: -kv[1].get("pushed", 0))
+        shown = [x for x in pushed if x[1].get("pushed")][:top]
+        if shown:
+            lines.append("Top pushed rewrites:")
+            for name, actions in shown:
+                lines.append(
+                    f"  {name}: pushed={actions.get('pushed', 0)} "
+                    f"pruned={actions.get('pruned', 0)} "
+                    f"duplicate={actions.get('duplicate', 0)}"
+                )
+    cands = [e for e in events if e.get("kind") == "search.candidate"]
+    if cands:
+        improved = sum(1 for e in cands if e.get("improved"))
+        lines.append(
+            f"Fully-costed candidates: {len(cands)} ({improved} improved "
+            f"the champion)"
+        )
+    splits = [e for e in events if e.get("kind") in ("search.split", "dp.split")]
+    if splits:
+        ops = Counter(e.get("op") for e in splits)
+        lines.append(
+            "Split points: "
+            + ", ".join(f"{op} x{n}" for op, n in ops.most_common(top))
+        )
+    dpsum = [e for e in events if e.get("kind") == "dp.summary"]
+    if dpsum:
+        d = dpsum[-1]
+        hits, misses = d.get("memo_hits", 0), d.get("memo_misses", 0)
+        rate = hits / max(1, hits + misses)
+        lines.append(
+            f"DP memo: {hits} hits / {misses} misses ({rate:.0%} hit rate), "
+            f"native={d.get('native_hits', 0)}, "
+            f"greedy-fallbacks={d.get('greedy_hits', 0)}"
+        )
+    perf = [e for e in events if e.get("kind") == "search.perf"]
+    if perf:
+        p = perf[-1]
+        ds, fs = p.get("delta_sims", 0), p.get("full_sims", 0)
+        drate = ds / max(1, ds + fs)
+        rh = p.get("cache_row_hits", 0)
+        rm = p.get("cache_row_misses", 0)
+        line = (
+            f"Search perf: {p.get('search_seconds')}s search + "
+            f"{p.get('calibration_seconds')}s calibration; "
+            f"{len(cands)} candidates fully costed; simulations: "
+            f"{ds} delta / {fs} full ({drate:.0%} delta-served, "
+            f"{p.get('delta_bails', 0)} bails)"
+        )
+        if rh + rm:
+            line += (f"; cost-cache rows: {rh}/{rh + rm} hits "
+                     f"({rh / (rh + rm):.0%})")
+        if p.get("result_cache_hit"):
+            line += "; RESULT served from the persistent cost cache"
+        lines.append(line)
+        md = p.get("match_delta_scans", 0)
+        if md:
+            scanned = p.get("match_nodes_rescanned", 0)
+            skipped = p.get("match_nodes_skipped", 0)
+            denom = max(1, scanned + skipped)
+            lines.append(
+                f"Delta matching: {md} dirty-region rescans / "
+                f"{p.get('match_full_scans', 0)} full scans; "
+                f"{scanned} nodes rescanned, {skipped} served from the "
+                f"parent ({skipped / denom:.0%} of match work skipped)")
+    lines.append("")
+
+    # ---- strategy table ---------------------------------------------------
+    # prefer the last JOINT-SEARCH table: bench runs also compile
+    # forced-DP baselines and sweep variants after the searched program
+    tables = [e for e in events if e.get("kind") == "strategy.table"]
+    searched_tables = [e for e in tables if e.get("searched")]
+    table = (searched_tables or tables)[-1] if tables else None
+    rows = table.get("rows", []) if table else []
+    if not rows and results:
+        rows = results[-1].get("table", []) or []
+    if rows:
+        lines.append(
+            f"## Chosen strategy ({len(rows)} ops, predicted "
+            f"{_ms(table.get('predicted_s')) if table else '—'} ms/iter"
+            + (f", {len(tables)} strategies compiled this run"
+               if len(tables) > 1 else "")
+            + ")"
+        )
+        lines.append("")
+        lines.append("| op | type | view | fwd ms | full ms | sync ms | "
+                     "sync precision |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for row in rows:
+            lines.append(
+                f"| {row.get('op')} | {row.get('type')} | "
+                f"{_view_str(row.get('view', {}))} | "
+                f"{_ms(row.get('fwd_s'))} | {_ms(row.get('full_s'))} | "
+                f"{_ms(row.get('sync_s'))} | "
+                f"{row.get('sync_precision', '—')} |"
+            )
+        lines.append("")
+        costly = sorted(
+            (r for r in rows if isinstance(r.get("full_s"), (int, float))),
+            key=lambda r: -r["full_s"])[:top]
+        if costly:
+            lines.append(
+                "Top predicted-cost ops (the drift candidates to check "
+                "first when measured steps run slow):")
+            for r in costly:
+                lines.append(
+                    f"  {r['op']}: {_ms(r['full_s'])} ms compute + "
+                    f"{_ms(r.get('sync_s'))} ms sync "
+                    f"[{_view_str(r.get('view', {}))}]"
+                )
+        lines.append("")
+
+    # ---- runtime: profile + drift ----------------------------------------
+    profs = [e for e in events if e.get("kind") == "profile.summary"]
+    if profs:
+        p = profs[-1]
+        note = " (INCLUDES COMPILE STEP)" if p.get("includes_compile") else ""
+        lines.append(
+            f"Measured steps: {p.get('steps')}  mean "
+            f"{_ms(p.get('mean_s'))} ms  p95 {_ms(p.get('p95_s'))} ms{note}"
+        )
+    drifts = [e for e in events if e.get("kind") == "drift.report"]
+    if drifts:
+        d = drifts[-1]
+        lines.append("")
+        lines.append("## Drift (predicted vs measured)")
+        lines.append("")
+        flag = (" — CALIBRATION STALE" if d.get("calibration_stale")
+                else " — STALE" if d.get("stale") else "")
+        lines.append(
+            f"Step: predicted {_ms(d.get('predicted_s'))} ms, measured "
+            f"{_ms(d.get('measured_s'))} ms, ratio "
+            f"{d.get('ratio'):.2f}{flag}"
+        )
+        phases = d.get("phases", {})
+        if phases:
+            lines.append("| phase | predicted ms | measured ms | ratio |")
+            lines.append("|---|---|---|---|")
+            for k, v in phases.items():
+                r = v.get("ratio")
+                lines.append(
+                    f"| {k} | {_ms(v.get('predicted_s'))} | "
+                    f"{_ms(v.get('measured_s'))} | "
+                    f"{f'{r:.2f}' if isinstance(r, (int, float)) else '—'} |"
+                )
+        buckets = d.get("sync_buckets") or []
+        if buckets:
+            lines.append("")
+            lines.append(
+                "Sync-schedule buckets (predicted lanes; the executed "
+                "step is one fused program, so the overlap claim is "
+                "verified by the scheduled-vs-monolithic measured step "
+                "delta, not per-bucket host timers):")
+            lines.append(
+                "| bucket | groups | precision | plan | issue-ready ms | "
+                "sync ms | exposed ms | per-level ms |")
+            lines.append("|---|---|---|---|---|---|---|---|")
+            for b in buckets:
+                lv = b.get("predicted_levels_s") or {}
+                lv_cell = " ".join(
+                    f"{k}={_ms(v)}" for k, v in lv.items()) or "—"
+                lines.append(
+                    f"| {b.get('name')} | {b.get('ops')} | "
+                    f"{b.get('precision')} | "
+                    f"{b.get('plan') or 'flat'} | "
+                    f"{_ms(b.get('predicted_ready_s'))} | "
+                    f"{_ms(b.get('predicted_sync_s'))} | "
+                    f"{_ms(b.get('predicted_exposed_s'))} | "
+                    f"{lv_cell} |")
+        # only the aggregate step has both sides (single-sided phases
+        # carry no ratio by design); rank the measured host phases by
+        # their share of the step instead to point at where time went
+        measured = d.get("measured_s")
+        shares = sorted(
+            ((k, v["measured_s"]) for k, v in phases.items()
+             if k != "step" and isinstance(v.get("measured_s"),
+                                           (int, float))),
+            key=lambda kv: -kv[1])
+        if measured and shares:
+            k, v = shares[0]
+            lines.append(
+                f"Largest measured phase: {k!r} at {_ms(v)} ms "
+                f"({v / measured:.0%} of the step)")
+    stale = [e for e in events if e.get("kind") == "calibration.staleness"]
+    if stale:
+        s = stale[-1]
+        lines.append(
+            f"CALIBRATION STALENESS flagged: measured/predicted = "
+            f"{s.get('ratio'):.2f} beyond threshold "
+            f"{s.get('threshold')} — re-probe with --calibrate"
+        )
+    ignored = [e for e in events if e.get("kind") == "calibration.ignored"]
+    for e in ignored:
+        lines.append(
+            f"Calibration ignored: probed on {e.get('backend')!r} but the "
+            f"machine model is {e.get('machine')!r}"
+        )
+
+    logs = [e for e in events if e.get("kind") == "search.log"]
+    if logs:
+        lines.append("")
+        lines.append(f"(search log: {len(logs)} lines captured; last: "
+                     f"{logs[-1].get('msg')!r})")
+    return "\n".join(lines) + "\n"
+
+
+def cmd_report(args) -> int:
+    events = read_events(args.log)
+    sys.stdout.write(
+        render_report(events, top=args.top, all_runs=args.all_runs))
+    return 0
+
+
+def cmd_validate(args) -> int:
+    from flexflow_tpu.obs.events import validate_event
+
+    events = read_events(args.log)
+    bad = 0
+    for i, e in enumerate(events, 1):
+        errors = validate_event(e)
+        if errors:
+            bad += 1
+            print(f"{args.log}:{i}: {'; '.join(errors)}")
+    print(f"{len(events)} events, {bad} invalid")
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ffobs", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_rep = sub.add_parser("report", help="render a strategy-explanation "
+                           "report from a JSONL event log")
+    p_rep.add_argument("log")
+    p_rep.add_argument("--top", type=int, default=10)
+    p_rep.add_argument("--all-runs", action="store_true",
+                       help="aggregate every run appended to the log "
+                            "instead of the last one")
+    p_rep.set_defaults(fn=cmd_report)
+    p_val = sub.add_parser("validate", help="schema-check every event line")
+    p_val.add_argument("log")
+    p_val.set_defaults(fn=cmd_validate)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

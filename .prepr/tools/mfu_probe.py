@@ -1,0 +1,104 @@
+"""CHIP-TIME experiment (PYTHONPATH=. python tools/mfu_probe.py):
+decompose the bench step on-chip: fwd / fwd+bwd / full train.
+
+Times each variant with the same stacked-scan discipline bench.py uses,
+so the split tells where the non-MXU time goes.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.random as jrandom
+
+import flexflow_tpu as ff
+from flexflow_tpu.models import build_transformer
+
+batch, seq, hidden, layers, heads, ff_dim = 64, 256, 512, 6, 8, 2048
+dtype = "bfloat16"
+
+cfg = ff.FFConfig(batch_size=batch, epochs=1, num_devices=1,
+                  only_data_parallel=True, compute_dtype=dtype)
+model = build_transformer(cfg, num_layers=layers, hidden=hidden,
+                          num_heads=heads, ff_dim=ff_dim, seq_len=seq,
+                          dtype=dtype)
+model.compile(optimizer=ff.AdamOptimizer(alpha=1e-4),
+              loss_type="mean_squared_error",
+              metrics=["mean_squared_error"])
+
+rng = np.random.default_rng(0)
+import ml_dtypes
+in_np = np.dtype(getattr(ml_dtypes, dtype))
+N = 10
+xs = rng.normal(size=(N, batch, seq, hidden)).astype(in_np)
+ys = rng.normal(size=(N, batch, seq, hidden)).astype(np.float32)
+xs_d = jax.device_put(xs, model.compiled.stacked_input_sharding(0))
+ys_d = jax.device_put(ys, model.compiled.stacked_batch_sharding())
+
+comp = model.compiled
+params, opt_state, state = model.params, model.opt_state, model.state
+
+fwd_flops = sum(n.op.flops() for n in model.graph.nodes.values())
+print(f"fwd_flops/step: {fwd_flops/1e9:.2f} GF, train=3x: "
+      f"{3*fwd_flops/1e9:.2f} GF")
+peak = 1.97e14
+
+
+def timeit(fn, reps=3):
+    out = None
+    for _ in range(3):
+        out = fn()
+    float(out)  # fence
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        float(out)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) / N
+
+
+# 1) full train step (bench.py's measurement) — includes opt update+metrics
+def full():
+    p, o, s, losses, m = comp.train_steps(
+        params, opt_state, state, jrandom.key(0), [xs_d], ys_d)
+    return losses[-1]
+
+t_full = timeit(full)
+print(f"full train step: {t_full*1e3:.3f} ms/step  "
+      f"MFU(3x)={3*fwd_flops/t_full/peak:.4f}")
+
+# 2) forward only over the same stacked batches
+def fwd_scan(params, state):
+    def body(c, xy):
+        x, y = xy
+        logits, _ = comp.apply(params, state, [x], jrandom.key(1), train=True)
+        return c + jnp.sum(logits).astype(jnp.float32), None
+    c, _ = jax.lax.scan(body, jnp.float32(0), (xs_d, ys_d))
+    return c
+
+jf = jax.jit(fwd_scan)
+t_fwd = timeit(lambda: jf(params, state))
+print(f"forward only:    {t_fwd*1e3:.3f} ms/step  "
+      f"MFU(1x)={fwd_flops/t_fwd/peak:.4f}")
+
+# 3) loss + grad, no optimizer update, no metrics
+def grad_scan(params, state):
+    def body(c, xy):
+        x, y = xy
+        def lossfn(p):
+            logits, new_state = comp.apply(p, state, [x], jrandom.key(1),
+                                           train=True)
+            return comp._loss_from(logits, y, new_state)
+        l, g = jax.value_and_grad(lossfn)(params)
+        leaves = jax.tree_util.tree_leaves(g)
+        return c + l + sum(jnp.sum(x_).astype(jnp.float32) for x_ in leaves), None
+    c, _ = jax.lax.scan(body, jnp.float32(0), (xs_d, ys_d))
+    return c
+
+jg = jax.jit(grad_scan)
+t_grad = timeit(lambda: jg(params, state))
+print(f"fwd+bwd (no upd/metrics): {t_grad*1e3:.3f} ms/step  "
+      f"MFU(3x)={3*fwd_flops/t_grad/peak:.4f}")
+
+print(f"update+metrics overhead: {(t_full-t_grad)*1e3:.3f} ms/step")
+print(f"bwd/fwd ratio: {(t_grad-t_fwd)/t_fwd:.2f}")

@@ -700,6 +700,223 @@ def topology_sweep(n_devices):
     return sweep
 
 
+def co_search_sweep(n_devices):
+    """The --co-search sweep: sequential (strategy→plan) vs JOINT
+    strategy x comm-plan pricing (search/comm_plan.py, ROADMAP item 2).
+
+    For each sync-bound zoo config (bert/dlrm/mlp) on the flat and
+    2-slice topologies, both pipelines run the full substitution
+    search — sequential picks the strategy under the legacy per-node
+    overlap credit and fits the comm plan afterwards; joint prices
+    every candidate with its best plan (sync schedule + per-group wire
+    precision + staged reductions + per-group ZeRO) — and both final
+    results are then scored in the SAME joint currency (best plan +
+    zero credit, exposed-comm simulation), so the step numbers compare
+    the strategies, not the scoring.  Also records the joint search's
+    wall-clock overhead vs sequential (inception + gpt_xl carry the
+    ≤1.5x acceptance) and the comm-plan memo serve rate (≥80%
+    acceptance).  Simulated only, deliberately: the priced wins are
+    exposed-comm + update-shard terms a CPU mesh cannot exhibit."""
+    import dataclasses
+    import time as _time
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.models import (
+        build_dlrm,
+        build_gpt_xl,
+        build_inception_v3,
+        build_mlp_unify,
+        build_transformer,
+    )
+    from flexflow_tpu.search import driver as _driver
+    from flexflow_tpu.search.comm_plan import JointPricer
+    from flexflow_tpu.search.driver import (
+        LAST_SEARCH_STATS,
+        optimize_strategy,
+    )
+    from flexflow_tpu.search.simulator import Simulator
+
+    builders = {
+        # bert at batch 64 (per-device 8) with the full sync-bound
+        # widths: enough compute that the legacy per-node overlap
+        # credit HIDES most of DP's weight sync — the regime where the
+        # sequential pipeline's ranking flips vs the exposed-comm joint
+        # currency (at per-device batch 1 both pipelines find the same
+        # TP strategy and the comparison degenerates to 1.0x)
+        "bert": (64, 30, lambda cfg: build_transformer(
+            cfg, **SYNC_BOUND_BERT_KW)),
+        "dlrm": (64, 20, lambda cfg: build_dlrm(cfg)),
+        "mlp": (64, 20, lambda cfg: build_mlp_unify(cfg)),
+    }
+    base_spec = ff.FFConfig(batch_size=8,
+                            num_devices=n_devices).machine_spec
+    gap = 10.0
+    topologies = {"flat": base_spec}
+    if n_devices % 2 == 0 and n_devices // 2 >= 2:
+        topologies["2slice"] = dataclasses.replace(
+            base_spec, devices_per_host=n_devices // 2,
+            dcn_bandwidth=base_spec.ici_bandwidth / gap)
+
+    def _cfg(batch, bud, spec, co):
+        return ff.FFConfig(
+            batch_size=batch, num_devices=n_devices, search_budget=bud,
+            machine_spec=spec, cost_cache_file="",  # each run cold: the
+            # comparison is search-vs-search, not cache-vs-cache
+            sync_precision="search", sync_schedule="search",
+            co_search=co)
+
+    def _joint_price(cfg_joint, g, s):
+        """Both pipelines' results scored in the joint currency —
+        through Simulator.for_config, the ONE place config-derived
+        cost flags are threaded (a hand-built Simulator would silently
+        miss the next flag the way sync_ef was nearly missed)."""
+        sim = Simulator.for_config(cfg_joint)
+        return JointPricer(cfg_joint).price(sim, g, s)
+
+    sweep = {
+        "devices": n_devices,
+        "ici_dcn_gap": gap,
+        "note": (
+            "simulated on the TPU machine model; both pipelines' final "
+            "(graph, strategy) results are re-scored in the joint "
+            "currency (best comm plan via the exposed-comm simulation "
+            "minus the per-group ZeRO update credit), so step ratios "
+            "compare strategies under one scoring rule"
+        ),
+        "models": {},
+        "overhead": {},
+    }
+    for name, (batch, bud, build) in builders.items():
+        rows = {}
+        for topo, spec in topologies.items():
+            cfg_seq = _cfg(batch, bud, spec, co=False)
+            g0 = build(cfg_seq).graph
+            t0 = _time.monotonic()
+            g_seq, s_seq = optimize_strategy(g0, cfg_seq,
+                                             return_graph=True)
+            t_seq = _time.monotonic() - t0
+
+            cfg_joint = _cfg(batch, bud, spec, co=True)
+            g1 = build(cfg_joint).graph
+            t0 = _time.monotonic()
+            g_j, s_j = optimize_strategy(g1, cfg_joint, return_graph=True)
+            t_joint = _time.monotonic() - t0
+            serves = LAST_SEARCH_STATS.get("comm_plan_serves", 0)
+            searches = LAST_SEARCH_STATS.get("comm_plan_searches", 0)
+            # every candidate the search evaluated (tier-1 estimates +
+            # tier-2/merge/floor groundings): the depth-gated design
+            # ranks interiors in the bounded scalar currency and
+            # grounds winners jointly, so a candidate evaluation pays
+            # a comm-plan SEARCH only when its top-level grounding hits
+            # a never-seen synced-group signature — the serve-rate
+            # acceptance reads plan_search_free_rate (fraction of
+            # candidate evaluations served without re-searching a
+            # plan); comm_plan_serve_rate is the stricter repeat rate
+            # at the pricer itself
+            evals = (LAST_SEARCH_STATS.get("full_sims", 0)
+                     + LAST_SEARCH_STATS.get("delta_sims", 0))
+
+            c_seq = _joint_price(cfg_joint, g_seq, s_seq)
+            c_j = _joint_price(cfg_joint, g_j, s_j)
+            row = {
+                "sequential_step_ms": round(c_seq * 1e3, 4),
+                "joint_step_ms": round(c_j * 1e3, 4),
+                "step_win": round(c_seq / c_j, 4) if c_j else None,
+                "sequential_search_s": round(t_seq, 3),
+                "joint_search_s": round(t_joint, 3),
+                "search_overhead": round(t_joint / max(t_seq, 1e-9), 3),
+                "comm_plan_serves": serves,
+                "comm_plan_searches": searches,
+                "comm_plan_serve_rate": round(
+                    serves / max(1, serves + searches), 4),
+                "candidate_evals": evals,
+                "plan_search_free_rate": round(
+                    1.0 - searches / max(1, evals), 4),
+                "zero_groups": len(_driver.LAST_ZERO_GROUPS),
+            }
+            rows[topo] = row
+            print(json.dumps({"co_search": topo, "model": name, **row}))
+        sweep["models"][name] = rows
+
+    # wall-clock overhead acceptance rows (search only, flat machine):
+    # the two biggest zoo graphs, joint/sequential ≤ 1.5x
+    overhead_models = {
+        "inception": (64, 10, lambda cfg: build_inception_v3(cfg)),
+        "gpt_xl": (8, 16, lambda cfg: build_gpt_xl(cfg)),
+    }
+    for name, (batch, bud, build) in overhead_models.items():
+        cfg_seq = _cfg(batch, bud, base_spec, co=False)
+        g0 = build(cfg_seq).graph
+        t0 = _time.monotonic()
+        optimize_strategy(g0, cfg_seq, return_graph=True)
+        t_seq = _time.monotonic() - t0
+        cfg_joint = _cfg(batch, bud, base_spec, co=True)
+        g1 = build(cfg_joint).graph
+        t0 = _time.monotonic()
+        optimize_strategy(g1, cfg_joint, return_graph=True)
+        t_joint = _time.monotonic() - t0
+        serves = LAST_SEARCH_STATS.get("comm_plan_serves", 0)
+        searches = LAST_SEARCH_STATS.get("comm_plan_searches", 0)
+        evals = (LAST_SEARCH_STATS.get("full_sims", 0)
+                 + LAST_SEARCH_STATS.get("delta_sims", 0))
+        row = {
+            "nodes": g1.num_nodes,
+            "sequential_search_s": round(t_seq, 3),
+            "joint_search_s": round(t_joint, 3),
+            "search_overhead": round(t_joint / max(t_seq, 1e-9), 3),
+            "comm_plan_serve_rate": round(
+                serves / max(1, serves + searches), 4),
+            "plan_search_free_rate": round(
+                1.0 - searches / max(1, evals), 4),
+        }
+        sweep["overhead"][name] = row
+        print(json.dumps({"co_search_overhead": name, **row}))
+    return sweep
+
+
+def _co_search_sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## Joint comm-plan co-search (sequential strategy→plan vs "
+        "joint pricing, "
+        f"{sweep['devices']} devices)",
+        "",
+        sweep["note"],
+        "",
+        "| model | topology | sequential ms | joint ms | step win | "
+        "search overhead | plan-search-free evals | memo repeat rate | "
+        "zero groups |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for name, rows in sweep["models"].items():
+        for topo, r in rows.items():
+            lines.append(
+                f"| {name} | {topo} | {r['sequential_step_ms']} | "
+                f"{r['joint_step_ms']} | "
+                f"{r['step_win']}x | {r['search_overhead']}x | "
+                f"{r.get('plan_search_free_rate', 0):.1%} | "
+                f"{r['comm_plan_serve_rate']:.0%} | "
+                f"{r['zero_groups']} |")
+    lines += [
+        "",
+        "plan-search-free evals = candidate evaluations served without "
+        "re-searching a comm plan (the depth-gated design grounds "
+        "interior winners against memoized plans); memo repeat rate = "
+        "served/(served+searched) at the pricer itself.",
+        "",
+        "| overhead model | nodes | sequential s | joint s | overhead | "
+        "plan-search-free evals |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name, r in sweep.get("overhead", {}).items():
+        lines.append(
+            f"| {name} | {r['nodes']} | {r['sequential_search_s']} | "
+            f"{r['joint_search_s']} | {r['search_overhead']}x | "
+            f"{r.get('plan_search_free_rate', 0):.1%} |")
+    lines.append("")
+    return lines
+
+
 def scale_sweep(n_devices, budget=16):
     """The --scale sweep: production-graph search throughput (ROADMAP
     item 3 / PR 7).  gpt_xl (models/transformer.py GPT_XL_KW, ~1015
@@ -1014,6 +1231,14 @@ def main():
                     help="run ONLY the sync-schedule sweep and merge it "
                          "into the existing artifact, leaving every "
                          "model row untouched")
+    ap.add_argument("--co-search", action="store_true",
+                    help="also run the joint strategy x comm-plan "
+                         "co-search sweep (sequential strategy→plan vs "
+                         "joint pricing on the sync-bound zoo configs, "
+                         "flat + 2-slice; search/comm_plan.py)")
+    ap.add_argument("--co-search-only", action="store_true",
+                    help="run ONLY the co-search sweep and merge it "
+                         "into existing BENCH_SEARCH artifacts")
     ap.add_argument("--topology", action="store_true",
                     help="also sweep hierarchical machine topologies "
                          "(flat vs 2-slice vs 4-slice, 10x ICI/DCN "
@@ -1112,6 +1337,39 @@ def main():
                         report["scale_sweep"]))
                     + "\n" + tail)
         print(f"# merged scale sweep into {path} / {md}")
+        return
+    if args.co_search_only:
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["co_search_sweep"] = co_search_sweep(args.devices)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous co-search section (same merge
+            # discipline as the other --*-only modes)
+            marker = "\n## Joint comm-plan co-search"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_co_search_sweep_md_lines(
+                        report["co_search_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged co-search sweep into {path} / {md}")
         return
     if args.topology_only:
         path = f"{args.out_prefix}.json"
@@ -1364,6 +1622,8 @@ def main():
             drift_threshold=args.drift_threshold)
     if args.topology:
         report["topology_sweep"] = topology_sweep(args.devices)
+    if args.co_search:
+        report["co_search_sweep"] = co_search_sweep(args.devices)
     if args.scale:
         report["scale_sweep"] = scale_sweep(args.devices)
 
@@ -1441,6 +1701,8 @@ def main():
         lines += _schedule_sweep_md_lines(report["sync_schedule_sweep"])
     if report.get("topology_sweep"):
         lines += _topology_sweep_md_lines(report["topology_sweep"])
+    if report.get("co_search_sweep"):
+        lines += _co_search_sweep_md_lines(report["co_search_sweep"])
     if report.get("scale_sweep"):
         lines += _scale_sweep_md_lines(report["scale_sweep"])
     with open(f"{args.out_prefix}.md", "w") as f:

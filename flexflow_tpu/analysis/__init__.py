@@ -39,6 +39,7 @@ from flexflow_tpu.analysis.sharding import (
     lint_reduction_plan,
     lint_strategy,
     lint_sync_schedule,
+    lint_zero_map,
 )
 
 __all__ = [
@@ -56,4 +57,5 @@ __all__ = [
     "lint_reduction_plan",
     "lint_strategy",
     "lint_sync_schedule",
+    "lint_zero_map",
 ]

@@ -49,6 +49,21 @@ always-on wherever a schedule is produced or imported):
   (search/sync_precision.py) — the two artifacts are built together
   and must not contradict
 
+Per-group optimizer-state sharding legality (``lint_zero_map`` — the
+co-searched ZeRO-1 dimension of search/comm_plan.py, gated always-on
+wherever the map is produced or imported):
+
+* **SHD140** membership: every named op exists in the graph, carries
+  weights, and actually SYNCS under the strategy (some propagated
+  weight annot is replicated — optimizer state only shards over
+  replication axes, so a non-synced entry is incoherent)
+* **SHD141** shardability: the op's achieved ZeRO shard factor under
+  the shared placement rule (``comm_plan.zero_update_factor`` — the
+  same evenly-divisible ``place_zero_factors`` rule the lowering's
+  ``_zero_augmented`` and ``CostModel.op_memory`` apply) must exceed
+  1 — a map entry whose optimizer state cannot shard was credited a
+  win execution will never realize
+
 Staged REDUCTION-PLAN legality (``lint_reduction_plan`` — the
 per-bucket hierarchical reduction strategies of
 search/reduction_plan.py, gated always-on with the schedule):
@@ -367,6 +382,83 @@ def lint_sync_schedule(graph, strategy: Dict[int, object], schedule,
     return findings
 
 
+def _z(code: str, message: str, **kw) -> Finding:
+    return Finding(code=code, pass_name="zero_map", message=message, **kw)
+
+
+def lint_zero_map(graph, strategy: Dict[int, object], zero_map,
+                  cost_model) -> List[Finding]:
+    """Legality findings for a per-group optimizer-state sharding map
+    (op names whose ZeRO-1 state/update shards — search/comm_plan.py
+    ``choose_zero_groups``) against its (graph, strategy) — SHD140/141
+    ([] = legal, and an empty map trivially is).  ``cost_model``
+    supplies the device count for the shared placement rule, so a map
+    that lints clean is credited and executed coherently."""
+    from flexflow_tpu.search.comm_plan import zero_update_factor
+
+    names = list(zero_map or ())
+    if not names:
+        return []
+    findings: List[Finding] = []
+    by_name: Dict[str, object] = {}
+    mv_of: Dict[str, object] = {}
+    for node in graph.topo_order():
+        n = getattr(node.op, "name", None)
+        if n is None:
+            continue
+        by_name[n] = node.op
+        mv = strategy.get(node.guid)
+        if mv is None and hasattr(node.op, "fixed_machine_view"):
+            mv = node.op.fixed_machine_view()
+        mv_of[n] = mv
+    seen = set()
+    for name in names:
+        if name in seen:
+            findings.append(_z(
+                "SHD140", f"op {name!r} appears twice in the "
+                f"optimizer-sharding map", op=name))
+            continue
+        seen.add(name)
+        op = by_name.get(name)
+        if op is None:
+            findings.append(_z(
+                "SHD140", f"optimizer-sharding map names op {name!r} "
+                f"the graph does not have", op=name))
+            continue
+        if not getattr(op, "_weight_specs", ()):
+            findings.append(_z(
+                "SHD140", f"op {name!r} carries no weights — nothing "
+                f"to shard optimizer state for", op=name))
+            continue
+        mv = mv_of.get(name)
+        if mv is None:
+            from flexflow_tpu.core.machine import MachineView
+
+            mv = MachineView.trivial(op.output_shapes[0].ndim)
+        synced = False
+        try:
+            osh = op.propagate(mv)
+            synced = any(
+                a is not None and a.replica > 1 for a in osh.weights)
+        except Exception:
+            pass  # SHD105 owns propagation failures
+        if not synced:
+            findings.append(_z(
+                "SHD140", f"op {name!r} has no replicated weight under "
+                f"this strategy — optimizer state only shards over "
+                f"replication axes, so the entry is incoherent",
+                op=name))
+            continue
+        f = zero_update_factor(cost_model, op, mv)
+        if f <= 1.0:
+            findings.append(_z(
+                "SHD141", f"op {name!r} achieves no ZeRO shard factor "
+                f"under the shared placement rule (achieved {f:g}) — "
+                f"the credited update win would never be realized",
+                op=name))
+    return findings
+
+
 def _p(code: str, message: str, **kw) -> Finding:
     return Finding(code=code, pass_name="reduction_plan", message=message,
                    **kw)
@@ -439,10 +531,13 @@ def lint_reduction_plan(graph, strategy: Dict[int, object], schedule,
                 f"{deepest} — the plan's level coverage does not match "
                 f"the topology the groups actually cross"))
         # SHD133: cross precision composes with the bucket precision
+        # (int8_ef buckets stage at the plain int8 wire — wire_base)
+        from flexflow_tpu.search.sync_schedule import wire_base
+
         bprec = getattr(bucket, "precision", "fp32")
         for s in plan.stages:
             if s.kind == "allreduce" and s.precision not in (
-                    "fp32", bprec):
+                    "fp32", wire_base(bprec)):
                 findings.append(_p(
                     "SHD133",
                     f"bucket {bname!r} plan {plan.name!r} compresses the "

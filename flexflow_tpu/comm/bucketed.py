@@ -44,6 +44,7 @@ from flexflow_tpu.comm.quantized import (
     DEFAULT_CHUNK,
     MIN_COMPRESS_ELEMS,
     quantized_allreduce,
+    quantized_allreduce_ef,
     replication_axes,
 )
 
@@ -67,7 +68,8 @@ def bucketed_grad_sync(
     schedule,
     chunk: int = DEFAULT_CHUNK,
     machine=None,
-) -> Dict[str, Dict[str, jax.Array]]:
+    residuals: Optional[Dict[str, Dict[str, jax.Array]]] = None,
+):
     """Run ``schedule``'s buckets in issue order over ``grads`` (the
     already-GSPMD-reduced gradient tree) — call inside the jitted step,
     before the optimizer update.  Ops absent from the schedule (or
@@ -79,7 +81,18 @@ def bucketed_grad_sync(
     compressed wire runs the hierarchical RS → cross-slice exchange →
     AG shape (comm/hierarchical.py) over the plan's nested axis
     groupings instead of one flat collective.  All-fp32 plans stay
-    value-identity anchors — bit-exact with the monolithic path."""
+    value-identity anchors — bit-exact with the monolithic path.
+
+    ``residuals`` — error-feedback state (op → weight → residual,
+    sharded like the param) for ``int8_ef`` buckets: their fused
+    payload rides ``quantized_allreduce_ef`` — the residuals flatten
+    into the SAME fused buffer as the grads, so the feedback composes
+    with coalescing — and the call returns ``(merged, new_residuals)``
+    for the training loop to persist.  Staged (plan-carrying) buckets
+    execute their cross stage at the plain int8 wire and skip EF
+    (exactly how the cost model priced them); with ``residuals=None``
+    int8_ef degrades to plain int8 and the legacy return shape is
+    kept."""
     from flexflow_tpu.comm.compat import shard_map
     from flexflow_tpu.comm.hierarchical import (
         plan_axis_groups,
@@ -88,6 +101,7 @@ def bucketed_grad_sync(
     )
 
     merged = {op: dict(ws) for op, ws in grads.items()}
+    new_res: Dict[str, Dict[str, jax.Array]] = {}
     token = None
     for bucket in getattr(schedule, "buckets", schedule):
         prec = getattr(bucket, "precision", "fp32")
@@ -96,11 +110,20 @@ def bucketed_grad_sync(
         # a plan whose every stage is fp32 has no explicit wire work
         # (GSPMD's own psum reduced the grads; the priced stages model
         # XLA's hierarchical psum) — its members all pass through
-        wire = prec in ("bf16", "int8") and (
+        wire = prec in ("bf16", "int8", "int8_ef") and (
             plan is None or cross_prec is not None)
+        # EF rides every group that executes the FLAT collective —
+        # including the within-slice groups of a plan-carrying bucket
+        # (pricing charges them the EF passes, bucket_sync_cost);
+        # groups the plan actually STAGES skip EF on both sides (the
+        # cross stage carries already-reduced shards the residual
+        # never sees), decided per group below once `staged` is known
+        ef = prec == "int8_ef" and residuals is not None
         # bucket members' replicated grads, grouped by replication axes
-        # — one fused payload per (axes, n) group
-        groups: Dict[Tuple, List[Tuple[str, str, jax.Array, object]]] = {}
+        # — one fused payload per (axes, n, has-residual) group (EF and
+        # residual-less members must not share a collective: the fused
+        # buffer either threads feedback or it does not)
+        groups: Dict[Tuple, List[Tuple]] = {}
         plain: List[Tuple[str, str, jax.Array]] = []
         for op_name in bucket.ops:
             for w_name, g in grads.get(op_name, {}).items():
@@ -111,17 +134,19 @@ def bucketed_grad_sync(
                 if not rep:
                     continue
                 if wire and g.size >= MIN_COMPRESS_ELEMS:
-                    groups.setdefault((rep, n), []).append(
-                        (op_name, w_name, g, sh.spec))
+                    r = (residuals or {}).get(op_name, {}).get(w_name) \
+                        if ef else None
+                    groups.setdefault((rep, n, r is not None), []).append(
+                        (op_name, w_name, g, sh.spec, r))
                 else:
                     # fp32 wire = GSPMD's own backward psum (already
                     # happened); the bucket only anchors issue order
                     plain.append((op_name, w_name, g))
         toks: List[jax.Array] = []
-        for (rep, n), members in groups.items():
-            gs = [g for _o, _w, g, _s in members]
+        for (rep, n, has_res), members in groups.items():
+            gs = [g for _o, _w, g, _s, _r in members]
             gs, token = _ordered(gs, token)
-            specs = [s for _o, _w, _g, s in members]
+            specs = [s for _o, _w, _g, s, _r in members]
             # per-group reduction: the plan's staged shape when its
             # cross stage has axes to ride on this group, the flat
             # quantized collective otherwise (a within-slice group of a
@@ -134,6 +159,8 @@ def bucketed_grad_sync(
                     rep, mesh, machine, plan.cross_level)
                 if st_axes[-1]:
                     staged = (st_axes, st_sizes)
+            # int8_ef's wire IS int8 — EF changes what is quantized
+            wire_prec = "int8" if prec == "int8_ef" else prec
 
             def reduce_flat(flat, _rep=rep, _n=n, _staged=staged):
                 if _staged is not None:
@@ -141,8 +168,8 @@ def bucketed_grad_sync(
                         flat, _staged[0], _staged[1], cross_prec,
                         chunk=chunk, mean=True)
                 return quantized_allreduce(
-                    flat, _rep, precision=prec, chunk=chunk, mean=True,
-                    axis_size=_n,
+                    flat, _rep, precision=wire_prec, chunk=chunk,
+                    mean=True, axis_size=_n,
                 )
 
             def fused(*local, _red=reduce_flat):
@@ -161,11 +188,51 @@ def bucketed_grad_sync(
                     off += sz
                 return tuple(out)
 
-            synced = shard_map(
-                fused, mesh=mesh, in_specs=tuple(specs),
-                out_specs=tuple(specs),
-            )(*gs)
-            for (op_name, w_name, _g, _s), y in zip(members, synced):
+            def fused_ef(*local, _rep=rep, _n=n):
+                # EF variant: grads then residuals, each flattened into
+                # one fused buffer — feedback rides the SAME coalesced
+                # collective the schedule priced
+                k = len(local) // 2
+                gs_loc, rs_loc = local[:k], local[k:]
+                sizes = [x.size for x in gs_loc]
+                cat = (lambda xs: xs[0].reshape(-1) if len(xs) == 1 else
+                       jax.numpy.concatenate([x.reshape(-1) for x in xs]))
+                red, nr = quantized_allreduce_ef(
+                    cat(gs_loc), cat(rs_loc), _rep, precision="int8",
+                    chunk=chunk, mean=True, axis_size=_n,
+                )
+                out, rout, off = [], [], 0
+                for x, sz in zip(gs_loc, sizes):
+                    out.append(red[off:off + sz].reshape(x.shape))
+                    rout.append(nr[off:off + sz].reshape(x.shape))
+                    off += sz
+                return tuple(out) + tuple(rout)
+
+            if has_res and staged is not None:
+                # the plan stages this group: the cross-slice exchange
+                # carries already-reduced shards the residual never
+                # sees, so EF is off for it — exactly how
+                # bucket_sync_cost priced it (staged stages at the
+                # plain wire, no EF passes); the residual is left
+                # untouched, not advanced with stale feedback
+                has_res = False
+            if has_res:
+                rs = [r for _o, _w, _g, _s, r in members]
+                outs = shard_map(
+                    fused_ef, mesh=mesh,
+                    in_specs=tuple(specs) + tuple(specs),
+                    out_specs=tuple(specs) + tuple(specs),
+                )(*gs, *rs)
+                synced, res_out = outs[:len(members)], outs[len(members):]
+                for (op_name, w_name, _g, _s, _r), nr in zip(
+                        members, res_out):
+                    new_res.setdefault(op_name, {})[w_name] = nr
+            else:
+                synced = shard_map(
+                    fused, mesh=mesh, in_specs=tuple(specs),
+                    out_specs=tuple(specs),
+                )(*gs)
+            for (op_name, w_name, _g, _s, _r), y in zip(members, synced):
                 merged[op_name][w_name] = y
             # one completion scalar PER fused collective: the next
             # bucket must order after every one of this bucket's
@@ -185,4 +252,6 @@ def bucketed_grad_sync(
             token = toks[0]
             for t in toks[1:]:
                 token = token + t
-    return merged
+    if residuals is None:
+        return merged
+    return merged, new_res

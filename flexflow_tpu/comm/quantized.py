@@ -259,10 +259,12 @@ def quantized_grad_sync(
     param_shardings: Dict[str, Dict[str, "jax.sharding.NamedSharding"]],
     precision_map: Dict[str, str],
     chunk: int = DEFAULT_CHUNK,
-) -> Dict[str, Dict[str, jax.Array]]:
+    residuals: Optional[Dict[str, Dict[str, jax.Array]]] = None,
+):
     """Route the weight groups named by ``precision_map`` (op name →
-    bf16/int8) through the quantized collective over their replication
-    axes — the mesh axes the param's PartitionSpec does not consume.
+    bf16/int8/int8_ef) through the quantized collective over their
+    replication axes — the mesh axes the param's PartitionSpec does not
+    consume.
 
     Gradients arrive already reduced (replicated across those axes), so
     the round trip sums n identical addends and divides by n: the value
@@ -271,13 +273,25 @@ def quantized_grad_sync(
     fp32 groups, and sub-MIN_COMPRESS_ELEMS weights (the bias/scale
     vectors of an otherwise-compressed op — latency-bound sync, nothing
     to win) pass through untouched — with an empty map the function is
-    an identity and the lowering is bit-exact with history."""
+    an identity and the lowering is bit-exact with history.
+
+    ``residuals`` — the error-feedback state tree (op → weight →
+    residual array, sharded like the param) for ``int8_ef`` groups:
+    each is threaded through ``quantized_allreduce_ef`` and the call
+    then returns ``(merged_grads, new_residuals)`` so the training loop
+    can persist the updated residuals (compiler/lowering.py carries
+    them in the model-state dict).  With ``residuals=None`` (legacy
+    callers) the signature and return value are unchanged and
+    ``int8_ef`` degrades to the plain int8 wire — EF without its state
+    would silently re-zero the residual every step."""
     from jax.sharding import PartitionSpec
 
     from flexflow_tpu.comm.compat import shard_map
 
     sel: Dict[str, Dict[str, jax.Array]] = {}
+    res_sel: Dict[str, Dict[str, jax.Array]] = {}
     specs: Dict[str, Dict[str, PartitionSpec]] = {}
+    res_specs: Dict[str, Dict[str, PartitionSpec]] = {}
     plan: Dict[str, Dict[str, Tuple[Tuple[str, ...], str, int]]] = {}
     for op_name, prec in precision_map.items():
         if prec == "fp32":
@@ -291,28 +305,49 @@ def quantized_grad_sync(
             rep, n = replication_axes(sh, mesh)
             if not rep:
                 continue
+            p = prec
+            if p == "int8_ef":
+                r = (residuals or {}).get(op_name, {}).get(w_name)
+                if r is None:
+                    p = "int8"  # no state to thread — plain wire
+                else:
+                    res_sel.setdefault(op_name, {})[w_name] = r
+                    res_specs.setdefault(op_name, {})[w_name] = sh.spec
             sel.setdefault(op_name, {})[w_name] = g
             specs.setdefault(op_name, {})[w_name] = sh.spec
-            plan.setdefault(op_name, {})[w_name] = (rep, prec, n)
+            plan.setdefault(op_name, {})[w_name] = (rep, p, n)
     if not sel:
-        return grads
+        return grads if residuals is None else (grads, {})
 
-    def local(gs):
+    def local(gs, rs):
         out: Dict[str, Dict[str, jax.Array]] = {}
+        rout: Dict[str, Dict[str, jax.Array]] = {}
         for op_name, ws in gs.items():
             for w_name, g in ws.items():
                 rep, prec, n = plan[op_name][w_name]
-                out.setdefault(op_name, {})[w_name] = quantized_allreduce(
-                    g, rep, precision=prec, chunk=chunk, mean=True,
-                    axis_size=n,
-                )
-        return out
+                if prec == "int8_ef":
+                    y, nr = quantized_allreduce_ef(
+                        g, rs[op_name][w_name], rep, precision="int8",
+                        chunk=chunk, mean=True, axis_size=n,
+                    )
+                    out.setdefault(op_name, {})[w_name] = y
+                    rout.setdefault(op_name, {})[w_name] = nr
+                else:
+                    out.setdefault(op_name, {})[w_name] = (
+                        quantized_allreduce(
+                            g, rep, precision=prec, chunk=chunk,
+                            mean=True, axis_size=n,
+                        ))
+        return out, rout
 
-    synced = shard_map(
-        local, mesh=mesh, in_specs=(specs,), out_specs=specs
-    )(sel)
+    synced, new_res = shard_map(
+        local, mesh=mesh, in_specs=(specs, res_specs),
+        out_specs=(specs, res_specs),
+    )(sel, res_sel)
     merged = {op: dict(ws) for op, ws in grads.items()}
     for op_name, ws in synced.items():
         for w_name, g in ws.items():
             merged[op_name][w_name] = g
-    return merged
+    if residuals is None:
+        return merged
+    return merged, new_res

@@ -95,6 +95,7 @@ class CompiledModel:
         label_dtype: str = "int32",
         sync_precision: Optional[Dict[str, str]] = None,
         sync_schedule=None,
+        zero_groups: Optional[Sequence[str]] = None,
     ):
         self.graph = graph
         self.strategy = strategy
@@ -110,6 +111,13 @@ class CompiledModel:
         # optimization_barrier anchoring inside the backward; None (the
         # default) keeps the monolithic post-backward path
         self.sync_schedule = sync_schedule
+        # per-group optimizer-state sharding (the co-searched ZeRO-1
+        # dimension, search/comm_plan.py): op names whose optimizer
+        # state (and update) shards over their replication axes — the
+        # per-group generalization of config.zero_dp_shard, which
+        # still arms ALL ops when set.  Linted (SHD140/141) before it
+        # gets here.
+        self.zero_groups: Tuple[str, ...] = tuple(zero_groups or ())
         self.loss_type = LossType.from_any(loss_type)
         self.metric_types = [MetricsType.from_any(m) for m in metric_types]
         self.optimizer = optimizer
@@ -328,13 +336,50 @@ class CompiledModel:
                 state[f"{node.op.name}/{name}"] = v
         self.param_shardings = shardings
         self._zero_shardings = None
-        if getattr(self.config, "zero_dp_shard", False) and self._multi_device:
+        zero_all = getattr(self.config, "zero_dp_shard", False)
+        zg = set(self.zero_groups)
+        if (zero_all or zg) and self._multi_device:
+            # global flag = every op; the co-searched per-group map
+            # restricts the augmented shardings to its members — ops
+            # outside it keep replicated optimizer state (and the
+            # update credit the joint currency never claimed for them)
             zs: Dict[str, Dict[str, jax.sharding.NamedSharding]] = {}
             for op_name, w_name, shape, _, _, sh in specs:
+                if not zero_all and op_name not in zg:
+                    continue
                 zs.setdefault(op_name, {})[w_name] = self._zero_augmented(
                     sh, shape
                 )
-            self._zero_shardings = zs
+            self._zero_shardings = zs or None
+        # error-feedback residual state (comm.quantized_allreduce_ef):
+        # one fp32 residual per int8_ef weight, sharded like the param
+        # so the shard_map-local block aligns with the grad's — carried
+        # in the model-state dict like any other training-loop state
+        # (checkpoints round-trip it for free)
+        self._ef_keys: Dict[str, Dict[str, str]] = {}
+        ef_ops = {op for op, p in self.sync_precision.items()
+                  if p == "int8_ef"}
+        if ef_ops and self._multi_device:
+            from flexflow_tpu.comm.quantized import (
+                MIN_COMPRESS_ELEMS,
+                replication_axes,
+            )
+
+            for op_name, w_name, shape, _, _, sh in specs:
+                if op_name not in ef_ops:
+                    continue
+                nelems = 1
+                for d in shape:
+                    nelems *= d
+                if nelems < MIN_COMPRESS_ELEMS:
+                    continue  # sub-floor weights never compress
+                rep, _n = replication_axes(sh, self.mesh)
+                if not rep:
+                    continue
+                key = f"{op_name}/{w_name}/ef_residual"
+                self._ef_keys.setdefault(op_name, {})[w_name] = key
+                state[key] = jax.device_put(
+                    jnp.zeros(shape, jnp.float32), sh)
         return params, state
 
     # ------------------------------------------------------------------
@@ -401,42 +446,64 @@ class CompiledModel:
 
     def shard_opt_state(self, opt_state):
         """Re-place freshly initialized optimizer state under the
-        ZeRO-1 shardings (no-op unless config.zero_dp_shard)."""
+        ZeRO-1 shardings (no-op unless config.zero_dp_shard or a
+        per-group ``zero_groups`` map armed some ops; non-member ops'
+        slots pass through untouched)."""
         if getattr(self, "_zero_shardings", None) is None:
             return opt_state
-        return self._map_param_slots(
-            opt_state,
-            lambda op, w, x: jax.device_put(x, self._zero_shardings[op][w]),
-        )
+        zs = self._zero_shardings
+
+        def place(op, w, x):
+            sh = zs.get(op, {}).get(w)
+            return x if sh is None else jax.device_put(x, sh)
+
+        return self._map_param_slots(opt_state, place)
 
     def _constrain_update(self, new_params, new_opt_state):
         """Pin the post-update shardings inside the jitted step: params
         back to their layer shardings (the all-gather side of ZeRO),
         optimizer slots to the augmented shardings (the reduce-scatter
-        side)."""
+        side).  With a per-group map only the member ops are pinned —
+        the others' update stays wherever GSPMD placed it, exactly the
+        pre-ZeRO behavior."""
         if getattr(self, "_zero_shardings", None) is None:
             return new_params, new_opt_state
+        zs = self._zero_shardings
         new_params = {
             op: {
-                w: jax.lax.with_sharding_constraint(
-                    x, self.param_shardings[op][w]
+                w: (
+                    jax.lax.with_sharding_constraint(
+                        x, self.param_shardings[op][w]
+                    )
+                    if zs.get(op, {}).get(w) is not None else x
                 )
                 for w, x in ws.items()
             }
             for op, ws in new_params.items()
         }
-        new_opt_state = self._map_param_slots(
-            new_opt_state,
-            lambda op, w, x: jax.lax.with_sharding_constraint(
-                x, self._zero_shardings[op][w]
-            ),
-        )
+
+        def pin(op, w, x):
+            sh = zs.get(op, {}).get(w)
+            return x if sh is None else jax.lax.with_sharding_constraint(
+                x, sh)
+
+        new_opt_state = self._map_param_slots(new_opt_state, pin)
         return new_params, new_opt_state
 
     # ------------------------------------------------------------------
-    def _sync_grads(self, grads):
+    def _sync_grads(self, grads, ef_state=None):
         """Gradient sync inside the jitted step, before the optimizer
         update.
+
+        ``ef_state`` — the model-state dict carrying the error-feedback
+        residuals for ``int8_ef`` groups (``init_params`` created them
+        under ``{op}/{w}/ef_residual`` keys): the call then returns
+        ``(grads, updates)`` where ``updates`` maps those state keys to
+        the new residuals — the training step merges them into its
+        ``new_state`` so the feedback persists across steps.  With
+        ``ef_state=None`` (direct callers, pre-EF tests) the legacy
+        single-value return is kept and int8_ef runs the plain int8
+        wire.
 
         With a searched ``sync_schedule`` the buckets execute in issue
         order (comm/bucketed.py): each compressed bucket's member grads
@@ -458,11 +525,22 @@ class CompiledModel:
         placement of the update is unchanged; with grad accumulation
         the AVERAGED grads sync once per optimizer step.
         """
+        def ret(g, updates=None):
+            return g if ef_state is None else (g, updates or {})
+
         if not self._multi_device:
-            return grads
+            return ret(grads)
         shardings = getattr(self, "param_shardings", None)
         if shardings is None:  # init_params not run yet — nothing to map
-            return grads
+            return ret(grads)
+        residuals = None
+        ef_keys = getattr(self, "_ef_keys", None)
+        if ef_state is not None and ef_keys:
+            residuals = {
+                op: {w: ef_state[key] for w, key in ws.items()
+                     if key in ef_state}
+                for op, ws in ef_keys.items()
+            }
         schedule = self.sync_schedule
         if schedule is not None and getattr(schedule, "buckets", None):
             from flexflow_tpu.comm import bucketed_grad_sync
@@ -470,16 +548,36 @@ class CompiledModel:
             # the machine spec arms staged (hierarchical) execution of
             # buckets carrying a reduction plan — the nested axis split
             # follows the spec's slice structure, not the live backend
-            return bucketed_grad_sync(
+            got = bucketed_grad_sync(
                 grads, self.mesh, shardings, schedule,
-                machine=self.config.machine_spec)
+                machine=self.config.machine_spec, residuals=residuals)
+            if residuals is None:
+                return ret(got)
+            merged, new_res = got
+            return ret(merged, self._ef_updates(new_res))
         if not self.sync_precision:
-            return grads
+            return ret(grads)
         from flexflow_tpu.comm import quantized_grad_sync
 
-        return quantized_grad_sync(
-            grads, self.mesh, shardings, self.sync_precision
+        got = quantized_grad_sync(
+            grads, self.mesh, shardings, self.sync_precision,
+            residuals=residuals,
         )
+        if residuals is None:
+            return ret(got)
+        merged, new_res = got
+        return ret(merged, self._ef_updates(new_res))
+
+    def _ef_updates(self, new_res):
+        """Map the sync path's returned residual tree back onto its
+        model-state keys."""
+        updates = {}
+        for op, ws in (new_res or {}).items():
+            for w, r in ws.items():
+                key = self._ef_keys.get(op, {}).get(w)
+                if key is not None:
+                    updates[key] = r
+        return updates
 
     def _loss_from(self, logits, labels, new_state):
         loss = compute_loss(self.loss_type, logits, labels)
@@ -504,7 +602,8 @@ class CompiledModel:
         (loss, (logits, new_state)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params)
-        grads = self._sync_grads(grads)
+        grads, ef_updates = self._sync_grads(grads, ef_state=state)
+        new_state.update(ef_updates)
         new_params, new_opt_state = optimizer.apply(params, grads, opt_state)
         new_params, new_opt_state = self._constrain_update(
             new_params, new_opt_state
@@ -554,7 +653,10 @@ class CompiledModel:
             (keys, tuple(resh(x) for x in inputs), resh(labels)),
         )
         grads = jax.tree.map(lambda g: g / ga, gsum)
-        grads = self._sync_grads(grads)
+        # the AVERAGED grads sync once per optimizer step, so the EF
+        # residual advances once per step too (state, not per-microbatch)
+        grads, ef_updates = self._sync_grads(grads, ef_state=state)
+        new_state.update(ef_updates)
         new_params, new_opt_state = self.optimizer.apply(
             params, grads, opt_state
         )

@@ -157,6 +157,34 @@ class FFConfig:
     # floor (fused fp32 payload bytes per bucket); 0 sweeps the
     # DEFAULT_BUCKET_BYTES thresholds plus adaptive fractions of the
     # model's total sync bytes
+    sync_ef: str = "off"  # error-feedback residuals on int8 gradient
+    # sync (comm.quantized_allreduce_ef, EF-SGD): "auto" upgrades every
+    # int8 group the precision search picks to "int8_ef" — each device
+    # re-injects its local quantization error next step, carried as
+    # persistent training-loop state (the lowering threads the residual
+    # through the model-state dict), so compression error stops
+    # accumulating across steps.  The residual add's (real, small) HBM
+    # overhead is priced into the choice; the fidelity win is the
+    # point — the cost currency cannot see it, so this is a policy
+    # gate, not a cost comparison.  "off" (default) keeps the plain
+    # int8 wire bit-identical to history.  Deliberately independent of
+    # co_search: EF shifts the pricing currency (its overhead is
+    # priced), so folding it into the joint-vs-sequential comparison
+    # would conflate two effects.
+    co_search: bool = False  # joint strategy x comm-plan co-search
+    # (search/comm_plan.py): candidate strategies inside
+    # optimize_strategy — substitution proposals, DP re-validations,
+    # chain-segment solves — are priced with their BEST comm plan
+    # (sync schedule + per-group wire precision + staged reduction
+    # plans + per-group optimizer-state sharding) through the
+    # simulator's exposed-comm semantics, instead of choosing the
+    # strategy first under the legacy per-node overlap credit and
+    # fitting the comm plan afterwards.  A comm-plan memo keyed by the
+    # strategy's synced-group signature keeps the inner loop cheap
+    # (most substitutions do not change the synced-group set, so the
+    # plan is served, not re-searched).  Enabling this auto-enables
+    # sync_schedule="search".  False (the default) keeps the
+    # sequential strategy→plan pipeline bit-identical to history.
     # observability (flexflow_tpu/obs): unified telemetry
     obs_log_file: Optional[str] = None  # JSONL structured-event sink
     # (search-decision tracing, strategy tables, drift reports); also
@@ -204,6 +232,15 @@ class FFConfig:
                 f"sync_schedule must be off|search, got "
                 f"{self.sync_schedule!r}"
             )
+        if self.sync_ef not in ("off", "auto"):
+            raise ValueError(
+                f"sync_ef must be off|auto, got {self.sync_ef!r}"
+            )
+        if self.co_search and self.sync_schedule == "off":
+            # the joint pricing currency IS the exposed-comm scheduled
+            # sync — co-search without the schedule dimension would
+            # price candidates with plans the lowering never executes
+            self.sync_schedule = "search"
         if self.num_devices == 0:
             try:
                 import jax
@@ -306,6 +343,18 @@ class FFConfig:
                        type=int, default=0,
                        help="pin the schedule search's per-bucket "
                             "coalescing floor in bytes (0 = sweep)")
+        p.add_argument("--co-search", dest="co_search",
+                       action="store_true",
+                       help="joint strategy x comm-plan co-search: "
+                            "price every candidate strategy with its "
+                            "best sync schedule/precision/reduction "
+                            "plan inside the substitution search "
+                            "(search/comm_plan.py)")
+        p.add_argument("--sync-ef", dest="sync_ef",
+                       choices=("off", "auto"), default="off",
+                       help="error-feedback residuals on int8 gradient "
+                            "sync (per-group int8_ef wire choice, "
+                            "residual threaded as training-loop state)")
         p.add_argument("--obs-log", dest="obs_log", type=str, default=None,
                        help="JSONL structured-event telemetry sink "
                             "(flexflow_tpu/obs; tools/ffobs.py renders it)")
@@ -367,6 +416,8 @@ class FFConfig:
             sync_precision=args.sync_precision,
             sync_schedule=args.sync_schedule,
             sync_bucket_bytes=args.sync_bucket_bytes,
+            co_search=args.co_search,
+            sync_ef=args.sync_ef,
             obs_log_file=args.obs_log,
             obs_trace_file=args.obs_trace,
             drift_threshold=args.drift_threshold,

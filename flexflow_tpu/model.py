@@ -461,6 +461,7 @@ class FFModel:
         # returned (a placement proposal may replace `strategy` below)
         imported_sync_schedule = None  # __meta__.sync_schedule of an
         # imported strategy file (already behind the digest gate)
+        imported_zero_groups = None  # __meta__.zero_groups likewise
         if strategy is None:
             if pipeline is not None:
                 # dp over the devices left after the pp axis is carved off
@@ -509,8 +510,9 @@ class FFModel:
                         f"for this graph/mesh", bad)
                 from flexflow_tpu.search.strategy_io import read_meta
 
-                imported_sync_schedule = read_meta(
-                    self.config.import_strategy_file).get("sync_schedule")
+                _imeta = read_meta(self.config.import_strategy_file)
+                imported_sync_schedule = _imeta.get("sync_schedule")
+                imported_zero_groups = _imeta.get("zero_groups")
             elif self.config.only_data_parallel:
                 strategy = data_parallel_strategy(self.graph, self.config.num_devices)
             else:
@@ -724,6 +726,51 @@ class FFModel:
                 self.sync_schedule = _build_sync_schedule(
                     self.graph, strategy, _sync_sim, self.config
                 )
+        # per-group optimizer-state sharding (the co-searched ZeRO-1
+        # dimension, search/comm_plan.py): adopted from the search
+        # (LAST_ZERO_GROUPS — already gated by the driver's always-on
+        # SHD140/141 lint) or from an imported strategy file's
+        # __meta__.zero_groups (re-linted against THIS graph/strategy
+        # here).  The global config.zero_dp_shard flag is untouched and
+        # keeps arming every op; the per-group map is ignored under it.
+        self.zero_groups: tuple = ()
+        if (
+            comp_mode == "training"
+            and strategy
+            and pipeline is None
+            and not self.config.zero_dp_shard
+        ):
+            if imported_zero_groups is not None:
+                from flexflow_tpu.analysis import (
+                    AnalysisError,
+                    emit_findings,
+                    errors_only,
+                    lint_zero_map,
+                )
+                from flexflow_tpu.search.machine_model import CostModel
+
+                if (not isinstance(imported_zero_groups, list)
+                        or any(not isinstance(z, str)
+                               for z in imported_zero_groups)):
+                    raise AnalysisError(
+                        "imported strategy file carries a malformed "
+                        "zero_groups map (expected a list of op names)",
+                        [])
+                _zcm = CostModel(
+                    self.config.machine_spec,
+                    num_devices=self.config.search_devices)
+                bad = errors_only(lint_zero_map(
+                    self.graph, strategy, imported_zero_groups, _zcm))
+                if bad:
+                    emit_findings(bad)
+                    raise AnalysisError(
+                        "imported zero_groups map is illegal for this "
+                        "graph/strategy", bad)
+                self.zero_groups = tuple(imported_zero_groups)
+            elif searched_strategy and strategy is searched_strategy_obj:
+                from flexflow_tpu.search import driver as _driver
+
+                self.zero_groups = tuple(_driver.LAST_ZERO_GROUPS)
         # predicted step breakdown + strategy-explanation telemetry —
         # the predicted half of the DriftReport fit() completes.  Only
         # computed when something will consume it (profiling, the obs
@@ -793,6 +840,10 @@ class FFModel:
                 # the searched comm plan persists NEXT to the strategy,
                 # behind the same graph-digest gate import enforces
                 _meta["sync_schedule"] = self.sync_schedule.to_jsonable()
+            if self.zero_groups:
+                # the co-searched per-group optimizer-sharding map
+                # rides the same digest gate (fflint checks it, STR207)
+                _meta["zero_groups"] = sorted(self.zero_groups)
             export_strategy(
                 self.config.export_strategy_file, self.graph, strategy,
                 meta=_meta or None,
@@ -885,6 +936,7 @@ class FFModel:
                     self.optimizer, mesh=mesh,
                     sync_precision=self.sync_precision_map,
                     sync_schedule=self.sync_schedule,
+                    zero_groups=self.zero_groups,
                 )
         else:
             self.compiled = CompiledModel(
@@ -897,6 +949,7 @@ class FFModel:
                 mesh=mesh,
                 sync_precision=self.sync_precision_map,
                 sync_schedule=self.sync_schedule,
+                zero_groups=self.zero_groups,
             )
         from flexflow_tpu.compiler.staged_pipeline_lowering import (
             StagedPipelinedModel as _Staged,
@@ -916,6 +969,21 @@ class FFModel:
                 f"execute them; gradients sync at fp32"
             )
             self.sync_precision_map = {}
+        if self.zero_groups and getattr(
+                self.compiled, "zero_groups", None) is None:
+            # same honesty rule for the per-group optimizer sharding:
+            # placed/pipelined lowerings manage their own placement and
+            # cannot execute the map — say so instead of silently
+            # leaving optimizer state replicated
+            from flexflow_tpu.utils.logging import SEARCH_LOG
+
+            SEARCH_LOG.log(
+                f"co-search chose {len(self.zero_groups)} "
+                f"optimizer-sharded group(s) but this lowering "
+                f"({type(self.compiled).__name__}) cannot execute the "
+                f"per-group map; optimizer state stays replicated"
+            )
+            self.zero_groups = ()
         if self.sync_schedule is not None and getattr(
                 self.compiled, "sync_schedule", None) is None:
             # same honesty rule for the sync schedule: placed/pipelined
@@ -938,6 +1006,7 @@ class FFModel:
             mesh=mesh,
             sync_precision=dict(self.sync_precision_map),
             sync_schedule=self.sync_schedule,
+            zero_groups=self.zero_groups,
             staged=(self.pipeline_proposal
                     if isinstance(self.compiled, _Staged) else None),
         )
@@ -997,6 +1066,7 @@ class FFModel:
                     mesh=ctx.get("mesh"),
                     sync_precision=ctx.get("sync_precision"),
                     sync_schedule=ctx.get("sync_schedule"),
+                    zero_groups=ctx.get("zero_groups"),
                 )
         old_params, old_state, old_opt = self.params, self.state, self.opt_state
         self.params, self.state = self.compiled.init_params(self.config.seed)

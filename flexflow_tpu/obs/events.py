@@ -34,11 +34,24 @@ EVENT_KINDS = {
     "search.substitution": {"xfer", "action"},
     "search.candidate": {"cost_s", "best_s", "improved"},
     "search.split": {"op", "pre_nodes", "post_nodes"},
+    # k-way chain decomposition (production-scale graphs, PR 7) —
+    # emitted since the chain search landed but never registered, so
+    # ffobs validate rejected logs containing them
+    "search.chain": {"nodes", "segments"},
+    "search.chain_done": {"bound_s", "cost_s"},
     "search.floor": {"kept_dp", "dp_cost_s", "searched_cost_s"},
     "search.result": {"cost_s", "rewritten"},
     "search.perf": {"search_seconds", "calibration_seconds", "full_sims",
                     "delta_sims"},
     "search.log": {"msg"},
+    # joint strategy x comm-plan co-search (search/comm_plan.py): one
+    # event per comm-plan decision — served=True rode the signature
+    # memo ("memo") or the persistent layer ("disk"), False paid the
+    # full choose_sync_schedule sweep ("search")
+    "search.comm_plan": {"served", "source", "groups"},
+    # the per-group optimizer-state sharding choice the co-search
+    # adopted for its final result (ZeRO-1 dimension)
+    "search.zero_groups": {"groups", "credit_s"},
     # DP inner loop (search/dp.py)
     "dp.split": {"op", "pre_nodes", "post_nodes", "cost_s"},
     "dp.summary": {"memo_hits", "memo_misses"},

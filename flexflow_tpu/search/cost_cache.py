@@ -53,6 +53,13 @@ SCHEMA_VERSION = 1
 # fflint CCH405) and keeps the rest of the cache — corrupt memo rows
 # must cost a recompute, never serve a wrong strategy.
 DP_SCHEMA = 1
+# sub-schema of the persisted comm-plan memo rows ("comm_plans"/
+# "comm_schema" keys, search/comm_plan.py): the co-search's chosen
+# sync schedules / precision maps / zero-sharding choices per
+# synced-group signature.  Same additive discipline as the dp layer —
+# an unknown comm_schema drops ONLY this layer, loudly (stderr +
+# fflint CCH407), and a re-search rebuilds it.
+COMM_SCHEMA = 1
 
 _ROW_HITS = METRICS.counter("cost_cache.row_hits")
 _ROW_MISSES = METRICS.counter("cost_cache.row_misses")
@@ -60,6 +67,8 @@ _RESULT_HITS = METRICS.counter("cost_cache.result_hits")
 _RESULT_MISSES = METRICS.counter("cost_cache.result_misses")
 _DP_HITS = METRICS.counter("cost_cache.dp_row_hits")
 _DP_MISSES = METRICS.counter("cost_cache.dp_row_misses")
+_COMM_HITS = METRICS.counter("cost_cache.comm_plan_hits")
+_COMM_MISSES = METRICS.counter("cost_cache.comm_plan_misses")
 
 RowKey = Tuple[str, Tuple[int, ...], int]
 
@@ -114,6 +123,14 @@ def cost_signature(cost_model) -> str:
         "network": cost_model.network is not None,
         "calibration": calibration_digest(cost_model.calibration),
     }
+    if getattr(cost_model, "sync_ef", False):
+        # EF changes the priced sync seconds (EF_PASSES in
+        # _quant_overhead, the int8→int8_ef upgrade) so its rows must
+        # not cross-serve plain-int8 runs — extension-only keying:
+        # sync_ef=off signatures stay byte-identical to caches written
+        # before the flag existed (same discipline as search_key's
+        # co_search marker)
+        parts["sync_ef"] = True
     return hashlib.sha256(
         json.dumps(parts, sort_keys=True).encode()).hexdigest()[:16]
 
@@ -177,6 +194,12 @@ class CostCache:
         # and the bit-identical regression gate holds
         self.dp_rows: Dict[str, dict] = {}
         self.dp_loaded = False
+        # persisted comm-plan memo rows (comm-plan layer,
+        # search/comm_plan.py): signature digest -> jsonable
+        # CommPlanEntry.  Only consulted under FFConfig.co_search, so
+        # the layer is inert on every sequential-pipeline run and the
+        # bit-identical regression gate holds by construction.
+        self.comm_plans: Dict[str, dict] = {}
         self.stale = False
         self.invalidated = False  # file existed with another signature
         self._dirty = False
@@ -186,6 +209,8 @@ class CostCache:
         self.result_misses = 0
         self.dp_row_hits = 0
         self.dp_row_misses = 0
+        self.comm_plan_hits = 0
+        self.comm_plan_misses = 0
         self._load()
 
     # ------------------------------------------------------------------
@@ -243,6 +268,23 @@ class CostCache:
             elif isinstance(dp, dict):
                 self.dp_rows = dp
                 self.dp_loaded = True
+        cp = data.get("comm_plans")
+        if cp:
+            if data.get("comm_schema") != COMM_SCHEMA:
+                # same fail-LOUD discipline as the dp layer: unknown
+                # layout drops only the comm-plan layer (one re-search
+                # per signature), keeps row/result/dp layers intact
+                print(
+                    f"flexflow_tpu cost cache: persisted comm-plan rows "
+                    f"carry unknown comm_schema "
+                    f"{data.get('comm_schema')!r} (known: {COMM_SCHEMA}) "
+                    f"— dropping the comm-plan layer; plans will be "
+                    f"re-searched (run tools/fflint.py cache to "
+                    f"inspect)",
+                    file=sys.stderr,
+                )
+            elif isinstance(cp, dict):
+                self.comm_plans = cp
         if os.path.exists(self.result_path):
             try:
                 with open(self.result_path, "rb") as f:
@@ -279,7 +321,9 @@ class CostCache:
             json.dump(
                 {"schema": SCHEMA_VERSION, "signature": self.signature,
                  "calibration_stale": False, "rows": rows,
-                 "dp_schema": DP_SCHEMA, "dp_rows": self.dp_rows},
+                 "dp_schema": DP_SCHEMA, "dp_rows": self.dp_rows,
+                 "comm_schema": COMM_SCHEMA,
+                 "comm_plans": self.comm_plans},
                 f,
             )
         os.replace(tmp, self.path)
@@ -347,6 +391,37 @@ class CostCache:
         _DP_HITS.inc()
         return hit
 
+    # ---- comm-plan memo layer (co-search, search/comm_plan.py) --------
+    def get_comm_plan(self, key: str) -> Optional[dict]:
+        """The persisted comm-plan row for a synced-group signature
+        digest, or None.  The payload is the jsonable CommPlanEntry
+        (schedule + precision map + zero map + credit); comm_plan.py
+        validates it structurally and treats malformation as a miss."""
+        if self.stale:
+            return None
+        hit = self.comm_plans.get(key)
+        if hit is None:
+            self.comm_plan_misses += 1
+            _COMM_MISSES.inc()
+            return None
+        self.comm_plan_hits += 1
+        _COMM_HITS.inc()
+        return hit
+
+    # soft bound mirroring DP_MAX_ROWS — a signature-rich sweep must
+    # not grow the file without limit
+    COMM_MAX_ROWS = 20000
+
+    def put_comm_plan(self, key: str, payload: dict) -> None:
+        if self.stale:
+            return
+        if key in self.comm_plans:
+            return  # deterministic choice: first write wins
+        if len(self.comm_plans) >= self.COMM_MAX_ROWS:
+            return
+        self.comm_plans[key] = payload
+        self._dirty = True
+
     # soft bound on the persisted memo: a production sweep over many
     # large graphs must not grow COST_CACHE.json without limit — beyond
     # the cap new rows cost a recompute next run, nothing breaks
@@ -382,6 +457,12 @@ class CostCache:
             config.search_improvement_margin,
             sub_digest,
         )
+        if getattr(config, "co_search", False):
+            # extension-only keying: a joint co-search result is a
+            # different function value, but sequential-pipeline keys
+            # must stay byte-identical to caches written before the
+            # flag existed
+            knobs = knobs + ("co_search",)
         return stable_graph_digest(graph) + ":" + hashlib.sha256(
             repr(knobs).encode()).hexdigest()[:12]
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import math
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.core.graph import Graph, Node
@@ -225,6 +226,51 @@ class SearchHelper:
         self.ctx_rebuilds = 0
         self.dp_rows_served = 0
         self.segments_stamped = 0
+        # joint strategy x comm-plan co-search (search/comm_plan.py):
+        # when the driver binds a JointPricer here, every cost this
+        # helper GROUNDS (the _finish re-validation, its DP floor, the
+        # ambiguous-pairing re-simulations, the native engine's
+        # winners) is priced in the joint exposed-comm currency — the
+        # enumeration interiors (split bounds, leaf brute force,
+        # native DP) keep ranking in the fast legacy scalar currency
+        # and the joint gate re-prices their winners.  None (default)
+        # keeps every path bit-identical to the sequential pipeline.
+        self.joint = None
+        # depth gate mirroring the driver's sequence_optimize gate: the
+        # joint currency grounds only the TOP-level graph_cost query
+        # (the whole candidate graph) — an interior split segment
+        # priced jointly in isolation is charged the full exposed sync
+        # tail the merged graph hides under the other segments'
+        # backward, so joint-priced segments compose into provably
+        # worse merges (and every novel segment signature would pay an
+        # unmemoized plan sweep).  Interior recursion suspends the
+        # pricer; the top-level _finish re-prices the composed winner
+        # jointly.
+        self._joint_depth = 0
+
+    def _price(self, graph, strategy) -> float:
+        """Ground-truth pricing of one (graph, strategy): the joint
+        exposed-comm currency under co-search, the legacy scalar
+        simulation otherwise."""
+        if self.joint is not None:
+            return self.joint.price(self.sim, graph, strategy)
+        return self.sim.simulate(graph, strategy)
+
+    @contextmanager
+    def joint_scope(self, top: bool):
+        """THE depth-gate rule, shared by every gated recursion
+        (``graph_cost``/``graph_cost_only`` here, the driver's
+        ``sequence_optimize``): interior levels suspend the joint
+        pricer — a segment priced jointly in isolation is charged the
+        exposed sync tail the merged graph hides — and the top level
+        keeps it, so composed winners ground jointly exactly once."""
+        saved = self.joint
+        if not top:
+            self.joint = None
+        try:
+            yield
+        finally:
+            self.joint = saved
 
     # ------------------------------------------------------------------
     def _views(self, node: Node, budget: int, start: int = 0) -> List[MachineView]:
@@ -663,13 +709,17 @@ class SearchHelper:
         # mirror the result into the Python memo: isomorphic graphs with
         # different guids (repeated blocks seen through other Graph
         # objects) then reuse it via canonical remapping exactly as the
-        # Python path would
-        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, 0)
-        if key not in self.memo:
-            self.memo[key] = (
-                float(cost), canonicalize_strategy(graph, strategy))
-            self._persist_dp_row(graph, fixed, budget, 0, float(cost),
-                                 strategy)
+        # Python path would.  Under co-search the caller routes this
+        # winner through _finish (joint re-pricing + floor), which owns
+        # the memo write there — mirroring the native scalar cost would
+        # poison the joint-currency memo.
+        if self.joint is None:
+            key = (graph.hash(), canon_fixed_views(graph, fixed), budget, 0)
+            if key not in self.memo:
+                self.memo[key] = (
+                    float(cost), canonicalize_strategy(graph, strategy))
+                self._persist_dp_row(graph, fixed, budget, 0, float(cost),
+                                     strategy)
         return float(cost), strategy
 
     # ------------------------------------------------------------------
@@ -704,6 +754,11 @@ class SearchHelper:
         knobs = (budget, start, self.num_devices, self.leaf_threshold,
                  self.max_views_per_op, self.max_bottleneck_tries,
                  bool(self.sim.placement_overlap))
+        if self.joint is not None:
+            # joint-currency rows live under their own key family so a
+            # sequential-pipeline run never serves a co-searched cost
+            # (extension-only: off-mode keys stay byte-identical)
+            knobs = knobs + ("co_search",)
         tail = blake2b(repr((pins, knobs)).encode(),
                        digest_size=10).hexdigest()
         return stable_graph_digest(graph) + ":" + tail
@@ -736,12 +791,12 @@ class SearchHelper:
         if strategy is None or len(strategy) != graph.num_nodes:
             return None
         if ambiguous:
-            cost = self.sim.simulate(graph, strategy)
+            cost = self._price(graph, strategy)
         from flexflow_tpu.analysis import errors_only, lint_strategy
 
         if errors_only(lint_strategy(graph, strategy, self.num_devices)):
             return None
-        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
+        key = self._memo_key(graph, fixed, budget, start)
         if key not in self.memo:
             self.memo[key] = (cost, canonicalize_strategy(graph, strategy))
         self.dp_rows_served += 1
@@ -781,11 +836,39 @@ class SearchHelper:
             # multi-member hash groups: the in-group pairing may not
             # follow one isomorphism, so the cached cost may not match
             # this strategy — ground it in the sim
-            cost = self.sim.simulate(graph, strategy)
+            cost = self._price(graph, strategy)
         return cost, strategy
 
     # ------------------------------------------------------------------
+    def _memo_key(self, graph, fixed, budget: int, start: int) -> Tuple:
+        """In-process memo key.  Joint-priced rows (top-level queries
+        under co-search) live under their own key family so a
+        scalar-currency lookup can never serve an exposed-comm cost
+        into a bound comparison (and vice versa) — the same
+        extension-only marker the persistent dp layer carries."""
+        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
+        if self.joint is not None:
+            key = key + ("co_search",)
+        return key
+
     def graph_cost(
+        self,
+        graph: Graph,
+        fixed: Optional[Strategy] = None,
+        budget: Optional[int] = None,
+        start: int = 0,
+    ) -> Tuple[float, Strategy]:
+        """Depth-gated wrapper (see ``joint_scope``): interior split
+        recursion suspends the joint pricer, the top level keeps it."""
+        top = self._joint_depth == 0
+        self._joint_depth += 1
+        try:
+            with self.joint_scope(top):
+                return self._graph_cost_gated(graph, fixed, budget, start)
+        finally:
+            self._joint_depth -= 1
+
+    def _graph_cost_gated(
         self,
         graph: Graph,
         fixed: Optional[Strategy] = None,
@@ -797,25 +880,37 @@ class SearchHelper:
         devices beginning at device ``start``."""
         fixed = fixed or {}
         budget = budget or self.num_devices
-        if self._dp_cache_warm():
+        if self._dp_cache_warm() or self.joint is not None:
             # warm prelude: the in-process memo first (repeat queries
             # must not re-lint a served row), then the persisted rows —
-            # BEFORE the native engine, which is the work being skipped
-            key = (graph.hash(), canon_fixed_views(graph, fixed), budget,
-                   start)
+            # BEFORE the native engine, which is the work being skipped.
+            # Co-search also takes this prelude: _finish's joint
+            # re-pricing is the expensive step there, so repeat queries
+            # must serve the memoized joint cost instead of re-pricing
+            key = self._memo_key(graph, fixed, budget, start)
             got = self._memo_lookup(graph, key, fixed)
             if got is not None:
                 self.memo_hits += 1
                 _MEMO_HITS.inc()
                 return got
-            served = self._serve_persistent_dp(graph, fixed, budget, start)
-            if served is not None:
-                return served
+            if self._dp_cache_warm():
+                served = self._serve_persistent_dp(graph, fixed, budget,
+                                                   start)
+                if served is not None:
+                    return served
         if start == 0:
             native = self._native_graph_cost(graph, fixed, budget)
             if native is not None:
                 self.native_hits += 1
                 _NATIVE_HITS.inc()
+                if self.joint is not None:
+                    # the native engine enumerated in the legacy scalar
+                    # currency; its winner still passes the joint gate
+                    # (re-price + DP floor + memo) like every other
+                    # DP result
+                    key = self._memo_key(graph, fixed, budget, start)
+                    return self._finish(graph, key, native[0], native[1],
+                                        fixed, budget, start)
                 return native
         # structural memo: keyed by graph hash + guid-free canonical
         # fixed views, so isomorphic segments with different guids
@@ -823,7 +918,7 @@ class SearchHelper:
         # Cached strategies are canonical and remapped onto the caller's
         # guids (reconstruct_strategy); round 2's guid-set key blocked
         # exactly this sharing and made 12-layer search intractable.
-        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
+        key = self._memo_key(graph, fixed, budget, start)
         got = self._memo_lookup(graph, key, fixed)
         if got is not None:
             self.memo_hits += 1
@@ -842,30 +937,52 @@ class SearchHelper:
         budget: Optional[int] = None,
         start: int = 0,
     ) -> float:
+        """Depth-gated like ``graph_cost`` (see ``joint_scope``)."""
+        top = self._joint_depth == 0
+        self._joint_depth += 1
+        try:
+            with self.joint_scope(top):
+                return self._graph_cost_only_gated(graph, fixed, budget,
+                                                   start)
+        finally:
+            self._joint_depth -= 1
+
+    def _graph_cost_only_gated(
+        self,
+        graph: Graph,
+        fixed: Optional[Strategy] = None,
+        budget: Optional[int] = None,
+        start: int = 0,
+    ) -> float:
         """Cost without strategy materialization — memo hits skip the
         canonical-strategy reconstruction, which dominates enumeration
         loops (the reference's templated float-only graph_cost,
         graph.cc:1456-1526, exists for exactly this reason)."""
         fixed = fixed or {}
         budget = budget or self.num_devices
-        if self._dp_cache_warm():
-            key = (graph.hash(), canon_fixed_views(graph, fixed), budget,
-                   start)
+        if self._dp_cache_warm() or self.joint is not None:
+            key = self._memo_key(graph, fixed, budget, start)
             hit = self.memo.get(key)
             if hit is not None:
                 self.memo_hits += 1
                 _MEMO_HITS.inc()
                 return hit[0]
-            served = self._serve_persistent_dp(graph, fixed, budget, start)
-            if served is not None:
-                return served[0]
+            if self._dp_cache_warm():
+                served = self._serve_persistent_dp(graph, fixed, budget,
+                                                   start)
+                if served is not None:
+                    return served[0]
         if start == 0:
             native = self._native_graph_cost(graph, fixed, budget)
             if native is not None:
                 self.native_hits += 1
                 _NATIVE_HITS.inc()
+                if self.joint is not None:
+                    key = self._memo_key(graph, fixed, budget, start)
+                    return self._finish(graph, key, native[0], native[1],
+                                        fixed, budget, start)[0]
                 return native[0]
-        key = (graph.hash(), canon_fixed_views(graph, fixed), budget, start)
+        key = self._memo_key(graph, fixed, budget, start)
         hit = self.memo.get(key)
         if hit is not None:
             # the cached cost is achievable on any isomorphic graph, so
@@ -882,14 +999,17 @@ class SearchHelper:
         # Re-validate against the simulator: split-based composition
         # over-counts boundary nodes and assumes realizable overlap; the
         # event-driven sim of the full (sub)graph is ground truth.
+        # Under co-search this is THE DP re-validation the co-search
+        # prices jointly: the composed strategy and the DP floor both
+        # carry their best comm plan into the comparison.
         if strategy:
-            cost = self.sim.simulate(graph, strategy)
+            cost = self._price(graph, strategy)
         # Floor: the batch-parallel default is always in the search
         # space, so the result must never be worse than it (the split
         # composition optimizes a bound, not the true cost, and can
         # otherwise steer to a worse re-validated strategy).
         dp = self._default_strategy(graph, fixed, budget, start)
-        c_dp = self.sim.simulate(graph, dp)
+        c_dp = self._price(graph, dp)
         if c_dp < cost:
             cost, strategy = c_dp, dp
         self.memo[key] = (cost, canonicalize_strategy(graph, strategy))

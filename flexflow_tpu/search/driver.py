@@ -112,6 +112,17 @@ class _UnityOptimizer:
         # cost, strategy, fixed guid->view at store time)
         self.cache: Dict[Tuple, Tuple] = {}
         self._edge_scores: Optional[Dict[Tuple[int, int], int]] = None
+        # joint co-search depth gate: the exposed-comm joint currency is
+        # only meaningful for WHOLE-graph candidates — a segment priced
+        # in isolation gets charged its full exposed sync tail, which
+        # the merged graph hides under the other segments' backward, so
+        # joint-priced segment solves compose into provably worse
+        # merges.  Interior recursion levels therefore rank in the
+        # legacy scalar bound (identical trajectory to the sequential
+        # pipeline) and every TOP-level grounding — substitution
+        # proposals on the full graph, split/chain merges, the DP
+        # floor — is re-validated jointly.
+        self._depth = 0
 
     def _expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline
@@ -224,7 +235,7 @@ class _UnityOptimizer:
         # hash groups have >1 member — re-simulate so the returned cost
         # is honest for the remapped strategy (code-review r3 finding)
         if any(len(v) > 1 for v in stored_groups.values()):
-            cost = self.helper.sim.simulate(g2, strat2)
+            cost = self.helper._price(g2, strat2)
         # segment STAMP: a solved segment transplanted onto an
         # isomorphic sibling (repeated transformer layers).  Stamped
         # strategies must still prove legal — the always-on SHD1xx gate
@@ -343,13 +354,27 @@ class _UnityOptimizer:
                     merged_g, merged_s, g_i, s_i, in_guid)
             if out_guid is not None:
                 merged_s[out_guid] = v
-        c_true = self.helper.sim.simulate(merged_g, merged_s)
+        c_true = self.helper._price(merged_g, merged_s)
         if BUS.enabled:
             BUS.emit("search.chain_done", bound_s=bound, cost_s=c_true)
         return merged_g, c_true, merged_s
 
     # -- recursive sequence optimization (reference: :2190-2370) -----------
     def sequence_optimize(
+        self, graph: Graph, fixed: Strategy
+    ) -> Tuple[Graph, float, Strategy]:
+        """Depth-gated wrapper: interior recursion levels suspend the
+        joint pricer (``SearchHelper.joint_scope`` — THE shared gate
+        rule), the top level restores it."""
+        top = self._depth == 0
+        self._depth += 1
+        try:
+            with self.helper.joint_scope(top):
+                return self._sequence_optimize(graph, fixed)
+        finally:
+            self._depth -= 1
+
+    def _sequence_optimize(
         self, graph: Graph, fixed: Strategy
     ) -> Tuple[Graph, float, Strategy]:
         key = (graph.hash(), canon_fixed_views(graph, fixed))
@@ -398,7 +423,7 @@ class _UnityOptimizer:
                     g_pre, s_pre, g_post, s_post, bn.guid
                 )
                 merged_s[bn.guid] = v
-                c_true = self.helper.sim.simulate(merged_g, merged_s)
+                c_true = self.helper._price(merged_g, merged_s)
                 if c_true < best[1]:
                     best = (merged_g, c_true, merged_s)
                 if self._expired():
@@ -622,20 +647,79 @@ LAST_SEARCH_STATS: Dict[str, object] = {}
 # choice; None when the mode is off or the monolithic baseline won
 LAST_SYNC_SCHEDULE = None
 
+# the per-group optimizer-state sharding map the LAST optimize_strategy
+# chose under config.co_search (search/comm_plan.py choose_zero_groups):
+# op names whose ZeRO-1 reduce-scatter/all-gather placement genuinely
+# shrinks the update term — compile() adopts it the way it adopts
+# LAST_SYNC_SCHEDULE; () when co-search is off or nothing qualifies
+LAST_ZERO_GROUPS: tuple = ()
 
-def _build_sync_schedule(graph, strategy, sim, config):
+
+def _build_sync_schedule(graph, strategy, sim, config, joint=None):
     """Choose + legality-gate the gradient-sync schedule for a search
     result (search/sync_schedule.py) — runs on BOTH the fresh and the
     cache-served paths of ``optimize_strategy``, so every result this
     function hands out carries a linted schedule (or None).  The gate
     (SHD12x) is always-on inside ``choose_sync_schedule``; a failure
-    there is a builder bug and raises."""
-    global LAST_SYNC_SCHEDULE
+    there is a builder bug and raises.
+
+    Under co-search (``joint`` bound) the schedule is SERVED from the
+    JointPricer's comm-plan memo — the plan the winning strategy was
+    actually priced with — instead of re-running the sweep, and the
+    memoized per-group optimizer-sharding choice lands in
+    ``LAST_ZERO_GROUPS``.  Served plans (memo or disk) still pass the
+    full SHD12x/SHD14x legality gates against THIS (graph, strategy):
+    a corrupt persisted plan costs one re-search, never an illegal
+    artifact."""
+    global LAST_SYNC_SCHEDULE, LAST_ZERO_GROUPS
     LAST_SYNC_SCHEDULE = None
+    LAST_ZERO_GROUPS = ()
     if getattr(config, "sync_schedule", "off") != "search" or not strategy:
         return None
     from flexflow_tpu.search.sync_precision import choose_sync_precision
-    from flexflow_tpu.search.sync_schedule import choose_sync_schedule
+    from flexflow_tpu.search.sync_schedule import (
+        choose_sync_schedule,
+        lint_gate,
+    )
+
+    if joint is not None:
+        entry = joint.plan_for(graph, strategy, sim)
+        schedule = None
+        if entry is not None and entry.adopted:
+            schedule = entry.schedule
+            lint_gate(graph, strategy, schedule, entry.pmap,
+                      cost_model=sim.cost)
+        if entry is not None and entry.zero:
+            from flexflow_tpu.analysis import (
+                AnalysisError,
+                emit_findings,
+                errors_only,
+                lint_zero_map,
+            )
+
+            bad = errors_only(lint_zero_map(
+                graph, strategy, entry.zero, sim.cost))
+            if bad:
+                # a served zero map that fails the always-on gate is a
+                # plan bug (or a corrupt persisted row): fail loudly
+                # like every other artifact this tree produces
+                emit_findings(bad)
+                raise AnalysisError(
+                    "co-search produced an illegal per-group "
+                    "optimizer-sharding map", bad)
+            LAST_ZERO_GROUPS = tuple(entry.zero)
+        LAST_SEARCH_STATS["sync_schedule"] = {
+            "buckets": len(schedule.buckets) if schedule is not None else 0,
+            "co_search": True,
+            "zero_groups": len(LAST_ZERO_GROUPS),
+        }
+        if BUS.enabled:
+            BUS.emit(
+                "search.zero_groups", groups=list(LAST_ZERO_GROUPS),
+                credit_s=entry.zero_credit if entry is not None else 0.0,
+            )
+        LAST_SYNC_SCHEDULE = schedule
+        return schedule
 
     pmap = {}
     if getattr(config, "sync_precision", "fp32") != "fp32":
@@ -756,6 +840,7 @@ def _optimize_strategy(
     match_base = (
         _subst._SCANS.value, _subst._DELTA_SCANS.value,
         _subst._DELTA_NODES.value, _subst._DELTA_SKIPPED.value,
+        _subst._INDEX_SKIPS.value,
     )
     t_cal = 0.0  # seconds spent probing/persisting calibration — split
     # out of the reported search time (bench satellite: the two were
@@ -865,6 +950,25 @@ def _optimize_strategy(
     sim = Simulator.for_config(config, calibration=calibration)
     floor_sim = sim  # the sim the champion-vs-DP floor must score with
     helper = SearchHelper(sim, n)
+    joint = None
+    if getattr(config, "co_search", False):
+        # joint strategy x comm-plan co-search (search/comm_plan.py):
+        # bind one comm-plan memo to this search — every candidate the
+        # helper or the unity loop grounds is then priced with its
+        # best sync schedule/precision/zero plan through the
+        # exposed-comm simulation instead of the legacy per-node
+        # overlap credit
+        from flexflow_tpu.search.comm_plan import JointPricer
+
+        joint = JointPricer(config, cost_cache=sim.cost_cache)
+        helper.joint = joint
+
+    def _price(s, g, st):
+        """Candidate grounding in the search's currency: joint
+        exposed-comm under co-search, legacy scalar otherwise."""
+        if joint is not None:
+            return joint.price(s, g, st)
+        return s.simulate(g, st)
 
     BUS.emit(
         "search.begin", nodes=graph.num_nodes, devices=n,
@@ -911,7 +1015,8 @@ def _optimize_strategy(
             )
             # cache-served results pass the SAME schedule choice + gate
             # as fresh ones — the persisted artifact never skips it
-            _build_sync_schedule(best_graph, best_strategy, sim, config)
+            _build_sync_schedule(best_graph, best_strategy, sim, config,
+                                 joint=joint)
             return best_graph, best_strategy
     with log.enter(f"optimize_strategy: {graph.num_nodes} nodes, {n} devices"):
         if (return_graph and config.search_budget > 0
@@ -927,7 +1032,7 @@ def _optimize_strategy(
             )
 
             best_strategy = _dps(graph, n)
-            best_cost = sim.simulate(graph, best_strategy)
+            best_cost = _price(sim, graph, best_strategy)
             log.log(
                 f"baseline data-parallel cost: {best_cost * 1e3:.4f} "
                 f"ms/iter (whole-graph DP deferred to the segment "
@@ -988,8 +1093,8 @@ def _optimize_strategy(
                     sim2 = Simulator.for_config(config, calibration=calibration)
                     floor_sim = sim2  # sim's _node_costs cache predates
                     # the new probes; the floor must not mix tables
-                    best_cost = sim2.simulate(graph, best_strategy)
-                    c2 = sim2.simulate(g2, s2)
+                    best_cost = _price(sim2, graph, best_strategy)
+                    c2 = _price(sim2, g2, s2)
             if c2 < best_cost and s2:
                 log.log(
                     f"substitution improved: {best_cost * 1e3:.4f}"
@@ -1007,7 +1112,7 @@ def _optimize_strategy(
     from flexflow_tpu.compiler.lowering import data_parallel_strategy
 
     dp_strategy = data_parallel_strategy(graph, n)
-    dp_cost = floor_sim.simulate(graph, dp_strategy)
+    dp_cost = _price(floor_sim, graph, dp_strategy)
     margin = max(0.0, config.search_improvement_margin)
     kept_dp = math.isfinite(dp_cost) and best_cost > dp_cost * (1.0 - margin)
     BUS.emit("search.floor", kept_dp=kept_dp, dp_cost_s=dp_cost,
@@ -1067,10 +1172,12 @@ def _optimize_strategy(
     )
 
     if best_strategy and math.isfinite(best_cost):
-        _build_sync_schedule(best_graph, best_strategy, floor_sim, config)
+        _build_sync_schedule(best_graph, best_strategy, floor_sim, config,
+                             joint=joint)
     else:
-        global LAST_SYNC_SCHEDULE
+        global LAST_SYNC_SCHEDULE, LAST_ZERO_GROUPS
         LAST_SYNC_SCHEDULE = None
+        LAST_ZERO_GROUPS = ()
 
     if return_graph:
         return best_graph, best_strategy
@@ -1079,7 +1186,7 @@ def _optimize_strategy(
 
 def _emit_search_done(
     floor_sim, best_graph, graph, best_strategy, best_cost, kept_dp,
-    helper, t_start, t_cal, result_cache_hit, match_base=(0, 0, 0, 0),
+    helper, t_start, t_cal, result_cache_hit, match_base=(0, 0, 0, 0, 0),
 ) -> None:
     """Search-completion telemetry: the final result/summary events
     plus the search-perf roll-up (delta-vs-full simulation counts,
@@ -1106,6 +1213,11 @@ def _emit_search_done(
         "match_delta_scans": _subst._DELTA_SCANS.value - match_base[1],
         "match_nodes_rescanned": _subst._DELTA_NODES.value - match_base[2],
         "match_nodes_skipped": _subst._DELTA_SKIPPED.value - match_base[3],
+        # per-op-type seed index (ROADMAP PR 7 follow-up): matcher
+        # calls skipped because the node's op type cannot anchor the
+        # xfer's pattern
+        "match_index_skips": _subst._INDEX_SKIPS.value - (
+            match_base[4] if len(match_base) > 4 else 0),
         "cache_row_hits": cache.row_hits if cache else 0,
         "cache_row_misses": cache.row_misses if cache else 0,
         "result_cache_hit": bool(result_cache_hit),
@@ -1119,6 +1231,13 @@ def _emit_search_done(
         "dp_memo_hits": helper.memo_hits,
         "dp_memo_misses": helper.memo_misses,
     }
+    if helper.joint is not None:
+        # joint strategy x comm-plan co-search: how often the candidate
+        # pricing SERVED a memoized plan vs paid the full
+        # choose_sync_schedule sweep (the ≥80% serve-rate acceptance
+        # gate reads exactly these)
+        stats["comm_plan_serves"] = helper.joint.serves
+        stats["comm_plan_searches"] = helper.joint.searches
     LAST_SEARCH_STATS.clear()
     LAST_SEARCH_STATS.update(stats)
     if not BUS.enabled:

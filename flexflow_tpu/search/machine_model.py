@@ -93,6 +93,13 @@ class CostModel:
     # cost, so the DP trades e.g. TP (no sync) against DP + compressed
     # sync with honest numbers (EQuARX, arXiv:2506.17615)
     sync_precision: str = "fp32"
+    # error feedback on int8 sync (FFConfig.sync_ef="auto"): int8
+    # choices upgrade to "int8_ef" — same wire, plus the residual
+    # add/store passes priced in _quant_overhead.  A fidelity POLICY,
+    # not a cost comparison: EF costs strictly more seconds than plain
+    # int8 and the currency cannot see the error it removes, so the
+    # upgrade is gated here instead of argmin'd
+    sync_ef: bool = False
 
     # ---- slice topology --------------------------------------------------
     def levels(self):
@@ -301,11 +308,18 @@ class CostModel:
     # int8+scales, read back ≈ 3 streaming passes over the buffer)
     QUANT_PASSES = 3.0
 
+    # extra HBM passes the error-feedback residual costs per collective:
+    # read the carried residual into the addend, write the new residual
+    # back — two streaming passes over the full local fp32 buffer
+    EF_PASSES = 2.0
+
     def _wire_scale(self, precision: Optional[str]) -> float:
-        """Wire bytes per fp32 byte under the sync precision."""
+        """Wire bytes per fp32 byte under the sync precision
+        (``int8_ef`` rides the identical int8 wire — EF changes what is
+        quantized, not the payload format)."""
         if precision == "bf16":
             return 0.5
-        if precision == "int8":
+        if precision in ("int8", "int8_ef"):
             return (1.0 + 4.0 / self.QUANT_CHUNK) / 4.0
         return 1.0
 
@@ -317,13 +331,18 @@ class CostModel:
         the mid requant (between reduce-scatter and all-gather) over
         the 1/n reduced shard.  bf16 conversion is the same streaming
         pattern at the same pass count (the VPU cast is free; the
-        traffic isn't)."""
+        traffic isn't).  ``int8_ef`` additionally pays the residual
+        read + write (EF_PASSES over the full buffer) — the honest
+        price of threading the error-feedback state."""
         if precision in (None, "fp32") or n <= 1:
             return 0.0
-        return (
+        t = (
             self.QUANT_PASSES * (nbytes + nbytes / n)
             / self.machine.hbm_bandwidth
         )
+        if precision == "int8_ef":
+            t += self.EF_PASSES * nbytes / self.machine.hbm_bandwidth
+        return t
 
     # ---- collectives -----------------------------------------------------
     def _crosses(self, n: int, spans_dcn: Optional[int]) -> int:
@@ -859,6 +878,16 @@ class CostModel:
             c = self.weight_sync_cost(op, mv, precision=p)
             if c < best[1]:
                 best = (p, c)
+        if best[0] == "int8" and self.sync_ef:
+            # EF upgrade (FFConfig.sync_ef="auto"): same int8 wire plus
+            # the residual passes, returned at its honest (slightly
+            # higher) price — chosen for fidelity the currency cannot
+            # see, never by the argmin above.  Unless the EF passes eat
+            # the whole compression win: fp32 is then both exact AND
+            # cheaper, so the upgrade falls back instead of picking a
+            # strictly dominated wire.
+            c_ef = self.weight_sync_cost(op, mv, precision="int8_ef")
+            best = ("int8_ef", c_ef) if c_ef < base else ("fp32", base)
         return best
 
     def sync_cost(self, op: Operator, mv: MachineView) -> float:

@@ -204,7 +204,13 @@ def enumerate_reduction_plans(
         return []
     precs = ["fp32"]
     if bucket_precision not in (None, "fp32"):
-        precs.append(bucket_precision)
+        from flexflow_tpu.search.sync_schedule import wire_base
+
+        # an int8_ef bucket's cross-slice stage runs the plain int8
+        # wire: EF compensates the flat ENTRY quantization; the staged
+        # exchange carries already-reduced shards the residual never
+        # sees (and the raw collective only knows SYNC_PRECISIONS)
+        precs.append(wire_base(bucket_precision))
     plans = []
     for cross in range(1, num_levels):
         for pc in precs:

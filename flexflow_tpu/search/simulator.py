@@ -99,7 +99,7 @@ class Simulator:
                  use_network_model: bool = True, calibration=None,
                  placement_overlap: bool = False, zero_dp_shard: bool = False,
                  inference: bool = False, sync_precision: str = "fp32",
-                 cost_cache=None):
+                 sync_ef: bool = False, cost_cache=None):
         self.machine = machine
         self.num_devices = num_devices or machine.num_devices
         # placement_overlap=True credits inter-op COMPUTE overlap for
@@ -134,7 +134,8 @@ class Simulator:
                               num_devices=self.num_devices,
                               zero_dp_shard=zero_dp_shard,
                               inference=inference,
-                              sync_precision=sync_precision)
+                              sync_precision=sync_precision,
+                              sync_ef=sync_ef)
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
         # propagate()/op_cost results per (op signature, view): structural
         # keys stay valid across graph copies and op lifetimes (an id()
@@ -186,6 +187,7 @@ class Simulator:
             zero_dp_shard=config.zero_dp_shard,
             inference=config.comp_mode == "inference",
             sync_precision=getattr(config, "sync_precision", "fp32"),
+            sync_ef=getattr(config, "sync_ef", "off") == "auto",
             **kw,
         )
         if sim.cost_cache is None:

@@ -46,6 +46,12 @@ _APPLIES = METRICS.counter("substitution.applies")
 _DELTA_SCANS = METRICS.counter("substitution.delta_match_calls")
 _DELTA_NODES = METRICS.counter("substitution.delta_match_nodes_scanned")
 _DELTA_SKIPPED = METRICS.counter("substitution.delta_match_nodes_skipped")
+# per-op-type seed index (ROADMAP PR 7 follow-up): matcher calls skipped
+# because the node's op type cannot anchor the pattern (search.perf
+# match_index_skips) — at thousand-node scale candidate generation is
+# the dominant per-pop cost, and most of it was matchers returning
+# False on the very first op-type check
+_INDEX_SKIPS = METRICS.counter("substitution.match_index_skips")
 
 # how many undirected hops around the changed-guid seed sets a rescan
 # covers.  Every built-in matcher reads only its node's edge lists plus
@@ -63,6 +69,27 @@ def _delta_check_enabled() -> bool:
 
 
 DELTA_MATCH_CHECK = _delta_check_enabled()
+
+
+def _op_type_index(graph: Graph):
+    """``(op type -> topo-ordered node list, guid -> topo position)``
+    for ``graph``, cached on the graph instance keyed by the identity
+    of its ``topo_order()`` list — any structural change invalidates
+    the topo cache (``Graph._invalidate``), so a fresh topo list means
+    a fresh index; COW clones start without the attribute and build
+    their own.  One O(nodes) sweep amortized over every anchor-typed
+    xfer's ``find_matches`` on this graph."""
+    topo = graph.topo_order()
+    cached = getattr(graph, "_op_type_index", None)
+    if cached is not None and cached[0] is topo:
+        return cached[1], cached[2]
+    idx: Dict[OperatorType, List[Node]] = {}
+    pos: Dict[int, int] = {}
+    for i, n in enumerate(topo):
+        idx.setdefault(n.op.op_type, []).append(n)
+        pos[n.guid] = i
+    graph._op_type_index = (topo, idx, pos)
+    return idx, pos
 
 
 def _mark(g: Graph, ins=(), outs=()) -> None:
@@ -109,15 +136,48 @@ def _finish_rewrite(parent: Graph, g: Optional[Graph],
 
 @dataclass
 class GraphXfer:
-    """A rewrite: match a node, produce a rewritten graph."""
+    """A rewrite: match a node, produce a rewritten graph.
+
+    ``anchor_types`` — the op types a match can ANCHOR on (the matcher
+    provably returns False for every other type, because its first
+    check is the type test).  When set, ``find_matches`` consults the
+    per-op-type seed index instead of calling the matcher on every
+    node: only nodes whose type can anchor the pattern are scanned,
+    the rest count into ``match_index_skips``.  ``None`` (rewrites
+    built outside this module, e.g. substitution_loader JSON rules
+    whose matcher shape is unknown) keeps the full scan.  Identity
+    with the unindexed scan is asserted under FLEXFLOW_TPU_DELTA_CHECK.
+    """
 
     name: str
     matcher: Callable[[Graph, Node], bool]
     apply_fn: Callable[[Graph, Node], Optional[Graph]]
+    anchor_types: Optional[frozenset] = None
 
     def find_matches(self, graph: Graph) -> List[Match]:
-        out = [n for n in graph.topo_order() if self.matcher(graph, n)]
         _SCANS.inc()
+        if self.anchor_types is None:
+            out = [n for n in graph.topo_order() if self.matcher(graph, n)]
+        else:
+            idx, pos = _op_type_index(graph)
+            cands: List[Node] = []
+            for t in self.anchor_types:
+                cands.extend(idx.get(t, ()))
+            if len(self.anchor_types) > 1:
+                # per-type lists are topo-ordered; a multi-type anchor
+                # set needs the merged topo order the full scan yields
+                cands.sort(key=lambda n: pos[n.guid])
+            _INDEX_SKIPS.inc(len(pos) - len(cands))
+            out = [n for n in cands if self.matcher(graph, n)]
+            if DELTA_MATCH_CHECK:
+                full = [n for n in graph.topo_order()
+                        if self.matcher(graph, n)]
+                assert [n.guid for n in out] == [n.guid for n in full], (
+                    f"indexed find_matches diverged from the full scan "
+                    f"for {self.name}: the declared anchor_types "
+                    f"{sorted(t.value for t in self.anchor_types)} do "
+                    f"not cover the matcher"
+                )
         if out:
             _MATCHES.inc(len(out))
         return out
@@ -162,9 +222,19 @@ class GraphXfer:
         hits = {
             g for g in parent_match_guids if g in nodes and g not in region
         }
+        anchors = self.anchor_types
+        idx_skips = 0
         for g in region:
+            # the seed index rule applies inside the dirty region too:
+            # a node whose type cannot anchor the pattern never matches
+            # (the DELTA_CHECK oracle below proves it per xfer)
+            if anchors is not None and nodes[g].op.op_type not in anchors:
+                idx_skips += 1
+                continue
             if self.matcher(graph, nodes[g]):
                 hits.add(g)
+        if idx_skips:
+            _INDEX_SKIPS.inc(idx_skips)
         out = [nodes[g] for g in sorted(hits, key=pos.__getitem__)]
         _DELTA_SCANS.inc()
         _DELTA_NODES.inc(len(region))
@@ -368,6 +438,7 @@ def make_partition_combine_xfer(
         name=f"partition_{op_type.value}_combine_d{degree}_dim{dim}",
         matcher=matcher,
         apply_fn=apply_fn,
+        anchor_types=frozenset({op_type}),
     )
 
 
@@ -405,6 +476,7 @@ def make_replicate_reduce_xfer(op_type: OperatorType, degree: int) -> GraphXfer:
         name=f"replicate_{op_type.value}_reduce_d{degree}",
         matcher=matcher,
         apply_fn=apply_fn,
+        anchor_types=frozenset({op_type}),
     )
 
 
@@ -437,7 +509,8 @@ def make_simplify_xfer() -> GraphXfer:
         return g
 
     return GraphXfer(
-        name="cancel_repartition_combine", matcher=matcher, apply_fn=apply_fn
+        name="cancel_repartition_combine", matcher=matcher, apply_fn=apply_fn,
+        anchor_types=frozenset({OperatorType.REPARTITION}),
     )
 
 
@@ -503,7 +576,8 @@ def make_linear_activation_fusion_xfer() -> GraphXfer:
         return g
 
     return GraphXfer(
-        name="fuse_linear_activation", matcher=matcher, apply_fn=apply_fn
+        name="fuse_linear_activation", matcher=matcher, apply_fn=apply_fn,
+        anchor_types=frozenset({OperatorType.LINEAR}),
     )
 
 
@@ -539,7 +613,8 @@ def make_parallel_chain_fusion_xfer() -> GraphXfer:
         return g
 
     return GraphXfer(
-        name="fuse_parallel_op_chain", matcher=matcher, apply_fn=apply_fn
+        name="fuse_parallel_op_chain", matcher=matcher, apply_fn=apply_fn,
+        anchor_types=frozenset(_SPLICEABLE),
     )
 
 
@@ -588,7 +663,8 @@ def make_combine_concat_sink_xfer() -> GraphXfer:
         )
 
     return GraphXfer(
-        name="sink_combine_through_concat", matcher=matcher, apply_fn=apply_fn
+        name="sink_combine_through_concat", matcher=matcher, apply_fn=apply_fn,
+        anchor_types=frozenset({OperatorType.CONCAT}),
     )
 
 
@@ -649,7 +725,8 @@ def make_unary_hoist_partition_xfer() -> GraphXfer:
         return g
 
     return GraphXfer(
-        name="hoist_partition_above_unary", matcher=matcher, apply_fn=apply_fn
+        name="hoist_partition_above_unary", matcher=matcher, apply_fn=apply_fn,
+        anchor_types=frozenset(_HOISTABLE_UNARY),
     )
 
 

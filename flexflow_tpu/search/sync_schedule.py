@@ -40,8 +40,19 @@ SCHEDULE_SCHEMA = 1
 
 # wire precisions a bucket may carry — mirrors comm.quantized
 # SYNC_PRECISIONS without importing jax (this module must stay loadable
-# by the stdlib-only lint path)
-BUCKET_PRECISIONS = ("fp32", "bf16", "int8")
+# by the stdlib-only lint path).  "int8_ef" is the error-feedback
+# variant of int8 (comm.quantized_allreduce_ef): identical wire format,
+# the device re-injects its local quantization error next step via a
+# residual carried as training-loop state (FFConfig.sync_ef)
+BUCKET_PRECISIONS = ("fp32", "bf16", "int8", "int8_ef")
+
+
+def wire_base(precision: Optional[str]) -> Optional[str]:
+    """The on-wire format of a bucket precision: ``int8_ef`` rides the
+    plain int8 wire (EF changes WHAT is quantized, not the payload) —
+    the normalization every consumer of the raw collective applies
+    (staged cross-slice stages, the execution dispatch, SHD133)."""
+    return "int8" if precision == "int8_ef" else precision
 
 # default coalescing floors swept by the search when FFConfig does not
 # pin one (sync_bucket_bytes): fused-bucket fp32 payload bytes below
@@ -265,6 +276,14 @@ def choose_sync_schedule(
     multi_level = len(sim.cost.levels()) > 1
     if not synced or (len(synced) < 2 and not multi_level):
         return None, info  # nothing to order, coalesce, or stage
+    names = [node.op.name for node, _mv, _p in synced]
+    if len(names) != len(set(names)):
+        # stamped production graphs (PR 7 segment stamping) can repeat
+        # op names; buckets are keyed by name, so a schedule cannot
+        # address such groups individually — the monolithic status quo
+        # stands (SHD121's exact-once coverage would reject any
+        # schedule built here)
+        return None, info
     pmap = dict(precision_map or {})
     mono = build_bucketed_schedule(synced, pmap, math.inf)
     base = sim.simulate(graph, strategy, sync_schedule=mono)

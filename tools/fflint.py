@@ -46,6 +46,7 @@ sys.path.insert(
 META_KEY = "__meta__"  # mirrors search/strategy_io.py (stdlib path)
 CACHE_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.SCHEMA_VERSION
 DP_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.DP_SCHEMA
+COMM_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.COMM_SCHEMA
 
 
 def _load_json(path: str):
@@ -85,6 +86,10 @@ def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
             "matches its target graph (re-export with this tree)"))
     if isinstance(meta, dict) and "sync_schedule" in meta:
         out += _lint_sync_schedule_meta(meta["sync_schedule"])
+    if isinstance(meta, dict) and "zero_groups" in meta:
+        out += _lint_zero_groups_meta(
+            meta["zero_groups"],
+            {k for k in data if k != META_KEY})
     views = {k: v for k, v in data.items() if k != META_KEY}
     if not views:
         out.append(("error", "STR202", "file names no ops at all"))
@@ -111,7 +116,38 @@ def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
 
 
 _SCHEDULE_SCHEMA = 1  # mirrors search/sync_schedule.SCHEDULE_SCHEMA
-_BUCKET_PRECISIONS = ("fp32", "bf16", "int8")
+_BUCKET_PRECISIONS = ("fp32", "bf16", "int8", "int8_ef")
+
+
+def _lint_zero_groups_meta(zg, op_names) -> List[Tuple[str, str, str]]:
+    """STR207: structural lint of a persisted ``__meta__.zero_groups``
+    map (the co-searched per-group optimizer-state sharding,
+    search/comm_plan.py).  Graph-side legality (the op actually syncs,
+    the shard factor is achievable — SHD140/141) needs the graph and
+    runs at import/compile time; this proves what the file alone can:
+    a list of unique op names the file itself covers."""
+    out: List[Tuple[str, str, str]] = []
+    if not isinstance(zg, list):
+        return [("error", "STR207", "zero_groups is not a list")]
+    if not zg:
+        out.append(("error", "STR207",
+                    "zero_groups is empty — an empty map is persisted "
+                    "as ABSENT, so an empty list is a writer bug"))
+    seen = set()
+    for i, name in enumerate(zg):
+        if not isinstance(name, str) or not name:
+            out.append(("error", "STR207",
+                        f"zero_groups[{i}] is not an op name: {name!r}"))
+            continue
+        if name in seen:
+            out.append(("error", "STR207",
+                        f"zero_groups[{i}] duplicates {name!r}"))
+        seen.add(name)
+        if name not in op_names:
+            out.append(("error", "STR207",
+                        f"zero_groups[{i}] names op {name!r} the "
+                        f"strategy file does not cover"))
+    return out
 
 
 def _lint_sync_schedule_meta(sched) -> List[Tuple[str, str, str]]:
@@ -265,6 +301,7 @@ def lint_cache_file(path: str) -> List[Tuple[str, str, str]]:
     if os.path.exists(sidecar) and os.path.getsize(sidecar) == 0:
         out.append(("error", "CCH404", f"empty results sidecar {sidecar}"))
     out += _lint_dp_rows(data)
+    out += _lint_comm_plans(data)
     return out
 
 
@@ -320,6 +357,69 @@ def _lint_dp_rows(data) -> List[Tuple[str, str, str]]:
                 out.append(("error", "CCH406",
                             f"{where}: strategy[{j}] malformed: "
                             f"{str(entry)[:100]}"))
+    return out
+
+
+def _lint_comm_plans(data) -> List[Tuple[str, str, str]]:
+    """CCH407/408: the persisted comm-plan memo layer
+    (search/cost_cache.py ``comm_plans`` — the co-search's chosen sync
+    schedules/precision maps/zero choices per synced-group signature,
+    search/comm_plan.py).  An unknown ``comm_schema`` is a DISTINCT
+    error (CCH407): the loader drops the layer loudly rather than
+    serving plans written under another layout; malformed rows are
+    CCH408 (the in-process reader treats them as a miss — one
+    re-search, never a wrong plan)."""
+    cp = data.get("comm_plans")
+    if cp is None:
+        return []
+    out: List[Tuple[str, str, str]] = []
+    if data.get("comm_schema") not in COMM_SCHEMA_VERSIONS:
+        out.append(("error", "CCH407",
+                    f"comm_plans present but comm_schema "
+                    f"{data.get('comm_schema')!r} unknown (known: "
+                    f"{list(COMM_SCHEMA_VERSIONS)}) — the loader will "
+                    f"drop the whole comm-plan layer"))
+    if not isinstance(cp, dict):
+        return out + [("error", "CCH408", "comm_plans is not an object")]
+    for key, row in sorted(cp.items()):
+        where = f"comm_plans[{key[:32]}...]" if len(key) > 32 else \
+            f"comm_plans[{key}]"
+        if (not isinstance(key, str) or len(key) != 24
+                or any(c not in "0123456789abcdef" for c in key)):
+            out.append(("error", "CCH408",
+                        f"{where}: malformed key (expect a 24-hex-char "
+                        f"signature digest)"))
+        if not isinstance(row, dict):
+            out.append(("error", "CCH408",
+                        f"{where}: row is not an object"))
+            continue
+        sched = row.get("schedule")
+        if not isinstance(sched, dict):
+            out.append(("error", "CCH408", f"{where}: no schedule"))
+        else:
+            for sev, _code, msg in _lint_sync_schedule_meta(sched):
+                out.append((sev, "CCH408", f"{where}: {msg}"))
+        if not isinstance(row.get("adopted"), bool):
+            out.append(("error", "CCH408",
+                        f"{where}: malformed adopted "
+                        f"{row.get('adopted')!r}"))
+        pmap = row.get("pmap", {})
+        if (not isinstance(pmap, dict)
+                or any(not isinstance(k, str) or v not in
+                       _BUCKET_PRECISIONS for k, v in pmap.items())):
+            out.append(("error", "CCH408",
+                        f"{where}: malformed pmap {str(pmap)[:80]}"))
+        zero = row.get("zero", [])
+        if (not isinstance(zero, list)
+                or any(not isinstance(z, str) or not z for z in zero)):
+            out.append(("error", "CCH408",
+                        f"{where}: malformed zero list "
+                        f"{str(zero)[:80]}"))
+        credit = row.get("credit", 0.0)
+        if (not isinstance(credit, (int, float))
+                or not math.isfinite(credit) or credit < 0):
+            out.append(("error", "CCH408",
+                        f"{where}: malformed credit {credit!r}"))
     return out
 
 

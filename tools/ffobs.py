@@ -212,6 +212,44 @@ def render_report(events: List[dict], top: int = 10,
                 f"{p.get('match_full_scans', 0)} full scans; "
                 f"{scanned} nodes rescanned, {skipped} served from the "
                 f"parent ({skipped / denom:.0%} of match work skipped)")
+        mi = p.get("match_index_skips", 0)
+        if mi:
+            lines.append(
+                f"Match seed index: {mi} matcher calls skipped (node op "
+                f"type cannot anchor the pattern)")
+        cps = p.get("comm_plan_serves")
+        cpr = p.get("comm_plan_searches")
+        if cps is not None:
+            total = max(1, (cps or 0) + (cpr or 0))
+            lines.append(
+                f"Co-search comm plans: {cps} served from the "
+                f"signature memo / {cpr} re-searched "
+                f"({(cps or 0) / total:.0%} serve rate) — every "
+                f"candidate priced with its best sync "
+                f"schedule/precision/zero plan")
+    # per-candidate comm-plan decision lines (search.comm_plan events):
+    # one roll-up by source so a chatty search stays one line each
+    plans = [e for e in events if e.get("kind") == "search.comm_plan"]
+    if plans:
+        from collections import Counter as _Counter
+
+        by_src = _Counter(e.get("source", "?") for e in plans)
+        adopted = sum(1 for e in plans
+                      if not e.get("served") and e.get("adopted"))
+        lines.append(
+            f"Comm-plan decisions: "
+            + ", ".join(f"{src} x{n}" for src, n in by_src.most_common())
+            + (f"; {adopted} fresh searches adopted bucketing"
+               if adopted else ""))
+    zg = [e for e in events if e.get("kind") == "search.zero_groups"]
+    if zg and zg[-1].get("groups"):
+        z = zg[-1]
+        lines.append(
+            f"Optimizer-state sharding (ZeRO-1, per-group): "
+            f"{len(z['groups'])} group(s) "
+            f"[{', '.join(z['groups'][:6])}"
+            + ("…" if len(z["groups"]) > 6 else "")
+            + f"] — credited {_ms(z.get('credit_s'))} ms/iter update win")
     lines.append("")
 
     # ---- strategy table ---------------------------------------------------

@@ -8,16 +8,23 @@ Three passes, one finding vocabulary (``findings.py``):
 1. ``invariants``  — graph well-formedness after every rewrite
    (``PCG0xx``), armed by ``FLEXFLOW_TPU_VERIFY=1`` / ``--verify``.
 2. ``equivalence`` — executable numeric proofs for the substitution
-   registry (``EQV3xx``).
+   registry (``EQV3xx``); ``proofgen`` generates the proof graphs
+   from each rewrite's own matcher contract (EQV305 closed by
+   construction for factory xfers, EQV306 reports unproven rules).
 3. ``sharding``    — strategy/MachineView legality + search/lowering
    coherence (``SHD1xx``), the always-on gate in ``optimize_strategy``.
+4. ``placement``   — pipeline stage cuts and ``start_part`` device
+   blocks (``SHD150``-``SHD155``), the always-on gate on every
+   pipeline/placement proposal the search returns, persists or
+   imports.
 
 ``tools/fflint.py`` exposes all of it as a CI-friendly CLI; findings
 also flow through the obs event bus as ``analysis.finding`` events.
 
-``equivalence`` is intentionally NOT imported here: it imports the
-substitution machinery, which itself imports ``invariants`` — load it
-explicitly (``from flexflow_tpu.analysis.equivalence import …``).
+``equivalence`` and ``proofgen`` are intentionally NOT imported here:
+they import the substitution machinery, which itself imports
+``invariants`` — load them explicitly
+(``from flexflow_tpu.analysis.equivalence import …``).
 """
 
 from flexflow_tpu.analysis.findings import (
@@ -34,6 +41,11 @@ from flexflow_tpu.analysis.invariants import (
     scoped_verify,
     set_verify,
     verification_enabled,
+)
+from flexflow_tpu.analysis.placement import (
+    lint_pipeline_stages,
+    lint_placement,
+    placement_meta,
 )
 from flexflow_tpu.analysis.sharding import (
     lint_reduction_plan,
@@ -54,8 +66,11 @@ __all__ = [
     "scoped_verify",
     "set_verify",
     "verification_enabled",
+    "lint_pipeline_stages",
+    "lint_placement",
     "lint_reduction_plan",
     "lint_strategy",
     "lint_sync_schedule",
     "lint_zero_map",
+    "placement_meta",
 ]

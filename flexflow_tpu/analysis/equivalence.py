@@ -25,8 +25,14 @@ Weight correspondence across a rewrite ("the bridge"):
 Finding codes: EQV300 apply declined a reported match, EQV301 value
 mismatch, EQV302 evaluation failure, EQV303 unbridgeable weights,
 EQV305 a registered rewrite matched no proof graph (coverage hole).
-Invariant findings (PCG0xx) from the rewritten graph are passed
-through — an unsound splice usually fails well-formedness first.
+``analysis/proofgen.py`` extends the range: proof graphs GENERATED
+from each rewrite's own ``anchor_types`` close the EQV305 hole class
+for factory xfers by construction, and EQV306 explicitly reports
+rules (JSON ``substitution_loader`` patterns) the generator cannot
+prove.  The hand-curated ``_proof_graphs`` zoo below stays as the
+regression anchor.  Invariant findings (PCG0xx) from the rewritten
+graph are passed through — an unsound splice usually fails
+well-formedness first.
 """
 
 from __future__ import annotations
@@ -249,7 +255,12 @@ def verify_rewrite(graph, xfer, match, seed: int = 0,
                     f"{name}: output {i} of {node.op.name!r} changed "
                     f"shape {a.shape} -> {b.shape}",
                     node=guid, op=node.op.name))
-            elif np.issubdtype(a.dtype, np.floating):
+            elif not np.issubdtype(a.dtype, np.integer) \
+                    and a.dtype != np.bool_:
+                # float path.  NOT spelled issubdtype(floating): the
+                # bfloat16 proof lane's extension dtype is no numpy
+                # float subtype, and exact-equality on it would reject
+                # legal summation-order changes
                 if not np.allclose(a.astype(np.float64),
                                    b.astype(np.float64),
                                    rtol=rtol, atol=atol):

@@ -78,6 +78,25 @@ def _cut(graph: Graph, strategy: Dict[int, MachineView]):
     return in_a, in_b, crossing, back
 
 
+# the SAME structural cut the constructor and placeable() compute —
+# shared with the placement legality lint (analysis/placement.py,
+# SHD153-155) so "what the lint checks" and "what the executor runs"
+# cannot drift apart
+placement_cut = _cut
+
+
+def placement_block_widths(in_a, in_b, strategy) -> Tuple[int, int]:
+    """(block A width, block B width) — the submesh size each segment
+    compiles over (max view parts per side).  ONE rule shared by the
+    constructor, the legality lint and the persisted ``__meta__``
+    frame, same anti-drift discipline as ``placement_cut``."""
+    n_a = max((strategy[n.guid].num_parts for n in in_a
+               if strategy.get(n.guid) is not None), default=1)
+    n_b = max((strategy[n.guid].num_parts for n in in_b
+               if strategy.get(n.guid) is not None), default=1)
+    return n_a, n_b
+
+
 MAX_CROSSING_TENSORS = 16
 
 
@@ -215,16 +234,7 @@ class PlacedCompiledModel:
             for n in in_b if strategy.get(n.guid) is not None
         }
         devices = jax.devices()[: config.num_devices]
-        n_a = max(
-            (strategy[n.guid].num_parts for n in in_a
-             if strategy.get(n.guid) is not None),
-            default=1,
-        )
-        n_b = max(
-            (strategy[n.guid].num_parts for n in in_b
-             if strategy.get(n.guid) is not None),
-            default=1,
-        )
+        n_a, n_b = placement_block_widths(in_a, in_b, strategy)
         if start_b < n_a or start_b + n_b > len(devices):
             raise ValueError(
                 f"device blocks overlap or overflow: A needs {n_a} from 0, "

@@ -513,6 +513,132 @@ class FFModel:
                 _imeta = read_meta(self.config.import_strategy_file)
                 imported_sync_schedule = _imeta.get("sync_schedule")
                 imported_zero_groups = _imeta.get("zero_groups")
+                # pipeline/placement proposal provenance rides the same
+                # digest gate — re-lint against THIS graph/strategy so
+                # a hand-edited proposal block fails with a finding at
+                # import, not inside the placed/staged lowering
+                # (analysis/placement.py SHD150-155)
+                if _imeta.get("placement") is not None:
+                    from flexflow_tpu.analysis import (
+                        lint_placement,
+                        placement_meta,
+                    )
+
+                    bad = errors_only(lint_placement(
+                        self.graph, strategy, self.config))
+                    if not bad and placement_meta(
+                            self.graph, strategy, self.config
+                    ) != _imeta["placement"]:
+                        from flexflow_tpu.analysis import Finding
+
+                        bad = [Finding(
+                            code="SHD153", pass_name="placement",
+                            message=(
+                                "imported __meta__.placement block frame "
+                                "disagrees with the device blocks the "
+                                "strategy's start_part views actually "
+                                "form"))]
+                    if bad:
+                        emit_findings(bad)
+                        raise AnalysisError(
+                            "imported placement proposal is illegal for "
+                            "this graph/strategy", bad)
+                if _imeta.get("pipeline") is not None:
+                    from flexflow_tpu.analysis import (
+                        Finding,
+                        lint_pipeline_stages,
+                    )
+
+                    _pmeta = _imeta["pipeline"]
+                    bad = []
+                    stage_guids = None
+                    _ns = _nm = 0
+                    # a hand-edited meta block may carry ANY JSON type:
+                    # malformed shapes must become findings, never a
+                    # bare TypeError out of the gate itself
+                    if not isinstance(_pmeta, dict):
+                        bad = [Finding(
+                            code="SHD150", pass_name="placement",
+                            message="imported __meta__.pipeline is not "
+                                    "an object")]
+                    else:
+                        _ns = _pmeta.get("num_stages", 0)
+                        _nm = _pmeta.get("num_microbatches", 0)
+                        _stages = _pmeta.get("stages")
+                        if (not isinstance(_ns, int)
+                                or not isinstance(_nm, int)
+                                or isinstance(_ns, bool)
+                                or isinstance(_nm, bool)):
+                            bad = [Finding(
+                                code="SHD150", pass_name="placement",
+                                message=(
+                                    f"imported __meta__.pipeline has "
+                                    f"non-integer num_stages/"
+                                    f"num_microbatches ({_ns!r}, "
+                                    f"{_nm!r})"))]
+                        elif _stages is not None and not (
+                                isinstance(_stages, list)
+                                and all(isinstance(s, list)
+                                        and all(isinstance(op, str)
+                                                for op in s)
+                                        for s in _stages)):
+                            bad = [Finding(
+                                code="SHD150", pass_name="placement",
+                                message=(
+                                    "imported __meta__.pipeline stages "
+                                    "is not a list of op-name lists"))]
+                        elif _stages is not None:
+                            by_name = {n.op.name: n.guid
+                                       for n in self.graph.topo_order()}
+                            stage_guids = [
+                                [by_name.get(op, -1) for op in stage]
+                                for stage in _stages
+                            ]
+                    if not bad:
+                        bad = errors_only(lint_pipeline_stages(
+                            self.graph, stage_guids, _ns, _nm,
+                            self.config))
+                    if bad:
+                        emit_findings(bad)
+                        raise AnalysisError(
+                            "imported pipeline proposal is illegal for "
+                            "this graph/strategy", bad)
+                    # the validated proposal is ADOPTED, not just
+                    # checked: an export whose compile ran the staged
+                    # executor must round-trip to the staged executor
+                    # (an import that re-lints but silently lowers
+                    # flat would defeat the proposal it validated —
+                    # e.g. the HBM-infeasible regime staged pipelining
+                    # exists for)
+                    if stage_guids is not None:
+                        from flexflow_tpu.search.pipeline_search import (
+                            StagedPipelineProposal,
+                        )
+
+                        self.pipeline_proposal = StagedPipelineProposal(
+                            num_stages=_ns, num_microbatches=_nm,
+                            stage_guids=stage_guids,
+                            cost=float("nan"),  # not re-simulated here
+                            executable=False,
+                        )
+                    elif pipeline is None:
+                        # S x M without explicit stages = the
+                        # stacked-block shape; adopt it exactly as if
+                        # the user had passed compile(pipeline=...)
+                        from flexflow_tpu.parallel.pipeline import (
+                            PipelineConfig,
+                        )
+
+                        if self.config.zero_dp_shard:
+                            # the early compile(pipeline=) guard has
+                            # already run by this point — re-raise its
+                            # contract rather than silently leaving
+                            # optimizer state replicated
+                            raise NotImplementedError(
+                                "zero_dp_shard is not supported with "
+                                "an imported pipeline proposal")
+                        pipeline = PipelineConfig(
+                            num_stages=_ns, num_microbatches=_nm)
             elif self.config.only_data_parallel:
                 strategy = data_parallel_strategy(self.graph, self.config.num_devices)
             else:
@@ -830,6 +956,23 @@ class FFModel:
                         total_s=bd.get("total_s"))
             except Exception:  # telemetry must never fail a compile
                 self.predicted_breakdown = None
+        _placed_lint_cache: list = []
+
+        def _placed_lint_errors():
+            """Error findings of the placed-cut legality lint for the
+            strategy about to lower — computed ONCE per compile (the
+            per-segment sub-lints rebuild block subgraphs) and shared
+            by the export decision and the placed-lowering gate."""
+            if not _placed_lint_cache:
+                from flexflow_tpu.analysis import (
+                    errors_only,
+                    lint_placement,
+                )
+
+                _placed_lint_cache.append(errors_only(lint_placement(
+                    self.graph, strategy, self.config)))
+            return _placed_lint_cache[0]
+
         if self.config.export_strategy_file:
             from flexflow_tpu.search.strategy_io import export_strategy
 
@@ -844,6 +987,47 @@ class FFModel:
                 # the co-searched per-group optimizer-sharding map
                 # rides the same digest gate (fflint checks it, STR207)
                 _meta["zero_groups"] = sorted(self.zero_groups)
+            # pipeline/placement proposals persist NEXT to the strategy
+            # behind the same digest gate (the lint already gated them
+            # at proposal time; fflint strategy re-checks the frame
+            # stdlib-only, STR208)
+            from flexflow_tpu.analysis import placement_meta as _pmeta_fn
+            from flexflow_tpu.compiler.placement_lowering import (
+                placeable as _placeable,
+            )
+
+            # only a cut the placed executor will actually run is a
+            # placement proposal: the lowering decision below requires
+            # pipeline/mesh unset AND placeable, and the frame must
+            # pass the same legality gate the placed branch enforces —
+            # a compile that will fail that gate (or run flat under
+            # mesh=) must not leave a placement artifact on disk.
+            # Inert multi-block strategies (the historical
+            # flat-lowering fallback) persist no meta either.
+            _pl = (
+                _pmeta_fn(self.graph, strategy, self.config)
+                if (strategy and pipeline is None and mesh is None
+                    and _placeable(self.graph, strategy, self.config)
+                    and not _placed_lint_errors())
+                else None
+            )
+            if _pl is not None:
+                _meta["placement"] = _pl
+            if self.pipeline_proposal is not None:
+                _pp = self.pipeline_proposal
+                _meta["pipeline"] = {
+                    "num_stages": _pp.num_stages,
+                    "num_microbatches": _pp.num_microbatches,
+                    "stages": [
+                        [self.graph.nodes[g].op.name for g in stage]
+                        for stage in _pp.stage_guids
+                    ],
+                }
+            elif pipeline is not None:
+                _meta["pipeline"] = {
+                    "num_stages": pipeline.num_stages,
+                    "num_microbatches": pipeline.num_microbatches,
+                }
             export_strategy(
                 self.config.export_strategy_file, self.graph, strategy,
                 meta=_meta or None,
@@ -880,6 +1064,22 @@ class FFModel:
                 PlacedCompiledModel,
             )
 
+            # always-on legality gate on the cut about to execute
+            # (search proposals were gated at proposal time; this also
+            # covers caller-supplied placed strategies with findings
+            # instead of opaque lowering errors).  Shares the export
+            # path's one-shot lint cache.
+            from flexflow_tpu.analysis import (
+                AnalysisError,
+                emit_findings,
+            )
+
+            _bad = _placed_lint_errors()
+            if _bad:
+                emit_findings(_bad)
+                raise AnalysisError(
+                    "placed strategy is illegal for this graph/mesh",
+                    _bad)
             self.compiled = PlacedCompiledModel(
                 self.graph,
                 strategy,

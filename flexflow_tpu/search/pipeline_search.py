@@ -233,6 +233,8 @@ def propose_pipeline(graph: Graph, config, sim, baseline_cost: float):
     margin = max(0.0, config.search_improvement_margin)
     if not math.isfinite(baseline_cost) or (
             best[1] < baseline_cost * (1.0 - margin)):
+        _gate_pipeline_proposal(
+            graph, config, best[0].num_stages, best[0].num_microbatches)
         from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
         log.log(
@@ -243,6 +245,52 @@ def propose_pipeline(graph: Graph, config, sim, baseline_cost: float):
         )
         return best[0]
     return None
+
+
+def stacked_stage_guids(graph: Graph, stages: int) -> Optional[List[List[int]]]:
+    """The explicit stage partition a stacked-block PipelineConfig
+    implies: blocks grouped ``len(blocks)/S`` per stage, prologue in
+    stage 0, epilogue in the last — the cut the scan lowering will run,
+    materialized so the legality lint (SHD150-152) can check it."""
+    got = _applicable(graph, stages)
+    if got is None:
+        return None
+    blocks, prologue, epilogue, _entry = got
+    per = len(blocks) // stages
+    out: List[List[int]] = []
+    for si in range(stages):
+        stage = [n.guid for n in prologue] if si == 0 else []
+        for blk in blocks[si * per:(si + 1) * per]:
+            stage += [n.guid for n in blk]
+        if si == stages - 1:
+            stage += [n.guid for n in epilogue]
+        out.append(stage)
+    return out
+
+
+def _gate_pipeline_proposal(graph: Graph, config, stages: int,
+                            microbatches: int,
+                            stage_guids: Optional[List[List[int]]] = None,
+                            ) -> None:
+    """Always-on legality gate on every pipeline proposal the search
+    returns (analysis/placement.py SHD150-152) — the same discipline
+    optimize_strategy applies to flat strategies.  A failure is a
+    SEARCH bug: fail loudly at the proposal, not in the lowering."""
+    from flexflow_tpu.analysis import (
+        AnalysisError,
+        emit_findings,
+        errors_only,
+        lint_pipeline_stages,
+    )
+
+    if stage_guids is None:
+        stage_guids = stacked_stage_guids(graph, stages)
+    bad = errors_only(lint_pipeline_stages(
+        graph, stage_guids, stages, microbatches, config))
+    if bad:
+        emit_findings(bad)
+        raise AnalysisError(
+            "pipeline search produced an illegal stage partition", bad)
 
 
 def _balanced_intervals(costs: List[float], stages: int) -> List[int]:
@@ -406,4 +454,7 @@ def propose_pipeline_general(graph: Graph, config, sim,
     if math.isfinite(baseline_cost) and (
             best.cost >= baseline_cost * (1.0 - margin)):
         return None
+    _gate_pipeline_proposal(
+        graph, config, best.num_stages, best.num_microbatches,
+        stage_guids=best.stage_guids)
     return best

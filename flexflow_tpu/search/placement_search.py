@@ -184,6 +184,23 @@ def propose_placement(graph: Graph, config, flat_cost: float,
     strategy = best[1]
     if not placeable(graph, strategy, config):
         return None
+    # always-on legality gate (analysis/placement.py, SHD153-155 +
+    # per-segment SHD101-110) — the same discipline optimize_strategy
+    # applies to flat results: a proposal that fails is a SEARCH bug
+    # and must fail loudly here, not inside XLA or, worse, never
+    from flexflow_tpu.analysis import (
+        AnalysisError,
+        emit_findings,
+        errors_only,
+        lint_placement,
+    )
+
+    bad = errors_only(lint_placement(graph, strategy, config))
+    if bad:
+        emit_findings(bad)
+        raise AnalysisError(
+            "placement search produced an illegal 2-block placed "
+            "strategy", bad)
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     log.log(

@@ -780,12 +780,18 @@ class BatchEmbeddingsXfer:
     GraphXfer (find_matches/apply)."""
 
     name = "batch_parallel_embeddings"
+    # same contract as GraphXfer.anchor_types: the scan below provably
+    # only reads EMBEDDING nodes, so the per-op-type seed index serves
+    # it (and analysis/proofgen synthesizes its proof graphs from it)
+    anchor_types = frozenset({OperatorType.EMBEDDING})
 
     def find_matches(self, graph: Graph) -> List[Dict[int, int]]:
+        idx, pos = _op_type_index(graph)
+        embeds = idx.get(OperatorType.EMBEDDING, [])
+        _INDEX_SKIPS.inc(len(pos) - len(embeds))
         groups: Dict[Tuple, List[int]] = {}
-        for n in graph.topo_order():
-            if n.op.op_type is OperatorType.EMBEDDING:
-                groups.setdefault(n.op.signature(), []).append(n.guid)
+        for n in embeds:  # per-type lists are topo-ordered — identical
+            groups.setdefault(n.op.signature(), []).append(n.guid)
         return [
             {i: g for i, g in enumerate(gs)}
             for gs in groups.values()

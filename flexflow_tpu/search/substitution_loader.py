@@ -161,21 +161,58 @@ def _logical_dim(pm_dim: int, ndim: int) -> int:
 @dataclass
 class PatternRule:
     """A loaded rule, usable as a GraphXfer (same find_matches/apply
-    duck type as search.substitution.GraphXfer)."""
+    duck type as search.substitution.GraphXfer).
+
+    ``anchor_types`` follows the GraphXfer contract (ROADMAP PR 8's
+    per-op-type seed index): the rule's ROOT pattern op — matched
+    first by the backtracking engine — can only bind nodes of its own
+    declared type, so ``find_matches`` consults the per-op-type index
+    for every pattern position instead of sweeping ``graph.nodes``
+    per position.  Identity with the unindexed full scan (as a match
+    SET — the index enumerates candidates in topo order, the full
+    scan in node-dict order) is asserted under
+    ``FLEXFLOW_TPU_DELTA_CHECK``."""
 
     name: str
     src_ops: List[PatternOp]
     dst_ops: List[PatternOp]
     mapped_outputs: List[Tuple[int, int, int, int]]  # (srcOp, srcTs, dstOp, dstTs)
+    anchor_types: Optional[frozenset] = None
 
     # -- matching ----------------------------------------------------------
     def find_matches(self, graph: Graph) -> List[Dict[int, int]]:
         """All bindings {pattern_op_index: node_guid}."""
+        from flexflow_tpu.search.substitution import (
+            DELTA_MATCH_CHECK,
+            _INDEX_SKIPS,
+            _op_type_index,
+        )
+
         matches: List[Dict[int, int]] = []
-        self._extend(graph, {}, {}, 0, matches, limit=16)
+        if self.anchor_types is None:
+            self._extend(graph, {}, {}, 0, matches, limit=16)
+            return matches
+        idx, pos = _op_type_index(graph)
+        root = self.src_ops[0].type
+        _INDEX_SKIPS.inc(len(pos) - len(idx.get(root, ())))
+        self._extend(graph, {}, {}, 0, matches, limit=16, index=idx)
+        if DELTA_MATCH_CHECK:
+            full: List[Dict[int, int]] = []
+            self._extend(graph, {}, {}, 0, full, limit=16)
+            if len(matches) < 16 and len(full) < 16:
+                # un-truncated scans must find the same binding SET;
+                # at the limit the two enumeration orders may keep
+                # different 16, which is not a divergence
+                a = sorted(tuple(sorted(m.items())) for m in matches)
+                b = sorted(tuple(sorted(m.items())) for m in full)
+                assert a == b, (
+                    f"indexed find_matches diverged from the full scan "
+                    f"for {self.name}: the root pattern type "
+                    f"{root.value!r} does not cover the matcher")
         return matches
 
-    def _extend(self, graph, binding, ext_inputs, i, out, limit):
+    def _extend(self, graph, binding, ext_inputs, i, out, limit,
+                index=None):
         if len(out) >= limit:
             return
         if i == len(self.src_ops):
@@ -183,7 +220,13 @@ class PatternRule:
                 out.append(dict(binding))
             return
         pat = self.src_ops[i]
-        for guid, node in graph.nodes.items():
+        if index is None:
+            cands = graph.nodes.items()
+        else:
+            # per-type topo-ordered candidates: every non-pat.type node
+            # fails the type test below anyway — skip the sweep
+            cands = ((n.guid, n) for n in index.get(pat.type, ()))
+        for guid, node in cands:
             if guid in binding.values():
                 continue
             if node.op.op_type is not pat.type:
@@ -228,7 +271,8 @@ class PatternRule:
             if not ok:
                 continue
             binding[i] = guid
-            self._extend(graph, binding, new_ext, i + 1, out, limit)
+            self._extend(graph, binding, new_ext, i + 1, out, limit,
+                         index=index)
             del binding[i]
 
     def _node_params_ok(self, node: Node, pat: PatternOp) -> bool:
@@ -607,6 +651,11 @@ def _parse_rule(r: dict) -> Optional[PatternRule]:
         src_ops=src,
         dst_ops=dst,
         mapped_outputs=mapped,
+        # the root pattern op is matched FIRST by the backtracking
+        # engine, so its type is a sound anchor: no match can exist in
+        # a graph with no node of this type (per-op-type seed index;
+        # identity asserted under FLEXFLOW_TPU_DELTA_CHECK)
+        anchor_types=frozenset({src[0].type}),
     )
 
 

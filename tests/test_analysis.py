@@ -810,3 +810,584 @@ def test_compile_verify_knob_runs_checker():
     xd = np.random.default_rng(0).normal(size=(16, 16)).astype(np.float32)
     y = np.zeros(16, dtype=np.int32)
     m.fit(x=xd, y=y, verbose=False)
+
+
+# ---------------------------------------------------------------------------
+# generative equivalence proofs (ISSUE 9 tentpole): proof graphs derived
+# from the rewrite matchers themselves (analysis/proofgen.py)
+
+
+def test_registry_generated_proof_zero_eqv305():
+    """Every factory xfer anchors on GENERATED graphs and passes the
+    numeric proof there — the EQV305 coverage-hole class is closed by
+    construction, per dtype lane."""
+    from flexflow_tpu.analysis.proofgen import verify_registry_generated
+
+    findings, stats = verify_registry_generated(num_devices=8, seed=0)
+    assert findings == [], [str(f) for f in findings]
+    assert stats["unproven"] == 0
+    # every float-family xfer proven on BOTH dtype lanes, embeddings
+    # on the int32 lane (ids are integer by construction)
+    assert stats["lanes"]["float32"] == stats["lanes"]["bfloat16"] > 0
+    assert stats["lanes"]["int32"] > 0
+    assert stats["graphs_generated"] > 0
+
+
+def test_proofgen_generation_is_deterministic():
+    from flexflow_tpu.analysis.proofgen import synthesize_anchor_graphs
+    from flexflow_tpu.core.optype import OperatorType
+
+    def sig(graphs):
+        return [
+            (lane, mult, pv, tuple(
+                (n.op.op_type.value, tuple(n.op.output_shapes[0].sizes))
+                for n in g.topo_order()))
+            for lane, mult, pv, g in graphs
+        ]
+
+    for t in (OperatorType.LINEAR, OperatorType.EMBEDDING,
+              OperatorType.REPARTITION):
+        a = synthesize_anchor_graphs(t, 8, seed=3)
+        b = synthesize_anchor_graphs(t, 8, seed=3)
+        assert a and sig(a) == sig(b)
+
+
+def test_proofgen_factory_hole_is_eqv305():
+    """A factory GraphXfer whose anchor type has no motif family (or
+    whose matcher anchors nowhere) is a LOUD coverage hole."""
+    from flexflow_tpu.analysis.proofgen import verify_registry_generated
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.substitution import GraphXfer
+
+    bogus = GraphXfer(
+        name="bogus_bmm_xfer",
+        matcher=lambda g, n: False,
+        apply_fn=lambda g, n: None,
+        anchor_types=frozenset({OperatorType.BATCH_MATMUL}),
+    )
+    findings, stats = verify_registry_generated(num_devices=8, xfers=[bogus])
+    assert codes(findings) == {"EQV305"}
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_proofgen_unproven_json_rule_is_eqv306():
+    """A multi-node JSON pattern outside the synthesizer's motif
+    families is explicitly reported (EQV306, warn) instead of silently
+    un-proven."""
+    from flexflow_tpu.analysis.proofgen import verify_registry_generated
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search.substitution_loader import (
+        PatternOp,
+        PatternRule,
+    )
+
+    rule = PatternRule(
+        name="taso_like_double_conv",
+        src_ops=[
+            PatternOp(type=OperatorType.CONV2D, inputs=[(-1, 0), (-2, 0)]),
+            PatternOp(type=OperatorType.CONV2D, inputs=[(0, 0), (-3, 0)]),
+        ],
+        dst_ops=[
+            PatternOp(type=OperatorType.CONV2D, inputs=[(-1, 0), (-2, 0)]),
+        ],
+        mapped_outputs=[(1, 0, 0, 0)],
+        anchor_types=frozenset({OperatorType.CONV2D}),
+    )
+    findings, stats = verify_registry_generated(num_devices=8, xfers=[rule])
+    assert codes(findings) == {"EQV306"}
+    assert all(f.severity == "warn" for f in findings)
+    assert stats["unproven"] == 1
+
+
+def test_pattern_rule_indexed_scan_matches_full_scan():
+    """The loader's per-op-type seed index (anchor_types derived from
+    the pattern's ROOT op) finds exactly the full scan's binding set —
+    asserted inline here and by the FLEXFLOW_TPU_DELTA_CHECK oracle."""
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.search import substitution as subst
+    from flexflow_tpu.search.substitution_loader import (
+        PatternOp,
+        PatternRule,
+    )
+
+    rule = PatternRule(
+        name="rep_rep_fuse",
+        src_ops=[
+            # PM dims are Legion-ordered (innermost first): on a
+            # rank-3 tensor PM dim 1 = logical dim 1, PM dim 2 =
+            # logical dim 0 (_logical_dim mirrors the index)
+            PatternOp(type=OperatorType.REPARTITION, inputs=[(-1, 0)],
+                      params={"PM_REPARTITION_DIM": 1,
+                              "PM_REPARTITION_DEGREE": 2}),
+            PatternOp(type=OperatorType.REPARTITION, inputs=[(0, 0)],
+                      params={"PM_REPARTITION_DIM": 2,
+                              "PM_REPARTITION_DEGREE": 2}),
+        ],
+        dst_ops=[
+            PatternOp(type=OperatorType.REPARTITION, inputs=[(-1, 0)],
+                      params={"PM_PARALLEL_DIM": 0,
+                              "PM_PARALLEL_DEGREE": 4}),
+        ],
+        mapped_outputs=[(1, 0, 0, 0)],
+    )
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    x = m.create_tensor([16, 8, 4])
+    t = m.repartition(x, dim=1, degree=2)   # logical dim 1 = PM dim 1
+    t = m.repartition(t, dim=0, degree=2)
+    m.dense(t, 8)
+    full = rule.find_matches(m.graph)
+    assert full, "fixture pattern must match"
+    # arm the index via the derived anchor (what _parse_rule sets)
+    rule.anchor_types = frozenset({rule.src_ops[0].type})
+    was = subst.DELTA_MATCH_CHECK
+    subst.DELTA_MATCH_CHECK = True  # oracle: indexed == full, inline
+    try:
+        indexed = rule.find_matches(m.graph)
+    finally:
+        subst.DELTA_MATCH_CHECK = was
+    as_set = lambda ms: sorted(tuple(sorted(mm.items())) for mm in ms)  # noqa: E731
+    assert as_set(indexed) == as_set(full)
+
+
+# ---------------------------------------------------------------------------
+# pipeline/placement proposal legality (ISSUE 9 tentpole): SHD150-155
+# seeded corruptions, each caught with its code
+
+
+def _chain_model(layers=6):
+    cfg = ff.FFConfig(batch_size=16, num_devices=8,
+                      only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([16, 32], name="pl_x")
+    for i in range(layers):
+        t = m.dense(t, 32, activation="relu", name=f"pl_fc{i}")
+    m.dense(t, 4, name="pl_head")
+    return m, cfg
+
+
+def _stages_of(graph, num_stages):
+    topo = [n.guid for n in graph.topo_order()]
+    per = (len(topo) + num_stages - 1) // num_stages
+    return [topo[i * per:(i + 1) * per] for i in range(num_stages)]
+
+
+def test_clean_pipeline_stages_have_no_findings():
+    from flexflow_tpu.analysis import lint_pipeline_stages
+
+    m, cfg = _chain_model()
+    stages = _stages_of(m.graph, 2)
+    assert lint_pipeline_stages(m.graph, stages, 2, 4, cfg) == []
+
+
+def test_mutation_pipeline_structure_shd150():
+    from flexflow_tpu.analysis import lint_pipeline_stages
+
+    m, cfg = _chain_model()
+    stages = _stages_of(m.graph, 2)
+    # microbatches below the stage count: the bubble eats the win
+    found = codes(lint_pipeline_stages(m.graph, stages, 2, 1, cfg))
+    assert "SHD150" in found
+    # stage count that does not divide the machine
+    found = codes(lint_pipeline_stages(
+        m.graph, _stages_of(m.graph, 3), 3, 6, cfg))
+    assert "SHD150" in found
+    # unknown guid
+    bad = [list(s) for s in stages]
+    bad[0][0] = 99_999
+    assert "SHD150" in codes(
+        lint_pipeline_stages(m.graph, bad, 2, 4, cfg))
+
+
+def test_mutation_pipeline_coverage_shd151():
+    from flexflow_tpu.analysis import lint_pipeline_stages
+
+    m, cfg = _chain_model()
+    stages = [list(s) for s in _stages_of(m.graph, 2)]
+    dup = stages[0][0]
+    stages[1].append(dup)  # node in two stages
+    found = codes(lint_pipeline_stages(m.graph, stages, 2, 4, cfg))
+    assert "SHD151" in found
+    stages = [list(s) for s in _stages_of(m.graph, 2)]
+    stages[1] = stages[1][:-1]  # node in no stage
+    found = codes(lint_pipeline_stages(m.graph, stages, 2, 4, cfg))
+    assert "SHD151" in found
+
+
+def test_mutation_pipeline_back_edge_shd152():
+    from flexflow_tpu.analysis import lint_pipeline_stages
+
+    m, cfg = _chain_model()
+    stages = _stages_of(m.graph, 2)
+    swapped = [stages[1], stages[0]]  # every chain edge now crosses back
+    found = codes(lint_pipeline_stages(m.graph, swapped, 2, 4, cfg))
+    assert "SHD152" in found and "SHD151" not in found
+
+
+def _placed_model():
+    cfg = ff.FFConfig(batch_size=16, num_devices=8,
+                      compute_dtype="float32")
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor([16, 4], dtype="int32", name="pm_ids")
+    e = m.embedding(ids, 64, 8, name="pm_emb")
+    h = m.flat(e, name="pm_flat")
+    h = m.dense(h, 32, activation="relu", name="pm_mlp")
+    m.dense(h, 4, name="pm_head")
+    strat = {}
+    for node in m.graph.topo_order():
+        nd = node.op.output_shapes[0].ndim
+        if node.op.name in ("pm_mlp", "pm_head"):
+            strat[node.guid] = MachineView(
+                dim_degrees=(4,) + (1,) * (nd - 1), start_part=4)
+        else:
+            strat[node.guid] = (
+                node.op.fixed_machine_view()
+                or MachineView(dim_degrees=(4,) + (1,) * (nd - 1)))
+    return m, cfg, strat
+
+
+def test_clean_placement_has_no_findings():
+    from flexflow_tpu.analysis import lint_placement
+
+    m, cfg, strat = _placed_model()
+    assert lint_placement(m.graph, strat, cfg) == []
+
+
+def test_mutation_placement_three_blocks_shd153():
+    from flexflow_tpu.analysis import lint_placement
+
+    m, cfg, strat = _placed_model()
+    g = m.node_by_name("pm_head").guid
+    strat[g] = MachineView(dim_degrees=(2, 1), start_part=6)
+    found = codes(lint_placement(m.graph, strat, cfg))
+    assert "SHD153" in found
+
+
+def test_mutation_placement_overlap_shd154():
+    from flexflow_tpu.analysis import lint_placement
+
+    m, cfg, strat = _placed_model()
+    # block B slid onto block A's devices: A needs 4 from 0, B starts at 2
+    for name in ("pm_mlp", "pm_head"):
+        g = m.node_by_name(name).guid
+        strat[g] = MachineView(dim_degrees=(4, 1), start_part=2)
+    found = codes(lint_placement(m.graph, strat, cfg))
+    assert "SHD154" in found
+
+
+def test_mutation_placement_overflow_shd154():
+    from flexflow_tpu.analysis import lint_placement
+
+    m, cfg, strat = _placed_model()
+    for name in ("pm_mlp", "pm_head"):
+        g = m.node_by_name(name).guid
+        strat[g] = MachineView(dim_degrees=(4, 1), start_part=6)
+    found = codes(lint_placement(m.graph, strat, cfg))  # 6 + 4 > 8
+    assert "SHD154" in found
+
+
+def test_mutation_placement_cut_shape_shd155():
+    from flexflow_tpu.analysis import lint_placement
+
+    m, cfg, strat = _placed_model()
+    # sink pulled back into block A: B no longer owns the loss program
+    # AND the head's input edge now flows B -> A
+    g = m.node_by_name("pm_head").guid
+    strat[g] = MachineView(dim_degrees=(4, 1), start_part=0)
+    found = codes(lint_placement(m.graph, strat, cfg))
+    assert "SHD155" in found
+
+
+def test_mutation_placement_segment_views_shd1xx():
+    """The per-segment flat lint runs in each block's OWN submesh
+    geometry: a view legal on the 8-device machine but not on its
+    4-device block is caught (SHD103 against the block size)."""
+    from flexflow_tpu.analysis import lint_placement
+
+    m, cfg, strat = _placed_model()
+    g = m.node_by_name("pm_ids").guid
+    # 8 parts on a 4-device block: fits the machine, not the block
+    strat[g] = MachineView(dim_degrees=(8, 1))
+    found = codes(lint_placement(m.graph, strat, cfg))
+    assert found & {"SHD103", "SHD154"}
+
+
+def test_compile_gates_placed_strategy_with_findings():
+    """The compile-time placed-lowering gate: a 2-block strategy that
+    passes ``placeable()``'s structural checks but whose views are
+    illegal in their block's submesh geometry fails with an
+    AnalysisError carrying findings — not an opaque lowering error."""
+    m, cfg, strat = _placed_model()
+    # 8-part input view in block A: placeable() (cut shape only) still
+    # holds, but block A's width now collides with block B's start —
+    # the constructor would raise a bare ValueError; the gate reports
+    # SHD154 first
+    g = m.node_by_name("pm_ids").guid
+    strat[g] = MachineView(dim_degrees=(8, 1))
+    from flexflow_tpu.compiler.placement_lowering import placeable
+
+    assert placeable(m.graph, strat, cfg)
+    with pytest.raises(AnalysisError) as ei:
+        m.compile(loss_type="sparse_categorical_crossentropy",
+                  metrics=[], strategy=strat)
+    assert {f.code for f in ei.value.findings} & {"SHD154", "SHD103"}
+
+
+def test_pipeline_proposal_is_gated_and_general_proposal_lints():
+    """propose_pipeline_general's returned partition passes SHD150-152
+    (the always-on gate ran inside the proposal path)."""
+    import dataclasses
+
+    from flexflow_tpu.analysis import lint_pipeline_stages
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.pipeline_search import propose_pipeline_general
+    from flexflow_tpu.search.simulator import Simulator
+
+    spec = MachineSpec(num_devices=8, devices_per_host=4, platform="cpu",
+                       hbm_capacity=40e6)
+    cfg = ff.FFConfig(batch_size=16, num_devices=8,
+                      compute_dtype="float32", machine_spec=spec)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([16, 1021])
+    for i, w in enumerate((1019, 1013, 1009, 1021)):
+        t = m.dense(t, w, activation="relu", name=f"gl{i}_fc")
+    m.dense(t, 1021, name="gl_head")
+    sim = Simulator.for_config(cfg)
+    prop = propose_pipeline_general(m.graph, cfg, sim, math.inf)
+    assert prop is not None
+    assert lint_pipeline_stages(
+        m.graph, prop.stage_guids, prop.num_stages,
+        prop.num_microbatches, cfg) == []
+
+
+# ---------------------------------------------------------------------------
+# STR208: stdlib lint of persisted placement/pipeline proposal meta +
+# the fflint --json machine-readable contract
+
+
+def _export_placed(tmp_path):
+    from flexflow_tpu.analysis import placement_meta
+    from flexflow_tpu.search.strategy_io import attach_meta, export_strategy
+
+    m, cfg, strat = _placed_model()
+    p = str(tmp_path / "placed.json")
+    export_strategy(p, m.graph, strat)
+    attach_meta(p, placement=placement_meta(m.graph, strat, cfg),
+                pipeline={"num_stages": 2, "num_microbatches": 4,
+                          "stages": [["pm_ids", "pm_emb", "pm_flat"],
+                                     ["pm_mlp", "pm_head"]]})
+    return p
+
+
+def test_fflint_persisted_placement_meta_str208(tmp_path):
+    from tools.fflint import main
+
+    p = _export_placed(tmp_path)
+    assert main(["strategy", p]) == 0
+    with open(p) as f:
+        clean = json.load(f)
+
+    def corrupted(mutate):
+        data = json.loads(json.dumps(clean))
+        mutate(data)
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump(data, f)
+        return main(["strategy", bad])
+
+    meta = "__meta__"
+    # overlapping blocks / block A off device 0 / overflow / op outside
+    # the declared blocks / op wider than its block: all STR208
+    assert corrupted(
+        lambda d: d[meta]["placement"]["blocks"].__setitem__(
+            1, [2, 4])) == 1
+    assert corrupted(
+        lambda d: d[meta]["placement"]["blocks"].__setitem__(
+            0, [1, 4])) == 1
+    assert corrupted(
+        lambda d: d[meta]["placement"].update(num_devices=6)) == 1
+    assert corrupted(
+        lambda d: d["pm_head"].update(start=3)) == 1
+    assert corrupted(
+        lambda d: d["pm_head"].update(dims=[8, 1])) == 1
+    # pipeline meta corruptions: M < S / duplicated op / unknown op
+    assert corrupted(
+        lambda d: d[meta]["pipeline"].update(num_microbatches=1)) == 1
+    assert corrupted(
+        lambda d: d[meta]["pipeline"]["stages"][1].append("pm_ids")) == 1
+    assert corrupted(
+        lambda d: d[meta]["pipeline"]["stages"][1].append("ghost")) == 1
+
+
+def test_fflint_json_output_and_exit_contract(tmp_path, capsys):
+    """--json: one JSON object per line (findings first, summary last);
+    exit codes keep the 0/1/2 contract."""
+    from tools.fflint import main
+
+    m = small_model()
+    from flexflow_tpu.search.strategy_io import export_strategy
+
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, data_parallel_strategy(m.graph, 8))
+    capsys.readouterr()
+    assert main(["strategy", "--json", p]) == 0  # clean -> 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[-1]["summary"] is True and lines[-1]["errors"] == 0
+
+    with open(p) as f:
+        data = json.load(f)
+    data["ta_fc1"] = {"dims": [0, "x"], "replica": 1}
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump(data, f)
+    capsys.readouterr()
+    assert main(["strategy", "--json", bad]) == 1  # findings -> 1
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    finding = next(ln for ln in lines if not ln.get("summary"))
+    assert finding["code"] == "STR204" and finding["severity"] == "error"
+    assert lines[-1]["errors"] >= 1
+
+    assert main(["strategy"]) == 2  # usage error -> 2
+    assert main(["no-such-subcommand"]) == 2
+
+
+def test_placed_compile_persists_and_reimports_placement_meta(tmp_path):
+    """persist/import legs of the proposal gate: a placed compile
+    exports ``__meta__.placement`` behind the digest gate, fflint
+    checks it stdlib-only (STR208), and re-importing the file re-lints
+    the cut against the fresh graph before the placed lowering runs."""
+    from tools.fflint import main
+
+    from flexflow_tpu.compiler.placement_lowering import PlacedCompiledModel
+    from flexflow_tpu.search.strategy_io import read_meta
+
+    p = str(tmp_path / "placed_export.json")
+    m, _cfg, strat = _placed_model()
+    m.config.export_strategy_file = p
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+              strategy=strat)
+    assert isinstance(m.compiled, PlacedCompiledModel)
+    meta = read_meta(p)
+    assert meta["placement"]["blocks"] == [[0, 4], [4, 4]]
+    assert main(["strategy", p]) == 0
+
+    # re-import onto a fresh build of the same model: the placement
+    # meta is re-linted against THIS graph and the placed lowering runs
+    m2, _cfg2, _ = _placed_model()
+    m2.config.import_strategy_file = p
+    m2.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+    assert isinstance(m2.compiled, PlacedCompiledModel)
+
+    # a corrupted placement frame fails the import with a finding
+    data = json.load(open(p))
+    data["__meta__"]["placement"]["blocks"] = [[0, 4], [2, 4]]
+    bad = str(tmp_path / "bad_placed.json")
+    with open(bad, "w") as f:
+        json.dump(data, f)
+    m3, _cfg3, _ = _placed_model()
+    m3.config.import_strategy_file = bad
+    with pytest.raises(AnalysisError):
+        m3.compile(loss_type="sparse_categorical_crossentropy",
+                   metrics=[])
+
+
+def test_failed_placed_compile_leaves_no_placement_artifact(tmp_path):
+    """Review fix: a compile that fails the placed-lowering gate must
+    not first persist a __meta__.placement frame claiming the cut
+    executes."""
+    from flexflow_tpu.search.strategy_io import read_meta
+
+    p = str(tmp_path / "failed_placed.json")
+    m, _cfg, strat = _placed_model()
+    g = m.node_by_name("pm_ids").guid
+    strat[g] = MachineView(dim_degrees=(8, 1))  # SHD154 at the gate
+    m.config.export_strategy_file = p
+    with pytest.raises(AnalysisError):
+        m.compile(loss_type="sparse_categorical_crossentropy",
+                  metrics=[], strategy=strat)
+    assert not os.path.exists(p) or "placement" not in read_meta(p)
+
+
+def test_import_malformed_pipeline_meta_is_a_finding(tmp_path):
+    """Review fix: non-int num_stages / non-list stages in a
+    hand-edited __meta__.pipeline fail the import gate with an
+    AnalysisError finding, never a bare TypeError."""
+    from flexflow_tpu.search.strategy_io import attach_meta, export_strategy
+
+    for corrupt in ({"num_stages": None, "num_microbatches": 4},
+                    {"num_stages": 2, "num_microbatches": 4,
+                     "stages": 5},
+                    "not-an-object"):
+        m = small_model()
+        p = str(tmp_path / "pm.json")
+        export_strategy(p, m.graph, data_parallel_strategy(m.graph, 8))
+        attach_meta(p, pipeline=corrupt)
+        m2 = small_model()
+        m2.config.import_strategy_file = p
+        with pytest.raises(AnalysisError) as ei:
+            m2.compile(loss_type="sparse_categorical_crossentropy",
+                       metrics=[])
+        assert "SHD150" in {f.code for f in ei.value.findings}
+
+
+def test_imported_pipeline_meta_with_stages_adopts_staged_lowering(tmp_path):
+    """Review fix: an imported __meta__.pipeline with explicit stages
+    is ADOPTED (staged wavefront executor), not merely validated — an
+    import that re-lints but silently lowers flat would defeat the
+    proposal it just checked."""
+    from flexflow_tpu.compiler.staged_pipeline_lowering import (
+        StagedPipelinedModel,
+    )
+    from flexflow_tpu.search.strategy_io import attach_meta, export_strategy
+
+    m, cfg = _chain_model()
+    p = str(tmp_path / "pp.json")
+    s = data_parallel_strategy(m.graph, 8)
+    export_strategy(p, m.graph, s)
+    names = {n.guid: n.op.name for n in m.graph.topo_order()}
+    stage_guids = _stages_of(m.graph, 2)
+    attach_meta(p, pipeline={
+        "num_stages": 2, "num_microbatches": 4,
+        "stages": [[names[g] for g in st] for st in stage_guids]})
+
+    m2, _cfg2 = _chain_model()
+    m2.config.import_strategy_file = p
+    m2.compile(loss_type="mean_squared_error", metrics=[])
+    assert m2.pipeline_proposal is not None
+    assert m2.pipeline_proposal.num_stages == 2
+    assert isinstance(m2.compiled, StagedPipelinedModel)
+
+
+def test_imported_stacked_pipeline_meta_adopts_pipeline_config(tmp_path):
+    """S x M meta without explicit stages (the stacked-block shape)
+    round-trips to the scan-based pipelined lowering, exactly as if
+    the user had passed compile(pipeline=...)."""
+    from flexflow_tpu.compiler.pipeline_lowering import (
+        PipelinedCompiledModel,
+    )
+    from flexflow_tpu.parallel.pipeline import PipelineConfig
+
+    def build():
+        cfg = ff.FFConfig(batch_size=16, num_devices=8,
+                          compute_dtype="float32")
+        mm = ff.FFModel(cfg)
+        t = mm.create_tensor([16, 32], name="st_x")
+        for i in range(4):
+            t = mm.dense(t, 32, activation="relu", name=f"layer{i}_fc")
+        mm.dense(t, 4, name="st_head")
+        return mm
+
+    p = str(tmp_path / "stacked.json")
+    m = build()
+    m.config.export_strategy_file = p
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+              pipeline=PipelineConfig(num_stages=2, num_microbatches=4))
+    assert isinstance(m.compiled, PipelinedCompiledModel)
+    meta = json.load(open(p))["__meta__"]
+    assert meta["pipeline"] == {"num_stages": 2, "num_microbatches": 4}
+
+    m2 = build()
+    m2.config.import_strategy_file = p
+    m2.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+    assert isinstance(m2.compiled, PipelinedCompiledModel)

@@ -537,9 +537,25 @@ def test_indexed_find_matches_identical_to_full_scan(monkeypatch):
     assert anchored, "factory xfers must declare anchor types"
     before = subst._INDEX_SKIPS.value
     for x in anchored:
+        if not hasattr(x, "matcher"):
+            # BatchEmbeddingsXfer declares anchor_types too (for the
+            # index + proofgen) but is duck-typed without a per-node
+            # matcher; its indexed scan is checked against the old
+            # full scan below
+            continue
         got = [n.guid for n in x.find_matches(g)]
         full = [n.guid for n in g.topo_order() if x.matcher(g, n)]
         assert got == full
+    from flexflow_tpu.core.optype import OperatorType
+
+    be = subst.BatchEmbeddingsXfer()
+    groups = {}
+    for n in g.topo_order():
+        if n.op.op_type is OperatorType.EMBEDDING:
+            groups.setdefault(n.op.signature(), []).append(n.guid)
+    full_be = [{i: gu for i, gu in enumerate(gs)}
+               for gs in groups.values() if len(gs) >= 2]
+    assert be.find_matches(g) == full_be
     assert subst._INDEX_SKIPS.value > before
 
 

@@ -353,3 +353,68 @@ def test_search_fit_telemetry_smoke(tmp_path):
          "validate", log],
         capture_output=True, text=True)
     assert val.returncode == 0, val.stdout + val.stderr
+
+
+# ---------------------------------------------------------------------------
+# event-kind completeness guard (ISSUE 9 satellite): every emit site in
+# the tree must name a registered kind, so the PR-8 class of
+# "pre-existing ffobs validate gap" (search.chain emitted but never
+# registered) cannot recur
+
+
+def test_every_emit_site_names_a_registered_kind():
+    """AST sweep over flexflow_tpu/ + tools/ + the bench drivers: every
+    event-bus ``emit("<kind>", ...)`` call with a literal kind must
+    name a key of ``EVENT_KINDS`` — an unregistered kind would make
+    every log containing it fail ``ffobs validate``.  Bus receivers
+    are identified by name (``BUS`` / ``_obs_bus`` bindings, plus the
+    bus's own ``self.emit`` inside obs/events.py) so the frontends'
+    unrelated ``emit(op_kind, ...)`` builders do not false-positive."""
+    import ast
+
+    from flexflow_tpu.obs.events import EVENT_KINDS
+
+    def _receiver_is_bus(func: ast.Attribute, path: str) -> bool:
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id in ("BUS", "_obs_bus", "bus"):
+                return True
+            return base.id == "self" and path.endswith(
+                os.path.join("obs", "events.py"))
+        # dotted spellings like events.BUS.emit / obs.events.BUS.emit
+        return isinstance(base, ast.Attribute) and base.attr == "BUS"
+
+    roots = [os.path.join(REPO, "flexflow_tpu"),
+             os.path.join(REPO, "tools")]
+    files = [os.path.join(REPO, f) for f in os.listdir(REPO)
+             if f.startswith("bench") and f.endswith(".py")]
+    for root in roots:
+        for dirpath, _dirs, names in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            files += [os.path.join(dirpath, n) for n in names
+                      if n.endswith(".py")]
+    assert files
+    unregistered = []
+    emit_sites = 0
+    for path in sorted(files):
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and _receiver_is_bus(node.func, path)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            emit_sites += 1
+            kind = node.args[0].value
+            if kind not in EVENT_KINDS:
+                unregistered.append(
+                    f"{path}:{node.lineno}: emit({kind!r})")
+    assert emit_sites > 20, "the sweep found implausibly few emit sites"
+    assert not unregistered, (
+        "emit sites with unregistered kinds (add them to "
+        "obs.events.EVENT_KINDS so ffobs validate accepts the logs):\n"
+        + "\n".join(unregistered))

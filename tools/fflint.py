@@ -10,11 +10,17 @@ Subcommands:
   fflint cache FILE...        lint persistent cost-cache files (CCH4xx):
                               schema/signature shape, row
                               well-formedness, staleness — stdlib-only
-  fflint registry [--devices N]
-                              prove the substitution registry: graph
-                              invariants (PCG0xx) + numeric equivalence
-                              (EQV3xx) for every registered GraphXfer;
-                              imports the package (needs jax)
+  fflint registry [--devices N] [--substitution-json FILE]
+                              prove the substitution registry: the
+                              hand-zoo regression proof PLUS the
+                              generative proof (analysis/proofgen.py —
+                              proof graphs synthesized from each
+                              rewrite's own anchor_types; EQV305 =
+                              factory coverage hole, EQV306 = unproven
+                              JSON rule).  Reports both passes'
+                              wall-clock so the CI verification budget
+                              stays a number.  Imports the package
+                              (needs jax)
   fflint all [--root DIR]     the CI entry point: lint every committed
                               COST_CACHE*.json / *strategy*.json under
                               DIR (default .) plus the full registry
@@ -24,9 +30,11 @@ Subcommands:
                               (.githooks/pre-commit runs this; enable
                               with `git config core.hooksPath .githooks`)
 
-Exit codes: 0 clean, 1 findings, 2 usage/unreadable input.  Artifact
-subcommands never import jax, so they run anywhere the files land
-(same discipline as tools/ffobs.py).
+Exit codes: 0 clean, 1 findings, 2 usage error.  ``--json`` switches
+every subcommand to machine-readable output: one JSON object per line
+(findings first, a ``{"summary": ...}`` object last) — the exit-code
+contract is identical.  Artifact subcommands never import jax, so
+they run anywhere the files land (same discipline as tools/ffobs.py).
 """
 
 from __future__ import annotations
@@ -90,6 +98,13 @@ def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
         out += _lint_zero_groups_meta(
             meta["zero_groups"],
             {k for k in data if k != META_KEY})
+    if isinstance(meta, dict) and "placement" in meta:
+        out += _lint_placement_meta(
+            meta["placement"],
+            {k: v for k, v in data.items() if k != META_KEY})
+    if isinstance(meta, dict) and "pipeline" in meta:
+        out += _lint_pipeline_meta(
+            meta["pipeline"], {k for k in data if k != META_KEY})
     views = {k: v for k, v in data.items() if k != META_KEY}
     if not views:
         out.append(("error", "STR202", "file names no ops at all"))
@@ -147,6 +162,131 @@ def _lint_zero_groups_meta(zg, op_names) -> List[Tuple[str, str, str]]:
             out.append(("error", "STR207",
                         f"zero_groups[{i}] names op {name!r} the "
                         f"strategy file does not cover"))
+    return out
+
+
+def _view_parts(v) -> int:
+    """Total parts of a strategy-file view entry (product of dim
+    degrees x replica) — 0 when the entry is malformed (STR204 owns
+    that failure)."""
+    dims = v.get("dims") if isinstance(v, dict) else None
+    rep = v.get("replica", 1) if isinstance(v, dict) else None
+    if (not isinstance(dims, list)
+            or any(not isinstance(d, int) or d < 1 for d in dims)
+            or not isinstance(rep, int) or rep < 1):
+        return 0
+    parts = rep
+    for d in dims:
+        parts *= d
+    return parts
+
+
+def _lint_placement_meta(pm, views) -> List[Tuple[str, str, str]]:
+    """STR208: structural lint of a persisted ``__meta__.placement``
+    block (the 2-block device frame a placed proposal executes under,
+    analysis/placement.py).  Graph-side legality (cut shape, sink
+    ownership, crossing tensors — SHD153-155) needs the graph and runs
+    at proposal/import time; this proves what the file alone can: a
+    coherent disjoint 2-block frame that the file's own start_part
+    views actually inhabit."""
+    out: List[Tuple[str, str, str]] = []
+    if not isinstance(pm, dict):
+        return [("error", "STR208", "placement meta is not an object")]
+    n = pm.get("num_devices")
+    if not isinstance(n, int) or n < 2:
+        out.append(("error", "STR208",
+                    f"placement meta has malformed num_devices {n!r}"))
+        n = None
+    blocks = pm.get("blocks")
+    ok_blocks = (
+        isinstance(blocks, list) and len(blocks) == 2
+        and all(isinstance(b, list) and len(b) == 2
+                and all(isinstance(x, int) and x >= 0 for x in b)
+                and b[1] >= 1 for b in blocks)
+    )
+    if not ok_blocks:
+        return out + [("error", "STR208",
+                       f"placement meta needs exactly 2 [start, parts] "
+                       f"blocks, got {str(blocks)[:80]}")]
+    (s0, p0), (s1, p1) = blocks
+    if s0 != 0:
+        out.append(("error", "STR208",
+                    f"placement block A starts at device {s0}, not 0"))
+    if s1 < s0 + p0:
+        out.append(("error", "STR208",
+                    f"placement blocks overlap: A spans [0, {p0}) but B "
+                    f"starts at {s1}"))
+    if n is not None and s1 + p1 > n:
+        out.append(("error", "STR208",
+                    f"placement blocks overflow: B spans [{s1}, "
+                    f"{s1 + p1}) on a {n}-device machine"))
+    starts = {s0, s1}
+    for name, v in sorted(views.items()):
+        sv = v.get("start", 0) if isinstance(v, dict) else 0
+        if sv not in starts:
+            out.append(("error", "STR208",
+                        f"op {name!r} starts at device {sv!r}, outside "
+                        f"the declared blocks {sorted(starts)}"))
+            continue
+        cap = p0 if sv == s0 else p1
+        parts = _view_parts(v)
+        if parts > cap:
+            out.append(("error", "STR208",
+                        f"op {name!r} needs {parts} parts but its block "
+                        f"at device {sv} spans only {cap}"))
+    return out
+
+
+def _lint_pipeline_meta(pm, op_names) -> List[Tuple[str, str, str]]:
+    """STR208: structural lint of a persisted ``__meta__.pipeline``
+    block (a staged proposal's S x M frame + optional explicit stage
+    cut, analysis/placement.py).  Graph-side legality (coverage vs the
+    actual graph, boundary-edge coherence — SHD150-152) runs at
+    proposal/import time."""
+    out: List[Tuple[str, str, str]] = []
+    if not isinstance(pm, dict):
+        return [("error", "STR208", "pipeline meta is not an object")]
+    s = pm.get("num_stages")
+    m = pm.get("num_microbatches")
+    if not isinstance(s, int) or s < 2:
+        out.append(("error", "STR208",
+                    f"pipeline meta has malformed num_stages {s!r} "
+                    f"(need an int >= 2)"))
+        s = None
+    if not isinstance(m, int) or m < 1 or (s is not None and m < s):
+        out.append(("error", "STR208",
+                    f"pipeline meta has malformed num_microbatches "
+                    f"{m!r} (need an int >= num_stages)"))
+    stages = pm.get("stages")
+    if stages is None:
+        return out
+    if not isinstance(stages, list) or (
+            s is not None and len(stages) != s):
+        return out + [("error", "STR208",
+                       f"pipeline meta declares num_stages {s!r} but "
+                       f"carries {len(stages) if isinstance(stages, list) else stages!r} stage lists")]
+    seen = set()
+    for i, stage in enumerate(stages):
+        if not isinstance(stage, list) or not stage:
+            out.append(("error", "STR208",
+                        f"pipeline meta stages[{i}] is empty or not a "
+                        f"list"))
+            continue
+        for op in stage:
+            if not isinstance(op, str) or not op:
+                out.append(("error", "STR208",
+                            f"pipeline meta stages[{i}] has a non-name "
+                            f"entry {op!r}"))
+                continue
+            if op in seen:
+                out.append(("error", "STR208",
+                            f"pipeline meta covers op {op!r} twice — it "
+                            f"would run twice per tick"))
+            seen.add(op)
+            if op not in op_names:
+                out.append(("error", "STR208",
+                            f"pipeline meta stages[{i}] names op {op!r} "
+                            f"the strategy file does not cover"))
     return out
 
 
@@ -427,46 +567,110 @@ def _lint_comm_plans(data) -> List[Tuple[str, str, str]]:
 # rewrite registry (imports flexflow_tpu — jax required)
 
 
-def lint_registry(num_devices: int) -> List[Tuple[str, str, str]]:
-    from flexflow_tpu.analysis.equivalence import verify_registry
+def lint_registry(num_devices: int, substitution_json: str = "",
+                  ) -> Tuple[List[Tuple[str, str, str]], dict]:
+    """(findings, info) for the registry proof: the hand-zoo pass (the
+    regression anchor) over the factory xfers, then the GENERATIVE
+    pass (analysis/proofgen.py) over factory + any JSON rules —
+    factory xfers must anchor on generated graphs (EQV305 closed by
+    construction), unproven JSON rules are listed as EQV306.  ``info``
+    carries both passes' wall-clock (the CI verification budget) and
+    the generation stats."""
+    import time as _time
 
-    return [(f.severity, f.code, f.message) for f in verify_registry(
-        num_devices=num_devices)]
+    from flexflow_tpu.analysis.equivalence import verify_registry
+    from flexflow_tpu.analysis.proofgen import verify_registry_generated
+    from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+
+    factory = generate_all_pcg_xfers(num_devices)
+    t0 = _time.perf_counter()
+    findings = list(verify_registry(num_devices=num_devices,
+                                    xfers=factory))
+    t_zoo = _time.perf_counter() - t0
+    xfers = list(factory)
+    if substitution_json:
+        from flexflow_tpu.search.substitution_loader import (
+            load_substitution_json,
+        )
+
+        xfers += load_substitution_json(substitution_json)
+    t0 = _time.perf_counter()
+    gen_findings, stats = verify_registry_generated(
+        num_devices=num_devices, xfers=xfers)
+    t_gen = _time.perf_counter() - t0
+    findings += gen_findings
+    info = {
+        "zoo_seconds": round(t_zoo, 3),
+        "proofgen_seconds": round(t_gen, 3),
+        "xfers": stats["xfers"],
+        "graphs_generated": stats["graphs_generated"],
+        "proofs": stats["proofs"],
+        "lanes": stats["lanes"],
+        "unproven": stats["unproven"],
+    }
+    return ([(f.severity, f.code, f.message) for f in findings], info)
 
 
 # ---------------------------------------------------------------------------
 
 
-def _report(path: str, findings: List[Tuple[str, str, str]]) -> int:
+def _report(path: str, findings: List[Tuple[str, str, str]],
+            as_json: bool = False) -> int:
     errors = 0
     for sev, code, msg in findings:
-        print(f"{path}: {sev.upper()} [{code}] {msg}")
+        if as_json:
+            # machine-readable contract: one JSON object per finding
+            # line (exit codes unchanged — CI keys on both)
+            print(json.dumps({"path": path, "severity": sev,
+                              "code": code, "msg": msg}))
+        else:
+            print(f"{path}: {sev.upper()} [{code}] {msg}")
         if sev == "error":
             errors += 1
     return errors
 
 
+def _summary(args, text: str, **payload) -> None:
+    if getattr(args, "json", False):
+        print(json.dumps({"summary": True, "cmd": args.cmd, **payload}))
+    else:
+        print(text)
+
+
 def cmd_strategy(args) -> int:
     errors = 0
     for path in args.files:
-        errors += _report(path, lint_strategy_file(path))
-    print(f"fflint strategy: {len(args.files)} file(s), {errors} error(s)")
+        errors += _report(path, lint_strategy_file(path), args.json)
+    _summary(args,
+             f"fflint strategy: {len(args.files)} file(s), {errors} "
+             f"error(s)", files=len(args.files), errors=errors)
     return 1 if errors else 0
 
 
 def cmd_cache(args) -> int:
     errors = 0
     for path in args.files:
-        errors += _report(path, lint_cache_file(path))
-    print(f"fflint cache: {len(args.files)} file(s), {errors} error(s)")
+        errors += _report(path, lint_cache_file(path), args.json)
+    _summary(args,
+             f"fflint cache: {len(args.files)} file(s), {errors} "
+             f"error(s)", files=len(args.files), errors=errors)
     return 1 if errors else 0
 
 
 def cmd_registry(args) -> int:
-    findings = lint_registry(args.devices)
-    errors = _report("registry", findings)
-    print(f"fflint registry: {args.devices}-device rewrite registry, "
-          f"{errors} error(s)")
+    findings, info = lint_registry(
+        args.devices, getattr(args, "substitution_json", "") or "")
+    errors = _report("registry", findings, args.json)
+    _summary(
+        args,
+        f"fflint registry: {args.devices}-device rewrite registry, "
+        f"{errors} error(s)\n"
+        f"  zoo proof {info['zoo_seconds']}s; generative proof "
+        f"{info['proofgen_seconds']}s — {info['proofs']} proofs over "
+        f"{info['graphs_generated']} generated graphs "
+        f"({info['xfers']} xfers, lanes {info['lanes']}, "
+        f"{info['unproven']} unproven)",
+        errors=errors, **info)
     return 1 if errors else 0
 
 
@@ -542,16 +746,20 @@ def cmd_precommit(args) -> int:
         strategies = [(rel, p) for rel, p in staged
                       if "strategy" in os.path.basename(rel).lower()]
         for rel, path in caches:
-            errors += _report(rel, lint_cache_file(path))
+            errors += _report(rel, lint_cache_file(path), args.json)
         for rel, path in strategies:
-            errors += _report(rel, lint_strategy_file(path))
+            errors += _report(rel, lint_strategy_file(path), args.json)
     if not args.skip_registry:
-        errors += _report("registry", lint_registry(args.devices))
-    print(f"fflint pre-commit: {len(caches)} cache file(s), "
-          f"{len(strategies)} strategy file(s)"
-          + ("" if args.skip_registry else
-             f", registry @ {args.devices} devices")
-          + f" — {errors} error(s)")
+        findings, _info = lint_registry(args.devices)
+        errors += _report("registry", findings, args.json)
+    _summary(args,
+             f"fflint pre-commit: {len(caches)} cache file(s), "
+             f"{len(strategies)} strategy file(s)"
+             + ("" if args.skip_registry else
+                f", registry @ {args.devices} devices")
+             + f" — {errors} error(s)",
+             caches=len(caches), strategies=len(strategies),
+             errors=errors)
     return 1 if errors else 0
 
 
@@ -565,36 +773,53 @@ def cmd_all(args) -> int:
         if "strategy" in os.path.basename(p).lower()
     )
     for path in caches:
-        errors += _report(path, lint_cache_file(path))
+        errors += _report(path, lint_cache_file(path), args.json)
     for path in strategies:
-        errors += _report(path, lint_strategy_file(path))
-    findings = lint_registry(args.devices)
-    errors += _report("registry", findings)
-    print(f"fflint all: {len(caches)} cache file(s), "
-          f"{len(strategies)} strategy file(s), registry @ "
-          f"{args.devices} devices — {errors} error(s)")
+        errors += _report(path, lint_strategy_file(path), args.json)
+    findings, info = lint_registry(args.devices)
+    errors += _report("registry", findings, args.json)
+    _summary(args,
+             f"fflint all: {len(caches)} cache file(s), "
+             f"{len(strategies)} strategy file(s), registry @ "
+             f"{args.devices} devices — {errors} error(s) "
+             f"(registry proofs: zoo {info['zoo_seconds']}s + "
+             f"generative {info['proofgen_seconds']}s)",
+             caches=len(caches), strategies=len(strategies),
+             errors=errors, **info)
     return 1 if errors else 0
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fflint", description=__doc__)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--json", action="store_true",
+                        help="machine-readable output: one JSON object "
+                             "per finding line, a summary object last "
+                             "(exit codes unchanged)")
     sub = ap.add_subparsers(dest="cmd", required=True)
-    p = sub.add_parser("strategy", help="lint exported strategy files")
+    p = sub.add_parser("strategy", parents=[common],
+                       help="lint exported strategy files")
     p.add_argument("files", nargs="+")
     p.set_defaults(fn=cmd_strategy)
-    p = sub.add_parser("cache", help="lint persistent cost-cache files")
+    p = sub.add_parser("cache", parents=[common],
+                       help="lint persistent cost-cache files")
     p.add_argument("files", nargs="+")
     p.set_defaults(fn=cmd_cache)
-    p = sub.add_parser("registry",
+    p = sub.add_parser("registry", parents=[common],
                        help="numeric-equivalence proof of the rewrite "
-                            "registry (imports jax)")
+                            "registry — hand zoo + generated proof "
+                            "graphs (imports jax)")
     p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--substitution-json", default="",
+                   help="also prove the rules of this JSON collection "
+                        "(unproven rules are listed as EQV306)")
     p.set_defaults(fn=cmd_registry)
-    p = sub.add_parser("all", help="lint committed artifacts + registry")
+    p = sub.add_parser("all", parents=[common],
+                       help="lint committed artifacts + registry")
     p.add_argument("--root", default=".")
     p.add_argument("--devices", type=int, default=8)
     p.set_defaults(fn=cmd_all)
-    p = sub.add_parser("pre-commit",
+    p = sub.add_parser("pre-commit", parents=[common],
                        help="git pre-commit gate: lint STAGED artifact "
                             "files + prove the rewrite registry "
                             "(install: git config core.hooksPath "

@@ -700,6 +700,203 @@ def topology_sweep(n_devices):
     return sweep
 
 
+def serve_sweep(n_devices):
+    """The --serve sweep: throughput (objective=train) vs p99-latency
+    (objective=serve) strategies for the DECODE zoo (models/decode.py)
+    on the flat and 2-slice machine variants — ROADMAP item 4's
+    "serving wants a different Pareto point" claim as a recorded
+    artifact.
+
+    For each decode config both objectives run the full search; the
+    two results are then scored in BOTH currencies — mean step (train)
+    and the serving arrival model's p50/p90/p99 (search/serving.py) —
+    plus per-device KV residency, so the table compares strategies,
+    not scorers.  Simulated only, deliberately: a CPU mesh can execute
+    the decode graph (tests do) but cannot exhibit the HBM-bandwidth
+    cache-streaming ratios the machine model prices; the contract
+    numbers are falsifiable on a real chip via --calibrate.  A prefill
+    row records the compute-bound phase for contrast (no decode ops —
+    the serve objective degenerates to train pricing there by
+    design)."""
+    import dataclasses
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.models import (
+        GPT_DECODE_KW,
+        GPT_DECODE_SERVE_KW,
+        SERVE_FRAME_SLOTS,
+        build_gpt_decode,
+        build_gpt_prefill,
+    )
+    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.serving import (
+        kv_residency_bytes,
+        serve_latency_quantiles,
+    )
+    from flexflow_tpu.search.simulator import Simulator
+
+    base_spec = ff.FFConfig(batch_size=8,
+                            num_devices=n_devices).machine_spec
+    gap = 10.0
+    topologies = {"flat": base_spec}
+    if n_devices % 2 == 0 and n_devices // 2 >= 2:
+        topologies["2slice"] = dataclasses.replace(
+            base_spec, devices_per_host=n_devices // 2,
+            dcn_bandwidth=base_spec.ici_bandwidth / gap)
+    configs = {
+        # the serving-regime geometry (long ragged caches, modest
+        # width): where throughput and p99 provably part ways
+        "gpt_decode_serve": (SERVE_FRAME_SLOTS, GPT_DECODE_SERVE_KW),
+        # the small executor-tested config for contrast (cache too
+        # small for the ragged term to dominate — the objectives are
+        # allowed to agree here; the row proves the sweep does not
+        # manufacture divergence)
+        "gpt_decode_s": (16, GPT_DECODE_KW),
+    }
+    sweep = {
+        "devices": n_devices,
+        "note": (
+            "simulated on the TPU machine model (CPU execution cannot "
+            "exhibit HBM cache-streaming ratios); p50/p90/p99 are the "
+            "serving arrival model's quantile currencies "
+            "(search/serving.py), mean is the train currency; both "
+            "strategies scored in both, so the rows compare "
+            "strategies, not scorers"
+        ),
+        "models": {},
+    }
+
+    def _decode_views(g, s):
+        return [
+            {"op": n.op.name, "dims": list(s[n.guid].dim_degrees),
+             "replica": s[n.guid].replica_degree}
+            for n in g.topo_order()
+            if n.op.op_type == OperatorType.DECODE_ATTENTION
+        ]
+
+    def _named(g, s):
+        return {
+            n.op.name: (tuple(s[n.guid].dim_degrees),
+                        s[n.guid].replica_degree, s[n.guid].start_part)
+            for n in g.topo_order() if n.guid in s
+        }
+
+    for name, (batch, kw) in configs.items():
+        rows = {}
+        for topo, spec in topologies.items():
+            out = {}
+            results = {}
+            for obj in ("train", "serve"):
+                cfg = ff.FFConfig(
+                    batch_size=batch, num_devices=n_devices,
+                    machine_spec=spec, search_budget=8,
+                    search_timeout_s=60.0, objective=obj,
+                    comp_mode="inference", cost_cache_file="",
+                )
+                m = build_gpt_decode(cfg, **kw)
+                t0 = time.monotonic()
+                g, s = optimize_strategy(m.graph, cfg, return_graph=True)
+                results[obj] = (cfg, g, s)
+                out[f"{obj}_search_seconds"] = round(
+                    time.monotonic() - t0, 2)
+                out[f"{obj}_decode_views"] = _decode_views(g, s)
+                out[f"{obj}_kv_mb_per_device"] = round(
+                    kv_residency_bytes(g, s, n_devices) / 1e6, 2)
+            cfg_serve = results["serve"][0]
+            for obj in ("train", "serve"):
+                _cfg, g, s = results[obj]
+                q = serve_latency_quantiles(g, s, cfg_serve)
+                for k, v in q.items():
+                    out[f"{obj}_sim_{k}_ms"] = round(v * 1e3, 4)
+                mean_sim = Simulator(spec, num_devices=n_devices,
+                                     inference=True)
+                out[f"{obj}_sim_mean_ms"] = round(
+                    mean_sim.simulate(g, s) * 1e3, 4)
+            out["strategies_differ"] = (
+                _named(*results["train"][1:]) != _named(*results["serve"][1:]))
+            if out["serve_sim_p99_ms"]:
+                out["p99_win_ratio"] = round(
+                    out["train_sim_p99_ms"] / out["serve_sim_p99_ms"], 3)
+            rows[topo] = out
+            print(json.dumps({
+                "serve_sweep": name, "topology": topo,
+                **{k: v for k, v in out.items()
+                   if not k.endswith("decode_views")}}))
+        sweep["models"][name] = rows
+
+    # prefill contrast row: the compute-bound serving phase — plain
+    # causal forward, searched under inference mode (train currency;
+    # no decode ops, so no serve Pareto exists by construction)
+    cfg = ff.FFConfig(batch_size=8, num_devices=n_devices,
+                      search_budget=8, search_timeout_s=45.0,
+                      comp_mode="inference", cost_cache_file="")
+    m = build_gpt_prefill(cfg, **{k: v for k, v in GPT_DECODE_KW.items()
+                                  if k not in ("page_size",
+                                               "pages_per_seq")},
+                          seq_len=256)
+    t0 = time.monotonic()
+    g, s = optimize_strategy(m.graph, cfg, return_graph=True)
+    sim = Simulator(cfg.machine_spec, num_devices=n_devices,
+                    inference=True)
+    sweep["prefill"] = {
+        "config": "gpt_prefill (GPT_DECODE_KW widths, seq 256)",
+        "sim_mean_ms": round(sim.simulate(g, s) * 1e3, 4),
+        "search_seconds": round(time.monotonic() - t0, 2),
+        "nodes": g.num_nodes,
+    }
+    print(json.dumps({"serve_sweep": "prefill", **sweep["prefill"]}))
+    return sweep
+
+
+def _serve_sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## Inference serving (decode zoo: train vs serve objective)",
+        "",
+        sweep.get("note", ""),
+        "",
+        "| config | topology | objective | decode views | sim mean ms | "
+        "sim p50 ms | sim p90 ms | sim p99 ms | KV MB/dev | differ | "
+        "p99 win |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for name, rows in sweep.get("models", {}).items():
+        for topo, r in rows.items():
+            for obj in ("train", "serve"):
+                views = "; ".join(
+                    f"{v['dims']}r{v['replica']}"
+                    for v in r.get(f"{obj}_decode_views", [])[:2])
+                lines.append(
+                    f"| {name} | {topo} | {obj} | {views} | "
+                    f"{r.get(f'{obj}_sim_mean_ms')} | "
+                    f"{r.get(f'{obj}_sim_p50_ms')} | "
+                    f"{r.get(f'{obj}_sim_p90_ms')} | "
+                    f"{r.get(f'{obj}_sim_p99_ms')} | "
+                    f"{r.get(f'{obj}_kv_mb_per_device')} | "
+                    f"{'yes' if r.get('strategies_differ') else 'no'} | "
+                    f"{r.get('p99_win_ratio', '—') if obj == 'serve' else ''} |")
+    pre = sweep.get("prefill")
+    if pre:
+        lines += [
+            "",
+            f"Prefill contrast ({pre['config']}): "
+            f"{pre['sim_mean_ms']} ms simulated forward, "
+            f"{pre['nodes']} nodes — the compute-bound phase keeps the "
+            f"train currency (no decode ops, nothing ragged).",
+        ]
+    lines += [
+        "",
+        "p99 win = serve-objective strategy's simulated p99 advantage "
+        "over the throughput strategy's, both scored in the SAME "
+        "arrival-model currency.  'differ' marks the configs where the "
+        "two objectives select different strategies — the serving "
+        "Pareto point (ragged max-shard imbalance vs the head-split's "
+        "partial-sum tax) is real, not asserted.",
+    ]
+    return lines
+
+
 def co_search_sweep(n_devices):
     """The --co-search sweep: sequential (strategy→plan) vs JOINT
     strategy x comm-plan pricing (search/comm_plan.py, ROADMAP item 2).
@@ -1258,6 +1455,15 @@ def main():
                     help="run ONLY the scale sweep and merge it into "
                          "the existing artifact, leaving every model "
                          "row untouched")
+    ap.add_argument("--serve", action="store_true",
+                    help="also run the inference-serving sweep: decode "
+                         "zoo x flat/2-slice, throughput-objective vs "
+                         "serve-objective strategies with simulated "
+                         "p50/p90/p99 + KV-residency columns "
+                         "(search/serving.py)")
+    ap.add_argument("--serve-only", action="store_true",
+                    help="run ONLY the serving sweep and merge it into "
+                         "existing BENCH_SEARCH artifacts")
     ap.add_argument("--slice-levels", default=None,
                     help="multi-slice link hierarchy above ICI for the "
                          "sim tier, without a machine file: comma list "
@@ -1305,6 +1511,39 @@ def main():
         BUS.configure(obs_log)
 
     sweep_precisions = [p for p in args.sync_precision.split(",") if p]
+    if args.serve_only:
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["serve_sweep"] = serve_sweep(args.devices)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous serving section (same merge
+            # discipline as the other --*-only modes)
+            marker = "\n## Inference serving"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_serve_sweep_md_lines(
+                        report["serve_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged serving sweep into {path} / {md}")
+        return
     if args.scale_only:
         path = f"{args.out_prefix}.json"
         if os.path.exists(path):
@@ -1626,6 +1865,8 @@ def main():
         report["co_search_sweep"] = co_search_sweep(args.devices)
     if args.scale:
         report["scale_sweep"] = scale_sweep(args.devices)
+    if args.serve:
+        report["serve_sweep"] = serve_sweep(args.devices)
 
     with open(f"{args.out_prefix}.json", "w") as f:
         json.dump(report, f, indent=1)
@@ -1705,6 +1946,8 @@ def main():
         lines += _co_search_sweep_md_lines(report["co_search_sweep"])
     if report.get("scale_sweep"):
         lines += _scale_sweep_md_lines(report["scale_sweep"])
+    if report.get("serve_sweep"):
+        lines += _serve_sweep_md_lines(report["serve_sweep"])
     with open(f"{args.out_prefix}.md", "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"# wrote {args.out_prefix}.json / {args.out_prefix}.md")

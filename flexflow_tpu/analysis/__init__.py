@@ -49,6 +49,7 @@ from flexflow_tpu.analysis.placement import (
 )
 from flexflow_tpu.analysis.sharding import (
     lint_reduction_plan,
+    lint_serving,
     lint_strategy,
     lint_sync_schedule,
     lint_zero_map,
@@ -69,6 +70,7 @@ __all__ = [
     "lint_pipeline_stages",
     "lint_placement",
     "lint_reduction_plan",
+    "lint_serving",
     "lint_strategy",
     "lint_sync_schedule",
     "lint_zero_map",

@@ -83,11 +83,29 @@ search/reduction_plan.py, gated always-on with the schedule):
   the bucket's own (sync-precision-map-coherent) precision — per-level
   precision composes with the map, never contradicts it
 
+Serving-objective legality (``lint_serving`` — the serve/p99 artifacts
+of search/serving.py, gated always-on under
+``FFConfig.objective="serve"`` and re-run at import):
+
+* **SHD160** spec/graph coherence: the ServingSpec's frame geometry
+  matches every decode op's own attrs, decode ops exist, arrival
+  quantile in (0, 1)
+* **SHD161** KV residency fits: per-device memory incl. the
+  full-occupancy page pool within HBM capacity — the "rejected during
+  search, not at OOM" budget, re-proven on persisted artifacts
+* **SHD162** decode view legality: head-split divides the head count,
+  batch degree divides the frame's sequence slots (fixed frames must
+  shard evenly)
+* **SHD163** SLO coherence (warn): predicted p99 over the declared
+  budget is reported, never silently clamped
+
 Pure host-side: no mesh construction, no XLA — safe to run inside
 ``optimize_strategy`` as an always-on gate.
 """
 
 from __future__ import annotations
+
+import math
 
 from typing import Dict, List, Optional
 
@@ -545,4 +563,122 @@ def lint_reduction_plan(graph, strategy: Dict[int, object], schedule,
                     f"bucket's (sync-precision-map-coherent) precision "
                     f"is {bprec!r} — per-level precision must compose "
                     f"with the map, not contradict it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# serving-objective legality (SHD160-163)
+# ---------------------------------------------------------------------------
+def _srv(code: str, message: str, **kw) -> Finding:
+    return Finding(code=code, pass_name="serving", message=message, **kw)
+
+
+def lint_serving(graph, strategy: Dict[int, object], serving,
+                 cost_model, predicted_p99_s: Optional[float] = None,
+                 ) -> List[Finding]:
+    """Legality of a serve-objective result against its ServingSpec
+    (search/serving.py) — the always-on gate ``optimize_strategy`` runs
+    under ``FFConfig.objective="serve"`` before the strategy is
+    returned, persisted or imported:
+
+    * **SHD160** spec/graph coherence: the spec's frame geometry
+      (max_seqs, page_size, pages_per_seq) is positive and matches
+      every decode op's own attrs; the graph HAS decode ops (a serve
+      artifact for a graph with nothing ragged is a provenance bug);
+      the arrival quantile lies in (0, 1).
+    * **SHD161** KV residency fits: per-device memory under the
+      strategy — weights + activations + every decode op's page pool
+      at FULL occupancy — must fit the machine's HBM capacity (the
+      "rejected during search, not at OOM" budget, checked again here
+      so imported/cached artifacts cannot smuggle an over-budget map).
+    * **SHD162** decode view legality: each decode op's replica (head
+      split) degree must divide its head count and the batch degree
+      must divide the frame's sequence slots — frames shard evenly or
+      the executor's fixed frame composition breaks.
+    * **SHD163** SLO coherence (warn): a declared p99 budget that the
+      PREDICTED p99 already exceeds is reported — the deployment is
+      mis-sized, but prediction is not proof, so this warns rather
+      than gates.
+    """
+    from flexflow_tpu.core.machine import MachineView
+    from flexflow_tpu.search.serving import decode_nodes
+
+    findings: List[Finding] = []
+    nodes = decode_nodes(graph)
+    if serving is None:
+        return [_srv("SHD160", "serve artifact carries no serving spec")]
+    if not nodes:
+        return [_srv(
+            "SHD160",
+            "serve objective on a graph with no decode-attention ops — "
+            "nothing here is ragged; the artifact's objective is "
+            "mislabeled")]
+    if (serving.max_seqs < 1 or serving.page_size < 1
+            or serving.pages_per_seq < 1):
+        findings.append(_srv(
+            "SHD160",
+            f"serving spec has non-positive frame geometry "
+            f"(max_seqs={serving.max_seqs}, "
+            f"page_size={serving.page_size}, "
+            f"pages_per_seq={serving.pages_per_seq})"))
+    if not (0.0 < serving.quantile < 1.0):
+        findings.append(_srv(
+            "SHD160",
+            f"arrival quantile {serving.quantile} outside (0, 1)"))
+    if serving.p99_budget_ms < 0:
+        findings.append(_srv(
+            "SHD163",
+            f"declared p99 budget is negative "
+            f"({serving.p99_budget_ms} ms)"))
+    mem = 0.0
+    for node in graph.topo_order():
+        mv = strategy.get(node.guid)
+        if mv is None:
+            mv = node.op.fixed_machine_view() or MachineView.trivial(
+                node.op.output_shapes[0].ndim)
+        if node in nodes:
+            geo = (node.op.max_seqs, node.op.attrs["page_size"],
+                   node.op.attrs["pages_per_seq"])
+            if geo != (serving.max_seqs, serving.page_size,
+                       serving.pages_per_seq):
+                findings.append(_srv(
+                    "SHD160",
+                    f"decode op frame geometry {geo} disagrees with the "
+                    f"serving spec "
+                    f"({serving.max_seqs}, {serving.page_size}, "
+                    f"{serving.pages_per_seq})",
+                    node=node.guid, op=node.op.name))
+            r = max(mv.replica_degree, 1)
+            heads = node.op.attrs["num_heads"]
+            if heads % r != 0:
+                findings.append(_srv(
+                    "SHD162",
+                    f"head-split degree {r} does not divide the op's "
+                    f"{heads} heads", node=node.guid, op=node.op.name))
+            b = max(mv.dim_degrees[0], 1) if mv.dim_degrees else 1
+            if node.op.max_seqs % b != 0:
+                findings.append(_srv(
+                    "SHD162",
+                    f"batch degree {b} does not divide the frame's "
+                    f"{node.op.max_seqs} sequence slots — frames cannot "
+                    f"shard evenly", node=node.guid, op=node.op.name))
+        m = cost_model.op_memory(node.op, mv)
+        if math.isfinite(m):  # NaN/inf views: SHD105's propagation
+            mem += m  # findings own those failures
+    cap = cost_model.machine.hbm_capacity
+    if mem > cap:
+        findings.append(_srv(
+            "SHD161",
+            f"per-device memory under this strategy "
+            f"({mem / 1e9:.2f} GB incl. full-occupancy KV residency) "
+            f"exceeds the HBM capacity ({cap / 1e9:.2f} GB) — the "
+            f"decode deployment cannot hold its page pool"))
+    if (predicted_p99_s is not None and serving.p99_budget_ms > 0
+            and predicted_p99_s * 1e3 > serving.p99_budget_ms):
+        findings.append(_srv(
+            "SHD163",
+            f"predicted p99 decode latency "
+            f"({predicted_p99_s * 1e3:.3f} ms) exceeds the declared "
+            f"SLO budget ({serving.p99_budget_ms:.3f} ms)",
+            severity="warn"))
     return findings

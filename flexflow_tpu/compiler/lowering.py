@@ -329,10 +329,29 @@ class CompiledModel:
             ss = getattr(node.op, "state_specs", None)
             if ss is None:
                 continue
+            # ops that declare per-state shardings (the decode op's
+            # paged KV cache) get their state PLACED under the
+            # strategy's view instead of replicated — the KV residency
+            # the cost model credits to a sharded view is residency the
+            # compiled program actually realizes
+            st_annots = {}
+            ssh = getattr(node.op, "state_shardings", None)
+            if ssh is not None and self._multi_device:
+                mv = self.strategy.get(node.guid)
+                if mv is None:
+                    mv = node.op.fixed_machine_view() or MachineView.trivial(
+                        node.op.output_shapes[0].ndim)
+                st_annots = ssh(mv) or {}
             for name, shape, dtype, fill in ss():
                 v = jnp.full(shape, fill, dtype)
                 if self._multi_device:
-                    v = jax.device_put(v, rep)
+                    annot = st_annots.get(name)
+                    sh = rep if annot is None else jax.sharding.NamedSharding(
+                        self.mesh,
+                        annot_partition_spec(
+                            annot, self._slot_axes[node.guid]),
+                    )
+                    v = jax.device_put(v, sh)
                 state[f"{node.op.name}/{name}"] = v
         self.param_shardings = shardings
         self._zero_shardings = None

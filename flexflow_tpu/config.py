@@ -115,6 +115,20 @@ class FFConfig:
     export_strategy_computation_graph_file: Optional[str] = None
     export_strategy_task_graph_file: Optional[str] = None  # simulated
     # schedule dot export (reference: config.h:142, simulator.cc:1008)
+    objective: str = "train"  # "train" | "serve" — what the strategy
+    # search optimizes.  "train" (default) ranks by mean step time
+    # (throughput), bit-identical to history.  "serve" ranks a DECODE
+    # graph (models/decode.py, ops/decode_attention.py) by simulated
+    # p99 decode-step latency over a ragged-batch arrival model
+    # (search/serving.py): batch splits pay the max-shard imbalance of
+    # ragged KV loads, head splits (decode TP) don't — a different
+    # Pareto point than throughput.  Per-device KV residency at full
+    # page-pool occupancy enters the memory check either way, so
+    # HBM-infeasible strategies are rejected during search, not at OOM.
+    serve_p99_budget_ms: float = 0.0  # declared p99 SLO for the serve
+    # objective (--serve-p99-budget-ms): recorded in __meta__.serving
+    # and linted (SHD163 warns when the predicted p99 exceeds it);
+    # 0 = no declared budget (rank-only)
     comp_mode: str = "training"  # "training" | "inference" — set by
     # compile(comp_mode=...); inference searches rank strategies by
     # forward latency with no weight sync (reference:
@@ -236,6 +250,20 @@ class FFConfig:
             raise ValueError(
                 f"sync_ef must be off|auto, got {self.sync_ef!r}"
             )
+        if self.objective not in ("train", "serve"):
+            raise ValueError(
+                f"objective must be train|serve, got {self.objective!r}"
+            )
+        if self.objective == "serve" and self.co_search:
+            # the joint pricer's exposed-comm currency is a TRAINING
+            # currency (weight-grad sync plans); mixing it with the
+            # serve p99 currency would price plans a decode step never
+            # executes — refuse instead of silently conflating
+            raise ValueError(
+                "objective='serve' does not compose with co_search "
+                "(the joint comm-plan currency prices gradient sync, "
+                "which a decode step does not run)"
+            )
         if self.co_search and self.sync_schedule == "off":
             # the joint pricing currency IS the exposed-comm scheduled
             # sync — co-search without the schedule dimension would
@@ -355,6 +383,18 @@ class FFConfig:
                        help="error-feedback residuals on int8 gradient "
                             "sync (per-group int8_ef wire choice, "
                             "residual threaded as training-loop state)")
+        p.add_argument("--objective", dest="objective",
+                       choices=("train", "serve"), default="train",
+                       help="search objective: 'serve' ranks decode "
+                            "graphs by simulated p99 latency over a "
+                            "ragged arrival model under the HBM "
+                            "KV-residency budget (search/serving.py)")
+        p.add_argument("--serve-p99-budget-ms",
+                       dest="serve_p99_budget_ms", type=float,
+                       default=0.0,
+                       help="declared p99 SLO for objective=serve "
+                            "(recorded in __meta__.serving, linted "
+                            "SHD163); 0 = rank-only")
         p.add_argument("--obs-log", dest="obs_log", type=str, default=None,
                        help="JSONL structured-event telemetry sink "
                             "(flexflow_tpu/obs; tools/ffobs.py renders it)")
@@ -418,6 +458,8 @@ class FFConfig:
             sync_bucket_bytes=args.sync_bucket_bytes,
             co_search=args.co_search,
             sync_ef=args.sync_ef,
+            objective=args.objective,
+            serve_p99_budget_ms=args.serve_p99_budget_ms,
             obs_log_file=args.obs_log,
             obs_trace_file=args.obs_trace,
             drift_threshold=args.drift_threshold,

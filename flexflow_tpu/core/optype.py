@@ -25,6 +25,10 @@ class OperatorType(enum.Enum):
     LINEAR = "linear"
     EMBEDDING = "embedding"
     MULTIHEAD_ATTENTION = "multihead_attention"
+    # TPU-native serving addition: single-token decode attention over a
+    # paged KV cache (ops/decode_attention.py; no reference equivalent —
+    # the reference has no inference path at all)
+    DECODE_ATTENTION = "decode_attention"
     BATCH_MATMUL = "batch_matmul"
     DROPOUT = "dropout"
     SOFTMAX = "softmax"

@@ -1,0 +1,231 @@
+"""Ragged paged decode attention — Pallas TPU kernel + XLA fallback.
+
+The serving-side sibling of ``kernels/flash_attention``: one decode
+step attends a single fresh query token per sequence against that
+sequence's KV cache, which lives in a PAGED pool (PagedAttention /
+"Ragged Paged Attention", arXiv:2604.15464 — PAPERS.md) instead of a
+dense [B, S_max] buffer:
+
+* ``k_pages``/``v_pages`` — [num_pages, page_size, H, D]: one global
+  page pool shared by every sequence; a sequence owns the pages its
+  row of ``page_table`` names, so HBM residency tracks the RAGGED
+  total of live tokens, not B × S_max.
+* ``page_table`` — [B, pages_per_seq] int32 page ids (rows padded with
+  any valid id past the sequence's last live page — masked off).
+* ``seq_lens`` — [B] int32 live token counts; position ``seq_lens[b]``
+  is exclusive (lengths, not indices).
+
+The Pallas kernel runs a flash-style online softmax with the PAGE as
+the KV block: grid (B, H, pages_per_seq), pages innermost so the
+(m, l, acc) scratch accumulators carry across a sequence's pages, and
+the page indirection rides the BlockSpec index_map — the scalar-
+prefetched ``page_table`` picks which pool page each grid step loads,
+so only the sequence's OWN pages ever move HBM→VMEM (the ragged win;
+a dense layout would stream B × S_max tokens).  Pages past
+``ceil(len/page_size)`` are skipped with ``pl.when`` (they still DMA —
+the index map pins them to page 0 — but cost no FLOPs; the tail page's
+dead rows are masked at NEG_INF exactly like flash attention's causal
+mask).  On non-TPU backends the kernel runs in interpreter mode; any
+failure falls back to the gather/masked XLA path so CPU-mesh tests
+cover the same call sites.
+
+``dense_decode_reference`` is the oracle: materialize every sequence's
+KV densely, mask past ``seq_lens``, plain softmax — the parity target
+for both the kernel and the fallback (tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas may be unavailable on some backends; the XLA paths in
+    # this module must stay importable without it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# dense masked reference (the oracle)
+# ---------------------------------------------------------------------------
+def dense_decode_reference(q, k_dense, v_dense, seq_lens, scale=None):
+    """Single-token decode attention against dense per-sequence KV.
+
+    q [B, H, D], k_dense/v_dense [B, S_max, H, D], seq_lens [B] int32
+    -> [B, H, D].  Positions >= seq_lens[b] are masked out.  Pure XLA,
+    numerically the plain (not online) softmax — the reference both
+    the paged kernel and the gather fallback must match."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k_dense.astype(jnp.float32)) * scale
+    pos = jnp.arange(k_dense.shape[1], dtype=jnp.int32)
+    mask = pos[None, None, :] < seq_lens[:, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", p, v_dense.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def gather_kv_pages(pages, page_table):
+    """[P, page_size, H, D] pool + [B, pages_per_seq] table -> dense
+    [B, pages_per_seq * page_size, H, D] per-sequence KV (the fallback
+    path's gather; also how tests densify a paged cache for the
+    oracle)."""
+    g = pages[page_table]  # [B, pages_per_seq, page_size, H, D]
+    b, npp, ps, h, d = g.shape
+    return g.reshape(b, npp * ps, h, d)
+
+
+# ---------------------------------------------------------------------------
+# pure-XLA fallback: gather pages, mask, dense softmax
+# ---------------------------------------------------------------------------
+def _xla_ragged_paged(q, k_pages, v_pages, page_table, seq_lens, scale):
+    k_dense = gather_kv_pages(k_pages, page_table)
+    v_dense = gather_kv_pages(v_pages, page_table)
+    return dense_decode_reference(q, k_dense, v_dense, seq_lens, scale)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+def _rpa_kernel(
+    page_table_ref, seq_lens_ref,  # scalar-prefetch operands
+    q_ref, k_ref, v_ref, o_ref,
+    m_scratch, l_scratch, acc_scratch,
+    *, page_size: int, scale: float,
+):
+    """Grid (B, H, pages_per_seq), pages innermost (sequential on TPU)
+    so the online-softmax scratch carries across one sequence's pages.
+    The k/v BlockSpec index maps already routed THIS grid step's block
+    to pool page ``page_table[b, j]`` — the kernel only masks the
+    ragged tail and skips fully-dead pages."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    npp = pl.num_programs(2)
+    n = seq_lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    # a page whose first slot is already past the ragged length holds
+    # no live token for this sequence
+    @pl.when(j * page_size < n)
+    def _step():
+        q = q_ref[0]        # [1, D] — the lone decode token's row
+        k = k_ref[0, :, 0]  # [page_size, D]
+        v = v_ref[0, :, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [1, page_size] fp32
+        cols = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < n, s, NEG_INF)
+        m_prev = m_scratch[:]  # [1, 1]
+        l_prev = l_scratch[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scratch[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scratch[:] = m_new
+
+    @pl.when(j == npp - 1)
+    def _finish():
+        l = jnp.maximum(l_scratch[:], 1e-30)
+        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+
+
+def _pallas_ragged_paged(q, k_pages, v_pages, page_table, seq_lens, scale,
+                         interpret: bool):
+    b, h, d = q.shape
+    num_pages, page_size, hp, dp = k_pages.shape
+    assert (hp, dp) == (h, d), (k_pages.shape, q.shape)
+    pages_per_seq = page_table.shape[1]
+    grid = (b, h, pages_per_seq)
+
+    def kv_map(bi, hi, j, pt_ref, sl_ref):
+        # dead pages (page slot past ceil(len/page_size)) pin to pool
+        # page 0 — the DMA still runs but pl.when skips the math and
+        # the tail mask kills any live-page partial rows
+        live = (j * page_size) < sl_ref[bi]
+        page = jnp.where(live, pt_ref[bi, j], 0)
+        return (page, 0, hi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda bi, hi, j, pt, sl: (bi, hi, 0)),
+            pl.BlockSpec((1, page_size, 1, d), kv_map),
+            pl.BlockSpec((1, page_size, 1, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, d), lambda bi, hi, j, pt, sl: (bi, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _rpa_kernel, page_size=page_size, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+    return out
+
+
+def ragged_paged_attention(
+    q, k_pages, v_pages, page_table, seq_lens, scale=None,
+):
+    """Paged-KV decode attention: q [B, H, D] (one fresh token per
+    sequence), k_pages/v_pages [P, page_size, H, D], page_table
+    [B, pages_per_seq] int32, seq_lens [B] int32 -> [B, H, D].
+
+    Takes the Pallas kernel when available (interpreter mode off-TPU,
+    like flash_attention), falling back to the gather/masked XLA path
+    on any failure so the CPU mesh exercises identical call sites.
+    Decode is forward-only (no gradients flow into a serving step), so
+    no custom VJP is defined — autodiff through the fallback works for
+    the tests that want it."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    d = q.shape[-1]
+    page_size = k_pages.shape[1]
+    # the kernel wants MXU/VPU-friendly tails: head_dim a multiple of 8
+    # and at least one full lane-worth of page; anything else (tiny CPU
+    # test shapes) is served by the fallback, same contract
+    if _HAS_PLTPU and d % 8 == 0 and page_size % 8 == 0:
+        interpret = jax.default_backend() != "tpu"
+        try:
+            return _pallas_ragged_paged(
+                q, k_pages, v_pages, page_table, seq_lens, float(scale),
+                interpret)
+        except Exception:
+            pass  # fall through to the XLA path (e.g. unsupported jax)
+    return _xla_ragged_paged(q, k_pages, v_pages, page_table, seq_lens,
+                             float(scale))

@@ -180,6 +180,23 @@ class FFModel:
             kernel_initializer=kernel_initializer)
         return self._add_op(op, [query, key, value])[0]
 
+    def decode_attention(self, hidden: Tensor, page_table: Tensor,
+                         seq_lens: Tensor, embed_dim: int, num_heads: int,
+                         page_size: int = 16, pages_per_seq: int = 8,
+                         num_pages: int = 0, use_kernel: bool = True,
+                         kernel_initializer=None, name=None) -> Tensor:
+        """Single-token decode attention over this layer's paged KV
+        cache (ops/decode_attention.py — the serving-side sibling of
+        multihead_attention; no reference equivalent)."""
+        op = O.DecodeAttentionOp(
+            self._fresh_name("decode_attention", name),
+            [self._shape_of(hidden), self._shape_of(page_table),
+             self._shape_of(seq_lens)],
+            embed_dim=embed_dim, num_heads=num_heads, page_size=page_size,
+            pages_per_seq=pages_per_seq, num_pages=num_pages,
+            use_kernel=use_kernel, kernel_initializer=kernel_initializer)
+        return self._add_op(op, [hidden, page_table, seq_lens])[0]
+
     def batch_matmul(self, A: Tensor, B: Tensor, a_seq_length_dim: int = -1,
                      b_seq_length_dim: int = -1, name=None) -> Tensor:
         op = O.BatchMatmulOp(self._fresh_name("bmm", name),
@@ -542,6 +559,46 @@ class FFModel:
                         emit_findings(bad)
                         raise AnalysisError(
                             "imported placement proposal is illegal for "
+                            "this graph/strategy", bad)
+                if _imeta.get("serving") is not None:
+                    # imported serving provenance re-lints against THIS
+                    # graph/strategy (SHD16x): a hand-edited or
+                    # re-targeted serve artifact fails with findings,
+                    # not inside the executor
+                    from flexflow_tpu.analysis import lint_serving
+                    from flexflow_tpu.search.machine_model import (
+                        CostModel as _SCM,
+                    )
+                    from flexflow_tpu.search.serving import ServingSpec
+
+                    _sv = _imeta["serving"]
+                    try:
+                        _spec = ServingSpec(
+                            max_seqs=int(_sv["max_seqs"]),
+                            page_size=int(_sv["page_size"]),
+                            pages_per_seq=int(_sv["pages_per_seq"]),
+                            p99_budget_ms=float(
+                                _sv.get("p99_budget_ms", 0.0)),
+                            quantile=float(_sv.get("quantile", 0.99)),
+                        )
+                    except (KeyError, TypeError, ValueError) as e:
+                        raise AnalysisError(
+                            f"imported strategy file carries a malformed "
+                            f"__meta__.serving block: {e}", []) from e
+                    # inference=... must MATCH the producing gate's cost
+                    # model (the search ran under comp_mode=inference):
+                    # a training-mode CostModel counts activations 2x
+                    # and would SHD161-reject legal near-capacity
+                    # artifacts the search-time gate passed
+                    bad = errors_only(lint_serving(
+                        self.graph, strategy, _spec,
+                        _SCM(self.config.machine_spec,
+                             num_devices=self.config.search_devices,
+                             inference=comp_mode == "inference")))
+                    if bad:
+                        emit_findings(bad)
+                        raise AnalysisError(
+                            "imported serving provenance is illegal for "
                             "this graph/strategy", bad)
                 if _imeta.get("pipeline") is not None:
                     from flexflow_tpu.analysis import (
@@ -987,6 +1044,17 @@ class FFModel:
                 # the co-searched per-group optimizer-sharding map
                 # rides the same digest gate (fflint checks it, STR207)
                 _meta["zero_groups"] = sorted(self.zero_groups)
+            if (searched_strategy
+                    and getattr(self.config, "objective", "train")
+                    == "serve"):
+                # the serve objective's SHD16x-gated provenance
+                # (objective + SLO budget + frame geometry + predicted
+                # p99 + KV residency) persists behind the same digest
+                # gate; fflint strategy checks it stdlib-only (STR209)
+                from flexflow_tpu.search import driver as _sdriver
+
+                if _sdriver.LAST_SERVING_META:
+                    _meta["serving"] = dict(_sdriver.LAST_SERVING_META)
             # pipeline/placement proposals persist NEXT to the strategy
             # behind the same digest gate (the lint already gated them
             # at proposal time; fflint strategy re-checks the frame

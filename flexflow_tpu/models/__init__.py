@@ -10,6 +10,13 @@ from flexflow_tpu.models.transformer import (
     build_gpt_xl,
     build_transformer,
 )
+from flexflow_tpu.models.decode import (
+    GPT_DECODE_KW,
+    GPT_DECODE_SERVE_KW,
+    SERVE_FRAME_SLOTS,
+    build_gpt_decode,
+    build_gpt_prefill,
+)
 from flexflow_tpu.models.dlrm import build_dlrm
 from flexflow_tpu.models.xdl import build_xdl
 from flexflow_tpu.models.candle_uno import build_candle_uno
@@ -25,7 +32,12 @@ __all__ = [
     "build_transformer",
     "build_bert",
     "build_gpt",
+    "build_gpt_decode",
+    "build_gpt_prefill",
     "build_gpt_xl",
+    "GPT_DECODE_KW",
+    "GPT_DECODE_SERVE_KW",
+    "SERVE_FRAME_SLOTS",
     "build_dlrm",
     "build_xdl",
     "build_candle_uno",

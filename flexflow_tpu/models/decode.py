@@ -1,0 +1,127 @@
+"""Serving-phase zoo: prefill + single-token decode variants of the
+GPT configs (ROADMAP item 4 — "open the inference/serving workload").
+
+A serving step has two phases with OPPOSITE cost shapes:
+
+* **prefill** — the prompt's full forward pass: compute-bound causal
+  attention over the whole prompt, exactly the training-side GPT graph
+  minus the loss.  ``build_gpt_prefill`` reuses the causal encoder
+  stack (models/transformer.py) so the strategy search prices it with
+  everything it already knows (flash attention, ring/ulysses SP).
+* **decode** — one token per live sequence per step: memory-bound
+  streaming of the RAGGED paged KV cache.  ``build_gpt_decode`` builds
+  the decode-frame graph whose attention ops are
+  ``DecodeAttentionOp`` — explicit KV-cache state (page-pool indexed),
+  ``page_table``/``seq_lens`` frame inputs, ragged paged attention
+  kernel lowering.
+
+The decode graph's batch dim is the frame's SEQUENCE-SLOT count
+(``max_seqs``), fixed so the compiled program never re-specializes;
+the continuous-batching executor (runtime/decode.py) composes ragged
+requests into frames of this exact shape.
+"""
+
+from __future__ import annotations
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import FFModel
+
+# the canonical small decode config the executor tests lower and run
+# on the CPU mesh: 2 layers deep enough to exercise cache state
+# threading, small enough to compile in seconds
+GPT_DECODE_KW = dict(vocab=2048, num_layers=2, hidden=256, num_heads=8,
+                     ff_dim=512, page_size=16, pages_per_seq=16)
+
+# the serving-regime decode config the serve bench + objective tests
+# SEARCH (never lowered on the CPU mesh): long caches at modest width,
+# the window where the ragged-KV stream dominates the step — per
+# sequence 4096 cached tokens x 4 KB/token, 32-slot frames = 1 GB of
+# pool per layer — so the batch-split max-shard imbalance the serve
+# objective prices is the first-order term, while the weight stream
+# (4 MB/layer of projections) is small enough that the train (mean
+# step) objective still prefers the pure batch split.  This is the
+# configuration where throughput and p99 provably part ways
+# (BENCH_SEARCH.md "Inference serving").
+GPT_DECODE_SERVE_KW = dict(vocab=4096, num_layers=2, hidden=512,
+                           num_heads=8, ff_dim=1024, page_size=32,
+                           pages_per_seq=128)
+SERVE_FRAME_SLOTS = 32  # config.batch_size the serve sweep uses
+
+
+def decode_layer(model, t, page_table, seq_lens, hidden, num_heads,
+                 ff_dim, name, page_size, pages_per_seq, num_pages=0,
+                 layer_norm=True):
+    """One decode-step transformer layer: paged-cache attention +
+    residual + LN + FFN (the decode twin of transformer.encoder_layer,
+    which this must mirror so prefill/decode weights correspond
+    layer-for-layer)."""
+    a = model.decode_attention(
+        t, page_table, seq_lens, embed_dim=hidden, num_heads=num_heads,
+        page_size=page_size, pages_per_seq=pages_per_seq,
+        num_pages=num_pages, name=f"{name}_mha",
+    )
+    t = model.add(a, t, name=f"{name}_res1")
+    if layer_norm:
+        t = model.layer_norm(t, name=f"{name}_ln1")
+    f = model.dense(t, ff_dim, activation="relu", name=f"{name}_ff1")
+    f = model.dense(f, hidden, name=f"{name}_ff2")
+    t = model.add(f, t, name=f"{name}_res2")
+    if layer_norm:
+        t = model.layer_norm(t, name=f"{name}_ln2")
+    return t
+
+
+def build_gpt_decode(config: FFConfig, vocab: int = 2048,
+                     num_layers: int = 2, hidden: int = 256,
+                     num_heads: int = 8, ff_dim: int = 512,
+                     page_size: int = 16, pages_per_seq: int = 16,
+                     num_pages: int = 0):
+    """The single-token decode-step graph: token ids [B, 1] -> next-token
+    logits [B, 1, vocab], where B = config.batch_size is the decode
+    frame's sequence-slot count (max concurrent sequences).
+
+    Inputs, in binding order: ``token_ids`` [B, 1] i32, ``page_table``
+    [B, pages_per_seq] i32, ``seq_lens`` [B] i32.  Every layer's
+    attention reads/writes its OWN page-pool KV cache (model state);
+    all layers share one page-table geometry, so one allocator serves
+    the whole stack."""
+    model = FFModel(config)
+    b = config.batch_size
+    ids = model.create_tensor([b, 1], dtype="int32", name="token_ids")
+    page_table = model.create_tensor([b, pages_per_seq], dtype="int32",
+                                     name="page_table")
+    seq_lens = model.create_tensor([b], dtype="int32", name="seq_lens")
+    t = model.embedding(ids, vocab, hidden, aggr="none", name="tok_embed")
+    # learned positional embedding indexed by the token's position
+    # (= seq_lens): the decode twin of build_gpt's positional table
+    pos = model.reshape(seq_lens, [b, 1], name="pos_ids")
+    p = model.embedding(pos, page_size * pages_per_seq, hidden,
+                        aggr="none", name="pos_embed")
+    t = model.add(t, p, name="embed_sum")
+    for i in range(num_layers):
+        t = decode_layer(
+            model, t, page_table, seq_lens, hidden, num_heads, ff_dim,
+            f"layer{i}", page_size=page_size, pages_per_seq=pages_per_seq,
+            num_pages=num_pages, layer_norm=True,
+        )
+    t = model.layer_norm(t, name="final_ln")
+    t = model.dense(t, vocab, use_bias=False, name="lm_head")
+    return model
+
+
+def build_gpt_prefill(config: FFConfig, vocab: int = 2048,
+                      num_layers: int = 2, hidden: int = 256,
+                      num_heads: int = 8, ff_dim: int = 512,
+                      seq_len: int = 256):
+    """The prompt-phase graph: the causal GPT forward at prompt length
+    (compute-bound, seq-parallelizable — the training-side strategy
+    machinery applies unchanged).  Searched under
+    ``comp_mode="inference"`` it ranks by forward latency; cache
+    POPULATION is the executor's job (runtime/decode.py admits prompts
+    token-by-token through the decode graph on the CPU mesh — a
+    chunked-prefill writer is the on-TPU follow-up, ROADMAP item 4)."""
+    from flexflow_tpu.models.transformer import build_gpt
+
+    return build_gpt(config, vocab=vocab, num_layers=num_layers,
+                     hidden=hidden, num_heads=num_heads, ff_dim=ff_dim,
+                     seq_len=seq_len)

@@ -52,6 +52,18 @@ EVENT_KINDS = {
     # the per-group optimizer-state sharding choice the co-search
     # adopted for its final result (ZeRO-1 dimension)
     "search.zero_groups": {"groups", "credit_s"},
+    # serve-objective result (search/serving.py, FFConfig.objective):
+    # the SHD16x-gated p99/KV-residency numbers of the returned strategy
+    "search.serve": {"p99_s", "kv_bytes_per_device"},
+    # continuous-batching decode executor (runtime/decode.py): one
+    # event per composed decode frame (admissions/evictions/page
+    # residency + measured latency, predicted_s when a serving pricer
+    # supplied one) and one end-of-run roll-up — the decode phase of
+    # the predicted-vs-measured story (ffobs report renders both)
+    "decode.frame": {"frame", "active", "admitted", "evicted",
+                     "pages_in_use"},
+    "decode.summary": {"frames", "completed", "measured_p50_s",
+                       "measured_p99_s"},
     # DP inner loop (search/dp.py)
     "dp.split": {"op", "pre_nodes", "post_nodes", "cost_s"},
     "dp.summary": {"memo_hits", "memo_misses"},

@@ -26,6 +26,7 @@ from flexflow_tpu.ops.norm import BatchNormOp, DropoutOp, LayerNormOp, SoftmaxOp
 from flexflow_tpu.ops.conv import Conv2DOp, Pool2DOp
 from flexflow_tpu.ops.embedding import EmbeddingOp
 from flexflow_tpu.ops.attention import BatchMatmulOp, MultiHeadAttentionOp
+from flexflow_tpu.ops.decode_attention import DecodeAttentionOp
 from flexflow_tpu.ops.reductions import GatherOp, MeanOp, TopKOp
 from flexflow_tpu.ops.moe import AggregateOp, AggregateSpecOp, CacheOp, GroupByOp
 
@@ -58,6 +59,7 @@ __all__ = [
     "Pool2DOp",
     "EmbeddingOp",
     "BatchMatmulOp",
+    "DecodeAttentionOp",
     "MultiHeadAttentionOp",
     "GatherOp",
     "MeanOp",

@@ -1,3 +1,15 @@
 from flexflow_tpu.runtime.dataloader import SingleDataLoader
+from flexflow_tpu.runtime.decode import (
+    ContinuousBatchingExecutor,
+    DecodeRequest,
+    PageAllocator,
+    compiled_decode_step,
+)
 
-__all__ = ["SingleDataLoader"]
+__all__ = [
+    "SingleDataLoader",
+    "ContinuousBatchingExecutor",
+    "DecodeRequest",
+    "PageAllocator",
+    "compiled_decode_step",
+]

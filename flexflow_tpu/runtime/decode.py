@@ -1,0 +1,348 @@
+"""Continuous-batching decode executor.
+
+The runtime half of the serving workload (ROADMAP item 4): compose
+RAGGED requests into FIXED decode frames — the [max_seqs]-slot shape
+the compiled decode graph (models/decode.py) was specialized for — so
+one jitted program serves an arbitrary request stream:
+
+* a ``PageAllocator`` owns the KV page pool; a request is **admitted**
+  only when its full page allotment is free (reservation-style
+  residency — an admitted sequence can always grow to ``max_seq_len``
+  without preemption), and **evicted** (pages freed, slot reopened)
+  when it finishes;
+* each ``step`` fills every live slot's next token through ONE decode
+  graph call — prompt tokens first (prefill-via-decode: correct by
+  construction on any mesh; a chunked prefill writer is the on-TPU
+  fast path, see models/decode.py build_gpt_prefill), then generated
+  tokens until ``max_new_tokens`` or EOS;
+* every frame emits a ``decode.frame`` obs event (admissions,
+  evictions, live slots, pages in use, measured latency, predicted
+  latency when the caller supplies the search's number) and the run
+  ends with a ``decode.summary`` roll-up — the decode phase of the
+  predicted-vs-measured story; ``decode_drift_report`` folds the
+  measured frame latencies against the search's predicted p99 into
+  the same DriftReport shape model.fit produces for training steps
+  (``ffobs report`` renders both).
+
+The executor is deliberately decoupled from FFModel: it drives any
+``step_fn(token_ids [B,1] i32, page_table [B,P] i32, seq_lens [B] i32)
+-> logits [B, 1, V]``; ``compiled_decode_step`` builds that function
+from a compiled decode model (threading the KV-cache state dict
+across calls).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flexflow_tpu.obs.events import BUS
+
+
+@dataclass
+class DecodeRequest:
+    """One sequence to serve: the prompt's token ids and how many new
+    tokens to generate.  ``eos_id`` stops generation early when the
+    model emits it (None = run to max_new_tokens)."""
+
+    rid: str
+    prompt: Sequence[int]
+    max_new_tokens: int = 8
+    eos_id: Optional[int] = None
+
+
+@dataclass
+class _Live:
+    req: DecodeRequest
+    pages: List[int]
+    tokens: List[int] = field(default_factory=list)  # prompt + generated
+    cached: int = 0        # tokens already written into the KV cache
+    generated: int = 0
+    started_frame: int = 0
+
+
+class PageAllocator:
+    """Free-list page allocator over the decode graph's pool."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def alloc_ids(self, ids: Sequence[int]) -> Optional[List[int]]:
+        """Reserve SPECIFIC page ids (the slot-aligned fast path), or
+        None when any is already in use."""
+        if any(p not in self._free for p in ids):
+            return None
+        for p in ids:
+            self._free.remove(p)
+        return list(ids)
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            assert 0 <= p < self.num_pages and p not in self._free, p
+            self._free.append(p)
+
+
+class ContinuousBatchingExecutor:
+    """Admit ragged requests into fixed decode frames and drive the
+    step function until every request completes."""
+
+    def __init__(self, step_fn: Callable, *, max_seqs: int,
+                 page_size: int, pages_per_seq: int, num_pages: int = 0,
+                 predicted_step_s: Optional[float] = None):
+        self.step_fn = step_fn
+        self.max_seqs = max_seqs
+        self.page_size = page_size
+        self.pages_per_seq = pages_per_seq
+        self.allocator = PageAllocator(num_pages or max_seqs * pages_per_seq)
+        # slot-aligned allocation: when the pool covers every slot,
+        # slot i always takes pages [i*pps, (i+1)*pps) — contiguous
+        # slot shards own contiguous page ranges, which is EXACTLY the
+        # page-dim split the decode op's state_shardings places under a
+        # batch-split view, so the device-local cache streaming the
+        # cost model credits to batch splits is realized by the
+        # executor, not merely priced.  Undersized (oversubscribed)
+        # pools fall back to the free list, where a sequence's pages
+        # may land on another group's shard — the locality price of
+        # oversubscription.
+        self.slot_aligned = (
+            self.allocator.num_pages >= max_seqs * pages_per_seq)
+        # idle frame rows still scatter one garbage k/v (static-shape
+        # scatter — the op cannot skip rows), so they must point at a
+        # page no LIVE sequence can own.  Slot-aligned pools use the
+        # idle slot's OWN range (free by construction while the slot is
+        # idle; a later admission rewrites every position before
+        # reading it).  Oversubscribed pools RESERVE one scratch page
+        # up front — one page of capacity is the price of a pool that
+        # can otherwise be fully exhausted while slots sit idle (the
+        # free-list fallback of picking "some free page" corrupts live
+        # cache exactly then).
+        self._scratch_page = None
+        if not self.slot_aligned:
+            got = self.allocator.alloc(1)
+            assert got, "page pool too small to reserve the scratch page"
+            self._scratch_page = got[0]
+        # the search's predicted (p99) decode-step seconds, when the
+        # caller has one — recorded per frame so drift is computable
+        self.predicted_step_s = predicted_step_s
+        self.slots: List[Optional[_Live]] = [None] * max_seqs
+        self.queue: List[DecodeRequest] = []
+        self.finished: Dict[str, List[int]] = {}
+        self.frame = 0
+        self.frame_seconds: List[float] = []
+        self.total_admitted = 0
+        self.total_evicted = 0
+
+    # ------------------------------------------------------------------
+    def submit(self, requests: Sequence[DecodeRequest]) -> None:
+        for r in requests:
+            assert r.prompt, f"request {r.rid!r} has an empty prompt"
+            need = len(r.prompt) + r.max_new_tokens
+            cap = self.page_size * self.pages_per_seq
+            assert need <= cap, (
+                f"request {r.rid!r} wants {need} tokens but a sequence "
+                f"caps at {cap} (page_size x pages_per_seq)")
+            self.queue.append(r)
+
+    def _admit(self) -> int:
+        """Fill open slots from the queue while the allocator can
+        reserve a FULL per-sequence allotment (admission by page
+        residency: an admitted sequence never needs preemption)."""
+        admitted = 0
+        for i in range(self.max_seqs):
+            if self.slots[i] is not None or not self.queue:
+                continue
+            if self.slot_aligned:
+                pages = self.allocator.alloc_ids(range(
+                    i * self.pages_per_seq, (i + 1) * self.pages_per_seq))
+            else:
+                pages = self.allocator.alloc(self.pages_per_seq)
+            if pages is None:
+                break
+            req = self.queue.pop(0)
+            self.slots[i] = _Live(req=req, pages=pages,
+                                  tokens=list(req.prompt),
+                                  started_frame=self.frame)
+            admitted += 1
+        self.total_admitted += admitted
+        return admitted
+
+    def _evict(self) -> int:
+        """Free finished sequences' pages and reopen their slots."""
+        evicted = 0
+        for i, live in enumerate(self.slots):
+            if live is None:
+                continue
+            done_gen = live.generated >= live.req.max_new_tokens
+            eos = (live.req.eos_id is not None and live.generated > 0
+                   and live.tokens[-1] == live.req.eos_id)
+            if done_gen or eos:
+                self.finished[live.req.rid] = live.tokens[len(live.req.prompt):]
+                self.allocator.free(live.pages)
+                self.slots[i] = None
+                evicted += 1
+        self.total_evicted += evicted
+        return evicted
+
+    # ------------------------------------------------------------------
+    def _compose_frame(self):
+        """The fixed-shape frame arrays for the CURRENT step: every
+        live slot contributes its next uncached token (a prompt token
+        still being prefilled, or the last generated token); idle slots
+        carry token 0 at length 0 — page_table rows of idle slots point
+        at page 0 of live-anywhere pages, masked off by seq_lens=0."""
+        b = self.max_seqs
+        ids = np.zeros((b, 1), np.int32)
+        table = np.zeros((b, self.pages_per_seq), np.int32)
+        lens = np.zeros((b,), np.int32)
+        active = []
+        for i, live in enumerate(self.slots):
+            if live is None:
+                # idle row: its scatter must land where no live
+                # sequence reads (see __init__ — own slot range when
+                # slot-aligned, the reserved scratch page otherwise)
+                if self.slot_aligned:
+                    table[i, :] = np.arange(
+                        i * self.pages_per_seq,
+                        (i + 1) * self.pages_per_seq)
+                else:
+                    table[i, :] = self._scratch_page
+                continue
+            active.append(i)
+            ids[i, 0] = live.tokens[live.cached]
+            table[i, :len(live.pages)] = live.pages
+            lens[i] = live.cached
+        return ids, table, lens, active
+
+    def step(self) -> dict:
+        """One decode frame: admit, compose, run, harvest, evict.
+        Returns the frame record (also emitted as ``decode.frame``)."""
+        admitted = self._admit()
+        ids, table, lens, active = self._compose_frame()
+        t0 = time.perf_counter()
+        logits = np.asarray(self.step_fn(ids, table, lens))
+        dt = time.perf_counter() - t0
+        self.frame_seconds.append(dt)
+        next_tokens = logits[:, 0].argmax(axis=-1).astype(np.int32) \
+            if logits.ndim == 3 else logits[:, 0].astype(np.int32)
+        for i in active:
+            live = self.slots[i]
+            live.cached += 1
+            if live.cached < len(live.tokens):
+                continue  # still prefilling: the next prompt token is queued
+            # the model's prediction extends the sequence
+            live.tokens.append(int(next_tokens[i]))
+            live.generated += 1
+        evicted = self._evict()
+        rec = {
+            "frame": self.frame,
+            "active": len(active),
+            "admitted": admitted,
+            "evicted": evicted,
+            "pages_in_use": self.allocator.pages_in_use,
+            "queued": len(self.queue),
+            "measured_s": dt,
+            "predicted_s": self.predicted_step_s,
+        }
+        if BUS.enabled:
+            BUS.emit("decode.frame", **rec)
+        self.frame += 1
+        return rec
+
+    def run(self, requests: Sequence[DecodeRequest] = (),
+            max_frames: int = 10_000) -> Dict[str, List[int]]:
+        """Drive frames until every submitted request finished (or the
+        frame cap trips — a stuck executor must fail loud, not spin).
+        Returns rid -> generated token ids."""
+        if requests:
+            self.submit(requests)
+        while (self.queue or any(s is not None for s in self.slots)):
+            if self.frame >= max_frames:
+                raise RuntimeError(
+                    f"decode executor exceeded {max_frames} frames with "
+                    f"{len(self.queue)} queued and "
+                    f"{sum(s is not None for s in self.slots)} live")
+            self.step()
+        if BUS.enabled:
+            BUS.emit("decode.summary", **self.summary())
+        return dict(self.finished)
+
+    # ------------------------------------------------------------------
+    def summary(self) -> dict:
+        times = sorted(self.frame_seconds)
+        q = (lambda f: times[min(len(times) - 1,
+                                 int(f * (len(times) - 1)))]) if times \
+            else (lambda f: None)
+        return {
+            "frames": self.frame,
+            "completed": len(self.finished),
+            "admitted": self.total_admitted,
+            "evicted": self.total_evicted,
+            "measured_p50_s": q(0.5),
+            "measured_p99_s": q(0.99),
+            "predicted_step_s": self.predicted_step_s,
+        }
+
+    def decode_drift_report(self, threshold: float = 0.5):
+        """Predicted-vs-measured DECODE drift: the search's p99 step
+        prediction against the measured frame-latency p99 — the decode
+        phase of the DriftReport family (obs/drift.py).  None when
+        either side is missing.  Emitted as a ``drift.report`` event
+        when the bus is armed, like model.fit's training-side report."""
+        from flexflow_tpu.obs.drift import build_drift_report
+
+        s = self.summary()
+        if not self.predicted_step_s or not s["measured_p99_s"]:
+            return None
+        report = build_drift_report(
+            {"total_s": self.predicted_step_s},
+            s["measured_p99_s"], threshold=threshold)
+        if report is not None:
+            report.phases["decode"] = {
+                "predicted_s": self.predicted_step_s,
+                "measured_s": s["measured_p99_s"],
+                "ratio": report.ratio,
+            }
+            if BUS.enabled:
+                BUS.emit("drift.report", predicted_s=report.predicted_s,
+                         measured_s=report.measured_s, ratio=report.ratio,
+                         stale=report.stale, phase="decode")
+        return report
+
+
+def compiled_decode_step(model) -> Callable:
+    """A ``step_fn`` over a COMPILED decode model: one jitted forward
+    per frame, the KV-cache state dict threaded across calls (the
+    caches are model state — compiler/lowering.py init_params placed
+    them under the strategy's view)."""
+    import jax
+
+    compiled = model.compiled
+    fn = jax.jit(
+        lambda p, s, ins: compiled.apply(p, s, ins, None, False))
+    box = {"state": model.state}
+
+    def step(ids, page_table, seq_lens):
+        logits, new_state = fn(
+            model.params, box["state"], [ids, page_table, seq_lens])
+        box["state"] = new_state
+        return logits
+
+    step.state = box  # tests inspect the threaded cache
+    return step

@@ -131,6 +131,14 @@ def cost_signature(cost_model) -> str:
         # before the flag existed (same discipline as search_key's
         # co_search marker)
         parts["sync_ef"] = True
+    serving = getattr(cost_model, "serving", None)
+    if serving is not None:
+        # serve-objective rows price the decode ops' cache stream at
+        # the arrival model's ragged quantile load — a different cost
+        # surface per ServingSpec.  Extension-only: objective="train"
+        # signatures stay byte-identical to every cache written before
+        # the serving dimension existed
+        parts["serving"] = list(serving.signature())
     return hashlib.sha256(
         json.dumps(parts, sort_keys=True).encode()).hexdigest()[:16]
 
@@ -463,6 +471,13 @@ class CostCache:
             # must stay byte-identical to caches written before the
             # flag existed
             knobs = knobs + ("co_search",)
+        if getattr(config, "objective", "train") == "serve":
+            # the serve objective is a different search function (p99
+            # currency + serving lint gate) — same extension-only rule
+            knobs = knobs + (
+                "serve",
+                float(getattr(config, "serve_p99_budget_ms", 0.0) or 0.0),
+            )
         return stable_graph_digest(graph) + ":" + hashlib.sha256(
             repr(knobs).encode()).hexdigest()[:12]
 

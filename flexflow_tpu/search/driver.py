@@ -654,6 +654,14 @@ LAST_SYNC_SCHEDULE = None
 # LAST_SYNC_SCHEDULE; () when co-search is off or nothing qualifies
 LAST_ZERO_GROUPS: tuple = ()
 
+# the serving provenance of the LAST optimize_strategy run under
+# config.objective="serve" (search/serving.py): the SHD16x-gated
+# objective + SLO budget + frame geometry + predicted p99 + per-device
+# KV residency — compile() persists it as __meta__.serving behind the
+# digest gate (fflint strategy checks it stdlib-only, STR209); None
+# under the default train objective
+LAST_SERVING_META = None
+
 
 def _build_sync_schedule(graph, strategy, sim, config, joint=None):
     """Choose + legality-gate the gradient-sync schedule for a search
@@ -830,6 +838,7 @@ def optimize_strategy(
 def _optimize_strategy(
     graph: Graph, config: FFConfig, return_graph: bool = False
 ) -> "Strategy | Tuple[Graph, Strategy]":
+    global LAST_SERVING_META
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     t_start = time.monotonic()
@@ -947,7 +956,37 @@ def _optimize_strategy(
                 log.log(f"{len(calibration)} measured records")
             if config.calibration_file:
                 calibration.save(config.calibration_file)
-    sim = Simulator.for_config(config, calibration=calibration)
+    serving = None
+    if getattr(config, "objective", "train") == "serve":
+        # serving objective (search/serving.py): derive the arrival
+        # model from the graph's own decode ops and arm it at SIM
+        # CONSTRUCTION (before the cost cache computes its signature) —
+        # the whole search then ranks in the p99 decode-latency
+        # currency.  A serve search of a graph with no decode ops
+        # degenerates to train pricing; say so instead of silently
+        # renaming the objective.
+        if config.comp_mode != "inference":
+            # a decode step runs no backward and no gradient sync:
+            # pricing the p99 currency with training costs would mint
+            # an SLO number for a step that never executes — refuse
+            # loudly (the same discipline as the serve+co_search guard)
+            raise ValueError(
+                "objective='serve' requires comp_mode='inference' "
+                "(set FFConfig.comp_mode or pass "
+                "model.compile(comp_mode='inference')): a decode step "
+                "has no backward, so the training currency would price "
+                "an SLO the serving step never runs")
+        from flexflow_tpu.search.serving import serving_spec_for
+
+        serving = serving_spec_for(graph, config)
+        if serving is None:
+            log.log(
+                "objective='serve' on a graph with no decode-attention "
+                "ops: nothing is ragged here — pricing falls back to "
+                "the train (mean step) currency"
+            )
+    sim = Simulator.for_config(config, calibration=calibration,
+                               serving=serving)
     floor_sim = sim  # the sim the champion-vs-DP floor must score with
     helper = SearchHelper(sim, n)
     joint = None
@@ -1001,12 +1040,51 @@ def _optimize_strategy(
                 )
                 cache.drop_search_result(graph, config)
                 served = None
+        if served is not None and serving is not None:
+            # serve objective: served artifacts pass the SAME always-on
+            # SHD16x serving gate as fresh results — an over-budget or
+            # geometry-incoherent entry costs one re-search, never an
+            # illegal serve
+            from flexflow_tpu.analysis import (
+                emit_findings,
+                errors_only,
+                lint_serving,
+            )
+
+            sfind = lint_serving(best_graph, best_strategy, serving,
+                                 floor_sim.cost,
+                                 predicted_p99_s=best_cost)
+            emit_findings(sfind)
+            sbad = errors_only(sfind)
+            if sbad:
+                log.log(
+                    f"cost cache: served search result FAILED the "
+                    f"serving gate ({sbad[0]}); dropping the entry and "
+                    f"searching fresh"
+                )
+                cache.drop_search_result(graph, config)
+                served = None
         if served is not None:
             log.log(
                 f"cost cache: served searched strategy "
                 f"({best_cost * 1e3:.4f} ms/iter) for {graph.num_nodes}-"
                 f"node graph — skipping the search"
             )
+            LAST_SERVING_META = None
+            if serving is not None:
+                from flexflow_tpu.search.serving import kv_residency_bytes
+
+                LAST_SERVING_META = {
+                    "objective": "serve",
+                    "p99_budget_ms": serving.p99_budget_ms,
+                    "max_seqs": serving.max_seqs,
+                    "page_size": serving.page_size,
+                    "pages_per_seq": serving.pages_per_seq,
+                    "quantile": serving.quantile,
+                    "predicted_p99_step_ms": round(best_cost * 1e3, 6),
+                    "kv_bytes_per_device": kv_residency_bytes(
+                        best_graph, best_strategy, n),
+                }
             _emit_search_done(
                 floor_sim, best_graph, graph, best_strategy, best_cost,
                 kept_dp=False, helper=helper, t_start=t_start,
@@ -1090,7 +1168,8 @@ def _optimize_strategy(
                     )
                     if config.calibration_file:
                         calibration.save(config.calibration_file)
-                    sim2 = Simulator.for_config(config, calibration=calibration)
+                    sim2 = Simulator.for_config(config, calibration=calibration,
+                                                serving=serving)
                     floor_sim = sim2  # sim's _node_costs cache predates
                     # the new probes; the floor must not mix tables
                     best_cost = _price(sim2, graph, best_strategy)
@@ -1148,6 +1227,44 @@ def _optimize_strategy(
             f"legality lint ({bad[0]}); returning it for the compile "
             f"fallbacks, NOT persisting"
         )
+
+    # serving gate (objective="serve", always-on like the strategy
+    # lint above): the result must be a LEGAL serving artifact — frame
+    # geometry coherent with the spec, KV residency within HBM, decode
+    # views the executor's fixed frames can shard (SHD160-162; SHD163
+    # warns on a blown SLO) — before it is returned or persisted.
+    LAST_SERVING_META = None
+    if serving is not None and best_strategy and math.isfinite(best_cost):
+        from flexflow_tpu.analysis import (
+            AnalysisError,
+            emit_findings,
+            errors_only,
+            lint_serving,
+        )
+        from flexflow_tpu.search.serving import kv_residency_bytes
+
+        sfind = lint_serving(best_graph, best_strategy, serving,
+                             floor_sim.cost, predicted_p99_s=best_cost)
+        emit_findings(sfind)
+        sbad = errors_only(sfind)
+        if sbad:
+            raise AnalysisError(
+                "serve-objective search produced an illegal serving "
+                "artifact", sbad)
+        kv = kv_residency_bytes(best_graph, best_strategy, n)
+        LAST_SERVING_META = {
+            "objective": "serve",
+            "p99_budget_ms": serving.p99_budget_ms,
+            "max_seqs": serving.max_seqs,
+            "page_size": serving.page_size,
+            "pages_per_seq": serving.pages_per_seq,
+            "quantile": serving.quantile,
+            "predicted_p99_step_ms": round(best_cost * 1e3, 6),
+            "kv_bytes_per_device": kv,
+        }
+        BUS.emit("search.serve", p99_s=best_cost,
+                 budget_ms=serving.p99_budget_ms,
+                 kv_bytes_per_device=kv, kept_dp=kept_dp)
 
     # persist: cost rows accumulated this search + the finished result
     # (only complete searches — a deadline-truncated result is not the

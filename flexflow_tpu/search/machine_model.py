@@ -100,6 +100,15 @@ class CostModel:
     # int8 and the currency cannot see the error it removes, so the
     # upgrade is gated here instead of argmin'd
     sync_ef: bool = False
+    # serving arrival model (search/serving.py ServingSpec,
+    # FFConfig.objective="serve"): ops with a `sharded_bytes_accessed`
+    # hook (the paged-KV decode attention) then price their ragged
+    # cache stream at the spec's p-quantile max-shard load instead of
+    # full occupancy, which puts the WHOLE search — both DP engines,
+    # estimates, delta sim, the floor — in the p99-latency currency.
+    # None (the default) changes nothing: every existing op's pricing
+    # is byte-identical
+    serving: Optional[object] = None
 
     # ---- slice topology --------------------------------------------------
     def levels(self):
@@ -255,8 +264,17 @@ class CostModel:
         ``mv`` (all shards run concurrently on distinct devices).
         A calibration measurement for (op, view) overrides the
         roofline forward estimate when available."""
+        # ops with a per-shard bytes hook (the paged-KV decode
+        # attention) own their HBM-stream sharding rule: a head split
+        # genuinely divides the cache read, and an armed serving spec
+        # scales it to the ragged p-quantile load.  Such ops skip the
+        # calibration override when a serving spec is armed — a lone-
+        # chip probe measured full occupancy, which is exactly the
+        # shape the serve currency must NOT price.
+        sharded_bytes = getattr(op, "sharded_bytes_accessed", None)
         fwd = None
-        if self.calibration is not None:
+        if self.calibration is not None and not (
+                sharded_bytes is not None and self.serving is not None):
             fwd = self.calibration.get(op, mv)
         if fwd is None:
             # replica groups do REDUNDANT work: only the partition count
@@ -266,7 +284,10 @@ class CostModel:
             # compute that execution pays in full.
             parts = max(1, mv.num_parts // max(1, mv.replica_degree))
             flops = op.flops() / parts
-            bytes_ = op.bytes_accessed() / parts
+            if sharded_bytes is not None:
+                bytes_ = sharded_bytes(mv, serving=self.serving)
+            else:
+                bytes_ = op.bytes_accessed() / parts
             fwd = max(
                 flops / self.machine.peak_flops,
                 bytes_ / self.machine.hbm_bandwidth,
@@ -970,4 +991,13 @@ class CostModel:
                 n //= max(d, 1)
             mem += n * shape.dtype.itemsize * (1 if self.inference else 2)
             # fwd activation (+ its grad when training)
+        kv = getattr(op, "kv_cache_bytes", None)
+        if kv is not None:
+            # per-device KV residency at FULL page-pool occupancy (the
+            # paged decode cache, ops/decode_attention.py): strategies
+            # that cannot hold the pool are rejected inside the search's
+            # memory check, not at runtime OOM.  Full occupancy, not the
+            # arrival model's ragged load — HBM must fit the worst frame
+            # the executor is allowed to admit.
+            mem += kv(mv)
         return mem

@@ -99,7 +99,7 @@ class Simulator:
                  use_network_model: bool = True, calibration=None,
                  placement_overlap: bool = False, zero_dp_shard: bool = False,
                  inference: bool = False, sync_precision: str = "fp32",
-                 sync_ef: bool = False, cost_cache=None):
+                 sync_ef: bool = False, cost_cache=None, serving=None):
         self.machine = machine
         self.num_devices = num_devices or machine.num_devices
         # placement_overlap=True credits inter-op COMPUTE overlap for
@@ -130,12 +130,17 @@ class Simulator:
                 network = ici_network(machine, num_devices=self.num_devices)
             except (AssertionError, ValueError):
                 network = None
+        # serving: a search/serving.py ServingSpec — arms the serve
+        # objective's ragged-load pricing (MUST be set at construction,
+        # before the persistent cost cache computes its signature, so
+        # serve-currency rows never cross-serve train runs)
         self.cost = CostModel(machine, network=network, calibration=calibration,
                               num_devices=self.num_devices,
                               zero_dp_shard=zero_dp_shard,
                               inference=inference,
                               sync_precision=sync_precision,
-                              sync_ef=sync_ef)
+                              sync_ef=sync_ef,
+                              serving=serving)
         self._device_sets: Dict[Tuple, FrozenSet[int]] = {}
         # propagate()/op_cost results per (op signature, view): structural
         # keys stay valid across graph copies and op lifetimes (an id()

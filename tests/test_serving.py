@@ -1,0 +1,681 @@
+"""Serving workload: ragged paged decode attention, KV-cache-aware
+search, the serve (p99/SLO) objective, and the continuous-batching
+executor (ISSUE 10 / ROADMAP item 4).
+
+Contract highlights:
+
+* the ragged paged kernel (Pallas-interpret AND the XLA fallback)
+  matches the dense masked reference across ragged shapes, including
+  the single-token and full-page boundaries;
+* per-device KV residency enters the simulator's memory check: a
+  strategy that cannot hold the page pool is rejected INSIDE the
+  search, never at OOM;
+* on the serving-regime decode config the serve objective selects a
+  DIFFERENT strategy than the throughput objective and wins on
+  simulated p99 (the acceptance scenario BENCH_SEARCH records);
+* with objective="train" (the default) the serving machinery is
+  structurally inert — a poisoned spec builder proves the default
+  path never touches it, and cache signatures only extend under serve;
+* the executor's continuous batching is semantically invisible:
+  serving requests batched with admission/eviction yields EXACTLY the
+  tokens of serving each request alone.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.machine import MachineSpec, MachineView
+from flexflow_tpu.core.optype import OperatorType
+
+N_DEV = 8
+
+
+def _trivial_strategy(graph):
+    return {
+        n.guid: (n.op.fixed_machine_view()
+                 or MachineView.trivial(n.op.output_shapes[0].ndim))
+        for n in graph.topo_order()
+    }
+
+
+def _decode_views(graph, strategy):
+    return [
+        (tuple(strategy[n.guid].dim_degrees),
+         strategy[n.guid].replica_degree)
+        for n in graph.topo_order()
+        if n.op.op_type == OperatorType.DECODE_ATTENTION
+    ]
+
+
+# ---------------------------------------------------------------------------
+# kernel parity vs the dense masked reference
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "B,H,D,page_size,pages_per_seq,lens",
+    [
+        (4, 2, 16, 8, 3, (1, 8, 17, 24)),   # single-token + full-page
+        (2, 4, 32, 16, 2, (16, 32)),        # exact page boundaries
+        (3, 1, 8, 8, 4, (1, 9, 31)),        # ragged mid-page
+        (2, 2, 8, 4, 2, (3, 7)),            # sub-lane tiny pages
+    ],
+)
+def test_ragged_kernel_matches_dense_reference(B, H, D, page_size,
+                                               pages_per_seq, lens):
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.ragged_paged_attention import (
+        _pallas_ragged_paged,
+        _xla_ragged_paged,
+        dense_decode_reference,
+        gather_kv_pages,
+        ragged_paged_attention,
+    )
+
+    rng = np.random.default_rng(0)
+    P = B * pages_per_seq + 2  # pool larger than the allotment
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(P, page_size, H, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, page_size, H, D)), jnp.float32)
+    pt = jnp.asarray(
+        rng.permutation(P)[:B * pages_per_seq].reshape(B, pages_per_seq),
+        jnp.int32)
+    sl = jnp.asarray(lens, jnp.int32)
+    scale = 1.0 / math.sqrt(D)
+    ref = dense_decode_reference(
+        q, gather_kv_pages(kp, pt), gather_kv_pages(vp, pt), sl)
+    got = ragged_paged_attention(q, kp, vp, pt, sl)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    fb = _xla_ragged_paged(q, kp, vp, pt, sl, scale)
+    np.testing.assert_allclose(np.asarray(fb), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    if D % 8 == 0 and page_size % 8 == 0:
+        pk = _pallas_ragged_paged(q, kp, vp, pt, sl, scale, True)
+        np.testing.assert_allclose(np.asarray(pk), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_decode_op_incremental_matches_dense():
+    """Stepping DecodeAttentionOp token by token must equal dense
+    attention over every token cached so far — the cache scatter, the
+    page indirection, and the +1 fresh-token length all proven against
+    plain softmax."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.core.ptensor import ParallelTensorShape
+    from flexflow_tpu.kernels.ragged_paged_attention import (
+        dense_decode_reference,
+    )
+    from flexflow_tpu.ops.base import LoweringContext
+    from flexflow_tpu.ops.decode_attention import DecodeAttentionOp
+
+    B, E, H, ps, pps = 2, 32, 4, 4, 3
+    op = DecodeAttentionOp(
+        "dec",
+        [ParallelTensorShape.make((B, 1, E), "float32"),
+         ParallelTensorShape.make((B, pps), "int32"),
+         ParallelTensorShape.make((B,), "int32")],
+        embed_dim=E, num_heads=H, page_size=ps, pages_per_seq=pps)
+    rng = np.random.default_rng(1)
+    weights = {
+        ws.name: jnp.asarray(rng.normal(size=ws.shape) * 0.1, jnp.float32)
+        for ws in op._weight_specs
+    }
+    state = {}
+    for name, shape, dtype, fill in op.state_specs():
+        state[f"dec/{name}"] = jnp.full(shape, fill, dtype)
+    # non-trivial page assignment (pages deliberately interleaved)
+    pt = jnp.asarray([[1, 3, 5], [0, 2, 4]], jnp.int32)
+    steps = ps * pps - 1
+    xs = rng.normal(size=(steps, B, 1, E)).astype(np.float32)
+    hist = []  # per-step hidden inputs, to rebuild dense K/V
+    for t in range(steps):
+        ctx = LoweringContext(compute_dtype=jnp.float32, train=False)
+        ctx.state_in = state
+        hidden = jnp.asarray(xs[t])
+        lens = jnp.full((B,), t, jnp.int32)
+        (out,) = op.forward(ctx, [hidden, pt, lens], weights)
+        state = dict(state)
+        state.update(ctx.state_out)
+        hist.append(xs[t])
+        # dense reference over every token so far
+        x_all = jnp.asarray(np.stack(hist, axis=1)[:, :, 0, :])  # [B,t+1,E]
+        qh = jnp.einsum("be,ehd->bhd", jnp.asarray(xs[t][:, 0, :]),
+                        weights["wq"])
+        kh = jnp.einsum("bse,ehd->bshd", x_all, weights["wk"])
+        vh = jnp.einsum("bse,ehd->bshd", x_all, weights["wv"])
+        ref_attn = dense_decode_reference(
+            qh, kh, vh, jnp.full((B,), t + 1, jnp.int32))
+        ref = jnp.einsum("bhd,hde->be", ref_attn, weights["wo"])[:, None, :]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache-aware memory accounting
+# ---------------------------------------------------------------------------
+def _decode_model(batch=16, **overrides):
+    from flexflow_tpu.models import GPT_DECODE_KW, build_gpt_decode
+
+    kw = dict(GPT_DECODE_KW)
+    kw.update(overrides)
+    cfg = ff.FFConfig(batch_size=batch, num_devices=N_DEV,
+                      comp_mode="inference", cost_cache_file="",
+                      search_budget=8, search_timeout_s=30.0)
+    return build_gpt_decode(cfg, **kw), cfg
+
+
+def test_kv_residency_enters_memory_accounting():
+    from flexflow_tpu.search.machine_model import CostModel
+
+    m, cfg = _decode_model()
+    cm = CostModel(cfg.machine_spec, num_devices=N_DEV, inference=True)
+    node = next(n for n in m.graph.topo_order()
+                if n.op.op_type == OperatorType.DECODE_ATTENTION)
+    triv = MachineView.trivial(3)
+    dp = MachineView(dim_degrees=(8, 1, 1))
+    tp = MachineView(dim_degrees=(1, 1, 1), replica_degree=8)
+    kv_triv = node.op.kv_cache_bytes(triv)
+    assert kv_triv == pytest.approx(
+        node.op.attrs["num_pages"] * node.op.attrs["page_size"]
+        * node.op.kv_bytes_per_token())
+    # both batch and head splits genuinely divide residency
+    assert node.op.kv_cache_bytes(dp) == pytest.approx(kv_triv / 8)
+    assert node.op.kv_cache_bytes(tp) == pytest.approx(kv_triv / 8)
+    # and op_memory carries the pool (strictly more than the weight
+    # + activation memory of the same op with the hook detached)
+    with_kv = cm.op_memory(node.op, triv)
+    assert with_kv > kv_triv
+
+
+def test_capacity_edge_rejected_inside_search():
+    """On a machine whose HBM fits the page pool only when sharded,
+    the unsharded strategy simulates to inf (the memory check) and the
+    SEARCH returns a sharded strategy that fits — rejection happens at
+    strategy-selection time, not at runtime OOM."""
+    import dataclasses
+
+    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.serving import kv_residency_bytes
+    from flexflow_tpu.search.simulator import Simulator
+
+    m, cfg = _decode_model()
+    triv = _trivial_strategy(m.graph)
+    sim0 = Simulator(cfg.machine_spec, num_devices=N_DEV, inference=True)
+    need = sim0.peak_memory(m.graph, triv)
+    # capacity window: the replicated pool blows it, 1/8 residency fits
+    tight = dataclasses.replace(cfg.machine_spec, hbm_capacity=need / 2)
+    cfg_tight = ff.FFConfig(
+        batch_size=16, num_devices=N_DEV, comp_mode="inference",
+        machine_spec=tight, cost_cache_file="", search_budget=8,
+        search_timeout_s=30.0)
+    sim = Simulator(tight, num_devices=N_DEV, inference=True)
+    assert sim.simulate(m.graph, triv) == math.inf
+    g, s = optimize_strategy(m.graph, cfg_tight, return_graph=True)
+    cost = Simulator(tight, num_devices=N_DEV, inference=True).simulate(g, s)
+    assert math.isfinite(cost), "search returned an HBM-infeasible strategy"
+    assert kv_residency_bytes(g, s, N_DEV) < need / 2
+
+
+# ---------------------------------------------------------------------------
+# serve objective: divergence + inertness
+# ---------------------------------------------------------------------------
+def _search(objective, batch, kw):
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    cfg = ff.FFConfig(batch_size=batch, num_devices=N_DEV,
+                      search_budget=8, search_timeout_s=45.0,
+                      objective=objective, comp_mode="inference",
+                      cost_cache_file="")
+    m = build_gpt_decode(cfg, **kw)
+    g, s = optimize_strategy(m.graph, cfg, return_graph=True)
+    return cfg, g, s
+
+
+def test_serve_objective_diverges_and_wins_p99():
+    """THE acceptance scenario (also recorded in BENCH_SEARCH.md
+    "Inference serving"): on the serving-regime decode config the serve
+    objective picks a different strategy than throughput and wins on
+    simulated p99 under the same arrival-model currency."""
+    from flexflow_tpu.models import GPT_DECODE_SERVE_KW, SERVE_FRAME_SLOTS
+    from flexflow_tpu.search import driver
+    from flexflow_tpu.search.serving import serve_latency_quantiles
+
+    cfg_t, g_t, s_t = _search("train", SERVE_FRAME_SLOTS,
+                              GPT_DECODE_SERVE_KW)
+    assert driver.LAST_SERVING_META is None  # train run leaves no meta
+    cfg_s, g_s, s_s = _search("serve", SERVE_FRAME_SLOTS,
+                              GPT_DECODE_SERVE_KW)
+    assert _decode_views(g_t, s_t) != _decode_views(g_s, s_s)
+    p99_t = serve_latency_quantiles(g_t, s_t, cfg_s)["p99"]
+    p99_s = serve_latency_quantiles(g_s, s_s, cfg_s)["p99"]
+    assert p99_s < p99_t, (p99_s, p99_t)
+    meta = driver.LAST_SERVING_META
+    assert meta is not None and meta["objective"] == "serve"
+    assert meta["predicted_p99_step_ms"] > 0
+    assert meta["kv_bytes_per_device"] > 0
+
+
+def test_load_factor_monotone_in_batch_degree():
+    from flexflow_tpu.search.serving import ServingSpec
+
+    spec = ServingSpec(max_seqs=32, page_size=32, pages_per_seq=128)
+    f = [spec.load_factor(d) for d in (1, 2, 4, 8, 16, 32)]
+    assert all(0 < x <= 1.0 for x in f)
+    # fewer sequences per shard = less averaging = fatter relative p99
+    assert all(a <= b + 1e-9 for a, b in zip(f, f[1:])), f
+    assert f[0] < f[-1]  # the imbalance amplification is non-trivial
+
+
+def test_train_objective_is_structurally_inert(monkeypatch):
+    """The default objective must never touch the serving machinery
+    (the poisoned-builder discipline of test_co_search): a zoo search
+    with objective='train' completes with serving_spec_for booby-
+    trapped, and the cost/search cache keys are byte-identical to keys
+    that predate the serving dimension."""
+    from flexflow_tpu.models import build_mlp_unify
+    from flexflow_tpu.search import serving as serving_mod
+    from flexflow_tpu.search.cost_cache import cost_signature, CostCache
+    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.machine_model import CostModel
+
+    def _boom(*a, **k):  # pragma: no cover - must never run
+        raise AssertionError("serving machinery touched under train")
+
+    monkeypatch.setattr(serving_mod, "serving_spec_for", _boom)
+    monkeypatch.setattr(serving_mod.ServingSpec, "load_factor", _boom)
+    cfg = ff.FFConfig(batch_size=16, num_devices=N_DEV, search_budget=4,
+                      search_timeout_s=20.0, cost_cache_file="")
+    m = build_mlp_unify(cfg, in_dim=64, hidden=(64, 64))
+    g, s = optimize_strategy(m.graph, cfg, return_graph=True)
+    assert s
+    # signature inertness: serving=None adds no key material
+    cm = CostModel(cfg.machine_spec, num_devices=N_DEV)
+    sig = cost_signature(cm)
+    cm_no_attr = CostModel(cfg.machine_spec, num_devices=N_DEV)
+    del cm_no_attr.__dict__["serving"]  # a pre-PR cost model shape
+    assert cost_signature(cm_no_attr) == sig
+    k_train = CostCache.search_key(m.graph, cfg)
+    cfg2 = ff.FFConfig(batch_size=16, num_devices=N_DEV, search_budget=4,
+                       search_timeout_s=20.0, cost_cache_file="")
+    assert CostCache.search_key(m.graph, cfg2) == k_train
+    cfg_serve = ff.FFConfig(batch_size=16, num_devices=N_DEV,
+                            search_budget=4, search_timeout_s=20.0,
+                            cost_cache_file="", objective="serve")
+    assert CostCache.search_key(m.graph, cfg_serve) != k_train
+
+
+def test_serve_objective_without_decode_ops_degenerates():
+    from flexflow_tpu.models import build_mlp_unify
+    from flexflow_tpu.search import driver
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    cfg = ff.FFConfig(batch_size=16, num_devices=N_DEV, search_budget=4,
+                      search_timeout_s=20.0, cost_cache_file="",
+                      objective="serve", comp_mode="inference")
+    m = build_mlp_unify(cfg, in_dim=64, hidden=(64, 64))
+    g, s = optimize_strategy(m.graph, cfg, return_graph=True)
+    assert s and driver.LAST_SERVING_META is None
+
+
+def test_serve_objective_requires_inference_mode():
+    """A decode step has no backward: pricing the p99 currency with
+    training costs would mint an SLO for a step that never runs — the
+    driver refuses loudly instead (review finding)."""
+    from flexflow_tpu.models import GPT_DECODE_KW, build_gpt_decode
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    cfg = ff.FFConfig(batch_size=16, num_devices=N_DEV, search_budget=4,
+                      search_timeout_s=20.0, cost_cache_file="",
+                      objective="serve")  # comp_mode left at "training"
+    m = build_gpt_decode(cfg, **GPT_DECODE_KW)
+    with pytest.raises(ValueError, match="comp_mode='inference'"):
+        optimize_strategy(m.graph, cfg, return_graph=True)
+
+
+def test_co_search_refuses_serve_objective():
+    with pytest.raises(ValueError, match="does not compose"):
+        ff.FFConfig(objective="serve", co_search=True)
+
+
+# ---------------------------------------------------------------------------
+# SHD16x serving lints + STR209
+# ---------------------------------------------------------------------------
+def test_lint_serving_codes():
+    import dataclasses
+
+    from flexflow_tpu.analysis import errors_only, lint_serving
+    from flexflow_tpu.search.machine_model import CostModel
+    from flexflow_tpu.search.serving import ServingSpec, serving_spec_for
+
+    m, cfg = _decode_model()
+    strategy = _trivial_strategy(m.graph)
+    cm = CostModel(cfg.machine_spec, num_devices=N_DEV, inference=True)
+    spec = serving_spec_for(m.graph, cfg)
+    assert not errors_only(lint_serving(m.graph, strategy, spec, cm))
+    # SHD160: geometry disagreement with the decode ops
+    wrong = dataclasses.replace(spec, page_size=spec.page_size * 2,
+                                _factors={})
+    codes = [f.code for f in lint_serving(m.graph, strategy, wrong, cm)]
+    assert "SHD160" in codes
+    # SHD160: missing spec entirely
+    assert [f.code for f in lint_serving(m.graph, strategy, None, cm)] \
+        == ["SHD160"]
+    # SHD161: pool larger than HBM
+    tiny = CostModel(
+        dataclasses.replace(cfg.machine_spec, hbm_capacity=1e6),
+        num_devices=N_DEV, inference=True)
+    codes = [f.code for f in lint_serving(m.graph, strategy, spec, tiny)]
+    assert "SHD161" in codes
+    # SHD162: head split that does not divide the heads
+    bad = dict(strategy)
+    for n in m.graph.topo_order():
+        if n.op.op_type == OperatorType.DECODE_ATTENTION:
+            bad[n.guid] = MachineView(dim_degrees=(1, 1, 1),
+                                      replica_degree=3)
+    codes = [f.code for f in lint_serving(m.graph, bad, spec, cm)]
+    assert "SHD162" in codes
+    # SHD163: predicted p99 over the declared budget → warn, not error
+    budget = dataclasses.replace(spec, p99_budget_ms=1e-6, _factors={})
+    findings = lint_serving(m.graph, strategy, budget, cm,
+                            predicted_p99_s=1.0)
+    assert any(f.code == "SHD163" and f.severity == "warn"
+               for f in findings)
+    assert not errors_only(findings)
+    # driver behavior when NO strategy can hold the pool: the search's
+    # memory check prices everything inf, the result is returned for
+    # compile's fallback machinery (the train-objective contract), and
+    # no serving meta is minted for the infeasible artifact
+    import dataclasses as _dc
+
+    from flexflow_tpu.search import driver
+    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.simulator import Simulator
+
+    floor_bytes = sum(
+        n.op.kv_cache_bytes(MachineView(dim_degrees=(8, 1, 1)))
+        for n in m.graph.topo_order()
+        if n.op.op_type == OperatorType.DECODE_ATTENTION)
+    hopeless = _dc.replace(cfg.machine_spec, hbm_capacity=floor_bytes / 2)
+    cfg_bad = ff.FFConfig(
+        batch_size=16, num_devices=N_DEV, comp_mode="inference",
+        machine_spec=hopeless, cost_cache_file="", search_budget=4,
+        search_timeout_s=20.0, objective="serve")
+    g_bad, s_bad = optimize_strategy(m.graph, cfg_bad, return_graph=True)
+    assert driver.LAST_SERVING_META is None
+    assert Simulator(hopeless, num_devices=N_DEV,
+                     inference=True).simulate(g_bad, s_bad) == math.inf
+
+
+def test_str209_serving_meta_lint(tmp_path):
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        from fflint import lint_strategy_file
+    finally:
+        sys.path.pop(0)
+
+    good_meta = {
+        "graph_digest": "d" * 32,
+        "serving": {"objective": "serve", "max_seqs": 16,
+                    "page_size": 16, "pages_per_seq": 16,
+                    "quantile": 0.99, "p99_budget_ms": 0.0,
+                    "predicted_p99_step_ms": 0.05,
+                    "kv_bytes_per_device": 2.1e6},
+    }
+    base = {"lm_head": {"dims": [8, 1, 1], "replica": 1, "start": 0}}
+
+    def write(meta):
+        p = tmp_path / "strategy.json"
+        p.write_text(json.dumps({**base, "__meta__": meta}))
+        return str(p)
+
+    assert not [f for f in lint_strategy_file(write(good_meta))
+                if f[1] == "STR209"]
+    corruptions = [
+        ("not-an-object", {**good_meta, "serving": [1, 2]}),
+        ("wrong objective", {**good_meta, "serving": {
+            **good_meta["serving"], "objective": "train"}}),
+        ("zero max_seqs", {**good_meta, "serving": {
+            **good_meta["serving"], "max_seqs": 0}}),
+        ("bool page_size", {**good_meta, "serving": {
+            **good_meta["serving"], "page_size": True}}),
+        ("quantile 1.5", {**good_meta, "serving": {
+            **good_meta["serving"], "quantile": 1.5}}),
+        ("negative budget", {**good_meta, "serving": {
+            **good_meta["serving"], "p99_budget_ms": -1}}),
+        ("nan p99", {**good_meta, "serving": {
+            **good_meta["serving"], "predicted_p99_step_ms": float("nan")}}),
+        ("negative kv", {**good_meta, "serving": {
+            **good_meta["serving"], "kv_bytes_per_device": -5}}),
+    ]
+    for label, meta in corruptions:
+        found = [f for f in lint_strategy_file(write(meta))
+                 if f[1] == "STR209" and f[0] == "error"]
+        assert found, f"corruption {label!r} not caught by STR209"
+
+
+def test_serving_meta_round_trip(tmp_path):
+    """compile(objective=serve) persists __meta__.serving behind the
+    digest gate; import re-lints it (SHD16x) against the target graph."""
+    from flexflow_tpu.models import GPT_DECODE_KW, build_gpt_decode
+    from flexflow_tpu.search.strategy_io import read_meta
+
+    path = str(tmp_path / "serve_strategy.json")
+    kw = dict(GPT_DECODE_KW)
+    cfg = ff.FFConfig(batch_size=8, num_devices=N_DEV, search_budget=0,
+                      objective="serve", cost_cache_file="",
+                      export_strategy_file=path)
+    m = build_gpt_decode(cfg, **kw)
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+              comp_mode="inference")
+    meta = read_meta(path)
+    assert meta.get("serving", {}).get("objective") == "serve"
+    assert meta["serving"]["max_seqs"] == 8
+    # re-import: the serving block re-lints against THIS graph
+    cfg2 = ff.FFConfig(batch_size=8, num_devices=N_DEV,
+                       import_strategy_file=path, cost_cache_file="")
+    m2 = build_gpt_decode(cfg2, **kw)
+    m2.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+               comp_mode="inference")
+    assert m2.strategy
+    # a corrupted geometry must fail the import gate with findings
+    from flexflow_tpu.analysis import AnalysisError
+
+    data = json.load(open(path))
+    data["__meta__"]["serving"]["page_size"] = 64
+    bad_path = str(tmp_path / "bad.json")
+    json.dump(data, open(bad_path, "w"))
+    cfg3 = ff.FFConfig(batch_size=8, num_devices=N_DEV,
+                       import_strategy_file=bad_path, cost_cache_file="")
+    m3 = build_gpt_decode(cfg3, **kw)
+    with pytest.raises(AnalysisError):
+        m3.compile(loss_type="sparse_categorical_crossentropy",
+                   metrics=[], comp_mode="inference")
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching executor
+# ---------------------------------------------------------------------------
+def _synthetic_step(vocab=97):
+    """Deterministic model stand-in: the next token is a pure function
+    of (current token, position) — enough structure that scheduling
+    bugs (wrong slot, wrong position, corrupted cache) change the
+    output stream."""
+
+    def step(ids, table, lens):
+        ids = np.asarray(ids)
+        lens = np.asarray(lens)
+        nxt = (ids[:, 0] * 7 + lens * 13 + 5) % vocab
+        logits = np.zeros((ids.shape[0], 1, vocab), np.float32)
+        logits[np.arange(ids.shape[0]), 0, nxt] = 1.0
+        return logits
+
+    return step
+
+
+def test_executor_batched_equals_solo():
+    """Continuous batching must be semantically invisible: each
+    request's generated tokens equal serving it ALONE."""
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+    )
+
+    reqs = [
+        DecodeRequest(rid=f"r{i}", prompt=[3 + i, 11, 2 * i + 1],
+                      max_new_tokens=3 + (i % 3))
+        for i in range(7)
+    ]
+    solo = {}
+    for r in reqs:
+        ex = ContinuousBatchingExecutor(
+            _synthetic_step(), max_seqs=1, page_size=4, pages_per_seq=4)
+        solo.update(ex.run([DecodeRequest(rid=r.rid, prompt=list(r.prompt),
+                                          max_new_tokens=r.max_new_tokens)]))
+    # 3 slots, pages for only 2 concurrent sequences: admission waits
+    ex = ContinuousBatchingExecutor(
+        _synthetic_step(), max_seqs=3, page_size=4, pages_per_seq=4,
+        num_pages=8)
+    batched = ex.run(reqs, max_frames=400)
+    assert batched == solo
+    s = ex.summary()
+    assert s["completed"] == len(reqs)
+    assert s["admitted"] == len(reqs) and s["evicted"] == len(reqs)
+    # every sequence page returned; only the oversubscribed pool's
+    # permanently reserved scratch page stays out
+    assert ex.allocator.pages_in_use == 1 and not ex.slot_aligned
+
+
+def test_executor_exhausted_pool_never_corrupts_live_cache():
+    """Review-finding regression: an OVERSUBSCRIBED pool fully
+    exhausted by one live sequence while other slots sit idle — the
+    idle rows' unavoidable scatter must land on the reserved scratch
+    page, never on the live sequence's page 0 (whose slot 0 holds its
+    FIRST cached token).  Proven end-to-end on the compiled decode
+    graph: batched tokens equal serving the request alone."""
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+        compiled_decode_step,
+    )
+
+    kw = dict(vocab=128, num_layers=1, hidden=32, num_heads=2,
+              ff_dim=32, page_size=2, pages_per_seq=2, num_pages=3)
+    req = DecodeRequest(rid="a", prompt=[7, 11], max_new_tokens=2)
+
+    def run(num_pages):
+        cfg = ff.FFConfig(batch_size=2, num_devices=1, cost_cache_file="")
+        m = build_gpt_decode(cfg, **kw)
+        m.compile(loss_type="sparse_categorical_crossentropy",
+                  metrics=[], comp_mode="inference",
+                  strategy=_trivial_strategy(m.graph))
+        ex = ContinuousBatchingExecutor(
+            compiled_decode_step(m), max_seqs=2, page_size=2,
+            pages_per_seq=2, num_pages=num_pages)
+        return ex.run([DecodeRequest(rid="a", prompt=list(req.prompt),
+                                     max_new_tokens=2)], max_frames=40)
+
+    # pool 3: scratch reserved -> 2 usable -> the live sequence holds
+    # EVERY allocatable page while slot 1 idles (the corruption regime)
+    assert run(3) == run(4)  # 4 = slot-aligned, trivially safe
+
+
+def test_executor_page_accounting_and_caps():
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+        PageAllocator,
+    )
+
+    pa = PageAllocator(4)
+    got = pa.alloc(3)
+    assert pa.free_pages == 1 and pa.pages_in_use == 3
+    assert pa.alloc(2) is None  # refuse partial allotments
+    pa.free(got)
+    assert pa.free_pages == 4
+    ex = ContinuousBatchingExecutor(
+        _synthetic_step(), max_seqs=2, page_size=4, pages_per_seq=2)
+    with pytest.raises(AssertionError):  # request longer than a sequence
+        ex.submit([DecodeRequest(rid="x", prompt=[1] * 7,
+                                 max_new_tokens=9)])
+
+
+def test_executor_on_compiled_decode_model():
+    """End-to-end: the executor drives the COMPILED decode graph (KV
+    caches threaded as model state) and emits schema-valid obs
+    events + a decode DriftReport."""
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.obs.events import BUS, validate_event
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+        compiled_decode_step,
+    )
+
+    kw = dict(vocab=256, num_layers=1, hidden=64, num_heads=4,
+              ff_dim=64, page_size=4, pages_per_seq=4)
+    cfg = ff.FFConfig(batch_size=4, num_devices=1, cost_cache_file="")
+    m = build_gpt_decode(cfg, **kw)
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+              comp_mode="inference",
+              strategy=_trivial_strategy(m.graph))
+    import tempfile
+
+    log = tempfile.mktemp(suffix=".jsonl")
+    BUS.configure(log)
+    try:
+        ex = ContinuousBatchingExecutor(
+            compiled_decode_step(m), max_seqs=4, page_size=4,
+            pages_per_seq=4, num_pages=8, predicted_step_s=1e-4)
+        out = ex.run([DecodeRequest(rid=f"r{i}", prompt=[1 + i, 2],
+                                    max_new_tokens=3) for i in range(5)],
+                     max_frames=120)
+        assert len(out) == 5
+        assert all(len(v) == 3 for v in out.values())
+        rep = ex.decode_drift_report()
+        assert rep is not None and "decode" in rep.phases
+        BUS.flush()
+        with open(log) as f:
+            for line in f:
+                assert validate_event(json.loads(line)) == []
+    finally:
+        BUS.close()
+        import os
+
+        os.remove(log)
+
+
+def test_decode_graph_searched_strategy_executes():
+    """A SEARCHED multi-device decode strategy lowers and steps on the
+    host mesh — the state-sharded KV cache path is executable, not
+    just priced."""
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+        compiled_decode_step,
+    )
+
+    kw = dict(vocab=256, num_layers=1, hidden=64, num_heads=4,
+              ff_dim=64, page_size=4, pages_per_seq=4)
+    cfg = ff.FFConfig(batch_size=8, num_devices=N_DEV,
+                      search_budget=4, search_timeout_s=20.0,
+                      cost_cache_file="",
+                      machine_spec=MachineSpec.host_cpu(N_DEV))
+    m = build_gpt_decode(cfg, **kw)
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+              comp_mode="inference")
+    ex = ContinuousBatchingExecutor(
+        compiled_decode_step(m), max_seqs=8, page_size=4,
+        pages_per_seq=4)
+    out = ex.run([DecodeRequest(rid="a", prompt=[5, 6, 7],
+                                max_new_tokens=4)], max_frames=60)
+    assert len(out["a"]) == 4

@@ -105,6 +105,8 @@ def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
     if isinstance(meta, dict) and "pipeline" in meta:
         out += _lint_pipeline_meta(
             meta["pipeline"], {k for k in data if k != META_KEY})
+    if isinstance(meta, dict) and "serving" in meta:
+        out += _lint_serving_meta(meta["serving"])
     views = {k: v for k, v in data.items() if k != META_KEY}
     if not views:
         out.append(("error", "STR202", "file names no ops at all"))
@@ -132,6 +134,52 @@ def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
 
 _SCHEDULE_SCHEMA = 1  # mirrors search/sync_schedule.SCHEDULE_SCHEMA
 _BUCKET_PRECISIONS = ("fp32", "bf16", "int8", "int8_ef")
+
+
+def _lint_serving_meta(sv) -> List[Tuple[str, str, str]]:
+    """STR209: structural lint of a persisted ``__meta__.serving``
+    block (the serve-objective provenance, search/serving.py).
+    Graph-side legality (frame-geometry coherence with the decode ops,
+    KV residency vs HBM — SHD160-163) needs the graph + machine model
+    and runs at import/compile time."""
+    if not isinstance(sv, dict):
+        return [("error", "STR209", "serving meta is not an object")]
+    out: List[Tuple[str, str, str]] = []
+    if sv.get("objective") != "serve":
+        out.append(("error", "STR209",
+                    f"serving meta objective {sv.get('objective')!r} is "
+                    f"not 'serve'"))
+    for k in ("max_seqs", "page_size", "pages_per_seq"):
+        v = sv.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            out.append(("error", "STR209",
+                        f"serving meta {k} is not a positive int: {v!r}"))
+    q = sv.get("quantile", 0.99)
+    if not isinstance(q, (int, float)) or isinstance(q, bool) \
+            or not (0.0 < float(q) < 1.0):
+        out.append(("error", "STR209",
+                    f"serving meta quantile {q!r} outside (0, 1)"))
+    b = sv.get("p99_budget_ms", 0.0)
+    if not isinstance(b, (int, float)) or isinstance(b, bool) \
+            or float(b) < 0.0:
+        out.append(("error", "STR209",
+                    f"serving meta p99_budget_ms {b!r} is negative or "
+                    f"non-numeric"))
+    p99 = sv.get("predicted_p99_step_ms")
+    if p99 is not None and (
+            not isinstance(p99, (int, float)) or isinstance(p99, bool)
+            or not math.isfinite(float(p99)) or float(p99) <= 0.0):
+        out.append(("error", "STR209",
+                    f"serving meta predicted_p99_step_ms {p99!r} is not "
+                    f"a positive finite number"))
+    kv = sv.get("kv_bytes_per_device")
+    if kv is not None and (
+            not isinstance(kv, (int, float)) or isinstance(kv, bool)
+            or not math.isfinite(float(kv)) or float(kv) < 0.0):
+        out.append(("error", "STR209",
+                    f"serving meta kv_bytes_per_device {kv!r} is not a "
+                    f"non-negative finite number"))
+    return out
 
 
 def _lint_zero_groups_meta(zg, op_names) -> List[Tuple[str, str, str]]:

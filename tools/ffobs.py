@@ -368,6 +368,56 @@ def render_report(events: List[dict], top: int = 10,
             lines.append(
                 f"Largest measured phase: {k!r} at {_ms(v)} ms "
                 f"({v / measured:.0%} of the step)")
+    # ---- serving: serve-objective result + decode executor phase ---------
+    serves = [e for e in events if e.get("kind") == "search.serve"]
+    if serves:
+        s = serves[-1]
+        budget = s.get("budget_ms") or 0
+        kv = s.get("kv_bytes_per_device") or 0
+        lines.append("")
+        lines.append(
+            f"Serve objective: predicted p99 decode step "
+            f"{_ms(s.get('p99_s'))} ms"
+            + (f" (SLO budget {budget:.3f} ms)" if budget else "")
+            + f", KV residency {kv / 1e6:.1f} MB/device"
+            + (" — champion-vs-DP floor kept plain DP"
+               if s.get("kept_dp") else ""))
+    frames = [e for e in events if e.get("kind") == "decode.frame"]
+    summaries = [e for e in events if e.get("kind") == "decode.summary"]
+    if frames or summaries:
+        lines.append("")
+        lines.append("## Decode phase (continuous-batching executor)")
+        lines.append("")
+        if summaries:
+            s = summaries[-1]
+            lines.append(
+                f"{s.get('frames')} frames, {s.get('completed')} "
+                f"sequences completed ({s.get('admitted')} admitted / "
+                f"{s.get('evicted')} evicted); measured frame latency "
+                f"p50 {_ms(s.get('measured_p50_s'))} ms, p99 "
+                f"{_ms(s.get('measured_p99_s'))} ms"
+                + (f"; predicted {_ms(s.get('predicted_step_s'))} ms"
+                   if s.get("predicted_step_s") else ""))
+        if frames:
+            admitted = sum(e.get("admitted") or 0 for e in frames)
+            evicted = sum(e.get("evicted") or 0 for e in frames)
+            peak_pages = max(e.get("pages_in_use") or 0 for e in frames)
+            lines.append(
+                f"Admission/eviction across {len(frames)} frames: "
+                f"{admitted} admitted, {evicted} evicted, peak page "
+                f"residency {peak_pages} pages")
+            lines.append("")
+            lines.append("| frame | live | +admit | -evict | pages | "
+                         "predicted ms | measured ms |")
+            lines.append("|---|---|---|---|---|---|---|")
+            for e in frames[-8:]:  # the tail tells the story; full
+                # trace stays in the JSONL
+                lines.append(
+                    f"| {e.get('frame')} | {e.get('active')} | "
+                    f"{e.get('admitted')} | {e.get('evicted')} | "
+                    f"{e.get('pages_in_use')} | "
+                    f"{_ms(e.get('predicted_s'))} | "
+                    f"{_ms(e.get('measured_s'))} |")
     stale = [e for e in events if e.get("kind") == "calibration.staleness"]
     if stale:
         s = stale[-1]

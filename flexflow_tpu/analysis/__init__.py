@@ -17,6 +17,10 @@ Three passes, one finding vocabulary (``findings.py``):
    blocks (``SHD150``-``SHD155``), the always-on gate on every
    pipeline/placement proposal the search returns, persists or
    imports.
+5. ``swap``        — hot-swap legality (``SHD170``-``SHD172``): a live
+   mid-run strategy swap must preserve every weight/op-state shape and
+   cover the target graph, the always-on gate of
+   ``FFModel.swap_strategy`` / the always-on training controller.
 
 ``tools/fflint.py`` exposes all of it as a CI-friendly CLI; findings
 also flow through the obs event bus as ``analysis.finding`` events.
@@ -54,6 +58,7 @@ from flexflow_tpu.analysis.sharding import (
     lint_sync_schedule,
     lint_zero_map,
 )
+from flexflow_tpu.analysis.swap import lint_swap
 
 __all__ = [
     "AnalysisError",
@@ -72,6 +77,7 @@ __all__ = [
     "lint_reduction_plan",
     "lint_serving",
     "lint_strategy",
+    "lint_swap",
     "lint_sync_schedule",
     "lint_zero_map",
     "placement_meta",

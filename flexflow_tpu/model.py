@@ -963,6 +963,9 @@ class FFModel:
 
         self.predicted_breakdown = None
         self.drift_report = None
+        _pred_cal = None  # the coherent table the prediction was priced
+        # under — the export block digests THIS object (STR210) instead
+        # of re-parsing the file a second time
         if (
             strategy
             and pipeline is None
@@ -972,14 +975,21 @@ class FFModel:
                 or _obs_bus.enabled
                 or self.config.export_strategy_file
                 or self.config.obs_trace_file
+                # a calibrated compile must ALWAYS record its prediction:
+                # the drift/healthy-reset loop (fit tail, re-probe
+                # allowance) closes on it even when neither profiling nor
+                # the obs bus is armed — without this, the allowance
+                # reset rode the drift-report path only
+                or self.config.calibration_file
             )
         ):
             from flexflow_tpu.search.driver import coherent_calibration
             from flexflow_tpu.search.simulator import Simulator as _Sim
 
             try:
+                _pred_cal = coherent_calibration(self.config)
                 _psim = _Sim.for_config(
-                    self.config, calibration=coherent_calibration(self.config)
+                    self.config, calibration=_pred_cal
                 )
                 bd: Dict = {}
                 _sched: list = []
@@ -1036,6 +1046,26 @@ class FFModel:
             _meta = {}
             if self.predicted_breakdown:
                 _meta["predicted"] = self.predicted_breakdown
+            # the calibration signature the strategy was ranked under
+            # (content digest of the coherent measured table): fflint
+            # strategy compares it against the LIVE CALIBRATION.json
+            # (STR210) so a re-probed table flags every strategy file
+            # it orphans as stale.  The prediction block above already
+            # loaded the table; digest that exact object — it is BOTH
+            # the cheaper path and the honest one (the signature
+            # describes the table the predicted numbers were priced
+            # under).
+            from flexflow_tpu.search.cost_cache import calibration_digest
+
+            if _pred_cal is None and self.config.calibration_file:
+                from flexflow_tpu.search.driver import (
+                    coherent_calibration as _cc,
+                )
+
+                _pred_cal = _cc(self.config)
+            _cal_sig = calibration_digest(_pred_cal)
+            if _cal_sig is not None:
+                _meta["calibration_signature"] = _cal_sig
             if self.sync_schedule is not None:
                 # the searched comm plan persists NEXT to the strategy,
                 # behind the same graph-digest gate import enforces
@@ -1349,6 +1379,195 @@ class FFModel:
         self.opt_state = self.compiled.shard_opt_state(self.opt_state)
         return self.compiled
 
+    def swap_strategy(self, strategy: Dict[int, MachineView],
+                      graph: Optional[Graph] = None, config=None) -> dict:
+        """HOT-swap the parallelization strategy between training steps
+        (the always-on loop's core mechanism, runtime/controller.py):
+        the full live training state — params, optimizer slots, mutable
+        op state including EF residuals and KV page pools — is
+        checkpointed in memory, the model re-lowers under the new
+        (graph, strategy), and every value is re-sharded live onto the
+        new strategy's views (``jax.device_put`` onto the fresh
+        shardings — a value-identity operation at fp32, test-enforced
+        bit-exact).  ``config=`` additionally swaps the FFConfig, which
+        is how elastic mesh-size changes (preemption / added capacity)
+        re-home the state onto a different device set.
+
+        Gated always-on by the swap-legality lint (analysis/swap.py,
+        SHD170-172 + the flat SHD1xx strategy lint).  The searched comm
+        plan is rebuilt for the new pair and must re-pass its own
+        legality gates; when it does not, the swap falls back to the
+        monolithic fp32 sync path instead of failing the run.  Returns
+        ``{"fallback", "fresh", "dropped", "swap_seconds"}``."""
+        assert self.compiled is not None, "compile() before swap_strategy"
+        import time as _time
+
+        from flexflow_tpu.analysis import (
+            AnalysisError,
+            emit_findings,
+            errors_only,
+            lint_swap,
+        )
+        from flexflow_tpu.runtime.checkpoint import snapshot_in_memory
+
+        t0 = _time.perf_counter()
+        ctx = self._compile_ctx
+        from flexflow_tpu.compiler.placement_lowering import (
+            PlacedCompiledModel as _Placed,
+        )
+
+        if (ctx.get("pipeline") is not None or ctx.get("staged") is not None
+                or ctx.get("mesh") is not None
+                # a placed model's ctx has none of the three markers —
+                # gate on the lowering itself, or a live inter-op
+                # placement would silently re-lower FLAT mid-run
+                or isinstance(self.compiled, _Placed)):
+            raise NotImplementedError(
+                "swap_strategy supports the flat SPMD lowering only — "
+                "placed/pipelined/staged/user-mesh models manage their "
+                "own placement and cannot re-shard live state this way")
+        new_config = config if config is not None else self.config
+        new_graph = graph if graph is not None else self.graph
+        bad = errors_only(lint_swap(
+            self.graph, new_graph, strategy, new_config.num_devices))
+        if bad:
+            emit_findings(bad)
+            raise AnalysisError(
+                "hot-swap target is illegal for the live training state",
+                bad)
+        snap = snapshot_in_memory(self)
+        rollback = dict(
+            config=self.config, graph=self.graph, strategy=self.strategy,
+            compiled=self.compiled, params=self.params,
+            opt_state=self.opt_state, state=self.state,
+            sync_precision_map=self.sync_precision_map,
+            sync_schedule=self.sync_schedule, zero_groups=self.zero_groups,
+        )
+        try:
+            return self._swap_strategy_inner(
+                snap, new_config, new_graph, strategy, ctx, t0)
+        except Exception:
+            # a failed swap (e.g. an elastic GROW past the available
+            # device count rejected by mesh construction, or a corrupt
+            # cost cache) must leave the model exactly as it was — the
+            # OLD program with the OLD state — never half-swapped with
+            # config/graph describing a program that does not exist
+            for k, v in rollback.items():
+                setattr(self, k, v)
+            raise
+
+    def _swap_strategy_inner(self, snap, new_config, new_graph, strategy,
+                             ctx, t0) -> dict:
+        import time as _time
+
+        from flexflow_tpu.analysis import AnalysisError, errors_only
+        from flexflow_tpu.compiler.lowering import CompiledModel
+        from flexflow_tpu.runtime.checkpoint import restore_in_memory
+        from flexflow_tpu.search.driver import coherent_calibration
+        from flexflow_tpu.search.simulator import Simulator
+        from flexflow_tpu.utils.logging import SEARCH_LOG
+
+        self.config = new_config
+        self.graph = new_graph
+        self.strategy = strategy
+        # ONE calibration load + at most one Simulator per swap (the
+        # compile-path discipline): swap latency is a headline number
+        _cal = coherent_calibration(self.config)
+        _sim = None
+
+        def sim():
+            nonlocal _sim
+            if _sim is None:
+                _sim = Simulator.for_config(self.config, calibration=_cal)
+            return _sim
+
+        # rebuild the comm plan for the new pair.  Every piece re-runs
+        # its always-on legality gate against what is ACTUALLY being
+        # lowered; a searched plan that fails post-swap costs the run
+        # its overlap/compression win, never its life — graceful
+        # fallback to the monolithic fp32 sync path.
+        fallback = False
+        pmap: Dict[str, str] = {}
+        schedule = None
+        zero: tuple = ()
+        training = self.config.comp_mode == "training"
+        try:
+            if training and getattr(
+                    self.config, "sync_precision", "fp32") != "fp32":
+                from flexflow_tpu.search.sync_precision import (
+                    choose_sync_precision,
+                )
+
+                pmap = choose_sync_precision(
+                    new_graph, strategy, sim().cost)
+            if training and getattr(
+                    self.config, "sync_schedule", "off") == "search":
+                from flexflow_tpu.search.driver import _build_sync_schedule
+
+                schedule = _build_sync_schedule(
+                    new_graph, strategy, sim(), self.config)
+            if (training and self.zero_groups
+                    and not self.config.zero_dp_shard):
+                # the co-searched per-group optimizer-sharding map rides
+                # along only while it still lints for the new pair —
+                # remapping the per-group ZeRO shards is the restore's
+                # job, keeping an illegal map is nobody's
+                from flexflow_tpu.analysis import lint_zero_map
+                from flexflow_tpu.search.machine_model import CostModel
+
+                _zcm = CostModel(
+                    self.config.machine_spec,
+                    num_devices=self.config.search_devices)
+                if not errors_only(lint_zero_map(
+                        new_graph, strategy, sorted(self.zero_groups),
+                        _zcm)):
+                    zero = tuple(self.zero_groups)
+        except AnalysisError as e:
+            fallback, pmap, schedule, zero = True, {}, None, ()
+            SEARCH_LOG.log(
+                f"hot swap: searched comm plan failed its legality gate "
+                f"post-swap ({e}); falling back to the monolithic fp32 "
+                f"sync path")
+        self.sync_precision_map = pmap
+        self.sync_schedule = schedule
+        self.zero_groups = zero
+        self.compiled = CompiledModel(
+            new_graph, strategy, self.config, ctx["loss_type"],
+            ctx["metrics"], self.optimizer,
+            sync_precision=pmap, sync_schedule=schedule, zero_groups=zero,
+        )
+        self.params, self.state = self.compiled.init_params(self.config.seed)
+        self.opt_state = self.optimizer.init_state(self.params)
+        self.opt_state = self.compiled.shard_opt_state(self.opt_state)
+        report = restore_in_memory(self, snap)
+        if report["dropped"]:
+            SEARCH_LOG.log(
+                f"hot swap: {len(report['dropped'])} state entr(ies) "
+                f"have no home under the new comm plan and were dropped "
+                f"(e.g. {report['dropped'][:3]})")
+        ctx.update(
+            strategy=strategy, sync_precision=dict(pmap),
+            sync_schedule=schedule, zero_groups=zero,
+        )
+        # refresh the predicted side of the drift loop for the NEW
+        # strategy (same consumers and same never-fail rule as compile)
+        from flexflow_tpu.obs.events import BUS as _obs_bus
+
+        if (self.config.profiling or _obs_bus.enabled
+                or self.config.calibration_file):
+            try:
+                bd: Dict = {}
+                sim().simulate(new_graph, strategy, breakdown=bd,
+                               sync_schedule=schedule)
+                bd["calibrated"] = sim().cost.calibration is not None
+                bd["machine"] = self.config.machine_spec.name
+                self.predicted_breakdown = bd
+            except Exception:  # telemetry must never fail a swap
+                self.predicted_breakdown = None
+        report["fallback"] = fallback
+        report["swap_seconds"] = _time.perf_counter() - t0
+        return report
+
     # ------------------------------------------------------------------
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, shuffle: bool = True, verbose: bool = True,
@@ -1600,7 +1819,33 @@ class FFModel:
             self.last_throughput = thr
         if profiler is not None:
             self._report_profile(profiler, verbose)
+        elif steps_done > steps_at_t0 and elapsed > 0:
+            # re-probe-allowance bugfix: a HEALTHY calibrated fit must
+            # reset MAX_AUTO_REPROBES even when neither profiling nor
+            # the obs bus armed the full drift-report path — fit's own
+            # fenced post-compile timer is evidence enough to CLEAR
+            # staleness (stale-MARKING stays on the profiler's
+            # measurement: a false "stale" poisons the cost cache, a
+            # false "healthy" merely re-grants a re-probe)
+            self._healthy_calibration_reset(
+                elapsed / (steps_done - steps_at_t0))
         return history
+
+    def _healthy_calibration_reset(self, measured_step_s: float) -> None:
+        pred = getattr(self, "predicted_breakdown", None)
+        if (not pred or not pred.get("calibrated")
+                or not self.config.calibration_file):
+            return
+        from flexflow_tpu.obs.drift import build_drift_report
+
+        report = build_drift_report(
+            pred, measured_step_s=measured_step_s,
+            threshold=self.config.drift_threshold, calibrated=True)
+        if report is None or report.stale:
+            return
+        from flexflow_tpu.search.calibration import CalibrationTable
+
+        CalibrationTable.mark_healthy_file(self.config.calibration_file)
 
     def _report_profile(self, profiler, verbose: bool) -> None:
         """Step-profile reporting through the obs metrics registry +

@@ -84,6 +84,16 @@ EVENT_KINDS = {
     "profile.summary": {"steps"},
     "drift.report": {"predicted_s", "measured_s", "ratio", "stale"},
     "metrics.snapshot": {"counters"},
+    # always-on training controller (runtime/controller.py): the
+    # drift→re-search→hot-swap / elastic-recovery decision stream, plus
+    # the deterministic fault-injection harness (runtime/faults.py)
+    "fault.injected": {"fault", "step"},
+    "controller.research": {"step", "trigger", "search_seconds"},
+    "controller.swap": {"step", "swap_seconds", "fallback"},
+    "controller.recovery": {"step", "cause"},
+    "controller.retry": {"step", "attempt"},
+    "controller.fallback": {"step", "reason"},
+    "controller.summary": {"steps", "swaps", "recoveries"},
 }
 
 _VALID_ACTIONS = frozenset(

@@ -1,4 +1,10 @@
+from flexflow_tpu.runtime.controller import TrainingController, shrink_config
 from flexflow_tpu.runtime.dataloader import SingleDataLoader
+from flexflow_tpu.runtime.faults import (
+    Fault,
+    FaultPlan,
+    TransientCollectiveError,
+)
 from flexflow_tpu.runtime.decode import (
     ContinuousBatchingExecutor,
     DecodeRequest,
@@ -7,7 +13,12 @@ from flexflow_tpu.runtime.decode import (
 )
 
 __all__ = [
+    "Fault",
+    "FaultPlan",
     "SingleDataLoader",
+    "TrainingController",
+    "TransientCollectiveError",
+    "shrink_config",
     "ContinuousBatchingExecutor",
     "DecodeRequest",
     "PageAllocator",

@@ -61,6 +61,24 @@ def _path_token(p) -> str:
     return str(p)
 
 
+def _reshard_leaf(leaf, val: np.ndarray):
+    """One host array placed back onto a live leaf's sharding + dtype
+    (the fp32 value-identity re-shard both restore paths rely on)."""
+    import jax
+
+    val = val.astype(leaf.dtype)
+    sharding = getattr(leaf, "sharding", None)
+    # Re-apply only real mesh shardings. A SingleDeviceSharding
+    # template leaf (e.g. optimizer slots before the first step)
+    # must stay UNCOMMITTED, or the next jitted step sees it
+    # pinned to one device while params span the mesh.
+    if sharding is not None and not isinstance(
+        sharding, jax.sharding.SingleDeviceSharding
+    ):
+        return jax.device_put(val, sharding)
+    return val
+
+
 def _restore_like(template, arrays: Dict[str, np.ndarray]):
     """Rebuild ``template``'s tree from host arrays, preserving each live
     leaf's sharding + dtype (device_put onto the existing sharding)."""
@@ -79,21 +97,93 @@ def _restore_like(template, arrays: Dict[str, np.ndarray]):
                     f"shape mismatch for {key}: checkpoint {tuple(val.shape)} "
                     f"vs model {tuple(leaf.shape)}"
                 )
-            val = val.astype(leaf.dtype)
-            sharding = getattr(leaf, "sharding", None)
-            # Re-apply only real mesh shardings. A SingleDeviceSharding
-            # template leaf (e.g. optimizer slots before the first step)
-            # must stay UNCOMMITTED, or the next jitted step sees it
-            # pinned to one device while params span the mesh.
-            if sharding is not None and not isinstance(
-                sharding, jax.sharding.SingleDeviceSharding
-            ):
-                leaves.append(jax.device_put(val, sharding))
-            else:
-                leaves.append(val)
+            leaves.append(_reshard_leaf(leaf, val))
         else:  # python scalar leaf (e.g. step counters)
             leaves.append(type(leaf)(val))
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _restore_matching(template, arrays: Dict[str, np.ndarray]):
+    """Lenient sibling of ``_restore_like`` for HOT swaps: checkpoint
+    values land on every matching keypath, template leaves with no
+    (shape-compatible) saved value keep their fresh init, and saved
+    keys with no home are reported instead of raising — a comm-plan
+    change legitimately drops lowering-created state (EF residuals)
+    and the caller must be able to say so.  Returns
+    ``(tree, fresh_keys, dropped_keys)``."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves, fresh, used = [], [], set()
+    for path, leaf in flat:
+        key = "/".join(_path_token(p) for p in path) or "_root"
+        val = arrays.get(key)
+        if val is None or (hasattr(leaf, "shape")
+                           and tuple(val.shape) != tuple(leaf.shape)):
+            fresh.append(key)
+            leaves.append(leaf)
+            continue
+        used.add(key)
+        if hasattr(leaf, "shape"):
+            leaves.append(_reshard_leaf(leaf, val))
+        else:
+            leaves.append(type(leaf)(val))
+    dropped = sorted(set(arrays) - used)
+    return jax.tree_util.tree_unflatten(treedef, leaves), fresh, dropped
+
+
+def snapshot_in_memory(model) -> Dict[str, Any]:
+    """Host-side copy of a compiled FFModel's full training state —
+    the in-memory checkpoint the hot-swap path re-shards from.  Real
+    copies (``np.array(copy=True)``): the next train step donates the
+    device buffers, and on CPU ``np.asarray`` of a jax array is a
+    zero-copy view of exactly that donated memory."""
+    snap: Dict[str, Any] = {"trees": {}, "rng_counter": int(
+        getattr(model, "_rng_counter", 0))}
+    for name, tree in (("params", model.params),
+                       ("opt_state", model.opt_state),
+                       ("state", model.state)):
+        flat, _ = _flatten(tree)
+        snap["trees"][name] = {k: np.array(v, copy=True) for k, v in flat}
+    return snap
+
+
+def restore_in_memory(model, snap: Dict[str, Any]) -> Dict[str, list]:
+    """Place a ``snapshot_in_memory`` capture onto the model's CURRENT
+    (freshly re-lowered) state templates — each value device_put onto
+    the new strategy's sharding, a value-identity operation at fp32.
+    Returns ``{"fresh": [...], "dropped": [...]}`` keypaths (new
+    lowering-created state vs state the new comm plan no longer
+    carries)."""
+    report = {"fresh": [], "dropped": []}
+    for name, template in (("params", model.params),
+                           ("opt_state", model.opt_state),
+                           ("state", model.state)):
+        tree, fresh, dropped = _restore_matching(
+            template, snap["trees"].get(name, {}))
+        if name == "state" and isinstance(tree, dict):
+            # the model-state dict GROWS during training (per-iteration
+            # outputs like a CacheOp's score land after step 1): carry
+            # those live entries across the swap too — uncommitted, the
+            # next jitted step places them.  EF residuals are the one
+            # exception: they are DERIVED from the comm plan, and a
+            # residual for a wire the new plan no longer compresses is
+            # meaningless — those stay dropped (and reported).
+            carried = [k for k in dropped
+                       # a key already in the template landed in
+                       # `dropped` because its saved SHAPE mismatched —
+                       # the fresh init must win there, not the stale
+                       # buffer
+                       if k not in tree
+                       and not k.endswith("/ef_residual")]
+            for k in carried:
+                tree[k] = snap["trees"][name][k]
+            dropped = [k for k in dropped if k not in carried]
+        setattr(model, name, tree)
+        report["fresh"] += [f"{name}/{k}" for k in fresh]
+        report["dropped"] += [f"{name}/{k}" for k in dropped]
+    model._rng_counter = int(snap.get("rng_counter", 0))
+    return report
 
 
 class CheckpointManager:
@@ -135,6 +225,9 @@ class CheckpointManager:
                 self._pending_box,
             )
         os.makedirs(self.directory, exist_ok=True)
+        # a previous writer may have died mid-publish: recover/reclaim
+        # its leftovers before this manager lists or writes anything
+        self._recover_strays()
 
     @staticmethod
     def _drain(executor, pending_box):
@@ -298,6 +391,11 @@ class CheckpointManager:
         return step
 
     def _write_snapshot(self, path: str, arrays, manifest) -> None:
+        """Atomic publish: the full snapshot lands in a temp dir first
+        and only a complete one is swapped in via ``os.replace`` — a
+        kill at ANY point leaves either the previous complete
+        ``step_N`` or none, never a half-written one (the temp/old
+        names don't match ``_STEP_RE``, so listing ignores them)."""
         tmp = path + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
@@ -309,10 +407,65 @@ class CheckpointManager:
             np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
+        old = path + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
         if os.path.exists(path):
-            shutil.rmtree(path)
-        os.rename(tmp, path)
+            # re-saving an existing step: move the old dir aside first
+            # (os.replace cannot atomically replace a non-empty dir);
+            # the rename pair keeps the non-step names outside the
+            # crash window's visible set
+            os.rename(path, old)
+        os.replace(tmp, path)
+        if os.path.exists(old):
+            shutil.rmtree(old)
         self._gc()
+
+    # ------------------------------------------------------------------
+    def snapshot_complete(self, step: int) -> bool:
+        """True when ``step_N`` on disk is a COMPLETE snapshot: the
+        manifest parses and the payload it promises is actually there
+        (npz central directory readable, key set == manifest keys; for
+        orbax trees, the tree/metadata dirs exist).  A torn write on
+        shared storage — or an injected ``corrupt_checkpoint`` fault —
+        fails this check instead of surfacing mid-restore."""
+        return self._complete_dir(self._step_dir(step))
+
+    def _complete_dir(self, path: str) -> bool:
+        mf = os.path.join(path, "manifest.json")
+        if not os.path.exists(mf):
+            # multihost orbax snapshot: positive metadata marker only
+            return os.path.exists(
+                os.path.join(path, "_CHECKPOINT_METADATA"))
+        try:
+            with open(mf) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return False
+        want = {
+            f"{tree}/{k}"
+            for tree, keys in manifest.get("trees", {}).items()
+            for k in keys
+        }
+        npz = os.path.join(path, "arrays.npz")
+        if os.path.exists(npz):
+            import zipfile
+
+            try:
+                with np.load(npz) as z:
+                    return set(z.files) == want
+            except (OSError, ValueError, EOFError, zipfile.BadZipFile):
+                return False
+        tree_dir = os.path.join(path, "tree")
+        return os.path.isdir(tree_dir) and bool(os.listdir(tree_dir))
+
+    def latest_complete_step(self) -> Optional[int]:
+        """Newest step whose snapshot passes ``snapshot_complete`` —
+        the restore anchor when the newest ``step_N`` was torn."""
+        for step in reversed(self.all_steps()):
+            if self.snapshot_complete(step):
+                return step
+        return None
 
     def restore(self, model, step: Optional[int] = None) -> int:
         """Load a snapshot into a compiled FFModel; returns the step."""
@@ -321,9 +474,22 @@ class CheckpointManager:
 
         self.wait()  # an in-flight async save must land first
         if step is None:
-            step = self.latest_step()
-            if step is None:
+            if self.latest_step() is None:
                 raise FileNotFoundError(f"no checkpoints in {self.directory}")
+            step = self.latest_complete_step()
+            if step is None:
+                raise ValueError(
+                    f"no COMPLETE checkpoint in {self.directory}: every "
+                    f"step_N fails the manifest/payload completeness "
+                    f"check (torn writes?)")
+            skipped = [s for s in self.all_steps() if s > step]
+            if skipped:
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint step(s) {skipped} are truncated "
+                    f"(manifest/payload mismatch) — restoring the newest "
+                    f"complete step {step}", stacklevel=2)
         path = self._step_dir(step)
         if jax.process_count() > 1 or not os.path.exists(
                 os.path.join(path, "manifest.json")):
@@ -384,3 +550,23 @@ class CheckpointManager:
         while len(steps) > self.max_to_keep:
             victim = steps.pop(0)
             shutil.rmtree(self._step_dir(victim), ignore_errors=True)
+        self._recover_strays()
+
+    def _recover_strays(self) -> None:
+        """Leftovers of a publish interrupted mid-swap (never part of
+        the visible step set — the regex excludes them).  A kill
+        BETWEEN the rename pair leaves a COMPLETE snapshot parked at
+        ``step_N.old`` with no visible ``step_N``: that copy is the
+        only recoverable data and is renamed back rather than deleted.
+        Everything else (.tmp dirs, superseded or incomplete .old
+        dirs) is reclaimed."""
+        for name in os.listdir(self.directory):
+            full = os.path.join(self.directory, name)
+            if name.endswith(".old") and _STEP_RE.match(name[:-4]):
+                final = os.path.join(self.directory, name[:-4])
+                if not os.path.exists(final) and self._complete_dir(full):
+                    os.rename(full, final)
+                else:
+                    shutil.rmtree(full, ignore_errors=True)
+            elif name.endswith(".tmp") and _STEP_RE.match(name[:-4]):
+                shutil.rmtree(full, ignore_errors=True)

@@ -842,6 +842,11 @@ def _optimize_strategy(
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     t_start = time.monotonic()
+    # re-entrant discipline: the always-on controller re-runs this
+    # mid-training and reads LAST_SEARCH_STATS afterwards — a search
+    # that raises part-way must not leave the PREVIOUS run's stats
+    # (e.g. a stale result_cache_hit) for that consumer to misread
+    LAST_SEARCH_STATS.clear()
     # snapshot the delta-matching counters so search.perf reports THIS
     # search's rescan shrink, not the process-lifetime aggregate
     from flexflow_tpu.search import substitution as _subst

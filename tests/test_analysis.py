@@ -1391,3 +1391,88 @@ def test_imported_stacked_pipeline_meta_adopts_pipeline_config(tmp_path):
     m2.config.import_strategy_file = p
     m2.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
     assert isinstance(m2.compiled, PipelinedCompiledModel)
+
+
+def test_fflint_calibration_signature_str210(tmp_path, capsys):
+    """STR210 (always-on loop satellite): a strategy file whose
+    persisted __meta__.calibration_signature no longer matches the live
+    CALIBRATION.json is flagged STALE (warn — exit stays 0), matching
+    exactly; seeded corruption of any record flips it."""
+    from tools.fflint import _calibration_digest, lint_strategy_file, main
+
+    from flexflow_tpu.search.calibration import CalibrationTable
+    from flexflow_tpu.search.cost_cache import calibration_digest
+    from flexflow_tpu.search.strategy_io import export_strategy
+
+    cal = str(tmp_path / "CALIBRATION.json")
+    table = CalibrationTable()
+    table.put(small_model().graph.topo_order()[1].op,
+              MachineView.trivial(2), 1.5e-4)
+    table._clusters[(("a", "b"), (2, 1), 1)] = 3e-4
+    table.backend = "cpu"
+    table.save(cal)
+    # the stdlib mirror digests the JSON identically to the package
+    with open(cal) as f:
+        assert _calibration_digest(json.load(f)) == calibration_digest(
+            CalibrationTable.load(cal))
+
+    m = small_model()
+    p = str(tmp_path / "s.json")
+    export_strategy(p, m.graph, data_parallel_strategy(m.graph, 8),
+                    meta={"calibration_signature": calibration_digest(
+                        CalibrationTable.load(cal))})
+    # matching live table: clean (sibling default resolution)
+    assert lint_strategy_file(p) == []
+    assert main(["strategy", p]) == 0
+
+    # seeded corruption: each mutation rotates the live digest -> STR210
+    for mutate in (
+        lambda d: d["records"][0].__setitem__("seconds", 9e9),
+        lambda d: d["records"].pop(),
+        lambda d: d.__setitem__("backend", "tpu"),
+        lambda d: d["clusters"][0].__setitem__("replica", 4),
+    ):
+        table.save(cal)  # restore the healthy table
+        with open(cal) as f:
+            data = json.load(f)
+        mutate(data)
+        with open(cal, "w") as f:
+            json.dump(data, f)
+        findings = lint_strategy_file(p)
+        assert [(s, c) for s, c, _ in findings] == [("warn", "STR210")], \
+            findings
+        assert main(["strategy", p]) == 0  # warn does not gate
+        capsys.readouterr()
+
+    # explicit --calibration beats the sibling default
+    other = str(tmp_path / "other_cal.json")
+    CalibrationTable().save(other)
+    assert any(c == "STR210" for _, c, _ in lint_strategy_file(
+        p, calibration_path=other))
+    # no live table at all: nothing to compare, nothing to say
+    assert lint_strategy_file(
+        p, calibration_path=str(tmp_path / "missing.json")) == []
+    # valid JSON with malformed rows: a warn finding, never a traceback
+    # (the pre-commit hook runs this path)
+    broken = str(tmp_path / "broken_cal.json")
+    with open(broken, "w") as f:
+        json.dump({"records": [{"sig": "x"}]}, f)
+    findings = lint_strategy_file(p, calibration_path=broken)
+    assert [(s, c) for s, c, _ in findings] == [("warn", "STR210")]
+    assert main(["strategy", p, "--calibration", broken]) == 0
+
+
+def test_lint_swap_codes_and_clean_pass():
+    """SHD170-172 (hot-swap gate): clean swaps have no findings; each
+    corruption class reports its own code."""
+    from flexflow_tpu.analysis import lint_swap
+
+    m = small_model()
+    strat = data_parallel_strategy(m.graph, 8)
+    assert lint_swap(m.graph, m.graph, strat, 8) == []
+    # composes the flat SHD1xx lint on the target pair
+    bad_views = dict(strat)
+    guid = m.graph.topo_order()[1].guid
+    bad_views[guid] = MachineView(dim_degrees=(3, 3), replica_degree=1)
+    assert any(f.code.startswith("SHD1")
+               for f in lint_swap(m.graph, m.graph, bad_views, 8))

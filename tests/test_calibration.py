@@ -467,3 +467,51 @@ def test_auto_reprobe_capped_on_persistent_drift(tmp_path):
     healthy.stale = True
     healthy.begin_reprobe()
     assert healthy.reprobes == 1 and not healthy.stale
+
+
+def test_healthy_calibrated_fit_resets_allowance_without_obs(tmp_path):
+    """Regression (always-on loop satellite): the re-probe-allowance
+    reset must NOT ride the drift-report path alone — a healthy
+    calibrated fit with profiling OFF and the obs bus OFF still resets
+    ``reprobes`` via mark_healthy_file (fit's own post-compile step
+    timer is the evidence; staleness within the configured threshold
+    counts as healthy)."""
+    import json
+
+    import numpy as np
+
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.obs.events import BUS
+
+    path = str(tmp_path / "cal.json")
+    cfg = ff.FFConfig(batch_size=8, num_devices=2,
+                      machine_spec=MachineSpec.host_cpu(2),
+                      only_data_parallel=True, calibration_file=path,
+                      cost_cache_file="",
+                      # a CPU-host step never lands within a real drift
+                      # band; the threshold is config — what this test
+                      # pins is the RESET PATH, not the band
+                      drift_threshold=1e9)
+    m = ff.FFModel(cfg)
+    x = m.create_tensor([8, 16])
+    m.dense(m.dense(x, 32, name="fc1"), 4, name="head")
+    table = CalibrationTable()
+    for node in m.graph.topo_order():
+        table.put(node.op, MachineView.trivial(
+            node.op.output_shapes[0].ndim), 1e-4)
+    table.reprobes = CalibrationTable.MAX_AUTO_REPROBES  # spent allowance
+    table.save(path)
+    assert not BUS.enabled  # the whole point: no obs bus in play
+    m.compile(loss_type="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    # the calibrated compile recorded its prediction even with the bus
+    # off (the gate the bugfix widened)
+    assert m.predicted_breakdown and m.predicted_breakdown["calibrated"]
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 16).astype(np.float32)
+    Y = rng.randint(0, 4, size=(16,)).astype(np.int32)
+    m.fit(X, Y, batch_size=8, epochs=2, verbose=False)
+    with open(path) as f:
+        assert json.load(f)["reprobes"] == 0, (
+            "healthy calibrated fit must reset the re-probe allowance "
+            "even with profiling and the obs bus disabled")

@@ -214,6 +214,94 @@ def test_keras_model_checkpoint_callback(tmp_path):
     assert len(h) == 1  # epoch 2 only
 
 
+def test_truncated_newest_step_falls_back_to_complete(tmp_path):
+    """Atomic-write satellite: a torn step_N (payload truncated behind
+    the manifest — the kill-mid-write case) is DETECTED by the
+    completeness check and restore falls back to the newest COMPLETE
+    step instead of crashing mid-device-transfer."""
+    import os
+    import warnings
+
+    m = _make_model()
+    _train_a_bit(m)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5, use_orbax=False)
+    mgr.save(1, m)
+    w_at_1 = m.get_weight("dense_0")
+    _train_a_bit(m, seed=5)
+    mgr.save(2, m)
+    # simulate the kill: step_2's payload is half-written
+    npz = os.path.join(mgr._step_dir(2), "arrays.npz")
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+    assert mgr.snapshot_complete(1) and not mgr.snapshot_complete(2)
+    assert mgr.latest_step() == 2  # raw listing still sees it
+    assert mgr.latest_complete_step() == 1
+
+    m2 = _make_model(seed=9)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        step = mgr.restore(m2)
+    assert step == 1
+    assert any("truncated" in str(w.message) for w in caught)
+    np.testing.assert_array_equal(w_at_1, m2.get_weight("dense_0"))
+
+    # an explicitly-requested torn step still fails loudly
+    with pytest.raises(Exception):
+        mgr.restore(_make_model(), step=2)
+
+
+def test_manifest_key_mismatch_is_incomplete(tmp_path):
+    """A snapshot whose npz payload disagrees with its manifest (torn
+    differently: arrays written for another tree shape) is incomplete."""
+    import json
+    import os
+
+    m = _make_model()
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(3, m)
+    mf = os.path.join(mgr._step_dir(3), "manifest.json")
+    with open(mf) as f:
+        manifest = json.load(f)
+    manifest["trees"]["params"].append("ghost/kernel")
+    with open(mf, "w") as f:
+        json.dump(manifest, f)
+    assert not mgr.snapshot_complete(3)
+    assert mgr.latest_complete_step() is None
+
+
+def test_interrupted_publish_leaves_no_visible_step(tmp_path):
+    """A crash BEFORE the atomic publish leaves only the .tmp dir,
+    which the step listing ignores and the next retention pass
+    reclaims."""
+    import os
+
+    m = _make_model()
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(1, m)
+    # a dead writer's leftovers
+    os.makedirs(os.path.join(str(tmp_path), "step_9.tmp"))
+    os.makedirs(os.path.join(str(tmp_path), "step_4.old"))
+    assert mgr.all_steps() == [1]
+    assert mgr.restore(_make_model(seed=3)) == 1
+    mgr.save(2, m)  # publish triggers gc of the stray dirs
+    assert not os.path.exists(os.path.join(str(tmp_path), "step_9.tmp"))
+    assert not os.path.exists(os.path.join(str(tmp_path), "step_4.old"))
+
+
+def test_resave_same_step_replaces_atomically(tmp_path):
+    m = _make_model()
+    _train_a_bit(m)
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(1, m)
+    _train_a_bit(m, seed=4)
+    mgr.save(1, m)  # overwrite goes through the rename-aside swap
+    assert mgr.all_steps() == [1] and mgr.snapshot_complete(1)
+    m2 = _make_model(seed=2)
+    mgr.restore(m2)
+    np.testing.assert_array_equal(m.get_weight("dense_0"),
+                                  m2.get_weight("dense_0"))
+
+
 def test_resume_matches_uninterrupted_run(tmp_path):
     """Interrupt+resume must be EQUIVALENT to an uninterrupted run:
     the shuffle stream is fast-forwarded (a resumed epoch N sees the
@@ -240,3 +328,32 @@ def test_resume_matches_uninterrupted_run(tmp_path):
     for u, v in zip(a, b):
         np.testing.assert_allclose(np.asarray(u), np.asarray(v),
                                    rtol=0, atol=0)
+
+
+def test_kill_between_rename_pair_recovers_old_copy(tmp_path):
+    """Review fix: a kill between the rename-aside and the publish
+    leaves the ONLY complete snapshot parked at step_N.old — the next
+    manager recovers it instead of deleting it."""
+    import os
+    import shutil
+
+    m = _make_model()
+    _train_a_bit(m)
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(5, m)
+    w = m.get_weight("dense_0")
+    # simulate the crash window: step_5 moved aside, publish never ran
+    os.rename(mgr._step_dir(5), mgr._step_dir(5) + ".old")
+    assert CheckpointManager(str(tmp_path)).all_steps() == [5]  # recovered
+    m2 = _make_model(seed=4)
+    mgr2 = CheckpointManager(str(tmp_path), use_orbax=False)
+    assert mgr2.restore(m2) == 5
+    np.testing.assert_array_equal(w, m2.get_weight("dense_0"))
+    # an INCOMPLETE .old (superseded or torn) is reclaimed, not revived
+    os.rename(mgr2._step_dir(5), mgr2._step_dir(5) + ".old")
+    shutil.rmtree(os.path.join(mgr2._step_dir(5) + ".old"),
+                  ignore_errors=False)
+    os.makedirs(mgr2._step_dir(5) + ".old")  # empty = incomplete
+    mgr3 = CheckpointManager(str(tmp_path))
+    assert mgr3.all_steps() == []
+    assert not os.path.exists(mgr3._step_dir(5) + ".old")

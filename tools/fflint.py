@@ -71,12 +71,83 @@ def _load_json(path: str):
 # strategy files (stdlib)
 
 
-def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
+def _calibration_digest(data) -> str:
+    """Stdlib mirror of ``search/cost_cache.calibration_digest`` over a
+    CALIBRATION.json payload: identical bytes hashed in identical order
+    to what ``CalibrationTable.load`` + the package digest produce, so
+    the STR210 comparison below proves the same signature the search
+    keyed its caches (and the exported ``__meta__``) under."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(repr(data.get("backend")).encode())
+    records = {}
+    for r in data.get("records", []):
+        records[(r["sig"], tuple(r["degrees"]), int(r["replica"]))] = \
+            float(r["seconds"])
+    for k, v in sorted(records.items()):
+        h.update(repr((k, v)).encode())
+    clusters = {}
+    for r in data.get("clusters", []):
+        clusters[(tuple(r["sigs"]), tuple(r["degrees"]),
+                  int(r["replica"]))] = float(r["seconds"])
+    for k, v in sorted(clusters.items()):
+        h.update(repr((k, v)).encode())
+    return h.hexdigest()[:16]
+
+
+def _lint_calibration_signature(meta, strategy_path: str,
+                                calibration_path) -> List[Tuple[str, str, str]]:
+    """STR210: a persisted ``__meta__.calibration_signature`` that no
+    longer matches the LIVE calibration table is a STALE strategy —
+    the cost surface it was ranked under has rotated (a re-probe, a
+    drift fix) and the file's predicted numbers no longer describe this
+    machine.  Warn, matching the import-side severity philosophy for
+    provenance that is suspicious but not provably wrong."""
+    sig = meta.get("calibration_signature")
+    if not isinstance(sig, str) or not sig:
+        return []
+    if calibration_path is None:
+        # default: the CALIBRATION.json living next to the strategy
+        # file is "the live table" for that artifact set
+        calibration_path = os.path.join(
+            os.path.dirname(os.path.abspath(strategy_path)),
+            "CALIBRATION.json")
+    if not os.path.exists(calibration_path):
+        return []
+    data, err = _load_json(calibration_path)
+    if err or not isinstance(data, dict):
+        return [("warn", "STR210",
+                 f"cannot check calibration_signature: live table "
+                 f"{calibration_path} is unreadable ({err})")]
+    try:
+        live = _calibration_digest(data)
+    except (KeyError, TypeError, ValueError) as e:
+        # valid JSON, malformed rows: STR210 is warn-only by contract —
+        # the hook must not traceback over a table the package itself
+        # would refuse to load
+        return [("warn", "STR210",
+                 f"cannot check calibration_signature: live table "
+                 f"{calibration_path} has malformed rows "
+                 f"({type(e).__name__}: {e})")]
+    if live != sig:
+        return [("warn", "STR210",
+                 f"STALE: exported under calibration signature {sig} "
+                 f"but the live table ({calibration_path}) digests to "
+                 f"{live} — the cost surface rotated since this "
+                 f"strategy was searched; re-search or re-export")]
+    return []
+
+
+def lint_strategy_file(path: str,
+                       calibration_path=None) -> List[Tuple[str, str, str]]:
     """(severity, code, message) findings for one exported strategy
     file.  Graph-side checks (digest match, coverage, view legality
     against the op) need the graph and run at import time
     (search/strategy_io.import_strategy) — this lints what a file alone
-    can prove."""
+    can prove.  ``calibration_path`` pins the live CALIBRATION.json the
+    STR210 staleness check compares against (default: the strategy
+    file's sibling)."""
     data, err = _load_json(path)
     if err:
         return [("error", "STR200", err)]
@@ -107,6 +178,8 @@ def lint_strategy_file(path: str) -> List[Tuple[str, str, str]]:
             meta["pipeline"], {k for k in data if k != META_KEY})
     if isinstance(meta, dict) and "serving" in meta:
         out += _lint_serving_meta(meta["serving"])
+    if isinstance(meta, dict):
+        out += _lint_calibration_signature(meta, path, calibration_path)
     views = {k: v for k, v in data.items() if k != META_KEY}
     if not views:
         out.append(("error", "STR202", "file names no ops at all"))
@@ -688,7 +761,11 @@ def _summary(args, text: str, **payload) -> None:
 def cmd_strategy(args) -> int:
     errors = 0
     for path in args.files:
-        errors += _report(path, lint_strategy_file(path), args.json)
+        errors += _report(
+            path,
+            lint_strategy_file(
+                path, calibration_path=getattr(args, "calibration", None)),
+            args.json)
     _summary(args,
              f"fflint strategy: {len(args.files)} file(s), {errors} "
              f"error(s)", files=len(args.files), errors=errors)
@@ -796,7 +873,13 @@ def cmd_precommit(args) -> int:
         for rel, path in caches:
             errors += _report(rel, lint_cache_file(path), args.json)
         for rel, path in strategies:
-            errors += _report(rel, lint_strategy_file(path), args.json)
+            # the staged blob lives in the temp mirror, but its "live
+            # CALIBRATION.json sibling" (STR210) is the one in the repo
+            errors += _report(
+                rel,
+                lint_strategy_file(path, calibration_path=os.path.join(
+                    args.root, os.path.dirname(rel), "CALIBRATION.json")),
+                args.json)
     if not args.skip_registry:
         findings, _info = lint_registry(args.devices)
         errors += _report("registry", findings, args.json)
@@ -848,6 +931,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("strategy", parents=[common],
                        help="lint exported strategy files")
     p.add_argument("files", nargs="+")
+    p.add_argument("--calibration", default=None,
+                   help="live CALIBRATION.json the STR210 staleness "
+                        "check compares __meta__.calibration_signature "
+                        "against (default: each strategy file's "
+                        "sibling)")
     p.set_defaults(fn=cmd_strategy)
     p = sub.add_parser("cache", parents=[common],
                        help="lint persistent cost-cache files")

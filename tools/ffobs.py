@@ -418,6 +418,65 @@ def render_report(events: List[dict], top: int = 10,
                     f"{e.get('pages_in_use')} | "
                     f"{_ms(e.get('predicted_s'))} | "
                     f"{_ms(e.get('measured_s'))} |")
+    # ---- always-on controller: faults, swaps, recoveries ------------------
+    faults = [e for e in events if e.get("kind") == "fault.injected"]
+    researches = [e for e in events
+                  if e.get("kind") == "controller.research"]
+    swaps = [e for e in events if e.get("kind") == "controller.swap"]
+    recoveries = [e for e in events
+                  if e.get("kind") == "controller.recovery"]
+    fallbacks = [e for e in events
+                 if e.get("kind") == "controller.fallback"]
+    csummaries = [e for e in events
+                  if e.get("kind") == "controller.summary"]
+    if faults or swaps or recoveries or csummaries:
+        lines.append("")
+        lines.append("## Always-on controller (swap/recovery phases)")
+        lines.append("")
+        if csummaries:
+            s = csummaries[-1]
+            lines.append(
+                f"{s.get('steps')} steps driven: {s.get('swaps')} hot "
+                f"swap(s), {s.get('recoveries')} recover(ies), "
+                f"{s.get('retries')} retr(ies), {s.get('fallbacks')} "
+                f"monolithic-fp32 fallback(s)")
+        for e in faults:
+            lines.append(
+                f"Fault injected at step {e.get('step')}: "
+                f"{e.get('fault')}"
+                + (f" (arg {e.get('arg')})"
+                   if e.get("arg") is not None else ""))
+        for e in researches:
+            cal_s = e.get("calibration_seconds") or 0.0
+            lines.append(
+                f"Re-search at step {e.get('step')} "
+                f"({e.get('trigger')}): "
+                f"{(e.get('search_seconds') or 0.0):.3f}s"
+                + (f" (+{cal_s:.3f}s re-probe)" if cal_s else "")
+                + (" — served WARM from the result cache"
+                   if e.get("warm") else ""))
+        for e in swaps:
+            lines.append(
+                f"Hot swap at step {e.get('step')}: "
+                f"{(e.get('swap_seconds') or 0.0):.3f}s, "
+                f"{e.get('fresh') or 0} fresh / "
+                f"{e.get('dropped') or 0} dropped state entries"
+                + (" — FELL BACK to monolithic fp32 sync"
+                   if e.get("fallback") else ""))
+        for e in recoveries:
+            extra = ""
+            if e.get("cause") == "device_loss":
+                extra = f" onto {e.get('devices')} surviving device(s)"
+            elif e.get("cause") == "checkpoint":
+                extra = (f" from newest complete step "
+                         f"{e.get('restored_step')}")
+            lines.append(
+                f"Recovery at step {e.get('step')}: "
+                f"{e.get('cause')}{extra}")
+        for e in fallbacks:
+            lines.append(
+                f"Fallback at step {e.get('step')}: {e.get('reason')}")
+
     stale = [e for e in events if e.get("kind") == "calibration.staleness"]
     if stale:
         s = stale[-1]

@@ -314,6 +314,143 @@ def _motif_builders(op_type, unary_fns, binary_fns):
     return motifs
 
 
+def instantiate_pattern_graph(rule, num_devices: int):
+    """Build a ``PatternRule``'s SOURCE pattern directly as a PCG — the
+    multi-node-JSON proof instantiator (the PR 9 remainder): a rule
+    whose source pattern spans several ops rarely anchors on the
+    single-motif synthesizer graphs or the hand zoo, so it used to be
+    EQV306-reported un-proven.  Here the pattern ops themselves become
+    model calls (externals -> input tensors, weight-slot externals ->
+    the op's own weight, parallel ops from their PM_* params, compute
+    ops from the donor-less construction families), a dense head is
+    added on every MAPPED output (mapped outputs are the tensors the
+    matcher allows to escape), and the result feeds the SAME
+    ``verify_rewrite`` numeric proof as everything else.  Returns None
+    when the pattern uses an op family outside the supported subset or
+    a weight-sharing external our ops cannot express — those rules
+    stay honestly EQV306."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.optype import OperatorType as T
+    from flexflow_tpu.search.substitution_loader import (
+        _ACTI_MAP,
+        _PARALLEL_TYPES,
+        _logical_dim,
+    )
+
+    unary_calls = {T.RELU: "relu", T.SIGMOID: "sigmoid", T.TANH: "tanh",
+                   T.ELU: "elu", T.IDENTITY: "identity"}
+    binary_calls = {T.EW_ADD: "add", T.EW_MUL: "multiply",
+                    T.EW_SUB: "subtract", T.EW_DIV: "divide",
+                    T.EW_MAX: "max", T.EW_MIN: "min"}
+    # data-input arity per op family: pattern slots past it are the
+    # reference corpus' explicit weight tensors, which our ops OWN —
+    # they bind to the matched op's own weight at match time, so the
+    # instantiated graph simply omits them
+    data_arity = {T.LINEAR: 1, T.SOFTMAX: 1, T.LAYERNORM: 1}
+    data_arity.update({t: 1 for t in unary_calls})
+    data_arity.update({t: 2 for t in binary_calls})
+    data_arity.update({t: 1 for t in _PARALLEL_TYPES})
+
+    n = max(2, num_devices)
+    b = max(8, n)
+    if b % n:
+        b = n
+    w = 2 * n
+    cfg = ff.FFConfig(batch_size=b, num_devices=n,
+                      only_data_parallel=True)
+    m = ff.FFModel(cfg)
+    nm = _namer("pat")
+    ext: Dict[int, object] = {}
+    outs: Dict[Tuple[int, int], object] = {}
+    # weight-sharing externals (one negative id feeding two ops'
+    # weight slots) cannot be expressed with op-owned weights — the
+    # matcher could never bind them anyway, so decline
+    weight_ext_owner: Dict[int, int] = {}
+    for i, pat in enumerate(rule.src_ops):
+        t_type = pat.type
+        if t_type is T.CONCAT:
+            arity = len(pat.inputs)
+        else:
+            arity = data_arity.get(t_type)
+            if arity is None:
+                return None
+        ins = []
+        for slot, (src_id, ts_id) in enumerate(pat.inputs):
+            if slot >= arity:
+                if src_id >= 0:
+                    return None  # an internal tensor in a weight slot
+                if src_id in weight_ext_owner or src_id in ext:
+                    return None  # shared weight external
+                weight_ext_owner[src_id] = i
+                continue
+            if src_id >= 0:
+                t = outs.get((src_id, ts_id))
+                if t is None:
+                    return None
+            else:
+                if src_id in weight_ext_owner:
+                    return None
+                if src_id not in ext:
+                    ext[src_id] = m.create_tensor(
+                        [b, w], name=nm(f"ext{-src_id}"))
+                t = ext[src_id]
+            ins.append(t)
+        if len(ins) < arity:
+            return None  # pattern op missing a data input
+        try:
+            if t_type is T.LINEAR:
+                act = _ACTI_MAP.get(pat.params.get("PM_ACTI", 0))
+                y = m.dense(ins[0], w, activation=act, name=nm("lin"))
+            elif t_type is T.SOFTMAX:
+                y = m.softmax(ins[0], name=nm("sm"))
+            elif t_type is T.LAYERNORM:
+                y = m.layer_norm(ins[0], name=nm("ln"))
+            elif t_type is T.CONCAT:
+                y = m.concat(ins, axis=1, name=nm("cat"))
+            elif t_type in unary_calls:
+                y = getattr(m, unary_calls[t_type])(ins[0], name=nm("un"))
+            elif t_type in binary_calls:
+                y = getattr(m, binary_calls[t_type])(
+                    ins[0], ins[1], name=nm("bin"))
+            elif t_type in _PARALLEL_TYPES:
+                dim, deg = pat.parallel_dim_degree()
+                if deg is None:
+                    return None
+                if t_type is T.REPARTITION:
+                    ld = _logical_dim(dim or 0, 2)
+                    if (b, w)[ld] % deg:
+                        return None
+                    y = m.repartition(ins[0], dim=ld, degree=deg,
+                                      name=nm("rep"))
+                elif t_type is T.COMBINE:
+                    ld = _logical_dim(dim or 0, 2)
+                    y = m.combine(ins[0], dim=ld, degree=deg,
+                                  name=nm("comb"))
+                elif t_type is T.REPLICATE:
+                    y = m.replicate(ins[0], degree=deg, name=nm("repl"))
+                else:
+                    y = m.reduction(ins[0], degree=deg, name=nm("red"))
+            else:
+                return None
+        except Exception:
+            return None  # shape/param mismatch: the family declines
+        outs[(i, 0)] = y
+    # heads on MAPPED outputs only — the matcher's escape check rejects
+    # any other internal tensor leaving the pattern
+    headed = set()
+    for s_op, s_ts, _d_op, _d_ts in rule.mapped_outputs:
+        t = outs.get((s_op, s_ts))
+        if t is None:
+            return None
+        if (s_op, s_ts) not in headed:
+            headed.add((s_op, s_ts))
+            try:
+                m.dense(t, 4, name=nm("head"))
+            except Exception:
+                return None
+    return m.graph
+
+
 def verify_registry_generated(
     num_devices: int = 8, seed: int = 0, xfers=None,
 ) -> Tuple[List[Finding], Dict[str, object]]:
@@ -374,19 +511,39 @@ def verify_registry_generated(
                     stats["proofs"] += 1
                     stats["lanes"][lane] = stats["lanes"].get(lane, 0) + 1
         if not proven_lanes and not factory:
-            # non-factory rules (JSON patterns) may still be proven by
-            # the hand zoo before being declared un-proven
-            if zoo is None:
-                zoo = _proof_graphs(num_devices)
-            for g in zoo:
-                matches = xf.find_matches(g)
-                if matches:
-                    findings += verify_rewrite(g, xf, matches[0],
-                                               seed=seed)
-                    proven_lanes.append("zoo")
-                    stats["proofs"] += 1
-                    stats["zoo_fallbacks"] += 1
-                    break
+            # non-factory rules: multi-node JSON patterns rarely anchor
+            # on the single-motif bank — instantiate the rule's OWN
+            # source pattern as a PCG and prove there (the PR 9
+            # remainder; closes the EQV306 hole for every rule the
+            # instantiator can express)
+            from flexflow_tpu.search.substitution_loader import (
+                PatternRule,
+            )
+
+            if isinstance(xf, PatternRule):
+                g = instantiate_pattern_graph(xf, num_devices)
+                if g is not None:
+                    matches = xf.find_matches(g)
+                    if matches:
+                        findings += verify_rewrite(g, xf, matches[0],
+                                                   seed=seed)
+                        proven_lanes.append("pattern")
+                        stats["proofs"] += 1
+                        stats["pattern_proofs"] = stats.get(
+                            "pattern_proofs", 0) + 1
+            # the hand zoo stays as the regression anchor / last resort
+            if not proven_lanes:
+                if zoo is None:
+                    zoo = _proof_graphs(num_devices)
+                for g in zoo:
+                    matches = xf.find_matches(g)
+                    if matches:
+                        findings += verify_rewrite(g, xf, matches[0],
+                                                   seed=seed)
+                        proven_lanes.append("zoo")
+                        stats["proofs"] += 1
+                        stats["zoo_fallbacks"] += 1
+                        break
         if not proven_lanes:
             stats["unproven"] += 1
             if factory:

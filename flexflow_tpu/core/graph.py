@@ -341,6 +341,31 @@ class Graph:
         self._node_hash_cache = combined
         return combined
 
+    def stable_sig_reprs(self) -> Dict[int, str]:
+        """Per-node signature strings for PROCESS-STABLE digesting —
+        the ONE input-handling rule shared by ``stable_node_digests``
+        and ``cost_cache.stable_graph_digest`` (they key the same
+        persisted memo rows and must stay in lock-step): InputOp
+        signatures embed the frontend's GLOBAL tensor_guid counter
+        (process-lifetime, build-order dependent), so the input's rank
+        of appearance in topo order is substituted — it carries the
+        same distinctness without the counter, letting graphs/segments
+        containing model inputs digest identically across builds."""
+        input_rank: Dict[object, int] = {}
+        sigs: Dict[int, str] = {}
+        for node in self.topo_order():
+            op = node.op
+            if op.op_type.value == "input":
+                shape = op.output_shapes[0]
+                sigs[node.guid] = repr((
+                    "input", shape.sizes, shape.dtype.value,
+                    input_rank.setdefault(
+                        op.attrs.get("tensor_guid"), len(input_rank)),
+                ))
+            else:
+                sigs[node.guid] = self._sig_repr(node)
+        return sigs
+
     def stable_node_digests(self) -> Dict[int, str]:
         """Process-stable analogue of ``node_hashes``: per-node
         structural digests combining the ancestor- and descendant-
@@ -359,20 +384,21 @@ class Graph:
             return blake2b(payload.encode(), digest_size=12).hexdigest()
 
         topo = self.topo_order()
+        sigs = self.stable_sig_reprs()
         anc: Dict[int, str] = {}
         for node in topo:
             ins = sorted(
                 (anc[e.src], e.src_idx, e.dst_idx)
                 for e in self.in_edges[node.guid]
             )
-            anc[node.guid] = h(self._sig_repr(node) + repr(ins))
+            anc[node.guid] = h(sigs[node.guid] + repr(ins))
         desc: Dict[int, str] = {}
         for node in reversed(topo):
             outs = sorted(
                 (desc[e.dst], e.src_idx, e.dst_idx)
                 for e in self.out_edges[node.guid]
             )
-            desc[node.guid] = h(self._sig_repr(node) + repr(outs))
+            desc[node.guid] = h(sigs[node.guid] + repr(outs))
         combined = {g: h(anc[g] + desc[g]) for g in self.nodes}
         self._stable_nh_cache = combined
         return combined

@@ -22,6 +22,7 @@ from flexflow_tpu.models.xdl import build_xdl
 from flexflow_tpu.models.candle_uno import build_candle_uno
 from flexflow_tpu.models.moe import build_moe
 from flexflow_tpu.models.mlp import build_mlp_unify
+from flexflow_tpu.models.synthetic import build_moe_trunk, build_multibranch
 
 __all__ = [
     "build_alexnet",
@@ -42,5 +43,7 @@ __all__ = [
     "build_xdl",
     "build_candle_uno",
     "build_moe",
+    "build_moe_trunk",
+    "build_multibranch",
     "build_mlp_unify",
 ]

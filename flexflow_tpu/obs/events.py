@@ -39,6 +39,14 @@ EVENT_KINDS = {
     # ffobs validate rejected logs containing them
     "search.chain": {"nodes", "segments"},
     "search.chain_done": {"bound_s", "cost_s"},
+    # series-parallel decomposition (PR 12, search/decompose.py): one
+    # event per oversized (sub)graph naming the chosen decomposition —
+    # mode "chain" (width-1 bottleneck cuts, the PR 7 degenerate case),
+    # "sp" (bounded-width frontier cuts), or "fallback" with the
+    # ``reason`` the graph degraded to binary recursion, so a
+    # bottleneck-free thousand-node graph can never slow down silently
+    "search.decompose": {"nodes", "mode"},
+    "search.decompose_done": {"mode", "bound_s", "cost_s"},
     "search.floor": {"kept_dp", "dp_cost_s", "searched_cost_s"},
     "search.result": {"cost_s", "rewritten"},
     "search.perf": {"search_seconds", "calibration_seconds", "full_sims",

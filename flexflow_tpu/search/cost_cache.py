@@ -52,7 +52,11 @@ SCHEMA_VERSION = 1
 # stay valid).  An UNKNOWN dp_schema drops the dp layer loudly (stderr +
 # fflint CCH405) and keeps the rest of the cache — corrupt memo rows
 # must cost a recompute, never serve a wrong strategy.
-DP_SCHEMA = 1
+# v2: stable_node_digests substitutes input tensor_guids by rank of
+# appearance (matching stable_graph_digest), so input-bearing segments
+# key consistently across builds — v1 rows for such segments were
+# permanently dead keys that still counted against DP_MAX_ROWS.
+DP_SCHEMA = 2
 # sub-schema of the persisted comm-plan memo rows ("comm_plans"/
 # "comm_schema" keys, search/comm_plan.py): the co-search's chosen
 # sync schedules / precision maps / zero-sharding choices per
@@ -60,6 +64,14 @@ DP_SCHEMA = 1
 # an unknown comm_schema drops ONLY this layer, loudly (stderr +
 # fflint CCH407), and a re-search rebuilds it.
 COMM_SCHEMA = 1
+# sub-schema of the persisted SP-SEGMENT memo rows ("sp_rows"/
+# "sp_schema" keys): finished series-parallel segment SOLVES — the
+# whole unity recursion over one segment, substitutions included — as
+# guid-free strategy rows under stable digests (driver._persist_sp_row)
+# keyed by segment digest + pinned boundary-view tuple + search knobs.
+# Same additive fail-LOUD discipline: an unknown sp_schema drops only
+# this layer (stderr + fflint CCH409) and segments re-solve.
+SP_SCHEMA = 1
 
 _ROW_HITS = METRICS.counter("cost_cache.row_hits")
 _ROW_MISSES = METRICS.counter("cost_cache.row_misses")
@@ -69,6 +81,8 @@ _DP_HITS = METRICS.counter("cost_cache.dp_row_hits")
 _DP_MISSES = METRICS.counter("cost_cache.dp_row_misses")
 _COMM_HITS = METRICS.counter("cost_cache.comm_plan_hits")
 _COMM_MISSES = METRICS.counter("cost_cache.comm_plan_misses")
+_SP_HITS = METRICS.counter("cost_cache.sp_row_hits")
+_SP_MISSES = METRICS.counter("cost_cache.sp_row_misses")
 
 RowKey = Tuple[str, Tuple[int, ...], int]
 
@@ -158,19 +172,12 @@ def stable_graph_digest(graph) -> str:
         return cached
     order = graph.topo_order()
     pos = {n.guid: i for i, n in enumerate(order)}
-    input_rank: Dict[object, int] = {}
+    # input-rank substitution lives in ONE place (the same rule keys
+    # the per-node digests the dp/sp memo rows pair under)
+    sigs = graph.stable_sig_reprs()
     h = hashlib.blake2b(digest_size=16)
     for node in order:
-        op = node.op
-        if op.op_type.value == "input":
-            shape = op.output_shapes[0]
-            h.update(repr((
-                "input", shape.sizes, shape.dtype.value,
-                input_rank.setdefault(
-                    op.attrs.get("tensor_guid"), len(input_rank)),
-            )).encode())
-        else:
-            h.update(graph._sig_repr(node).encode())
+        h.update(sigs[node.guid].encode())
         for e in sorted(
             (pos[e.src], e.src_idx, e.dst_idx)
             for e in graph.in_edges[node.guid]
@@ -208,6 +215,16 @@ class CostCache:
         # the layer is inert on every sequential-pipeline run and the
         # bit-identical regression gate holds by construction.
         self.comm_plans: Dict[str, dict] = {}
+        # persisted SP-SEGMENT memo rows (sp-row layer): key string ->
+        # {"cost": float, "strategy": [[node_digest, dims, replica,
+        # start], ...]} — whole series-parallel segment solves
+        # (driver.sp_optimize) under guid-free stable digests.
+        # ``sp_loaded`` marks rows FROM DISK: only those are served —
+        # within one run the in-process segment cache already covers
+        # this run's writes, so a cold cache stays inert and the chain
+        # bit-identity gate holds.
+        self.sp_rows: Dict[str, dict] = {}
+        self.sp_loaded = False
         self.stale = False
         self.invalidated = False  # file existed with another signature
         self._dirty = False
@@ -219,6 +236,8 @@ class CostCache:
         self.dp_row_misses = 0
         self.comm_plan_hits = 0
         self.comm_plan_misses = 0
+        self.sp_row_hits = 0
+        self.sp_row_misses = 0
         self._load()
 
     # ------------------------------------------------------------------
@@ -276,6 +295,23 @@ class CostCache:
             elif isinstance(dp, dict):
                 self.dp_rows = dp
                 self.dp_loaded = True
+        sp = data.get("sp_rows")
+        if sp:
+            if data.get("sp_schema") != SP_SCHEMA:
+                # same fail-LOUD discipline as the dp layer: an unknown
+                # sub-schema drops ONLY the sp-row layer (segments
+                # re-solve, one recompute each), keeps the rest
+                print(
+                    f"flexflow_tpu cost cache: persisted sp-segment memo "
+                    f"rows carry unknown sp_schema "
+                    f"{data.get('sp_schema')!r} (known: {SP_SCHEMA}) — "
+                    f"dropping the sp-row layer; segments will be "
+                    f"re-solved (run tools/fflint.py cache to inspect)",
+                    file=sys.stderr,
+                )
+            elif isinstance(sp, dict):
+                self.sp_rows = sp
+                self.sp_loaded = True
         cp = data.get("comm_plans")
         if cp:
             if data.get("comm_schema") != COMM_SCHEMA:
@@ -331,7 +367,8 @@ class CostCache:
                  "calibration_stale": False, "rows": rows,
                  "dp_schema": DP_SCHEMA, "dp_rows": self.dp_rows,
                  "comm_schema": COMM_SCHEMA,
-                 "comm_plans": self.comm_plans},
+                 "comm_plans": self.comm_plans,
+                 "sp_schema": SP_SCHEMA, "sp_rows": self.sp_rows},
                 f,
             )
         os.replace(tmp, self.path)
@@ -398,6 +435,38 @@ class CostCache:
         self.dp_row_hits += 1
         _DP_HITS.inc()
         return hit
+
+    # ---- sp-segment memo-row layer (series-parallel segment solves) ---
+    def get_sp_row(self, key: str) -> Optional[dict]:
+        """The persisted sp-segment memo row for a (segment digest,
+        boundary-pin digest, knobs) key, or None.  The payload is
+        guid-free like the dp layer's; driver._serve_sp_row remaps it
+        onto the caller's segment and re-lints before serving."""
+        if self.stale:
+            return None
+        hit = self.sp_rows.get(key)
+        if hit is None:
+            self.sp_row_misses += 1
+            _SP_MISSES.inc()
+            return None
+        self.sp_row_hits += 1
+        _SP_HITS.inc()
+        return hit
+
+    # soft bound mirroring DP_MAX_ROWS — a 10k-node sweep over many
+    # boundary tuples must not grow the file without limit
+    SP_MAX_ROWS = 20000
+
+    def put_sp_row(self, key: str, cost: float, strategy_rows) -> None:
+        if self.stale or not math.isfinite(cost):
+            return
+        if key in self.sp_rows:
+            return  # deterministic solve: first write wins
+        if len(self.sp_rows) >= self.SP_MAX_ROWS:
+            return
+        self.sp_rows[key] = {"cost": float(cost),
+                             "strategy": strategy_rows}
+        self._dirty = True
 
     # ---- comm-plan memo layer (co-search, search/comm_plan.py) --------
     def get_comm_plan(self, key: str) -> Optional[dict]:

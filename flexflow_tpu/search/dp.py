@@ -187,6 +187,42 @@ def _pair_views(graph: Graph, nh, canon, fixed: Optional[Strategy]):
     return strategy, ambiguous
 
 
+def encode_strategy_rows(graph: Graph, strategy: Strategy):
+    """The persisted memo-row strategy encoding shared by the dp-row
+    and sp-row layers: ``[[stable node digest, degrees, replica,
+    start], ...]`` sorted by (digest, guid).  Returns None when
+    ``strategy`` does not cover the graph exactly (a partial strategy
+    is not a persistable result).  MUST stay the decode's inverse —
+    fflint's _lint_digest_row_layer lints the same shape."""
+    snh = graph.stable_node_digests()
+    rows = [
+        [snh[g], list(strategy[g].dim_degrees),
+         int(strategy[g].replica_degree), int(strategy[g].start_part)]
+        for g in sorted(strategy, key=lambda g: (snh.get(g, ""), g))
+        if g in graph.nodes
+    ]
+    if len(rows) != graph.num_nodes:
+        return None
+    return rows
+
+
+def decode_strategy_rows(row: dict):
+    """(cost, canonical digest-keyed strategy) from a persisted memo
+    row, or None on any malformation — the reader side of
+    ``encode_strategy_rows``, shared by the dp-row and sp-row serves
+    (a corrupt row is a miss, never a crash or a wrong serve)."""
+    try:
+        cost = float(row["cost"])
+        canon = tuple(
+            (h, MachineView(tuple(int(x) for x in dims), int(rep),
+                            int(st)))
+            for h, dims, rep, st in row["strategy"]
+        )
+    except (KeyError, TypeError, ValueError):
+        return None
+    return cost, canon
+
+
 class SearchHelper:
     def __init__(
         self,
@@ -226,6 +262,10 @@ class SearchHelper:
         self.ctx_rebuilds = 0
         self.dp_rows_served = 0
         self.segments_stamped = 0
+        # persisted sp-segment memo rows served (driver._serve_sp_row:
+        # whole SP-segment solves — substitution search included —
+        # answered from the cost cache's sp-row layer)
+        self.sp_rows_served = 0
         # joint strategy x comm-plan co-search (search/comm_plan.py):
         # when the driver binds a JointPricer here, every cost this
         # helper GROUNDS (the _finish re-validation, its DP floor, the
@@ -777,15 +817,10 @@ class SearchHelper:
             self._dp_persist_key(graph, fixed, budget, start))
         if row is None:
             return None
-        try:
-            cost = float(row["cost"])
-            canon = tuple(
-                (h, MachineView(tuple(int(x) for x in dims), int(rep),
-                                int(st)))
-                for h, dims, rep, st in row["strategy"]
-            )
-        except (KeyError, TypeError, ValueError):
+        decoded = decode_strategy_rows(row)
+        if decoded is None:
             return None
+        cost, canon = decoded
         strategy, ambiguous = _pair_views(
             graph, graph.stable_node_digests(), canon, fixed)
         if strategy is None or len(strategy) != graph.num_nodes:
@@ -809,14 +844,8 @@ class SearchHelper:
         if (cc is None or cc.stale or not math.isfinite(cost)
                 or graph.num_nodes < DP_PERSIST_MIN_NODES or not strategy):
             return
-        snh = graph.stable_node_digests()
-        rows = [
-            [snh[g], list(strategy[g].dim_degrees),
-             int(strategy[g].replica_degree), int(strategy[g].start_part)]
-            for g in sorted(strategy, key=lambda g: (snh.get(g, ""), g))
-            if g in graph.nodes
-        ]
-        if len(rows) != graph.num_nodes:
+        rows = encode_strategy_rows(graph, strategy)
+        if rows is None:
             return  # partial coverage is not a DP result
         cc.put_dp_row(self._dp_persist_key(graph, fixed, budget, start),
                       float(cost), rows)

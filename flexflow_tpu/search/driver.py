@@ -48,12 +48,30 @@ from flexflow_tpu.core.graph import Graph, Node
 from flexflow_tpu.core.machine import MachineView
 from flexflow_tpu.obs.events import BUS
 from flexflow_tpu.obs.metrics import METRICS
-from flexflow_tpu.search.dp import SearchHelper, Strategy, canon_fixed_views
+from flexflow_tpu.search import decompose as _decompose
+from flexflow_tpu.search.dp import (
+    DP_PERSIST_MIN_NODES,
+    SearchHelper,
+    Strategy,
+    _pair_views,
+    canon_fixed_views,
+    canonicalize_strategy,
+    decode_strategy_rows,
+    encode_strategy_rows,
+)
 from flexflow_tpu.search.simulator import Simulator
 from flexflow_tpu.search.substitution import generate_all_pcg_xfers
 from flexflow_tpu.search.views import boundary_views
 
 _SEG_STAMPS = METRICS.counter("search.segments_stamped")
+_SP_ROWS_SERVED = METRICS.counter("search.sp_rows_served")
+
+# decomposition provenance of the LAST optimize_strategy call in this
+# process (reset per run, cumulative over recursion levels): which
+# decomposition each oversized (sub)graph took, how many bounded-width
+# cuts/segments it produced, and how the segment solves were answered —
+# merged into LAST_SEARCH_STATS / the search.perf event
+LAST_DECOMPOSE: Dict[str, object] = {}
 
 # production-scale threshold: above this node count the binary
 # sequence_optimize recursion is replaced by the K-WAY chain
@@ -84,6 +102,15 @@ def _relaxed_gc():
         gc.set_threshold(*prev)
 
 
+def _worker_batches() -> int:
+    """Process-lifetime count of match batches dispatched to the
+    opt-in match-worker pool (search/match_workers.py) — 0 when the
+    pool was never armed."""
+    from flexflow_tpu.search import match_workers
+
+    return match_workers.BATCHES.value
+
+
 def _load_xfers(config: FFConfig, num_devices: int) -> list:
     xfers = list(generate_all_pcg_xfers(num_devices))
     if config.substitution_json:
@@ -111,6 +138,13 @@ class _UnityOptimizer:
         # structural key -> (orig segment nodes/groups, optimized graph,
         # cost, strategy, fixed guid->view at store time)
         self.cache: Dict[Tuple, Tuple] = {}
+        # sp-row serve memos: (row key, canonical served strategy) ->
+        # lint verdict / ambiguous re-price (the SHD1xx lint and the
+        # simulated cost are guid-renaming-invariant, so serves whose
+        # remap lands on the same canonical form share them — same
+        # discipline as the segment-cache stamp-lint memo)
+        self._sp_lint_ok: Dict[Tuple, bool] = {}
+        self._sp_cost_memo: Dict[Tuple, float] = {}
         self._edge_scores: Optional[Dict[Tuple[int, int], int]] = None
         # joint co-search depth gate: the exposed-comm joint currency is
         # only meaningful for WHOLE-graph candidates — a segment priced
@@ -133,9 +167,15 @@ class _UnityOptimizer:
         levels reuse the scores (segment guids are preserved by
         split_at_node, so edge keys stay valid)."""
         if self._edge_scores is None:
+            from flexflow_tpu.search import match_workers
+
             scores: Dict[Tuple[int, int], int] = {}
-            for xf in self.xfers:
-                for m in xf.find_matches(graph):
+            pooled = match_workers.find_all_matches(
+                self.xfers, graph, self.config, self.helper.num_devices)
+            for xi, xf in enumerate(self.xfers):
+                ms = pooled[xi] if pooled is not None \
+                    else xf.find_matches(graph)
+                for m in ms:
                     guids = set(m.values()) if isinstance(m, dict) else {m.guid}
                     for g in guids:
                         for e in graph.in_edges.get(g, []):
@@ -189,13 +229,29 @@ class _UnityOptimizer:
             cost,
             dict(strategy),
             {g: v for g, v in fixed.items() if g in graph.nodes},
+            # stamp-lint memo: {lint class -> verdict}, filled on the
+            # first remapped serve of each class.  The SHD1xx lint is
+            # guid-renaming-invariant, so serves sharing a lint class
+            # share the verdict (the 10k-node sweep paid ~10k redundant
+            # lints without this).  For entries whose hash groups are
+            # all singletons the remap pairing is unique — one class;
+            # AMBIGUOUS entries key the class by the served strategy's
+            # canonical form, since a different pairing is a different
+            # strategy and may lint differently (review finding)
+            {},
+            # ambiguity flag: True when any structural-hash group has
+            # >1 member, i.e. a remapped serve would RE-PRICE (the
+            # honest-cost rule).  Singleton-group entries serve their
+            # stored cost to cost-only queries (_cache_cost) without
+            # paying the remap — the dp-memo precedent
+            len(set(graph.node_hashes().values())) != graph.num_nodes,
         )
 
     def _cache_load(self, key, graph, fixed):
         hit = self.cache.get(key)
         if hit is None:
             return None
-        s_nh, s_guids, g_opt, cost, strategy, s_fixed = hit
+        s_nh, s_guids, g_opt, cost, strategy, s_fixed, lint_memo, amb = hit
         if s_guids == sorted(graph.nodes):
             return g_opt, cost, dict(strategy)
         # isomorphic segment with different guids: pair nodes by
@@ -240,16 +296,26 @@ class _UnityOptimizer:
         # isomorphic sibling (repeated transformer layers).  Stamped
         # strategies must still prove legal — the always-on SHD1xx gate
         # the fresh path passes; a lint failure costs one re-search of
-        # this segment, never an illegal serve
-        from flexflow_tpu.analysis import errors_only, lint_strategy
+        # this segment, never an illegal serve.  The verdict is linted
+        # once per LINT CLASS and memoized (see _cache_store): the lint
+        # is guid-renaming-invariant, so serves whose remap lands on
+        # the same canonical strategy share it
+        lkey = canonicalize_strategy(g2, strat2) if amb else True
+        verdict = lint_memo.get(lkey)
+        if verdict is None:
+            from flexflow_tpu.analysis import errors_only, lint_strategy
 
-        if errors_only(lint_strategy(g2, strat2, self.helper.num_devices)):
+            verdict = not errors_only(
+                lint_strategy(g2, strat2, self.helper.num_devices))
+            lint_memo[lkey] = verdict
+        if not verdict:
             return None
         self.helper.segments_stamped += 1
         _SEG_STAMPS.inc()
         return g2, cost, strat2
 
-    # -- k-way chain decomposition (production-scale graphs) ---------------
+    # -- k-way chain decomposition (PR 7; retained as the width-1
+    # regression ORACLE for the series-parallel path below) ----------------
     def chain_optimize(
         self, graph: Graph, fixed: Strategy
     ) -> Optional[Tuple[Graph, float, Strategy]]:
@@ -265,7 +331,16 @@ class _UnityOptimizer:
         x view (O(n^2) at this scale: the 455-node GPT took 600+
         deadline-truncated seconds); this is O(classes x views^2)
         segment solves + O(n).  Returns None when the graph has no
-        usable chain structure (caller falls back)."""
+        usable chain structure (caller falls back).
+
+        NOTE: the production path is now ``sp_optimize`` — the
+        series-parallel generalization whose bottleneck-rule cuts
+        (decompose.chain_cuts) reproduce this function's cuts exactly,
+        so chain-shaped graphs route through it as the width-1
+        degenerate case.  This function is KEPT, un-rewired, as the
+        bit-identity regression oracle (tests/test_decompose.py
+        asserts sp_optimize == chain_optimize on chain-shaped graphs:
+        digests, per-node views, exact sim-cost floats)."""
         bottlenecks = [b for b in graph.bottlenecks()
                        if b.guid not in fixed]
         if len(bottlenecks) < 8:
@@ -359,6 +434,301 @@ class _UnityOptimizer:
             BUS.emit("search.chain_done", bound_s=bound, cost_s=c_true)
         return merged_g, c_true, merged_s
 
+    # -- series-parallel decomposition (bounded-width cuts) ----------------
+    def _record_decompose(self, **kw) -> None:
+        d = LAST_DECOMPOSE
+        d["decompose_calls"] = d.get("decompose_calls", 0) + 1
+        if "decompose_mode" not in d and "mode" in kw:
+            d["decompose_mode"] = kw["mode"]
+        if kw.get("mode") == "fallback":
+            d["decompose_fallbacks"] = d.get("decompose_fallbacks", 0) + 1
+        d["decompose_cuts"] = d.get("decompose_cuts", 0) + kw.get("cuts", 0)
+        d["sp_segments"] = d.get("sp_segments", 0) + kw.get("segments", 0)
+        if kw.get("max_width"):
+            d["decompose_max_width"] = max(
+                d.get("decompose_max_width", 0), kw["max_width"])
+        if BUS.enabled:
+            BUS.emit("search.decompose", **kw)
+
+    def sp_optimize(
+        self, graph: Graph, fixed: Strategy
+    ) -> Optional[Tuple[Graph, float, Strategy]]:
+        """Series-parallel sequence optimization — ``chain_optimize``
+        generalized to bounded-width frontier cuts (search/decompose.py)
+        so graphs with NO bottleneck chain (multi-branch MoE trunks,
+        persistent-skip stacks, disaggregated placement graphs) still
+        decompose instead of degenerating to the binary recursion's
+        whole-graph brute force.  Cut selection tries PR 7's bottleneck
+        rule FIRST (mode "chain": width-1 cuts, bit-identical cuts and
+        solves to ``chain_optimize`` — the degenerate case), then
+        bounded-width frontiers (mode "sp"): the DP state becomes a
+        TUPLE of boundary views, one per crossing node, with nodes that
+        persist across consecutive cuts (skip connections) carrying one
+        view through.  Segment solves ride the same memoized
+        ``sequence_optimize`` recursion — the structural segment cache
+        stamps isomorphism classes, and finished solves persist as
+        guid-free sp-memo rows (cost_cache.py sp-row layer) a cold
+        process can serve.  Emits ``search.decompose`` naming the
+        chosen decomposition — or the fallback reason, so a silent
+        degradation to binary recursion cannot happen."""
+        threshold = max(4, self.config.base_optimize_threshold)
+        cuts, mode = _decompose.find_series_cuts(graph, fixed, threshold)
+        if cuts is None:
+            self._record_decompose(
+                nodes=graph.num_nodes, mode="fallback", reason=mode)
+            return None
+        segments = _decompose.split_series(graph, cuts)
+        if segments is None:
+            self._record_decompose(
+                nodes=graph.num_nodes, mode="fallback",
+                reason="stale_crossing")
+            return None
+        max_width = max(c.width for c in cuts)
+        self._record_decompose(
+            nodes=graph.num_nodes, mode=mode, cuts=len(cuts),
+            max_width=max_width, segments=len(segments),
+            max_segment=max(s[0].num_nodes for s in segments),
+        )
+
+        views_at = {
+            g: self._boundary_views(graph.nodes[g])
+            for c in cuts for g in c.crossing
+        }
+
+        def pin_views(seg, in_cross, u, out_cross, v):
+            f2 = dict(fixed)
+            if u is not None:
+                for g, vv in zip(in_cross, u):
+                    f2[g] = vv
+            if v is not None:
+                for g, vv in zip(out_cross, v):
+                    f2[g] = vv
+            return f2
+
+        def solve(seg, in_cross, u, out_cross, v):
+            f2 = pin_views(seg, in_cross, u, out_cross, v)
+            served = self._serve_sp_row(seg, f2)
+            if served is not None:
+                return served
+            res = self.sequence_optimize(seg, f2)
+            self._persist_sp_row(seg, f2, res)
+            return res
+
+        def solve_cost(seg, in_cross, u, out_cross, v):
+            """The DP enumeration needs only the segment COST — for
+            unambiguous cached entries the stored cost IS the served
+            cost (no re-price), so skip the remap/strategy
+            materialization the merge replay will pay exactly once.
+            In chain mode ambiguous/cold entries take the full solve,
+            so every float the DP compares is identical to the PR 7
+            path's (the bit-identity gate); in sp mode the stored cost
+            also serves AMBIGUOUS entries — the DP total is a ranking
+            bound either way (segment sums double-count the crossing
+            nodes), and the merge replay still materializes, lints,
+            and honestly re-simulates the composed winner."""
+            f2 = pin_views(seg, in_cross, u, out_cross, v)
+            key = (seg.hash(), canon_fixed_views(seg, f2))
+            hit = self.cache.get(key)
+            if hit is not None and (
+                    mode != "chain" or not hit[7]
+                    or hit[1] == sorted(seg.nodes)):
+                return hit[3]
+            return solve(seg, in_cross, u, out_cross, v)[1]
+
+        # chain DP over boundary-view tuples: state = the out-cut's
+        # view tuple (None at the chain ends).  Per-segment costs
+        # double-count the shared crossing nodes and ignore
+        # cross-segment overlap — the same pruning-bound currency the
+        # chain path sums; the merged graph's one simulation at the
+        # end is the honest cost.
+        prev: Dict[object, Tuple[float, tuple]] = {None: (0.0, ())}
+        for seg, in_cross, out_cross in segments:
+            in_states = list(prev)
+            if self._expired():
+                in_states = in_states[:1]
+            cur: Dict[object, Tuple[float, tuple]] = {}
+            for u in in_states:
+                c_in, path = prev[u]
+                carry = dict(zip(in_cross, u)) if u is not None else None
+                if out_cross:
+                    v_states = _decompose.boundary_tuples(
+                        views_at, out_cross, carry=carry)
+                    if self._expired():
+                        v_states = v_states[:1]
+                else:
+                    v_states = [None]
+                for v in v_states:
+                    got = cur.get(v)
+                    if got is not None and c_in >= got[0]:
+                        continue  # even a free segment cannot win
+                    c_seg = solve_cost(seg, in_cross, u, out_cross, v)
+                    total = c_in + c_seg
+                    if (got is None or total < got[0]) and math.isfinite(
+                            total):
+                        cur[v] = (total, path + (u,))
+            if not cur:
+                self._record_decompose(
+                    nodes=graph.num_nodes, mode="fallback",
+                    reason="infeasible_lane")
+                return None  # no feasible lane: binary recursion
+            if len(cur) > _decompose.MAX_CUT_TUPLES:
+                # beam: carried cut members multiply the state count
+                # (each tower tail that persists across cuts keeps its
+                # own view lanes) — keep the cheapest states.  Chain
+                # cuts share no members, so chain-mode states never
+                # exceed the per-node view count and the bit-identity
+                # gate is untouched.  Stable sort: ties keep insertion
+                # order, so the pruning is deterministic.
+                keep = sorted(cur.items(), key=lambda kv: kv[1][0])
+                cur = dict(keep[:_decompose.MAX_CUT_TUPLES])
+            prev = cur
+        if None not in prev:
+            self._record_decompose(
+                nodes=graph.num_nodes, mode="fallback",
+                reason="infeasible_lane")
+            return None
+        bound, path = prev[None]
+        pins = path[1:] + (None,)
+
+        merged_g, merged_s = None, {}
+        for (seg, in_cross, out_cross), v in zip(segments, pins):
+            u = (
+                tuple(merged_s[g] for g in in_cross)
+                if in_cross else None
+            )
+            g_i, _, s_i = solve(seg, in_cross, u, out_cross, v)
+            if merged_g is None:
+                # the accumulator must be owned: g_i may be a cached
+                # segment object the in-place merges below would corrupt
+                merged_g, merged_s = g_i.copy(), dict(s_i)
+            else:
+                _decompose.merge_segment_into(
+                    merged_g, merged_s, g_i, s_i, set(in_cross))
+            if v is not None:
+                for g, vv in zip(out_cross, v):
+                    merged_s[g] = vv
+        c_true = self.helper._price(merged_g, merged_s)
+        if BUS.enabled:
+            BUS.emit("search.decompose_done", mode=mode, bound_s=bound,
+                     cost_s=c_true, segments=len(segments))
+        return merged_g, c_true, merged_s
+
+    # -- persistent sp-segment memo rows (cost_cache.py sp-row layer) ------
+    def _sp_row_key(self, seg: Graph, f2: Strategy) -> str:
+        """Guid-free persistent key for one SP segment solve: stable
+        segment digest + stable pinned boundary views + every knob that
+        changes the solve's answer beyond the cache's cost-surface
+        signature (the segment solve runs the FULL unity recursion —
+        substitutions included — so the rewrite-registry knobs join
+        the DP-shape knobs)."""
+        from hashlib import blake2b
+
+        from flexflow_tpu.search.cost_cache import stable_graph_digest
+
+        sub_digest = getattr(self, "_sub_digest", False)
+        if sub_digest is False:
+            sub_digest = None
+            if self.config.substitution_json:
+                import hashlib
+
+                try:
+                    with open(self.config.substitution_json, "rb") as f:
+                        sub_digest = hashlib.sha256(
+                            f.read()).hexdigest()[:12]
+                except OSError:
+                    sub_digest = "unreadable"
+            self._sub_digest = sub_digest
+        snh = seg.stable_node_digests()
+        pins = tuple(sorted(
+            (snh[g], tuple(v.dim_degrees), int(v.replica_degree),
+             int(v.start_part))
+            for g, v in f2.items() if g in seg.nodes
+        ))
+        knobs = (
+            self.config.search_budget, self.config.search_alpha,
+            self.config.base_optimize_threshold,
+            self.helper.num_devices, sub_digest,
+        )
+        if self.helper.joint is not None:
+            # joint-currency rows live under their own key family —
+            # same extension-only discipline as the dp-row layer
+            knobs = knobs + ("co_search",)
+        tail = blake2b(repr((pins, knobs)).encode(),
+                       digest_size=10).hexdigest()
+        return stable_graph_digest(seg) + ":" + tail
+
+    def _serve_sp_row(self, seg: Graph, f2: Strategy):
+        """(graph, cost, strategy) from a persisted sp-segment memo row
+        remapped onto this segment's guids, or None.  Same serving
+        discipline as the persistent DP memo: rows LOADED from disk
+        only (the in-process segment cache covers this run's own
+        writes, so a cold cache stays inert and the chain bit-identity
+        gate holds), the shared ``_pair_views`` pairing rule over
+        stable digests, ambiguous pairings re-simulated for an honest
+        cost, and the stamped strategy re-linted SHD1xx — a corrupt
+        row costs one re-solve, never a wrong serve."""
+        cc = self.helper.sim.cost_cache
+        if (cc is None or not getattr(cc, "sp_loaded", False) or cc.stale
+                or seg.num_nodes < DP_PERSIST_MIN_NODES):
+            return None
+        key = self._sp_row_key(seg, f2)
+        row = cc.get_sp_row(key)
+        if row is None:
+            return None
+        decoded = decode_strategy_rows(row)
+        if decoded is None:
+            return None
+        cost, canon = decoded
+        strategy, ambiguous = _pair_views(
+            seg, seg.stable_node_digests(), canon, f2)
+        if strategy is None or len(strategy) != seg.num_nodes:
+            return None
+        # lint + ambiguous re-price memoized per (row, canonical served
+        # strategy): a remap landing on the same canonical form is the
+        # same strategy up to isomorphism, so verdict and simulated
+        # float are shared; a DIFFERENT pairing is a different class
+        # and pays its own lint/price (review finding: the verdict is
+        # exactly as pairing-dependent as the cost)
+        mkey = (key, canonicalize_strategy(seg, strategy)) if ambiguous \
+            else (key, True)
+        if ambiguous:
+            # interior currency: segment solves rank in the scalar
+            # simulation (the driver's depth gate), so the honest
+            # re-price for an ambiguous pairing is the scalar sim too
+            got = self._sp_cost_memo.get(mkey)
+            if got is None:
+                got = self.helper.sim.simulate(seg, strategy)
+                self._sp_cost_memo[mkey] = got
+            cost = got
+        if mkey not in self._sp_lint_ok:
+            from flexflow_tpu.analysis import errors_only, lint_strategy
+
+            self._sp_lint_ok[mkey] = not errors_only(
+                lint_strategy(seg, strategy, self.helper.num_devices))
+        if not self._sp_lint_ok[mkey]:
+            return None
+        self.helper.sp_rows_served += 1
+        _SP_ROWS_SERVED.inc()
+        return seg, cost, strategy
+
+    def _persist_sp_row(self, seg: Graph, f2: Strategy, res) -> None:
+        """Persist a finished segment solve as a guid-free sp-memo row.
+        Only UN-REWRITTEN solves persist into the JSON layer (a
+        rewritten segment graph cannot be expressed as digest-keyed
+        strategy rows on the original segment; it still rides the
+        in-process segment cache and the whole-result pickle layer)."""
+        g_opt, cost, strategy = res
+        cc = self.helper.sim.cost_cache
+        if (cc is None or cc.stale or not math.isfinite(cost)
+                or seg.num_nodes < DP_PERSIST_MIN_NODES or not strategy):
+            return
+        if sorted(g_opt.nodes) != sorted(seg.nodes):
+            return  # rewritten: structure moved off the segment digest
+        rows = encode_strategy_rows(seg, strategy)
+        if rows is None:
+            return
+        cc.put_sp_row(self._sp_row_key(seg, f2), float(cost), rows)
+
     # -- recursive sequence optimization (reference: :2190-2370) -----------
     def sequence_optimize(
         self, graph: Graph, fixed: Strategy
@@ -382,10 +752,10 @@ class _UnityOptimizer:
         if hit is not None:
             return hit
         if graph.num_nodes > CHAIN_MIN_NODES:
-            chained = self.chain_optimize(graph, fixed)
-            if chained is not None:
-                self._cache_store(key, graph, fixed, chained)
-                return chained
+            decomposed = self.sp_optimize(graph, fixed)
+            if decomposed is not None:
+                self._cache_store(key, graph, fixed, decomposed)
+                return decomposed
         bn = self.find_split_node(graph)
         if bn is None or bn.guid in fixed:
             result = self.base_optimize(graph, fixed)
@@ -494,9 +864,22 @@ class _UnityOptimizer:
             parent_matches = getattr(g, "_parent_match_guids", None)
             matches_by_xfer: List[list] = []
             match_payload: Dict[int, List[int]] = {}
+            pooled = None
+            if parent_matches is None:
+                # parent-less pops pay a full per-xfer sweep — the
+                # opt-in match-worker pool fans it out across processes
+                # (serial path when FLEXFLOW_TPU_MATCH_WORKERS is off)
+                from flexflow_tpu.search import match_workers
+
+                pooled = match_workers.find_all_matches(
+                    self.xfers, g, self.config, self.helper.num_devices)
             for xi, xf in enumerate(self.xfers):
                 delta_fn = getattr(xf, "find_matches_delta", None)
-                if delta_fn is not None:
+                if pooled is not None:
+                    ms = pooled[xi]
+                    if delta_fn is not None:
+                        match_payload[xi] = [n.guid for n in ms]
+                elif delta_fn is not None:
                     ms = delta_fn(
                         g,
                         parent_matches.get(xi) if parent_matches else None)
@@ -847,6 +1230,7 @@ def _optimize_strategy(
     # that raises part-way must not leave the PREVIOUS run's stats
     # (e.g. a stale result_cache_hit) for that consumer to misread
     LAST_SEARCH_STATS.clear()
+    LAST_DECOMPOSE.clear()
     # snapshot the delta-matching counters so search.perf reports THIS
     # search's rescan shrink, not the process-lifetime aggregate
     from flexflow_tpu.search import substitution as _subst
@@ -854,7 +1238,8 @@ def _optimize_strategy(
     match_base = (
         _subst._SCANS.value, _subst._DELTA_SCANS.value,
         _subst._DELTA_NODES.value, _subst._DELTA_SKIPPED.value,
-        _subst._INDEX_SKIPS.value,
+        _subst._INDEX_SKIPS.value, _subst._VEC_SKIPS.value,
+        _worker_batches(),
     )
     t_cal = 0.0  # seconds spent probing/persisting calibration — split
     # out of the reported search time (bench satellite: the two were
@@ -1352,6 +1737,16 @@ def _emit_search_done(
         "dp_rows_served": helper.dp_rows_served,
         "dp_memo_hits": helper.memo_hits,
         "dp_memo_misses": helper.memo_misses,
+        # series-parallel decomposition (ROADMAP item 4): which
+        # decomposition each oversized (sub)graph took, the bounded-
+        # width cut counts, and the sp-memo-row serves — the counters
+        # the --sp-scale sweep and ffobs report
+        "sp_rows_served": helper.sp_rows_served,
+        "match_vec_skips": _subst._VEC_SKIPS.value - (
+            match_base[5] if len(match_base) > 5 else 0),
+        "match_worker_batches": _worker_batches() - (
+            match_base[6] if len(match_base) > 6 else 0),
+        **LAST_DECOMPOSE,
     }
     if helper.joint is not None:
         # joint strategy x comm-plan co-search: how often the candidate

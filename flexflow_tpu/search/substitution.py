@@ -52,6 +52,17 @@ _DELTA_SKIPPED = METRICS.counter("substitution.delta_match_nodes_skipped")
 # the dominant per-pop cost, and most of it was matchers returning
 # False on the very first op-type check
 _INDEX_SKIPS = METRICS.counter("substitution.match_index_skips")
+# vectorized matcher core (ROADMAP item 4): anchor-typed candidates
+# additionally pruned by numpy predicate columns (divisibility,
+# predecessor/successor op-type guards) BEFORE the python matcher runs
+# — the matcher confirms survivors, so the filter only has to be a
+# sound superset, and the FLEXFLOW_TPU_DELTA_CHECK full-scan oracle
+# proves it per xfer
+_VEC_SKIPS = METRICS.counter("substitution.match_vec_skips")
+
+# below this candidate count the numpy mask costs more than the
+# matcher calls it saves — zoo-scale graphs keep the exact PR 7 path
+VEC_MIN_CANDS = 16
 
 # how many undirected hops around the changed-guid seed sets a rescan
 # covers.  Every built-in matcher reads only its node's edge lists plus
@@ -90,6 +101,98 @@ def _op_type_index(graph: Graph):
         pos[n.guid] = i
     graph._op_type_index = (topo, idx, pos)
     return idx, pos
+
+
+def _match_columns(graph: Graph):
+    """Per-node numpy predicate columns over the topo order — the
+    vectorized matcher core's shared input.  One O(nodes + edges)
+    python sweep, cached on the graph instance keyed by the identity of
+    its ``topo_order()`` list (the ``_op_type_index`` discipline: any
+    structural change invalidates the topo cache, so a fresh topo list
+    means fresh columns), then every anchor-typed xfer's
+    ``vec_filter`` is pure numpy over row slices.  Columns cover the
+    cheap checks every factory matcher leads with: output-dim sizes
+    (divisibility), in/out edge counts, distinct-successor counts, and
+    the predecessor/successor op-type guards."""
+    topo = graph.topo_order()
+    cached = getattr(graph, "_match_cols", None)
+    if cached is not None and cached[0] is topo:
+        return cached[1]
+    import numpy as np
+
+    n = len(topo)
+    max_nd = 1
+    for node in topo:
+        nd = len(node.op.output_shapes[0].sizes)
+        if nd > max_nd:
+            max_nd = nd
+    ndim = np.zeros(n, dtype=np.int64)
+    sizes = np.zeros((n, max_nd), dtype=np.int64)
+    n_in = np.zeros(n, dtype=np.int64)
+    n_out = np.zeros(n, dtype=np.int64)
+    n_succ = np.zeros(n, dtype=np.int64)
+    max_replica = np.zeros(n, dtype=np.int64)
+    pred_has_repartition = np.zeros(n, dtype=bool)
+    pred_has_replicate = np.zeros(n, dtype=bool)
+    pred_all_combine = np.zeros(n, dtype=bool)
+    succ_all_parallel = np.zeros(n, dtype=bool)
+    succ_all_repartition = np.zeros(n, dtype=bool)
+    succ_has_combine = np.zeros(n, dtype=bool)
+    succ_has_act = np.zeros(n, dtype=bool)
+    act_is_none = np.zeros(n, dtype=bool)
+    in_edges, out_edges, nodes = graph.in_edges, graph.out_edges, graph.nodes
+    T = OperatorType
+    for i, node in enumerate(topo):
+        op = node.op
+        sz = op.output_shapes[0].sizes
+        ndim[i] = len(sz)
+        sizes[i, :len(sz)] = sz
+        g = node.guid
+        ie, oe = in_edges[g], out_edges[g]
+        n_in[i] = len(ie)
+        n_out[i] = len(oe)
+        max_replica[i] = op.max_replica_degree()
+        act_is_none[i] = getattr(op, "attrs", {}).get("activation") is None
+        all_comb = bool(ie)
+        for e in ie:
+            pt = nodes[e.src].op.op_type
+            if pt is T.REPARTITION:
+                pred_has_repartition[i] = True
+            elif pt is T.REPLICATE:
+                pred_has_replicate[i] = True
+            if pt is not T.COMBINE:
+                all_comb = False
+        pred_all_combine[i] = all_comb
+        all_par = all_rep = bool(oe)
+        succs = set()
+        for e in oe:
+            succs.add(e.dst)
+            st = nodes[e.dst].op.op_type
+            if st is T.COMBINE:
+                succ_has_combine[i] = True
+            if st in _FUSABLE_ACTS:
+                succ_has_act[i] = True
+            if not st.is_parallel_op():
+                all_par = False
+            if st is not T.REPARTITION:
+                all_rep = False
+        n_succ[i] = len(succs)
+        succ_all_parallel[i] = all_par
+        succ_all_repartition[i] = all_rep
+    cols = {
+        "ndim": ndim, "sizes": sizes, "n_in": n_in, "n_out": n_out,
+        "n_succ": n_succ, "max_replica": max_replica,
+        "pred_has_repartition": pred_has_repartition,
+        "pred_has_replicate": pred_has_replicate,
+        "pred_all_combine": pred_all_combine,
+        "succ_all_parallel": succ_all_parallel,
+        "succ_all_repartition": succ_all_repartition,
+        "succ_has_combine": succ_has_combine,
+        "succ_has_act": succ_has_act,
+        "act_is_none": act_is_none,
+    }
+    graph._match_cols = (topo, cols)
+    return cols
 
 
 def _mark(g: Graph, ins=(), outs=()) -> None:
@@ -153,6 +256,27 @@ class GraphXfer:
     matcher: Callable[[Graph, Node], bool]
     apply_fn: Callable[[Graph, Node], Optional[Graph]]
     anchor_types: Optional[frozenset] = None
+    # vectorized candidate filter: ``vec_filter(cols, rows) -> bool
+    # mask`` over ``_match_columns`` row indices.  A SOUND SUPERSET of
+    # the matcher (never drops a true match — the matcher still
+    # confirms every survivor); factories derive it from the same
+    # predicates their matcher leads with, and the DELTA_CHECK oracle
+    # asserts indexed+filtered == full scan.
+    vec_filter: Optional[Callable] = None
+
+    def _vec_prune(self, graph: Graph, cands: List[Match],
+                   pos) -> List[Match]:
+        if self.vec_filter is None or len(cands) < VEC_MIN_CANDS:
+            return cands
+        import numpy as np
+
+        cols = _match_columns(graph)
+        rows = np.fromiter((pos[n.guid] for n in cands),
+                           dtype=np.int64, count=len(cands))
+        mask = self.vec_filter(cols, rows)
+        kept = [n for n, k in zip(cands, mask) if k]
+        _VEC_SKIPS.inc(len(cands) - len(kept))
+        return kept
 
     def find_matches(self, graph: Graph) -> List[Match]:
         _SCANS.inc()
@@ -168,6 +292,7 @@ class GraphXfer:
                 # set needs the merged topo order the full scan yields
                 cands.sort(key=lambda n: pos[n.guid])
             _INDEX_SKIPS.inc(len(pos) - len(cands))
+            cands = self._vec_prune(graph, cands, pos)
             out = [n for n in cands if self.matcher(graph, n)]
             if DELTA_MATCH_CHECK:
                 full = [n for n in graph.topo_order()
@@ -224,6 +349,7 @@ class GraphXfer:
         }
         anchors = self.anchor_types
         idx_skips = 0
+        cands: List[Node] = []
         for g in region:
             # the seed index rule applies inside the dirty region too:
             # a node whose type cannot anchor the pattern never matches
@@ -231,10 +357,14 @@ class GraphXfer:
             if anchors is not None and nodes[g].op.op_type not in anchors:
                 idx_skips += 1
                 continue
-            if self.matcher(graph, nodes[g]):
-                hits.add(g)
+            cands.append(nodes[g])
         if idx_skips:
             _INDEX_SKIPS.inc(idx_skips)
+        # the vectorized predicate filter feeds the delta scan too —
+        # hits is a set re-sorted below, so pruning order is free
+        for n in self._vec_prune(graph, cands, pos):
+            if self.matcher(graph, n):
+                hits.add(n.guid)
         out = [nodes[g] for g in sorted(hits, key=pos.__getitem__)]
         _DELTA_SCANS.inc()
         _DELTA_NODES.inc(len(region))
@@ -434,11 +564,24 @@ def make_partition_combine_xfer(
             copy=False,
         )
 
+    def vec_filter(c, rows):
+        # exactly the matcher's leading predicates, vectorized: dim in
+        # range, divisible size, no Repartition predecessor (the types
+        # this factory anchors on are never parallel ops)
+        if dim >= c["sizes"].shape[1]:
+            return c["ndim"][rows] > dim  # all-False mask, right shape
+        return (
+            (c["ndim"][rows] > dim)
+            & (c["sizes"][rows, dim] % degree == 0)
+            & ~c["pred_has_repartition"][rows]
+        )
+
     return GraphXfer(
         name=f"partition_{op_type.value}_combine_d{degree}_dim{dim}",
         matcher=matcher,
         apply_fn=apply_fn,
         anchor_types=frozenset({op_type}),
+        vec_filter=vec_filter,
     )
 
 
@@ -472,11 +615,18 @@ def make_replicate_reduce_xfer(op_type: OperatorType, degree: int) -> GraphXfer:
             copy=False,
         )
 
+    def vec_filter(c, rows):
+        return (
+            (c["max_replica"][rows] % degree == 0)
+            & ~c["pred_has_replicate"][rows]
+        )
+
     return GraphXfer(
         name=f"replicate_{op_type.value}_reduce_d{degree}",
         matcher=matcher,
         apply_fn=apply_fn,
         anchor_types=frozenset({op_type}),
+        vec_filter=vec_filter,
     )
 
 
@@ -511,6 +661,11 @@ def make_simplify_xfer() -> GraphXfer:
     return GraphXfer(
         name="cancel_repartition_combine", matcher=matcher, apply_fn=apply_fn,
         anchor_types=frozenset({OperatorType.REPARTITION}),
+        # sole successor which is a Combine; the dim equality stays
+        # with the matcher
+        vec_filter=lambda c, rows: (
+            (c["n_succ"][rows] == 1) & c["succ_has_combine"][rows]
+        ),
     )
 
 
@@ -578,6 +733,11 @@ def make_linear_activation_fusion_xfer() -> GraphXfer:
     return GraphXfer(
         name="fuse_linear_activation", matcher=matcher, apply_fn=apply_fn,
         anchor_types=frozenset({OperatorType.LINEAR}),
+        vec_filter=lambda c, rows: (
+            c["act_is_none"][rows]
+            & (c["n_succ"][rows] == 1) & (c["n_out"][rows] == 1)
+            & c["succ_has_act"][rows]
+        ),
     )
 
 
@@ -615,6 +775,10 @@ def make_parallel_chain_fusion_xfer() -> GraphXfer:
     return GraphXfer(
         name="fuse_parallel_op_chain", matcher=matcher, apply_fn=apply_fn,
         anchor_types=frozenset(_SPLICEABLE),
+        vec_filter=lambda c, rows: (
+            (c["n_out"][rows] > 0) & (c["n_in"][rows] > 0)
+            & c["succ_all_parallel"][rows]
+        ),
     )
 
 
@@ -665,6 +829,9 @@ def make_combine_concat_sink_xfer() -> GraphXfer:
     return GraphXfer(
         name="sink_combine_through_concat", matcher=matcher, apply_fn=apply_fn,
         anchor_types=frozenset({OperatorType.CONCAT}),
+        vec_filter=lambda c, rows: (
+            (c["n_in"][rows] >= 2) & c["pred_all_combine"][rows]
+        ),
     )
 
 
@@ -727,6 +894,10 @@ def make_unary_hoist_partition_xfer() -> GraphXfer:
     return GraphXfer(
         name="hoist_partition_above_unary", matcher=matcher, apply_fn=apply_fn,
         anchor_types=frozenset(_HOISTABLE_UNARY),
+        vec_filter=lambda c, rows: (
+            (c["n_out"][rows] >= 2) & c["succ_all_repartition"][rows]
+            & ~c["pred_has_repartition"][rows]
+        ),
     )
 
 

@@ -706,11 +706,12 @@ def test_fflint_cache_dp_row_layer(tmp_path, capsys):
     """CCH405/406: the persisted DP-memo-row layer must lint — a
     well-formed layer passes, an unknown dp_schema is the DISTINCT
     loud-refusal code (CCH405), malformed rows are CCH406."""
+    from flexflow_tpu.search.cost_cache import DP_SCHEMA
     from tools.fflint import main
 
     good = {"schema": 1, "signature": "0123456789abcdef", "calibration_stale": False,
             "rows": [],
-            "dp_schema": 1,
+            "dp_schema": DP_SCHEMA,
             "dp_rows": {"aabb:ccdd": {
                 "cost": 1.5e-3,
                 "strategy": [["0123abcd", [1, 8], 1, 0]]}}}

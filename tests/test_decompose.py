@@ -1,0 +1,465 @@
+"""Series-parallel decomposition + off-critical-path matching (PR 12).
+
+The gates ROADMAP item 4 names: chain-shaped graphs route through the
+generalized SP path as the width-1 degenerate case BIT-IDENTICALLY to
+the retained PR 7 chain oracle (digests + per-node views + exact
+sim-cost floats); bottleneck-free graphs decompose instead of
+degenerating to binary recursion, with the decision observable on the
+``search.decompose`` event; stamped segment solves stay SHD1xx-linted;
+finished segment solves persist as guid-free sp-memo rows a cold
+process serves (and an unknown sp_schema drops the layer LOUDLY); the
+vectorized matcher filters and the opt-in match-worker pool are
+serial-identical.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.compiler.lowering import data_parallel_strategy
+from flexflow_tpu.models import build_gpt, build_moe_trunk, build_multibranch
+from flexflow_tpu.search import decompose
+from flexflow_tpu.search.driver import (
+    CHAIN_MIN_NODES,
+    LAST_SEARCH_STATS,
+    _load_xfers,
+    _UnityOptimizer,
+    optimize_strategy,
+)
+from flexflow_tpu.search.dp import SearchHelper
+from flexflow_tpu.search.simulator import Simulator
+
+
+def _gpt_chain(cfg):
+    return build_gpt(cfg, vocab=4000, num_layers=40, hidden=256,
+                     num_heads=4, ff_dim=512, seq_len=64)
+
+
+# ---------------------------------------------------------------------------
+# decompose.py units
+
+
+def test_frontier_widths_matches_bruteforce():
+    """frontier_widths' incremental sweep == the O(n^2) definition on a
+    branchy graph (diamond + skip)."""
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    x = m.create_tensor([16, 8])
+    a = m.dense(x, 8, name="a")
+    b = m.dense(x, 8, name="b")
+    c = m.add(a, b, name="c")
+    d = m.add(c, x, name="d")  # skip keeps x live across the graph
+    m.dense(d, 4, name="head")
+    g = m.graph
+    topo, widths = decompose.frontier_widths(g)
+    pos = {n.guid: i for i, n in enumerate(topo)}
+    for i in range(len(topo)):
+        prefix = {n.guid for n in topo[: i + 1]}
+        expect = len({
+            e.src for guid in prefix for e in g.out_edges[guid]
+            if e.dst not in prefix
+        })
+        assert widths[i] == expect, (i, widths[i], expect)
+
+
+def test_chain_cuts_reproduce_bottleneck_rule():
+    """On a chain-shaped graph the cut selector returns mode='chain'
+    with width-1 cuts at exactly the PR 7 bottleneck spacing."""
+    cfg = ff.FFConfig(batch_size=8, num_devices=8)
+    g = _gpt_chain(cfg).graph
+    cuts, mode = decompose.find_series_cuts(g, {}, 10)
+    assert mode == "chain"
+    assert all(c.width == 1 for c in cuts)
+    # reproduce chain_optimize's own selection
+    order = {n.guid: i for i, n in enumerate(g.topo_order())}
+    expect, last = [], 0
+    for bn in g.bottlenecks():
+        at = order[bn.guid]
+        if at - last >= 10 and at < len(order) - 1:
+            expect.append(bn.guid)
+            last = at
+    assert [c.crossing[0] for c in cuts] == expect
+
+
+def test_split_series_covers_graph_exactly_once():
+    cfg = ff.FFConfig(batch_size=8, num_devices=8)
+    g = build_moe_trunk(cfg, num_blocks=12).graph
+    cuts, mode = decompose.find_series_cuts(g, {}, 8)
+    assert cuts is not None
+    segments = decompose.split_series(g, cuts)
+    assert segments is not None
+    interior_seen = set()
+    for seg, in_cross, out_cross in segments:
+        interior = set(seg.nodes) - set(in_cross)
+        assert not (interior & interior_seen)
+        interior_seen |= interior
+        # every in-crossing node is a source inside the segment
+        for gd in in_cross:
+            assert not seg.in_edges[gd]
+    assert interior_seen == set(g.nodes)
+
+
+def test_boundary_tuples_carry_pins_shared_nodes():
+    views = {1: ["a", "b"], 2: ["c", "d"]}
+    out = decompose.boundary_tuples(views, (1, 2), carry={1: "b"})
+    assert out == [("b", "c"), ("b", "d")]
+    # width-1, no carry: degenerates to the per-node view list
+    assert decompose.boundary_tuples(views, (1,)) == [("a",), ("b",)]
+
+
+# ---------------------------------------------------------------------------
+# the chain bit-identity regression gate (width-1 degenerate case)
+
+
+def test_sp_path_bit_identical_to_chain_oracle():
+    """sp_optimize on a chain-shaped production graph == the retained
+    PR 7 chain_optimize oracle: same rewritten-graph digest, same
+    per-node views, same exact sim-cost float.  Separate optimizers so
+    neither serves the other's segment cache."""
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file="")
+    g = _gpt_chain(cfg).graph
+    assert g.num_nodes > CHAIN_MIN_NODES
+    xfers = _load_xfers(cfg, 8)
+
+    def run(fn):
+        helper = SearchHelper(Simulator(cfg.machine_spec, num_devices=8), 8)
+        opt = _UnityOptimizer(helper, cfg, xfers)
+        return getattr(opt, fn)(g, {})
+
+    ga, ca, sa = run("sp_optimize")
+    gb, cb, sb = run("chain_optimize")
+    assert ca == cb  # exact float, not approx
+    assert ga.hash() == gb.hash()
+    assert sorted((k, repr(v)) for k, v in sa.items()) == \
+        sorted((k, repr(v)) for k, v in sb.items())
+
+
+def test_chain_shaped_graph_routes_through_sp_as_chain_mode():
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file="")
+    g = _gpt_chain(cfg).graph
+    optimize_strategy(g, cfg, return_graph=True)
+    assert LAST_SEARCH_STATS.get("decompose_mode") == "chain"
+    assert LAST_SEARCH_STATS.get("decompose_max_width") == 1
+    assert LAST_SEARCH_STATS.get("segments_stamped", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# bottleneck-free graphs decompose (the pre-PR silent degradation)
+
+
+def test_sp_decomposes_bottleneck_free_trunk():
+    """A persistent-skip MoE trunk past CHAIN_MIN_NODES has (near-)no
+    bottleneck chain; pre-PR it fell into the binary recursion's
+    whole-graph brute force.  It must now decompose via bounded-width
+    frontier cuts, stamp isomorphic segments, finish fast, beat pure
+    DP, and pass the strategy lint."""
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file="")
+    m = build_moe_trunk(cfg, num_blocks=30)
+    g = m.graph
+    assert g.num_nodes > CHAIN_MIN_NODES
+    assert len(g.bottlenecks()) < 8  # no usable chain
+    t0 = time.monotonic()
+    bg, strategy = optimize_strategy(g, cfg, return_graph=True)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, f"sp search took {elapsed:.1f}s"
+    assert LAST_SEARCH_STATS.get("decompose_mode") == "sp"
+    assert LAST_SEARCH_STATS.get("decompose_cuts", 0) >= 2
+    assert LAST_SEARCH_STATS.get("segments_stamped", 0) > 0
+    sim = Simulator(cfg.machine_spec, num_devices=8)
+    c_se = sim.simulate(bg, strategy)
+    c_dp = sim.simulate(g, data_parallel_strategy(g, 8))
+    assert c_se <= c_dp * 1.001, (c_se, c_dp)
+    from flexflow_tpu.analysis import errors_only, lint_strategy
+
+    assert errors_only(lint_strategy(bg, strategy, 8)) == []
+
+
+def test_sp_decomposes_multibranch_with_wide_cuts():
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file="")
+    m = build_multibranch(cfg, num_branches=3, depth=90)
+    g = m.graph
+    assert g.num_nodes > CHAIN_MIN_NODES
+    bg, strategy = optimize_strategy(g, cfg, return_graph=True)
+    assert LAST_SEARCH_STATS.get("decompose_mode") == "sp"
+    assert LAST_SEARCH_STATS.get("decompose_max_width", 0) >= 2
+    assert len(strategy) == bg.num_nodes
+
+
+def test_decompose_event_emitted_and_valid(tmp_path):
+    """The search.decompose obs event names the chosen decomposition
+    (satellite: the silent binary-recursion degradation is now an
+    observable decision) and validates against the registered schema."""
+    from flexflow_tpu.obs.events import BUS, validate_event
+
+    log = tmp_path / "obs.jsonl"
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file="")
+    g = build_moe_trunk(cfg, num_blocks=22).graph
+    BUS.configure(str(log))
+    try:
+        optimize_strategy(g, cfg, return_graph=True)
+    finally:
+        BUS.flush()
+        BUS.close()
+    events = [json.loads(ln) for ln in log.read_text().splitlines()]
+    for e in events:
+        assert validate_event(e) == [], e
+    decos = [e for e in events if e["kind"] == "search.decompose"]
+    assert decos and decos[0]["mode"] == "sp"
+    assert decos[0]["cuts"] >= 2
+    dones = [e for e in events if e["kind"] == "search.decompose_done"]
+    assert dones and np.isfinite(dones[-1]["cost_s"])
+
+
+# ---------------------------------------------------------------------------
+# stamped solves stay lint-gated
+
+
+def test_stamp_serve_rejected_when_lint_fails(monkeypatch):
+    """A stamped (remapped) segment serve that fails the SHD1xx lint
+    must be DROPPED (costs one re-search, never an illegal serve) —
+    and the lint-memo must remember the verdict per entry."""
+    import flexflow_tpu.analysis as analysis
+    from flexflow_tpu.analysis.findings import Finding
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file="")
+    g = _gpt_chain(cfg).graph
+    xfers = _load_xfers(cfg, 8)
+    helper = SearchHelper(Simulator(cfg.machine_spec, num_devices=8), 8)
+    opt = _UnityOptimizer(helper, cfg, xfers)
+
+    bad = Finding(code="SHD199", pass_name="sharding",
+                  message="forced failure", severity="error")
+    real_lint = analysis.lint_strategy
+    calls = {"n": 0}
+
+    def failing_lint(graph, strategy, n, **kw):
+        calls["n"] += 1
+        return [bad]
+
+    monkeypatch.setattr(analysis, "lint_strategy", failing_lint)
+    try:
+        res = opt.sp_optimize(g, {})
+    finally:
+        monkeypatch.setattr(analysis, "lint_strategy", real_lint)
+    # every remapped serve was rejected, so the search re-solved each
+    # segment fresh — slower but LEGAL, and the gate provably ran
+    assert calls["n"] > 0
+    assert helper.segments_stamped == 0
+    assert res is None or np.isfinite(res[1])
+
+
+# ---------------------------------------------------------------------------
+# persistent sp-memo rows: cold/warm serve + loud unknown-schema drop
+
+
+def test_sp_rows_cold_write_warm_serve(tmp_path):
+    """Cold search persists sp-segment memo rows; a warm search of a
+    DIFFERENT graph with isomorphic segments (so the whole-result
+    layer misses on the new graph digest) serves whole segment solves
+    from them — the guid-free cross-graph reuse the layer exists
+    for."""
+    cache = str(tmp_path / "sp_cache.json")
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file=cache,
+                      search_budget=16)
+    g_cold = build_moe_trunk(cfg, num_blocks=30).graph
+    optimize_strategy(g_cold, cfg, return_graph=True)
+    assert LAST_SEARCH_STATS.get("sp_rows_served", 0) == 0  # cold: inert
+    data = json.load(open(cache))
+    assert data.get("sp_schema") == 1
+    assert data.get("sp_rows"), "cold search persisted no sp rows"
+    # warm: a deeper trunk — same block structure, new graph digest
+    cfg2 = ff.FFConfig(batch_size=8, num_devices=8,
+                       cost_cache_file=cache, search_budget=16)
+    g_warm = build_moe_trunk(cfg2, num_blocks=34).graph
+    optimize_strategy(g_warm, cfg2, return_graph=True)
+    assert not LAST_SEARCH_STATS.get("result_cache_hit")
+    assert LAST_SEARCH_STATS.get("sp_rows_served", 0) > 0
+
+
+def test_sp_rows_unknown_schema_dropped_loudly(tmp_path, capsys):
+    from flexflow_tpu.search.cost_cache import CostCache
+
+    cache = str(tmp_path / "sp_cache.json")
+    cfg = ff.FFConfig(batch_size=8, num_devices=8, cost_cache_file=cache,
+                      search_budget=16)
+    g = build_moe_trunk(cfg, num_blocks=30).graph
+    optimize_strategy(g, cfg, return_graph=True)
+    data = json.load(open(cache))
+    assert data["sp_rows"]
+    sig = data["signature"]
+    data["sp_schema"] = 99
+    json.dump(data, open(cache, "w"))
+    capsys.readouterr()
+    cc = CostCache(cache, sig)
+    err = capsys.readouterr().err
+    assert "unknown sp_schema" in err
+    assert not cc.sp_loaded and not cc.sp_rows
+    # the still-valid layers survive the drop
+    assert cc.dp_loaded or cc.rows or cc.results
+
+
+def test_fflint_cache_sp_row_corruptions(tmp_path):
+    """fflint cache: CCH409 for an unknown sp_schema, CCH410 for
+    malformed sp rows, clean for a well-formed layer."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import fflint
+
+    def lint(payload):
+        p = tmp_path / "c.json"
+        p.write_text(json.dumps(payload))
+        return fflint.lint_cache_file(str(p))
+
+    base = {"schema": 1, "signature": "0" * 16,
+            "calibration_stale": False, "rows": []}
+    ok_row = {"cost": 1e-3,
+              "strategy": [["ab12", [2, 1], 1, 0], ["cd34", [1, 1], 1, 0]]}
+    clean = lint({**base, "sp_schema": 1, "sp_rows": {"d:k": ok_row}})
+    assert [f for f in clean if f[0] == "error"] == []
+    bad_schema = lint({**base, "sp_schema": 99,
+                       "sp_rows": {"d:k": ok_row}})
+    assert any(c == "CCH409" for _s, c, _m in bad_schema)
+    for corrupt in (
+        {"cost": -1.0, "strategy": ok_row["strategy"]},   # negative cost
+        {"cost": 1e-3, "strategy": []},                   # no rows
+        {"cost": 1e-3, "strategy": [["zz", [0], 0, -1]]},  # bad entry
+        "not-an-object",
+    ):
+        got = lint({**base, "sp_schema": 1, "sp_rows": {"d:k": corrupt}})
+        assert any(c == "CCH410" for _s, c, _m in got), corrupt
+
+
+# ---------------------------------------------------------------------------
+# matching off the critical path
+
+
+def test_vec_filters_identical_to_full_scan():
+    """Every factory xfer with a vec_filter finds EXACTLY the matches
+    of the unindexed full scan on a graph rich in parallel-op motifs
+    (the soundness contract: the filter is a superset, the matcher
+    confirms)."""
+    from flexflow_tpu.search.substitution import generate_all_pcg_xfers
+
+    m = ff.FFModel(ff.FFConfig(num_devices=8))
+    x = m.create_tensor([16, 8])
+    t = m.relu(x, name="act")
+    for i in range(3):
+        p = m.repartition(t, dim=0, degree=4, name=f"p{i}")
+        m.dense(p, 8, name=f"fc{i}")
+    a = m.dense(x, 32, name="fc_a")
+    a = m.relu(a)
+    b = m.repartition(a, dim=0, degree=2, name="rp")
+    b = m.combine(b, dim=0, degree=1, name="cb")
+    m.dense(b, 4, name="head")
+    g = m.graph
+    # force the vectorized path even on this small graph
+    import flexflow_tpu.search.substitution as subst
+
+    old = subst.VEC_MIN_CANDS
+    subst.VEC_MIN_CANDS = 1
+    try:
+        for xf in generate_all_pcg_xfers(8):
+            if getattr(xf, "vec_filter", None) is None:
+                continue
+            got = [n.guid for n in xf.find_matches(g)]
+            full = [n.guid for n in g.topo_order()
+                    if xf.matcher(g, n)]
+            assert got == full, xf.name
+    finally:
+        subst.VEC_MIN_CANDS = old
+
+
+def test_match_worker_pool_identical_to_serial(monkeypatch):
+    """The opt-in process pool returns exactly the serial matches for
+    every xfer (guids for node matchers, binding dicts for group
+    matchers), and degrades to None when off."""
+    from flexflow_tpu.search import match_workers
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=8)
+    g = build_multibranch(cfg, num_branches=4, depth=12).graph
+    xfers = _load_xfers(cfg, 8)
+    # off by default
+    assert match_workers.find_all_matches(xfers, g, cfg, 8) is None
+    monkeypatch.setenv("FLEXFLOW_TPU_MATCH_WORKERS", "2")
+    monkeypatch.setattr(match_workers, "MIN_POOL_NODES", 8)
+    monkeypatch.setattr(match_workers, "_DISABLED", False)
+    try:
+        pooled = match_workers.find_all_matches(xfers, g, cfg, 8)
+        assert pooled is not None
+        assert match_workers.BATCHES.value > 0
+        for xf, ms in zip(xfers, pooled):
+            serial = xf.find_matches(g)
+            a = [m.guid if hasattr(m, "guid") else m for m in ms]
+            b = [m.guid if hasattr(m, "guid") else m for m in serial]
+            assert a == b, getattr(xf, "name", xf)
+    finally:
+        match_workers.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pattern-graph instantiator (the EQV306 remainder)
+
+
+def test_pattern_instantiator_proves_multi_node_rule():
+    """A multi-node JSON PatternRule outside the motif families is
+    proven on a graph instantiated FROM ITS OWN source pattern instead
+    of being EQV306-reported."""
+    from flexflow_tpu.analysis.proofgen import (
+        instantiate_pattern_graph,
+        verify_registry_generated,
+    )
+    from flexflow_tpu.search.substitution_loader import _parse_rule
+
+    rule = _parse_rule({
+        "name": "swap_linear_twins",
+        "srcOp": [
+            {"type": "OP_LINEAR",
+             "input": [{"opId": -1, "tsId": 0}, {"opId": -2, "tsId": 0}],
+             "para": [{"key": "PM_ACTI", "value": 0}]},
+            {"type": "OP_RELU",
+             "input": [{"opId": 0, "tsId": 0}], "para": []},
+        ],
+        "dstOp": [
+            {"type": "OP_LINEAR",
+             "input": [{"opId": -1, "tsId": 0}, {"opId": -2, "tsId": 0}],
+             "para": [{"key": "PM_ACTI", "value": 2}]},
+        ],
+        "mappedOutput": [
+            {"srcOpId": 1, "srcTsId": 0, "dstOpId": 0, "dstTsId": 0}],
+    })
+    assert rule is not None
+    g = instantiate_pattern_graph(rule, 8)
+    assert g is not None
+    matches = rule.find_matches(g)
+    assert matches, "instantiated pattern graph does not match its rule"
+    findings, stats = verify_registry_generated(8, xfers=[rule])
+    assert not any(f.code == "EQV306" for f in findings), findings
+    assert stats["unproven"] == 0
+
+
+def test_pattern_instantiator_declines_unsupported_families():
+    from flexflow_tpu.analysis.proofgen import instantiate_pattern_graph
+    from flexflow_tpu.search.substitution_loader import _parse_rule
+
+    rule = _parse_rule({
+        "name": "conv_rule",
+        "srcOp": [
+            {"type": "OP_CONV2D",
+             "input": [{"opId": -1, "tsId": 0}, {"opId": -2, "tsId": 0}],
+             "para": []},
+        ],
+        "dstOp": [
+            {"type": "OP_CONV2D",
+             "input": [{"opId": -1, "tsId": 0}, {"opId": -2, "tsId": 0}],
+             "para": []},
+        ],
+        "mappedOutput": [
+            {"srcOpId": 0, "srcTsId": 0, "dstOpId": 0, "dstTsId": 0}],
+    })
+    assert rule is not None
+    assert instantiate_pattern_graph(rule, 8) is None  # honest decline

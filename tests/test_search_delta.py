@@ -516,7 +516,9 @@ def test_warm_process_serves_persisted_dp_rows(tmp_path):
     assert cold["covered"]
     with open(cache) as f:
         data = _json.load(f)
-    assert data["dp_schema"] == 1 and data["dp_rows"], (
+    from flexflow_tpu.search.cost_cache import DP_SCHEMA
+
+    assert data["dp_schema"] == DP_SCHEMA and data["dp_rows"], (
         "first search persisted no DP memo rows")
 
     out = _run_subprocess(_WARM_SCRIPT, 202, cache, 9)

@@ -53,8 +53,9 @@ sys.path.insert(
 
 META_KEY = "__meta__"  # mirrors search/strategy_io.py (stdlib path)
 CACHE_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.SCHEMA_VERSION
-DP_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.DP_SCHEMA
+DP_SCHEMA_VERSIONS = (2,)  # mirrors search/cost_cache.DP_SCHEMA
 COMM_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.COMM_SCHEMA
+SP_SCHEMA_VERSIONS = (1,)  # mirrors search/cost_cache.SP_SCHEMA
 
 
 def _load_json(path: str):
@@ -562,47 +563,53 @@ def lint_cache_file(path: str) -> List[Tuple[str, str, str]]:
     if os.path.exists(sidecar) and os.path.getsize(sidecar) == 0:
         out.append(("error", "CCH404", f"empty results sidecar {sidecar}"))
     out += _lint_dp_rows(data)
+    out += _lint_sp_rows(data)
     out += _lint_comm_plans(data)
     return out
 
 
-def _lint_dp_rows(data) -> List[Tuple[str, str, str]]:
-    """CCH405/406: the persisted DP-memo-row layer (search/cost_cache.py
-    dp_rows — tier-2 segment strategies under process-stable digests).
-    An unknown ``dp_schema`` is a DISTINCT error (CCH405): the loader
-    drops the layer loudly rather than serving rows written under
-    another layout; malformed rows are CCH406."""
-    dp = data.get("dp_rows")
-    if dp is None:
+def _lint_digest_row_layer(data, rows_key, schema_key, versions,
+                           code_schema, code_row,
+                           ) -> List[Tuple[str, str, str]]:
+    """Shared shape lint for the digest-keyed memo-row layers — the
+    dp-row layer (tier-2 segment strategies) and the sp-row layer
+    (whole series-parallel segment solves) persist the SAME row layout:
+    ``{"cost": float, "strategy": [[hex digest, degrees, replica,
+    start], ...]}`` under '<graph digest>:<pin/knob digest>' keys.  An
+    unknown sub-schema is the DISTINCT loud-drop error; malformed rows
+    get the layer's row code."""
+    layer = data.get(rows_key)
+    if layer is None:
         return []
     out: List[Tuple[str, str, str]] = []
-    if data.get("dp_schema") not in DP_SCHEMA_VERSIONS:
-        out.append(("error", "CCH405",
-                    f"dp_rows present but dp_schema "
-                    f"{data.get('dp_schema')!r} unknown (known: "
-                    f"{list(DP_SCHEMA_VERSIONS)}) — the loader will drop "
-                    f"the whole dp-row layer"))
-    if not isinstance(dp, dict):
-        return out + [("error", "CCH406", "dp_rows is not an object")]
-    for key, row in sorted(dp.items()):
-        where = f"dp_rows[{key[:32]}...]" if len(key) > 32 else \
-            f"dp_rows[{key}]"
+    if data.get(schema_key) not in versions:
+        out.append(("error", code_schema,
+                    f"{rows_key} present but {schema_key} "
+                    f"{data.get(schema_key)!r} unknown (known: "
+                    f"{list(versions)}) — the loader will drop "
+                    f"the whole {rows_key} layer"))
+    if not isinstance(layer, dict):
+        return out + [("error", code_row,
+                       f"{rows_key} is not an object")]
+    for key, row in sorted(layer.items()):
+        where = f"{rows_key}[{key[:32]}...]" if len(key) > 32 else \
+            f"{rows_key}[{key}]"
         if not isinstance(key, str) or ":" not in key:
-            out.append(("error", "CCH406",
+            out.append(("error", code_row,
                         f"{where}: malformed key (expect "
                         f"'<graph digest>:<pin/knob digest>')"))
         if not isinstance(row, dict):
-            out.append(("error", "CCH406", f"{where}: row is not an "
+            out.append(("error", code_row, f"{where}: row is not an "
                         "object"))
             continue
         cost = row.get("cost")
         if not isinstance(cost, (int, float)) or not math.isfinite(cost) \
                 or cost < 0:
-            out.append(("error", "CCH406",
+            out.append(("error", code_row,
                         f"{where}: malformed cost {cost!r}"))
         strat = row.get("strategy")
         if not isinstance(strat, list) or not strat:
-            out.append(("error", "CCH406", f"{where}: no strategy rows"))
+            out.append(("error", code_row, f"{where}: no strategy rows"))
             continue
         for j, entry in enumerate(strat):
             ok = (
@@ -615,10 +622,35 @@ def _lint_dp_rows(data) -> List[Tuple[str, str, str]]:
                 and isinstance(entry[3], int) and entry[3] >= 0
             )
             if not ok:
-                out.append(("error", "CCH406",
+                out.append(("error", code_row,
                             f"{where}: strategy[{j}] malformed: "
                             f"{str(entry)[:100]}"))
     return out
+
+
+def _lint_dp_rows(data) -> List[Tuple[str, str, str]]:
+    """CCH405/406: the persisted DP-memo-row layer (search/cost_cache.py
+    dp_rows — tier-2 segment strategies under process-stable digests).
+    An unknown ``dp_schema`` is a DISTINCT error (CCH405): the loader
+    drops the layer loudly rather than serving rows written under
+    another layout; malformed rows are CCH406."""
+    return _lint_digest_row_layer(
+        data, "dp_rows", "dp_schema", DP_SCHEMA_VERSIONS,
+        "CCH405", "CCH406")
+
+
+def _lint_sp_rows(data) -> List[Tuple[str, str, str]]:
+    """CCH409/410: the persisted SP-SEGMENT memo-row layer
+    (search/cost_cache.py sp_rows — whole series-parallel segment
+    solves keyed by segment digest + boundary-view-tuple pins + search
+    knobs, driver._persist_sp_row).  Same row layout and fail-LOUD
+    discipline as the dp layer: unknown ``sp_schema`` is CCH409 (the
+    loader drops the layer, segments re-solve), malformed rows are
+    CCH410 (the in-process reader treats them as a miss — one
+    re-solve, never a wrong stamped strategy)."""
+    return _lint_digest_row_layer(
+        data, "sp_rows", "sp_schema", SP_SCHEMA_VERSIONS,
+        "CCH409", "CCH410")
 
 
 def _lint_comm_plans(data) -> List[Tuple[str, str, str]]:

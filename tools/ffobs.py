@@ -217,6 +217,21 @@ def render_report(events: List[dict], top: int = 10,
             lines.append(
                 f"Match seed index: {mi} matcher calls skipped (node op "
                 f"type cannot anchor the pattern)")
+        mv = p.get("match_vec_skips", 0)
+        if mv:
+            lines.append(
+                f"Vectorized matcher: {mv} matcher calls pruned by the "
+                f"numpy predicate filters before the python matcher ran")
+        mw = p.get("match_worker_batches", 0)
+        if mw:
+            lines.append(
+                f"Match workers: {mw} full-scan sweeps dispatched to the "
+                f"process pool (FLEXFLOW_TPU_MATCH_WORKERS)")
+        sps = p.get("sp_rows_served", 0)
+        if sps:
+            lines.append(
+                f"SP segment memo: {sps} whole-segment solves served "
+                f"from persisted sp-rows (re-linted before serving)")
         cps = p.get("comm_plan_serves")
         cpr = p.get("comm_plan_searches")
         if cps is not None:
@@ -227,6 +242,31 @@ def render_report(events: List[dict], top: int = 10,
                 f"({(cps or 0) / total:.0%} serve rate) — every "
                 f"candidate priced with its best sync "
                 f"schedule/precision/zero plan")
+    # series-parallel decomposition decisions (search.decompose): one
+    # line per oversized (sub)graph — a fallback to binary recursion is
+    # REPORTED here instead of being a mystery slowdown
+    decos = [e for e in events if e.get("kind") == "search.decompose"]
+    for e in decos:
+        mode = e.get("mode")
+        if mode == "fallback":
+            lines.append(
+                f"Decomposition: {e.get('nodes')} nodes FELL BACK to "
+                f"binary recursion (reason: {e.get('reason')}) — no "
+                f"bounded-width series cuts")
+        else:
+            lines.append(
+                f"Decomposition: {e.get('nodes')} nodes via "
+                f"{'bottleneck chain (width-1)' if mode == 'chain' else 'series-parallel frontier cuts'} "
+                f"— {e.get('cuts')} cuts (max width "
+                f"{e.get('max_width')}), {e.get('segments')} segments "
+                f"(largest {e.get('max_segment')})")
+    dones = [e for e in events if e.get("kind") == "search.decompose_done"]
+    if dones:
+        d = dones[-1]
+        lines.append(
+            f"Decomposition result ({d.get('mode')}): DP bound "
+            f"{_ms(d.get('bound_s'))} ms -> merged+simulated "
+            f"{_ms(d.get('cost_s'))} ms over {d.get('segments')} segments")
     # per-candidate comm-plan decision lines (search.comm_plan events):
     # one roll-up by source so a chatty search stays one line each
     plans = [e for e in events if e.get("kind") == "search.comm_plan"]

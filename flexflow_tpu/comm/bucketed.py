@@ -35,7 +35,7 @@ and grad accumulation (sync of the averaged grads) are untouched.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 from jax import lax
@@ -69,6 +69,7 @@ def bucketed_grad_sync(
     chunk: int = DEFAULT_CHUNK,
     machine=None,
     residuals: Optional[Dict[str, Dict[str, jax.Array]]] = None,
+    lane_stamps: bool = False,
 ):
     """Run ``schedule``'s buckets in issue order over ``grads`` (the
     already-GSPMD-reduced gradient tree) — call inside the jitted step,
@@ -92,7 +93,16 @@ def bucketed_grad_sync(
     execute their cross stage at the plain int8 wire and skip EF
     (exactly how the cost model priced them); with ``residuals=None``
     int8_ef degrades to plain int8 and the legacy return shape is
-    kept."""
+    kept.
+
+    ``lane_stamps`` (``FFConfig.device_trace_dir`` consumers only)
+    brackets each bucket's collectives with ordered host-callback
+    markers carrying the bucket's STABLE lane id
+    (``bucket:<name>:sync`` — the simulator's comm_schedule name), so
+    a live ``device_trace`` capture records when the runtime actually
+    issued and finished each lane (obs/annotate.py; matched back to
+    the predicted lanes by obs/trace_ingest.py).  Off (the default)
+    the lowered program is byte-identical to history."""
     from flexflow_tpu.comm.compat import shard_map
     from flexflow_tpu.comm.hierarchical import (
         plan_axis_groups,
@@ -142,6 +152,33 @@ def bucketed_grad_sync(
                     # fp32 wire = GSPMD's own backward psum (already
                     # happened); the bucket only anchors issue order
                     plain.append((op_name, w_name, g))
+        lane = f"bucket:{bucket.name}:sync"
+        if lane_stamps and (groups or plain):
+            from flexflow_tpu.obs import annotate
+
+            # the issue marker depends on every member grad (fires once
+            # the bucket's payload is ready) and its 0.0 result is
+            # folded into the first member's PAYLOAD — the collectives
+            # consume it, so the marker both precedes them and stays
+            # live (XLA prunes optimization-barrier operands whose
+            # outputs are unused, so the token chain alone is not a
+            # liveness anchor).  The marker's trace timestamp IS the
+            # lane's host-observed issue point.
+            deps = [m[2].ravel()[0] for ms in groups.values()
+                    for m in ms]
+            deps += [g.ravel()[0] for _o, _w, g in plain]
+            d = deps[0]
+            for x in deps[1:]:
+                d = d + x.astype(d.dtype)
+            z = annotate.lane_stamp(lane, "issue", d)
+            if groups:
+                key = next(iter(groups))
+                m0 = groups[key][0]
+                groups[key][0] = m0[:2] + (
+                    m0[2] + z.astype(m0[2].dtype),) + m0[3:]
+            else:
+                o0, w0, g0 = plain[0]
+                plain[0] = (o0, w0, g0 + z.astype(g0.dtype))
         toks: List[jax.Array] = []
         for (rep, n, has_res), members in groups.items():
             gs = [g for _o, _w, g, _s, _r in members]
@@ -252,6 +289,21 @@ def bucketed_grad_sync(
             token = toks[0]
             for t in toks[1:]:
                 token = token + t
+            if lane_stamps:
+                from flexflow_tpu.obs import annotate
+
+                # the done marker depends on every collective of this
+                # bucket (the summed token) — its trace timestamp is
+                # the lane's host-observed completion.  Its 0.0 result
+                # is tied into one of the bucket's LIVE outputs: the
+                # last bucket's token feeds nothing downstream, and an
+                # unused pure_callback is dead code XLA may eliminate
+                z = annotate.lane_stamp(lane, "done", token)
+                token = token + z
+                o, w = (next(iter(groups.values()))[0][:2] if groups
+                        else plain[0][:2])
+                merged[o][w] = merged[o][w] + z.astype(
+                    merged[o][w].dtype)
     if residuals is None:
         return merged
     return merged, new_res

@@ -563,13 +563,18 @@ class CompiledModel:
         schedule = self.sync_schedule
         if schedule is not None and getattr(schedule, "buckets", None):
             from flexflow_tpu.comm import bucketed_grad_sync
+            from flexflow_tpu.obs.annotate import lane_stamps_armed
 
             # the machine spec arms staged (hierarchical) execution of
             # buckets carrying a reduction plan — the nested axis split
-            # follows the spec's slice structure, not the live backend
+            # follows the spec's slice structure, not the live backend.
+            # lane_stamps (device_trace_dir captures only) brackets
+            # each bucket with its stable lane id so the real trace
+            # tag-matches the predicted comm lanes.
             got = bucketed_grad_sync(
                 grads, self.mesh, shardings, schedule,
-                machine=self.config.machine_spec, residuals=residuals)
+                machine=self.config.machine_spec, residuals=residuals,
+                lane_stamps=lane_stamps_armed(self.config))
             if residuals is None:
                 return ret(got)
             merged, new_res = got

@@ -208,6 +208,15 @@ class FFConfig:
     obs_trace_file: Optional[str] = None  # compile() writes the
     # PREDICTED task timeline here as Chrome-trace JSON (Perfetto-
     # loadable), the artifact to view next to the real device_trace
+    device_trace_dir: Optional[str] = None  # fit() captures a REAL
+    # jax.profiler device trace of the post-compile steps into this
+    # logdir, with the lowered step's sync buckets bracketed by
+    # stable-lane-id markers (obs/annotate.py) and host phases
+    # annotated; after the run the capture is ingested and tag-matched
+    # against the predicted lanes (obs/trace_ingest.py) into
+    # model.lane_drift_report, filling the per-bucket DriftReport
+    # measured fields.  None (default): no capture, no markers — the
+    # lowered program is byte-identical to history.
     drift_threshold: float = 0.5  # |measured/predicted - 1| above which
     # the DriftReport flags the prediction stale (and, when a measured
     # calibration table was consulted, the TABLE as stale)
@@ -402,6 +411,13 @@ class FFConfig:
                        default=None,
                        help="write the PREDICTED task timeline as "
                             "Chrome-trace JSON at compile (Perfetto)")
+        p.add_argument("--device-trace-dir", dest="device_trace_dir",
+                       type=str, default=None,
+                       help="capture a REAL jax.profiler device trace "
+                            "of fit's post-compile steps into this "
+                            "logdir, lane-stamped and tag-matched "
+                            "against the predicted comm lanes "
+                            "(obs/trace_ingest.py LaneDriftReport)")
         p.add_argument("--drift-threshold", dest="drift_threshold",
                        type=float, default=0.5,
                        help="predicted-vs-measured step-time drift "
@@ -462,6 +478,7 @@ class FFConfig:
             serve_p99_budget_ms=args.serve_p99_budget_ms,
             obs_log_file=args.obs_log,
             obs_trace_file=args.obs_trace,
+            device_trace_dir=args.device_trace_dir,
             drift_threshold=args.drift_threshold,
             cost_cache_file="" if args.no_cost_cache else args.cost_cache_file,
             verify=args.verify,

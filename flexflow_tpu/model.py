@@ -963,6 +963,8 @@ class FFModel:
 
         self.predicted_breakdown = None
         self.drift_report = None
+        self.lane_drift_report = None  # filled by fit's device-trace
+        # capture (config.device_trace_dir) via obs/trace_ingest.py
         _pred_cal = None  # the coherent table the prediction was priced
         # under — the export block digests THIS object (STR210) instead
         # of re-parsing the file a second time
@@ -1699,6 +1701,15 @@ class FFModel:
             from flexflow_tpu.runtime.profiler import StepProfiler
 
             profiler = StepProfiler()
+        # real device-trace capture (obs/annotate.py + trace_ingest.py):
+        # the post-compile steps are captured under jax.profiler with
+        # the step annotated and the sync buckets lane-stamped (the
+        # lowering threaded the markers because device_trace_dir was
+        # set at compile); after the run the capture is ingested and
+        # tag-matched against the predicted comm lanes.
+        capture_dir = self.config.device_trace_dir
+        trace_active = False
+        self.lane_drift_report = None
         metrics = PerfMetrics()
         history = []
         t_start = None
@@ -1732,6 +1743,14 @@ class FFModel:
             for kind, inputs, labels in batch_iter:
                 self._rng_counter += 1
                 rng = jax.random.key(self._rng_counter)
+                step_span = None
+                if trace_active:
+                    from flexflow_tpu.obs import annotate as _annot
+
+                    # one ff.phase/step annotation per optimizer step:
+                    # the window trace_ingest assigns lane markers to
+                    step_span = _annot.phase_span(_annot.STEP_PHASE)
+                    step_span.__enter__()
                 if profiler is not None:
                     profiler.start_step()
                     profiler.start_phase("dispatch")
@@ -1764,6 +1783,12 @@ class FFModel:
                     float(loss)
                     profiler.end_phase("wait")
                     profiler.end_step()
+                elif step_span is not None:
+                    # the step annotation must cover the device work,
+                    # so a capture without profiling still fences
+                    float(loss)
+                if step_span is not None:
+                    step_span.__exit__(None, None, None)
                 if recompile_state is not None and recompile_state.check(self):
                     # drop the accumulator AND this step's metrics: the
                     # re-lowered program may emit a different metric tree
@@ -1777,6 +1802,21 @@ class FFModel:
                     # not reliably fence through remote-device tunnels)
                     t_start = time.perf_counter()  # skip compile time
                     steps_at_t0 = steps_done
+                    if capture_dir and not trace_active:
+                        # start the capture AFTER the compile step so
+                        # the trace holds steady-state steps only
+                        try:
+                            import os as _os
+
+                            from flexflow_tpu.obs import annotate as _annot
+
+                            _os.makedirs(capture_dir, exist_ok=True)
+                            jax.profiler.start_trace(capture_dir)
+                            _annot.arm()
+                            _annot.LANES.clear()
+                            trace_active = True
+                        except Exception:
+                            pass  # telemetry must never fail a fit
             if acc is not None:  # None if a recompile landed on the last batch
                 metrics.update(acc)
             if verbose:
@@ -1808,6 +1848,15 @@ class FFModel:
                 break
         for cb in callbacks:
             cb.on_train_end()
+        if trace_active:
+            from flexflow_tpu.obs import annotate as _annot
+
+            _annot.disarm()
+            try:
+                float(loss)  # fence: the last step must land in-trace
+                jax.profiler.stop_trace()
+            except Exception:
+                trace_active = False
         if steps_done == 0:
             return history
         float(loss)  # readback fence before reading the clock
@@ -1819,7 +1868,9 @@ class FFModel:
             self.last_throughput = thr
         if profiler is not None:
             self._report_profile(profiler, verbose)
-        elif steps_done > steps_at_t0 and elapsed > 0:
+        if trace_active:
+            self._ingest_device_trace(capture_dir, verbose)
+        if profiler is None and steps_done > steps_at_t0 and elapsed > 0:
             # re-probe-allowance bugfix: a HEALTHY calibrated fit must
             # reset MAX_AUTO_REPROBES even when neither profiling nor
             # the obs bus armed the full drift-report path — fit's own
@@ -1830,6 +1881,41 @@ class FFModel:
             self._healthy_calibration_reset(
                 elapsed / (steps_done - steps_at_t0))
         return history
+
+    def _ingest_device_trace(self, capture_dir: str, verbose: bool) -> None:
+        """Close the measured side of the lane loop: parse the capture
+        fit just stopped, tag-match it against the compile-time
+        predicted comm lanes, and fill the per-bucket DriftReport
+        measured fields that stayed ``None`` while no real trace
+        existed.  The report lands on ``self.lane_drift_report`` and
+        (when exporting) in the strategy file's ``__meta__``."""
+        try:
+            from flexflow_tpu.obs.events import BUS
+            from flexflow_tpu.obs.trace_ingest import (
+                apply_lane_measurements,
+                build_lane_drift_report,
+            )
+
+            report = build_lane_drift_report(
+                capture_dir, getattr(self, "predicted_breakdown", None),
+                threshold=self.config.drift_threshold)
+            self.lane_drift_report = report
+            if report is None:
+                return
+            apply_lane_measurements(self.drift_report, report)
+            if verbose:
+                print(f"LANES {report}")
+            if self.config.export_strategy_file:
+                from flexflow_tpu.search.strategy_io import attach_meta
+
+                try:
+                    attach_meta(self.config.export_strategy_file,
+                                lane_drift=report.to_dict())
+                except (OSError, ValueError):
+                    pass
+            BUS.flush()
+        except Exception:  # telemetry must never fail a fit
+            self.lane_drift_report = None
 
     def _healthy_calibration_reset(self, measured_step_s: float) -> None:
         pred = getattr(self, "predicted_breakdown", None)

@@ -14,6 +14,13 @@ cheap and tooling (tools/ffobs.py) can read artifacts without jax:
 * ``trace``/``drift`` — Chrome-trace (Perfetto-loadable) export of the
   SIMULATED task timeline, and ``DriftReport``: predicted-vs-measured
   step-time comparison that flags calibration staleness.
+* ``annotate``/``trace_ingest`` — the MEASURED side of the loop:
+  ``jax.profiler`` annotations keyed by phase and sync-bucket lane id
+  stamped onto the executed step, and a parser that matches a real
+  ``device_trace`` capture back to the simulator's predicted lanes by
+  tag (``LaneDriftReport``).
+* ``exposition`` — Prometheus text rendering of the metrics registry
+  (+ optional stdlib HTTP endpoint, ``FLEXFLOW_TPU_METRICS_PORT``).
 
 The reference has no analogue (its search logs through
 RecursiveLogger only); GSPMD-style sharding-decision introspection and
@@ -22,8 +29,18 @@ predicted-timeline artifacts are what operators actually debug with.
 
 from flexflow_tpu.obs.drift import DriftReport, build_drift_report  # noqa: F401
 from flexflow_tpu.obs.events import BUS, EventBus, validate_event  # noqa: F401
+from flexflow_tpu.obs.exposition import (  # noqa: F401
+    maybe_start_from_env as _maybe_start_metrics,
+    render_prometheus,
+    start_metrics_server,
+)
 from flexflow_tpu.obs.metrics import METRICS, MetricsRegistry  # noqa: F401
 from flexflow_tpu.obs.trace import write_chrome_trace  # noqa: F401
+from flexflow_tpu.obs.trace_ingest import (  # noqa: F401
+    LaneDriftReport,
+    apply_lane_measurements,
+    build_lane_drift_report,
+)
 
 __all__ = [
     "BUS",
@@ -31,7 +48,15 @@ __all__ = [
     "METRICS",
     "MetricsRegistry",
     "DriftReport",
+    "LaneDriftReport",
+    "apply_lane_measurements",
     "build_drift_report",
+    "build_lane_drift_report",
+    "render_prometheus",
+    "start_metrics_server",
     "validate_event",
     "write_chrome_trace",
 ]
+
+# FLEXFLOW_TPU_METRICS_PORT arms the exposition endpoint process-wide
+_maybe_start_metrics()

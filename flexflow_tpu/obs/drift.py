@@ -116,14 +116,24 @@ def build_drift_report(
     for row in predicted.get("sync_buckets") or []:
         buckets.append({
             "name": row.get("name"),
+            # the STABLE lane id shared with comm_schedule records and
+            # the executed step's trace annotations — what a real
+            # device_trace capture tag-matches against
+            # (obs/trace_ingest.apply_lane_measurements fills the
+            # measured fields below from a matched capture)
+            "lane": row.get("lane") or f"bucket:{row.get('name')}:sync",
             "precision": row.get("precision"),
             "plan": row.get("plan"),
             "ops": len(row.get("ops") or []),
             "predicted_ready_s": row.get("ready_s"),
+            "predicted_issue_s": row.get("start_s"),
             "predicted_sync_s": row.get("sync_s"),
             "predicted_exposed_s": row.get("exposed_s"),
             "predicted_levels_s": row.get("levels") or {},
-            "measured_s": None,  # one fused program: no per-bucket probe
+            # None until a device-trace capture is matched — the fused
+            # program has no per-bucket host timer without one
+            "measured_s": None,
+            "measured_issue_s": None,
         })
     return DriftReport(
         predicted_s=float(total),

@@ -72,6 +72,21 @@ EVENT_KINDS = {
                      "pages_in_use"},
     "decode.summary": {"frames", "completed", "measured_p50_s",
                        "measured_p99_s"},
+    # per-request serving lifecycle (runtime/decode.py): one event per
+    # completed request carrying its spans — queue wait, TTFT (enqueue
+    # -> first generated token), TPOT (steady per-token), e2e — the
+    # request-level currency of the serving telemetry.  Armed requests
+    # only: the executor checks the bus ONCE per frame when off.
+    "decode.request": {"rid", "phase"},
+    # device-trace ingestion + lane matching (obs/trace_ingest.py):
+    # one trace.ingest per parsed capture, one trace.lane_match per
+    # predicted sync-bucket lane (matched by annotation tag, never by
+    # fuzzy kernel name)
+    "trace.ingest": {"path", "events", "lanes"},
+    "trace.lane_match": {"lane", "matched"},
+    # Prometheus exposition endpoint start (obs/exposition.py,
+    # FLEXFLOW_TPU_METRICS_PORT)
+    "metrics.exposition": {"port"},
     # DP inner loop (search/dp.py)
     "dp.split": {"op", "pre_nodes", "post_nodes", "cost_s"},
     "dp.summary": {"memo_hits", "memo_misses"},
@@ -101,6 +116,10 @@ EVENT_KINDS = {
     "controller.recovery": {"step", "cause"},
     "controller.retry": {"step", "attempt"},
     "controller.fallback": {"step", "reason"},
+    # the measured-p99 drift watch (serving currency): the controller
+    # saw a measured decode p99 vs the searched prediction; drifted
+    # past threshold => the next step re-searches with this trigger
+    "controller.p99_drift": {"step", "ratio", "drifted"},
     "controller.summary": {"steps", "swaps", "recoveries"},
 }
 

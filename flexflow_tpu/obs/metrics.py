@@ -10,7 +10,9 @@ cache them at import time.
 
 from __future__ import annotations
 
+import random as _random
 import threading
+import zlib as _zlib
 from typing import Dict, List
 
 
@@ -38,11 +40,16 @@ class Gauge:
 
 class Histogram:
     """Bounded-sample histogram: exact count/sum/min/max, percentiles
-    from the first ``max_samples`` observations (enough for step-time
-    distributions; unbounded growth is the failure mode this avoids)."""
+    from a SEEDED RESERVOIR (Vitter's algorithm R) over the whole
+    stream.  The previous first-``max_samples`` window froze a
+    long-running serving process's p99 on its first minutes of
+    traffic; the reservoir keeps a uniform sample of everything
+    observed at the same bounded memory.  The seed derives from the
+    metric name, so a replayed stream reproduces the identical summary
+    (deterministic under test)."""
 
     __slots__ = ("name", "count", "sum", "min", "max", "_samples",
-                 "max_samples")
+                 "max_samples", "_rng")
 
     def __init__(self, name: str, max_samples: int = 4096):
         self.name = name
@@ -52,6 +59,8 @@ class Histogram:
         self.min = float("inf")
         self.max = float("-inf")
         self._samples: List[float] = []
+        self._rng = _random.Random(
+            _zlib.crc32(name.encode("utf-8", "ignore")))
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -63,6 +72,13 @@ class Histogram:
             self.max = v
         if len(self._samples) < self.max_samples:
             self._samples.append(v)
+        else:
+            # algorithm R: keep each of the `count` observations with
+            # probability max_samples/count — a uniform sample of the
+            # whole stream, not a frozen prefix
+            j = self._rng.randrange(self.count)
+            if j < self.max_samples:
+                self._samples[j] = v
 
     def summary(self) -> Dict[str, float]:
         if self.count == 0:
@@ -74,11 +90,13 @@ class Histogram:
 
         return {
             "count": self.count,
+            "sum": self.sum,
             "mean": self.sum / self.count,
             "min": self.min,
             "max": self.max,
             "p50": pct(0.50),
             "p95": pct(0.95),
+            "p99": pct(0.99),
         }
 
 
@@ -134,6 +152,10 @@ class MetricsRegistry:
                 h.min = float("inf")
                 h.max = float("-inf")
                 h._samples.clear()
+                # re-seed so a replay after reset() reproduces the
+                # identical reservoir (the determinism contract)
+                h._rng = _random.Random(
+                    _zlib.crc32(h.name.encode("utf-8", "ignore")))
 
     def emit_snapshot(self) -> None:
         """Persist the current snapshot through the event bus (no-op

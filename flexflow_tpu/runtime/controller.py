@@ -124,6 +124,15 @@ class TrainingController:
         self.history: List[dict] = []
         self._step_times: List[float] = []
         self._armed_collective = None
+        # measured-drift triggers from the OBSERVED side of the loop:
+        # a serving p99 feed past threshold (observe_p99) or a
+        # device-trace lane report with drifted lanes
+        # (observe_lane_drift / model.lane_drift_report) — consumed at
+        # the next step boundary as first-class re-search triggers
+        # next to the calibration-signature watch
+        self._p99_trigger: Optional[float] = None
+        self._lane_trigger: Optional[str] = None
+        self._lane_seen = None
         self._ckpt_mgr = None
         if checkpoint_dir is not None:
             from flexflow_tpu.runtime.checkpoint import CheckpointManager
@@ -161,12 +170,59 @@ class TrainingController:
         self._cal_stat_cache = (stat_sig, state)
         return state
 
+    # -- measured-drift feeds (serving p99 + device-trace lanes) ---------
+    def observe_p99(self, measured_s: float,
+                    predicted_s: Optional[float] = None,
+                    step: Optional[int] = None) -> Optional[float]:
+        """Feed a measured serving p99 (e.g.
+        ``ContinuousBatchingExecutor.measured_p99(window)``) against
+        the searched prediction.  Emits ``controller.p99_drift``;
+        drifted past the model's drift threshold, the next step
+        boundary re-searches with trigger ``"p99_drift"``.  Returns
+        the measured/predicted ratio (None when either side is
+        missing)."""
+        pred = predicted_s
+        if pred is None:
+            pred = (getattr(self.model, "predicted_breakdown", None)
+                    or {}).get("total_s")
+        if (not pred or not math.isfinite(pred) or not measured_s
+                or not math.isfinite(measured_s)):
+            return None
+        ratio = measured_s / pred
+        thr = self.model.config.drift_threshold
+        drifted = ratio > 1.0 + thr or ratio < 1.0 / (1.0 + thr)
+        BUS.emit("controller.p99_drift",
+                 step=step if step is not None else self.stats["steps"],
+                 ratio=ratio, drifted=drifted, predicted_s=pred,
+                 measured_s=measured_s, threshold=thr)
+        if drifted:
+            self._p99_trigger = ratio
+        return ratio
+
+    def observe_lane_drift(self, lane_report) -> None:
+        """Feed a matched ``LaneDriftReport`` (obs/trace_ingest.py);
+        any stale lane arms a ``"lane_drift"`` re-search at the next
+        step boundary.  ``_watch_drift`` also consumes a fresh
+        ``model.lane_drift_report`` automatically."""
+        if lane_report is None or lane_report is self._lane_seen:
+            return
+        self._lane_seen = lane_report
+        stale = lane_report.stale_lanes
+        if stale:
+            self._lane_trigger = ",".join(stale[:4])
+
     def _watch_drift(self, step: int) -> None:
         """The controller's own per-phase DriftReport: measured mean of
         the trailing step window vs the compile-time prediction.  On
         calibration staleness it marks the persisted table + cost cache
         exactly like ``model._report_profile`` — the next signature
         check then sees the rotation and re-searches."""
+        # a device-trace lane report the model's fit produced since the
+        # last check rides the same watch (per-lane drift is a sharper
+        # signal than the aggregate step ratio: it names WHICH comm
+        # lane the cost model mispriced)
+        self.observe_lane_drift(
+            getattr(self.model, "lane_drift_report", None))
         pred = getattr(self.model, "predicted_breakdown", None)
         window = self._step_times[1:]  # step 0 pays compile
         if (not pred or not pred.get("calibrated")
@@ -346,6 +402,17 @@ class TrainingController:
                 self.stats["recoveries"] += 1
                 BUS.emit("controller.recovery", step=step,
                          cause="device_loss", devices=survivors)
+            elif fault.kind == "p99_drift":
+                # seeded serving-currency drift: the measured decode
+                # p99 came in at draw x the searched prediction —
+                # routed through the same observe_p99 watch a live
+                # executor feeds, so the trigger path is identical
+                ratio = self.faults.inject_p99_drift(fault)
+                pred = (getattr(self.model, "predicted_breakdown", None)
+                        or {}).get("total_s")
+                if pred and math.isfinite(pred):
+                    self.observe_p99(pred * ratio, predicted_s=pred,
+                                     step=step)
             elif fault.kind == "collective_failure":
                 self._armed_collective = fault
             elif fault.kind == "corrupt_checkpoint":
@@ -414,6 +481,16 @@ class TrainingController:
                 state = self._live_cal_state()
                 if state != self._cal_state:
                     self._research_and_swap(step, "calibration_drift")
+            if self._p99_trigger is not None:
+                # the serving currency drifted past threshold: the
+                # searched strategy's p99 claim is falsified — re-search
+                # on the current cost surface (same first-class standing
+                # as the calibration-signature rotation)
+                self._p99_trigger = None
+                self._research_and_swap(step, "p99_drift")
+            if self._lane_trigger is not None:
+                self._lane_trigger = None
+                self._research_and_swap(step, "lane_drift")
             b = step % num_batches
             idx = slice(b * bs, (b + 1) * bs)
             model._rng_counter += 1

@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from flexflow_tpu.obs import annotate
 from flexflow_tpu.obs.events import BUS
 
 
@@ -62,6 +63,11 @@ class _Live:
     cached: int = 0        # tokens already written into the KV cache
     generated: int = 0
     started_frame: int = 0
+    # request lifecycle span stamps (perf_counter seconds) — populated
+    # only while the obs bus is armed (see step()'s one-check contract)
+    enqueue_t: Optional[float] = None
+    admit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
 
 
 class PageAllocator:
@@ -148,9 +154,17 @@ class ContinuousBatchingExecutor:
         self.frame_seconds: List[float] = []
         self.total_admitted = 0
         self.total_evicted = 0
+        # per-request lifecycle telemetry (enqueue→admit→first
+        # token→EOS/evict spans; TTFT/TPOT/e2e), recorded only while
+        # the obs bus is armed — the hot path checks BUS.enabled ONCE
+        # per frame (and once per submit batch) and skips every stamp
+        # when it is off
+        self._enqueue_t: Dict[str, float] = {}
+        self.request_records: List[dict] = []
 
     # ------------------------------------------------------------------
     def submit(self, requests: Sequence[DecodeRequest]) -> None:
+        obs = BUS.enabled  # one check per submit batch
         for r in requests:
             assert r.prompt, f"request {r.rid!r} has an empty prompt"
             need = len(r.prompt) + r.max_new_tokens
@@ -158,9 +172,11 @@ class ContinuousBatchingExecutor:
             assert need <= cap, (
                 f"request {r.rid!r} wants {need} tokens but a sequence "
                 f"caps at {cap} (page_size x pages_per_seq)")
+            if obs:
+                self._enqueue_t[r.rid] = time.perf_counter()
             self.queue.append(r)
 
-    def _admit(self) -> int:
+    def _admit(self, obs: bool = False) -> int:
         """Fill open slots from the queue while the allocator can
         reserve a FULL per-sequence allotment (admission by page
         residency: an admitted sequence never needs preemption)."""
@@ -176,14 +192,18 @@ class ContinuousBatchingExecutor:
             if pages is None:
                 break
             req = self.queue.pop(0)
-            self.slots[i] = _Live(req=req, pages=pages,
-                                  tokens=list(req.prompt),
-                                  started_frame=self.frame)
+            live = _Live(req=req, pages=pages,
+                         tokens=list(req.prompt),
+                         started_frame=self.frame)
+            if obs:
+                live.enqueue_t = self._enqueue_t.pop(req.rid, None)
+                live.admit_t = time.perf_counter()
+            self.slots[i] = live
             admitted += 1
         self.total_admitted += admitted
         return admitted
 
-    def _evict(self) -> int:
+    def _evict(self, obs: bool = False) -> int:
         """Free finished sequences' pages and reopen their slots."""
         evicted = 0
         for i, live in enumerate(self.slots):
@@ -197,8 +217,48 @@ class ContinuousBatchingExecutor:
                 self.allocator.free(live.pages)
                 self.slots[i] = None
                 evicted += 1
+                if obs:
+                    self._record_request(live)
         self.total_evicted += evicted
         return evicted
+
+    def _record_request(self, live: _Live) -> None:
+        """Close a finished request's lifecycle span: queue wait
+        (enqueue→admit), TTFT (enqueue→first generated token), TPOT
+        (steady per-token after the first), e2e — observed into the
+        metrics registry histograms and emitted as one
+        ``decode.request`` event.  Called only when the bus was armed
+        at eviction time (the caller's one-check-per-frame gate)."""
+        from flexflow_tpu.obs.metrics import METRICS
+
+        now = time.perf_counter()
+        enq, adm, first = live.enqueue_t, live.admit_t, live.first_token_t
+        queue_s = (adm - enq) if (enq is not None and adm is not None) \
+            else None
+        ttft_s = (first - enq) if (enq is not None and first is not None) \
+            else None
+        e2e_s = (now - enq) if enq is not None else None
+        tpot_s = None
+        if first is not None and live.generated > 1:
+            tpot_s = (now - first) / (live.generated - 1)
+        rec = {
+            "rid": live.req.rid,
+            "phase": "finish",
+            "queue_s": queue_s,
+            "ttft_s": ttft_s,
+            "tpot_s": tpot_s,
+            "e2e_s": e2e_s,
+            "tokens": live.generated,
+            "frames": self.frame - live.started_frame + 1,
+        }
+        self.request_records.append(rec)
+        for key, v in (("decode.queue_s", queue_s),
+                       ("decode.ttft_s", ttft_s),
+                       ("decode.tpot_s", tpot_s),
+                       ("decode.e2e_s", e2e_s)):
+            if v is not None:
+                METRICS.histogram(key).observe(v)
+        BUS.emit("decode.request", **rec)
 
     # ------------------------------------------------------------------
     def _compose_frame(self):
@@ -232,15 +292,21 @@ class ContinuousBatchingExecutor:
 
     def step(self) -> dict:
         """One decode frame: admit, compose, run, harvest, evict.
-        Returns the frame record (also emitted as ``decode.frame``)."""
-        admitted = self._admit()
+        Returns the frame record (also emitted as ``decode.frame``).
+        The request-span instrumentation costs exactly this one
+        ``BUS.enabled`` read per frame when telemetry is off
+        (test-enforced)."""
+        obs = BUS.enabled  # ONE check per frame gates every span stamp
+        admitted = self._admit(obs)
         ids, table, lens, active = self._compose_frame()
         t0 = time.perf_counter()
-        logits = np.asarray(self.step_fn(ids, table, lens))
+        with annotate.phase_span(annotate.DECODE_PHASE):
+            logits = np.asarray(self.step_fn(ids, table, lens))
         dt = time.perf_counter() - t0
         self.frame_seconds.append(dt)
         next_tokens = logits[:, 0].argmax(axis=-1).astype(np.int32) \
             if logits.ndim == 3 else logits[:, 0].astype(np.int32)
+        now = time.perf_counter() if obs else 0.0
         for i in active:
             live = self.slots[i]
             live.cached += 1
@@ -249,7 +315,9 @@ class ContinuousBatchingExecutor:
             # the model's prediction extends the sequence
             live.tokens.append(int(next_tokens[i]))
             live.generated += 1
-        evicted = self._evict()
+            if obs and live.first_token_t is None:
+                live.first_token_t = now  # TTFT closes here
+        evicted = self._evict(obs)
         rec = {
             "frame": self.frame,
             "active": len(active),
@@ -260,7 +328,10 @@ class ContinuousBatchingExecutor:
             "measured_s": dt,
             "predicted_s": self.predicted_step_s,
         }
-        if BUS.enabled:
+        if obs:
+            from flexflow_tpu.obs.metrics import METRICS
+
+            METRICS.histogram("decode.frame_s").observe(dt)
             BUS.emit("decode.frame", **rec)
         self.frame += 1
         return rec
@@ -284,12 +355,25 @@ class ContinuousBatchingExecutor:
         return dict(self.finished)
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _quantile(values, f: float):
+        if not values:
+            return None
+        s = sorted(values)
+        return s[min(len(s) - 1, int(f * (len(s) - 1)))]
+
+    def measured_p99(self, window: int = 0) -> Optional[float]:
+        """p99 of the measured frame latencies — over the trailing
+        ``window`` frames when given (the CONTINUOUS drift signal a
+        long-running server feeds the controller), else the whole
+        run."""
+        times = self.frame_seconds[-window:] if window \
+            else self.frame_seconds
+        return self._quantile(times, 0.99)
+
     def summary(self) -> dict:
-        times = sorted(self.frame_seconds)
-        q = (lambda f: times[min(len(times) - 1,
-                                 int(f * (len(times) - 1)))]) if times \
-            else (lambda f: None)
-        return {
+        q = lambda f: self._quantile(self.frame_seconds, f)  # noqa: E731
+        out = {
             "frames": self.frame,
             "completed": len(self.finished),
             "admitted": self.total_admitted,
@@ -298,25 +382,41 @@ class ContinuousBatchingExecutor:
             "measured_p99_s": q(0.99),
             "predicted_step_s": self.predicted_step_s,
         }
+        recs = self.request_records
+        if recs:
+            # request-level currency (recorded while the bus was
+            # armed): TTFT / TPOT / e2e percentiles across completions
+            for key in ("ttft_s", "tpot_s", "e2e_s", "queue_s"):
+                vals = [r[key] for r in recs if r.get(key) is not None]
+                out[f"{key[:-2]}_p50_s"] = self._quantile(vals, 0.5)
+                out[f"{key[:-2]}_p99_s"] = self._quantile(vals, 0.99)
+            out["requests_recorded"] = len(recs)
+        return out
 
-    def decode_drift_report(self, threshold: float = 0.5):
+    def decode_drift_report(self, threshold: float = 0.5,
+                            window: int = 0):
         """Predicted-vs-measured DECODE drift: the search's p99 step
         prediction against the measured frame-latency p99 — the decode
-        phase of the DriftReport family (obs/drift.py).  None when
-        either side is missing.  Emitted as a ``drift.report`` event
-        when the bus is armed, like model.fit's training-side report."""
+        phase of the DriftReport family (obs/drift.py).  ``window``
+        restricts the measured side to the trailing frames, turning a
+        one-shot report into the continuous serve-currency signal
+        (feed ``report.ratio`` — or the executor itself — to
+        ``TrainingController.observe_p99`` to make it a re-search
+        trigger).  None when either side is missing.  Emitted as a
+        ``drift.report`` event when the bus is armed, like model.fit's
+        training-side report."""
         from flexflow_tpu.obs.drift import build_drift_report
 
-        s = self.summary()
-        if not self.predicted_step_s or not s["measured_p99_s"]:
+        measured = self.measured_p99(window)
+        if not self.predicted_step_s or not measured:
             return None
         report = build_drift_report(
             {"total_s": self.predicted_step_s},
-            s["measured_p99_s"], threshold=threshold)
+            measured, threshold=threshold)
         if report is not None:
             report.phases["decode"] = {
                 "predicted_s": self.predicted_step_s,
-                "measured_s": s["measured_p99_s"],
+                "measured_s": measured,
                 "ratio": report.ratio,
             }
             if BUS.enabled:

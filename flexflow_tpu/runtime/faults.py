@@ -49,6 +49,10 @@ FAULT_KINDS = (
     "device_loss",
     "collective_failure",
     "corrupt_checkpoint",
+    # the serving currency drifted: a seeded measured-p99 vs
+    # searched-p99 ratio fed to the controller's observe_p99 watch —
+    # past threshold it becomes a "p99_drift" re-search trigger
+    "p99_drift",
 )
 
 
@@ -168,6 +172,15 @@ class FaultPlan:
             json.dump(data, f, indent=1)
         fault.fired = True
         return factor
+
+    def inject_p99_drift(self, fault: Fault) -> float:
+        """The measured serving p99 drifted off the searched
+        prediction: returns the seeded measured/predicted ratio
+        (1.5x–3.5x — always past the default 0.5 drift threshold, so a
+        scheduled p99_drift fault deterministically trips the
+        controller's observe_p99 watch)."""
+        fault.fired = True
+        return self._draws[id(fault)]
 
     def inject_device_loss(self, fault: Fault, num_devices: int) -> int:
         """Surviving device count after the loss (>= 1)."""

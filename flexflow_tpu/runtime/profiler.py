@@ -101,13 +101,21 @@ class StepProfiler:
 @contextlib.contextmanager
 def device_trace(logdir: str):
     """XLA device timeline trace (TensorBoard `Profile` tab / Perfetto).
-    The TPU analog of the reference's `-lg:prof` external tooling."""
+    The TPU analog of the reference's `-lg:prof` external tooling.
+    While the capture is live, the obs phase/lane annotations are armed
+    (obs/annotate.py) so the trace carries the ``ff.phase/*`` /
+    ``ff.lane/*`` tags ``obs/trace_ingest.py`` matches back to the
+    simulator's predicted lanes."""
     import jax
 
+    from flexflow_tpu.obs import annotate
+
     jax.profiler.start_trace(logdir)
+    annotate.arm()
     try:
         yield
     finally:
+        annotate.disarm()
         jax.profiler.stop_trace()
 
 

@@ -591,6 +591,11 @@ class Simulator:
             plan = getattr(bucket, "plan", None)
             rows.append({
                 "name": bucket.name,
+                # stable lane id — IDENTICAL to this bucket's
+                # comm_schedule record name and to the annotation tag
+                # the executed step stamps (obs/annotate.py), so a
+                # device-trace capture matches by tag equality
+                "lane": f"bucket:{bucket.name}:sync",
                 "ops": list(bucket.ops),
                 "precision": getattr(bucket, "precision", "fp32"),
                 "plan": plan.name if plan is not None else None,

@@ -556,3 +556,90 @@ def test_research_fallback_degrades_to_dp_past_chain_threshold(
     # the DP strategy is immediately swappable
     ctl._swap(0, s)
     ctl.run(X, Y, steps=2)
+
+
+# ---------------------------------------------------------------------------
+# measured-drift triggers (ISSUE 14): serving p99 + device-trace lanes
+def test_p99_drift_fault_triggers_research(tmp_path):
+    """A seeded measured-p99 drift past threshold (the p99_drift fault
+    kind) must trigger a controller re-search with the "p99_drift"
+    trigger — the serve currency joining the calibration-signature
+    watch as a first-class re-search signal."""
+    from flexflow_tpu.obs.events import BUS
+
+    log = str(tmp_path / "obs.jsonl")
+    BUS.configure(log)
+    try:
+        # profiling arms compile's predicted breakdown — the searched
+        # prediction the measured p99 is judged against
+        m = _make_model(profiling=True)
+        assert m.predicted_breakdown is not None
+        X, Y = _data()
+        ctl = TrainingController(
+            m, faults=FaultPlan.parse("p99_drift@2", seed=7))
+        out = ctl.run(X, Y, steps=5)
+        triggers = [d["trigger"] for d in ctl.stats["research_detail"]]
+        assert "p99_drift" in triggers
+        assert ctl.stats["swaps"] >= 1
+        assert all(np.isfinite(h["loss"]) for h in out["history"])
+    finally:
+        BUS.close()
+    events = [json.loads(line) for line in open(log)]
+    p99 = [e for e in events if e["kind"] == "controller.p99_drift"]
+    assert len(p99) == 1 and p99[0]["drifted"] is True
+    assert p99[0]["ratio"] > 1.5  # the seeded draw is 1.5x-3.5x
+    from flexflow_tpu.obs.events import validate_event
+
+    for e in events:
+        assert validate_event(e) == [], e
+    # determinism: the same seed pre-draws the same ratio (the full
+    # controller replay is covered by the calibration-drift e2e test —
+    # no need to pay a second 5-step run here)
+    plan_a = FaultPlan.parse("p99_drift@2", seed=7)
+    plan_b = FaultPlan.parse("p99_drift@2", seed=7)
+    assert plan_a._draws[id(plan_a.faults[0])] == \
+        plan_b._draws[id(plan_b.faults[0])] == pytest.approx(
+            p99[0]["ratio"])
+
+
+def test_observe_p99_below_threshold_is_inert():
+    m = _make_model(profiling=True)
+    ctl = TrainingController(m)
+    pred = m.predicted_breakdown["total_s"]
+    ratio = ctl.observe_p99(pred * 1.1, step=0)
+    assert ratio == pytest.approx(1.1)
+    assert ctl._p99_trigger is None
+    # missing either side declines instead of inventing a ratio
+    assert ctl.observe_p99(0.0, step=0) is None
+
+
+def test_lane_drift_report_triggers_research():
+    """A matched LaneDriftReport with a stale lane (the device-trace
+    measured side) arms a "lane_drift" re-search at the next step
+    boundary; a clean report stays inert, and the SAME report object
+    never fires twice."""
+    from flexflow_tpu.obs.trace_ingest import LaneDriftReport
+
+    m = _make_model(profiling=True)
+    X, Y = _data()
+    ctl = TrainingController(m)
+    clean = LaneDriftReport(
+        steps=2, predicted_total_s=1e-3, measured_step_s=1e-3,
+        threshold=0.5,
+        lanes=[{"lane": "bucket:b0:sync", "matched": True,
+                "sync_frac_ratio": 1.0}])
+    m.lane_drift_report = clean
+    ctl.run(X, Y, steps=2)
+    assert not any(d["trigger"] == "lane_drift"
+                   for d in ctl.stats["research_detail"])
+    drifted = LaneDriftReport(
+        steps=2, predicted_total_s=1e-3, measured_step_s=1e-3,
+        threshold=0.5,
+        lanes=[{"lane": "bucket:b0:sync", "matched": True,
+                "sync_frac_ratio": 9.0}])
+    assert drifted.stale_lanes == ["bucket:b0:sync"]
+    m.lane_drift_report = drifted
+    ctl.run(X, Y, steps=3)
+    lane_triggers = [d for d in ctl.stats["research_detail"]
+                     if d["trigger"] == "lane_drift"]
+    assert len(lane_triggers) == 1  # consumed once, not every step

@@ -52,6 +52,52 @@ def test_event_bus_off_by_default_and_cheap():
     assert elapsed < 1.0, f"disabled emit too slow: {elapsed:.3f}s"
 
 
+def test_decode_request_spans_one_bus_check_per_frame(monkeypatch):
+    """The off-by-default contract on the decode hot path: with
+    FLEXFLOW_TPU_OBS unset, request-span instrumentation must cost
+    exactly one ``BUS.enabled`` read per frame (plus one per submit
+    batch and one at run end) — no per-slot stamps, no histogram
+    traffic, no lifecycle records."""
+    from flexflow_tpu.runtime import decode as decode_mod
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+    )
+
+    class CountingBus:
+        def __init__(self):
+            self.reads = 0
+
+        @property
+        def enabled(self):
+            self.reads += 1
+            return False
+
+        def emit(self, *a, **k):  # pragma: no cover — enabled is False
+            raise AssertionError("emit while disabled")
+
+    bus = CountingBus()
+    monkeypatch.setattr(decode_mod, "BUS", bus)
+
+    def step(ids, table, lens):
+        b = np.asarray(ids).shape[0]
+        logits = np.zeros((b, 1, 7), np.float32)
+        logits[:, 0, 3] = 1.0
+        return logits
+
+    ex = ContinuousBatchingExecutor(step, max_seqs=2, page_size=4,
+                                    pages_per_seq=2)
+    ex.run([DecodeRequest(rid=f"r{i}", prompt=[1, 2], max_new_tokens=2)
+            for i in range(3)], max_frames=50)
+    frames = ex.frame
+    # one read per frame + one per submit batch + one at run end
+    assert bus.reads <= frames + 2, (bus.reads, frames)
+    # and none of the span machinery ran
+    assert ex.request_records == []
+    assert ex._enqueue_t == {}
+    assert all(s is None for s in ex.slots)
+
+
 def test_event_bus_jsonl_sink_and_schema(tmp_path):
     bus = EventBus()
     path = str(tmp_path / "log.jsonl")

@@ -16,6 +16,8 @@ log file lands.
 Usage:
   ffobs.py report <log.jsonl> [--top N]   strategy-explanation report
   ffobs.py validate <log.jsonl>           schema-check every line
+  ffobs.py metrics <log.jsonl>            Prometheus text from the
+                                          last metrics.snapshot event
 """
 
 from __future__ import annotations
@@ -372,16 +374,20 @@ def render_report(events: List[dict], top: int = 10,
                 )
         buckets = d.get("sync_buckets") or []
         if buckets:
+            measured_any = any(
+                b.get("measured_s") is not None for b in buckets)
             lines.append("")
             lines.append(
-                "Sync-schedule buckets (predicted lanes; the executed "
-                "step is one fused program, so the overlap claim is "
-                "verified by the scheduled-vs-monolithic measured step "
-                "delta, not per-bucket host timers):")
+                "Sync-schedule buckets (predicted lanes"
+                + (", measured side from a tag-matched device-trace "
+                   "capture)" if measured_any else
+                   "; measured side None until a device_trace capture "
+                   "is tag-matched — obs/trace_ingest.py):"))
             lines.append(
                 "| bucket | groups | precision | plan | issue-ready ms | "
-                "sync ms | exposed ms | per-level ms |")
-            lines.append("|---|---|---|---|---|---|---|---|")
+                "sync ms | exposed ms | measured issue ms | "
+                "measured sync ms | per-level ms |")
+            lines.append("|---|---|---|---|---|---|---|---|---|---|")
             for b in buckets:
                 lv = b.get("predicted_levels_s") or {}
                 lv_cell = " ".join(
@@ -393,6 +399,8 @@ def render_report(events: List[dict], top: int = 10,
                     f"{_ms(b.get('predicted_ready_s'))} | "
                     f"{_ms(b.get('predicted_sync_s'))} | "
                     f"{_ms(b.get('predicted_exposed_s'))} | "
+                    f"{_ms(b.get('measured_issue_s'))} | "
+                    f"{_ms(b.get('measured_s'))} | "
                     f"{lv_cell} |")
         # only the aggregate step has both sides (single-sided phases
         # carry no ratio by design); rank the measured host phases by
@@ -408,6 +416,44 @@ def render_report(events: List[dict], top: int = 10,
             lines.append(
                 f"Largest measured phase: {k!r} at {_ms(v)} ms "
                 f"({v / measured:.0%} of the step)")
+    # ---- measured lanes: device-trace ingestion + tag matching ------------
+    ingests = [e for e in events if e.get("kind") == "trace.ingest"]
+    matches = [e for e in events if e.get("kind") == "trace.lane_match"]
+    if ingests or matches:
+        lines.append("")
+        lines.append("## Measured lanes (device-trace capture)")
+        lines.append("")
+        if ingests:
+            i = ingests[-1]
+            lines.append(
+                f"Ingested {i.get('path')}: {i.get('events')} trace "
+                f"events, {i.get('lanes')} annotated lane(s), "
+                f"{i.get('steps')} step window(s)")
+        if matches:
+            matched = sum(1 for e in matches if e.get("matched"))
+            lines.append(
+                f"Lane matching (by annotation tag, never kernel "
+                f"names): {matched}/{len(matches)} predicted sync "
+                f"lanes matched")
+            lines.append(
+                "| lane | matched | samples | predicted sync ms | "
+                "measured sync ms | sync-share ratio |")
+            lines.append("|---|---|---|---|---|---|")
+            for e in matches:
+                r = e.get("sync_frac_ratio")
+                lines.append(
+                    f"| {e.get('lane')} | "
+                    f"{'yes' if e.get('matched') else 'NO'} | "
+                    f"{e.get('samples', 0)} | "
+                    f"{_ms(e.get('predicted_sync_s'))} | "
+                    f"{_ms(e.get('measured_sync_s'))} | "
+                    f"{f'{r:.3f}' if isinstance(r, (int, float)) else '—'} |")
+            lines.append(
+                "(sync-share ratio: each side's lane duration as a "
+                "fraction of its own step — the scale-free drift "
+                "signal a host-clock capture supports; ICI/DCN wire "
+                "behavior stays simulated until a TPU capture)")
+
     # ---- serving: serve-objective result + decode executor phase ---------
     serves = [e for e in events if e.get("kind") == "search.serve"]
     if serves:
@@ -438,6 +484,27 @@ def render_report(events: List[dict], top: int = 10,
                 f"{_ms(s.get('measured_p99_s'))} ms"
                 + (f"; predicted {_ms(s.get('predicted_step_s'))} ms"
                    if s.get("predicted_step_s") else ""))
+            if s.get("requests_recorded"):
+                lines.append(
+                    f"Per-request telemetry ({s['requests_recorded']} "
+                    f"completions): TTFT p50 {_ms(s.get('ttft_p50_s'))} "
+                    f"/ p99 {_ms(s.get('ttft_p99_s'))} ms, TPOT p50 "
+                    f"{_ms(s.get('tpot_p50_s'))} / p99 "
+                    f"{_ms(s.get('tpot_p99_s'))} ms, e2e p99 "
+                    f"{_ms(s.get('e2e_p99_s'))} ms, queue wait p99 "
+                    f"{_ms(s.get('queue_p99_s'))} ms")
+        requests = [e for e in events if e.get("kind") == "decode.request"]
+        if requests:
+            lines.append("")
+            lines.append("| request | tokens | frames | queue ms | "
+                         "TTFT ms | TPOT ms | e2e ms |")
+            lines.append("|---|---|---|---|---|---|---|")
+            for e in requests[-8:]:  # tail; the full stream is JSONL
+                lines.append(
+                    f"| {e.get('rid')} | {e.get('tokens')} | "
+                    f"{e.get('frames')} | {_ms(e.get('queue_s'))} | "
+                    f"{_ms(e.get('ttft_s'))} | {_ms(e.get('tpot_s'))} | "
+                    f"{_ms(e.get('e2e_s'))} |")
         if frames:
             admitted = sum(e.get("admitted") or 0 for e in frames)
             evicted = sum(e.get("evicted") or 0 for e in frames)
@@ -516,6 +583,16 @@ def render_report(events: List[dict], top: int = 10,
         for e in fallbacks:
             lines.append(
                 f"Fallback at step {e.get('step')}: {e.get('reason')}")
+    p99s = [e for e in events if e.get("kind") == "controller.p99_drift"]
+    for e in p99s:
+        r = e.get("ratio")
+        lines.append(
+            f"Serving p99 watch at step {e.get('step')}: measured "
+            f"{_ms(e.get('measured_s'))} ms vs searched "
+            f"{_ms(e.get('predicted_s'))} ms "
+            f"(ratio {f'{r:.2f}' if isinstance(r, (int, float)) else '—'})"
+            + (" — DRIFTED, re-search triggered" if e.get("drifted")
+               else ""))
 
     stale = [e for e in events if e.get("kind") == "calibration.staleness"]
     if stale:
@@ -547,6 +624,28 @@ def cmd_report(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Render the newest ``metrics.snapshot`` event of a JSONL log in
+    Prometheus text format — the offline twin of the live
+    ``FLEXFLOW_TPU_METRICS_PORT`` endpoint (obs/exposition.py)."""
+    from flexflow_tpu.obs.exposition import render_prometheus
+
+    events = read_events(args.log)
+    snaps = [e for e in events if e.get("kind") == "metrics.snapshot"]
+    if not snaps:
+        print(f"{args.log}: no metrics.snapshot event "
+              f"(call METRICS.emit_snapshot() with the bus armed)",
+              file=sys.stderr)
+        return 1
+    snap = snaps[-1]
+    sys.stdout.write(render_prometheus({
+        "counters": snap.get("counters") or {},
+        "gauges": snap.get("gauges") or {},
+        "histograms": snap.get("histograms") or {},
+    }))
+    return 0
+
+
 def cmd_validate(args) -> int:
     from flexflow_tpu.obs.events import validate_event
 
@@ -575,6 +674,11 @@ def main(argv=None) -> int:
     p_val = sub.add_parser("validate", help="schema-check every event line")
     p_val.add_argument("log")
     p_val.set_defaults(fn=cmd_validate)
+    p_met = sub.add_parser(
+        "metrics", help="render the last metrics.snapshot event as "
+                        "Prometheus text (offline exposition)")
+    p_met.add_argument("log")
+    p_met.set_defaults(fn=cmd_metrics)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -52,6 +52,7 @@ from flexflow_tpu.analysis.placement import (
     placement_meta,
 )
 from flexflow_tpu.analysis.sharding import (
+    lint_disaggregation,
     lint_reduction_plan,
     lint_serving,
     lint_strategy,
@@ -72,6 +73,7 @@ __all__ = [
     "scoped_verify",
     "set_verify",
     "verification_enabled",
+    "lint_disaggregation",
     "lint_pipeline_stages",
     "lint_placement",
     "lint_reduction_plan",

@@ -109,7 +109,7 @@ import math
 
 from typing import Dict, List, Optional
 
-from flexflow_tpu.analysis.findings import Finding
+from flexflow_tpu.analysis.findings import Finding, errors_only
 
 
 def _f(code: str, message: str, **kw) -> Finding:
@@ -681,4 +681,177 @@ def lint_serving(graph, strategy: Dict[int, object], serving,
             f"({predicted_p99_s * 1e3:.3f} ms) exceeds the declared "
             f"SLO budget ({serving.p99_budget_ms:.3f} ms)",
             severity="warn"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode disaggregation legality (SHD164/165)
+# ---------------------------------------------------------------------------
+def lint_disaggregation(decode_graph, meta, config, prefill_graph=None,
+                        prefill_strategy=None, decode_strategy=None,
+                        ) -> List[Finding]:
+    """Legality of a disaggregation proposal/artifact
+    (``__meta__.disaggregation``, search/disaggregation.py) against the
+    decode graph it targets — the always-on gate at proposal time and
+    the re-lint at import:
+
+    * **SHD164** two-block structure: positive prefill/decode block
+      widths that are disjoint and fit the machine; a chunk size >= 1;
+      the decode graph actually HAS decode-attention ops (and the
+      prefill graph, when available, has none — a decode op on the
+      prefill block would drag the page pool across the cut).
+    * **SHD165** handoff coherence: the persisted pool geometry
+      (max_seqs, page_size, pages_per_seq) matches every decode op's
+      own attrs — ONE allocator's pages cross the boundary, so the
+      writer and the reader must agree on the frame; the prefill graph
+      shares one parameter set with the decode graph
+      (``prefill_weight_bridge``); the SLO-class table is structurally
+      sound (unique names, non-negative deadlines, quantiles in
+      (0, 1)).
+
+    When per-phase strategies are supplied (proposal time), each block
+    additionally passes the flat SHD101-110 lint under ITS OWN submesh
+    width — the same per-segment discipline as ``lint_placement``."""
+    from flexflow_tpu.search.serving import decode_nodes
+
+    def _d(code, message, **kw):
+        return Finding(code=code, pass_name="disaggregation",
+                       message=message, **kw)
+
+    findings: List[Finding] = []
+    if not isinstance(meta, dict):
+        return [_d("SHD164", "disaggregation meta is not an object")]
+    nodes = decode_nodes(decode_graph)
+    if not nodes:
+        findings.append(_d(
+            "SHD164",
+            "disaggregation artifact targets a graph with no "
+            "decode-attention ops — there is no decode phase to "
+            "disaggregate"))
+    try:
+        a = int(meta.get("prefill_devices", 0))
+        b = int(meta.get("decode_devices", 0))
+        chunk = int(meta.get("chunk", 0))
+    except (TypeError, ValueError):
+        return findings + [_d(
+            "SHD164",
+            f"disaggregation meta has non-integer block/chunk fields "
+            f"({meta.get('prefill_devices')!r}, "
+            f"{meta.get('decode_devices')!r}, {meta.get('chunk')!r})")]
+    n = getattr(config, "search_devices", 0) or config.num_devices
+    if a < 1 or b < 1:
+        findings.append(_d(
+            "SHD164",
+            f"disaggregation blocks must both be non-empty "
+            f"(prefill={a}, decode={b})"))
+    elif a + b > n:
+        findings.append(_d(
+            "SHD164",
+            f"disaggregation blocks overflow the machine: prefill {a} "
+            f"+ decode {b} devices on a {n}-device mesh"))
+    if chunk < 1:
+        findings.append(_d(
+            "SHD164",
+            f"prefill chunk must be >= 1, got {chunk!r}"))
+    if prefill_graph is not None and decode_nodes(prefill_graph):
+        findings.append(_d(
+            "SHD164",
+            "prefill graph carries decode-attention ops — the page "
+            "pool would live on BOTH sides of the cut"))
+
+    # SHD165: pool geometry must agree across the handoff
+    geo = (meta.get("max_seqs"), meta.get("page_size"),
+           meta.get("pages_per_seq"))
+    for node in nodes:
+        got = (node.op.max_seqs, node.op.attrs["page_size"],
+               node.op.attrs["pages_per_seq"])
+        if got != geo:
+            findings.append(_d(
+                "SHD165",
+                f"decode op {node.op.name!r} frame geometry {got} "
+                f"disagrees with the persisted handoff geometry {geo} "
+                f"— the prefill writer and the decode reader would "
+                f"index different pools",
+                node=node.guid, op=node.op.name))
+    # shared-parameter-set bridge: proven on the META-ONLY path (import
+    # re-lint — derive the prompt twin from the decode graph itself).
+    # At proposal time the bridge was already proven on the ORIGINAL
+    # graph pair before any block search ran; the block solves may
+    # rewrite op names, so re-bridging rewritten block graphs here
+    # would manufacture false mismatches.
+    if (prefill_strategy is None and decode_strategy is None and nodes
+            and prefill_graph is None):
+        try:
+            from flexflow_tpu.models.decode import derive_prefill_model
+            from flexflow_tpu.runtime.prefill import (
+                prefill_weight_bridge,
+            )
+
+            twin = derive_prefill_model(
+                decode_graph, config,
+                seq_len=int(meta.get("prefill_seq_len") or 1),
+            )[0].graph
+            prefill_weight_bridge(twin, decode_graph)
+        except ValueError as e:
+            findings.append(_d(
+                "SHD165",
+                f"prefill and decode graphs do not share one parameter "
+                f"set: {e}"))
+        except Exception as e:
+            findings.append(_d(
+                "SHD165",
+                f"cannot derive the prefill twin of this decode graph "
+                f"({e}) — the shared-parameter-set contract is "
+                f"unprovable"))
+    classes = meta.get("slo_classes", [])
+    if not isinstance(classes, list):
+        findings.append(_d(
+            "SHD165", f"slo_classes is not a list: {classes!r}"))
+    else:
+        seen = set()
+        for i, c in enumerate(classes):
+            if not isinstance(c, dict) or not c.get("name") \
+                    or not isinstance(c.get("name"), str):
+                findings.append(_d(
+                    "SHD165",
+                    f"slo_classes[{i}] is not a named class object"))
+                continue
+            if c["name"] in seen:
+                findings.append(_d(
+                    "SHD165",
+                    f"slo_classes[{i}] duplicates {c['name']!r}"))
+            seen.add(c["name"])
+            if not isinstance(c.get("priority", 0), int) \
+                    or isinstance(c.get("priority", 0), bool):
+                findings.append(_d(
+                    "SHD165",
+                    f"slo class {c['name']!r} priority is not an int"))
+            df = c.get("deadline_frames", 0)
+            if not isinstance(df, int) or isinstance(df, bool) or df < 0:
+                findings.append(_d(
+                    "SHD165",
+                    f"slo class {c['name']!r} deadline_frames {df!r} "
+                    f"is not a non-negative int"))
+            q = c.get("quantile", 0.99)
+            if not isinstance(q, (int, float)) or isinstance(q, bool) \
+                    or not (0.0 < float(q) < 1.0):
+                findings.append(_d(
+                    "SHD165",
+                    f"slo class {c['name']!r} quantile {q!r} outside "
+                    f"(0, 1)"))
+
+    # per-block flat lint (proposal time only — imports carry no
+    # per-phase strategies): each phase compiles over its OWN submesh,
+    # so its views must pass the gate in that geometry
+    if not errors_only(findings):
+        from flexflow_tpu.compiler.placement_lowering import _strip_start
+
+        for graph, strategy, width in (
+                (prefill_graph, prefill_strategy, a),
+                (decode_graph, decode_strategy, b)):
+            if graph is None or strategy is None:
+                continue
+            stripped = {g: _strip_start(mv)
+                        for g, mv in strategy.items() if mv is not None}
+            findings += lint_strategy(graph, stripped, width)
     return findings

@@ -15,6 +15,46 @@ from typing import Dict, Optional, Sequence, Tuple
 from flexflow_tpu.core.machine import MachineSpec
 
 
+def parse_slo_classes(value) -> Tuple[Dict, ...]:
+    """Normalize the SLO-class table: the CLI spelling
+    ``"name:priority:deadline_frames[:quantile][,...]"`` or an iterable
+    of dicts -> a tuple of ``{"name", "priority", "deadline_frames",
+    "quantile"}`` dicts (runtime/decode.py ``SLOClass`` consumes them;
+    the winning disaggregation persists them in ``__meta__``)."""
+    if isinstance(value, str):
+        classes = []
+        for part in value.split(","):
+            fields = part.split(":")
+            if len(fields) not in (3, 4):
+                raise ValueError(
+                    f"SLO class {part!r} must be "
+                    f"name:priority:deadline_frames[:quantile]")
+            classes.append({
+                "name": fields[0],
+                "priority": int(fields[1]),
+                "deadline_frames": int(fields[2]),
+                "quantile": float(fields[3]) if len(fields) == 4 else 0.99,
+            })
+        value = classes
+    out = []
+    seen = set()
+    for c in value:
+        c = {"name": str(c["name"]), "priority": int(c["priority"]),
+             "deadline_frames": int(c.get("deadline_frames", 0)),
+             "quantile": float(c.get("quantile", 0.99))}
+        if not c["name"] or c["name"] in seen:
+            raise ValueError(
+                f"SLO class names must be unique and non-empty "
+                f"(got {c['name']!r})")
+        if c["deadline_frames"] < 0 or not (0.0 < c["quantile"] < 1.0):
+            raise ValueError(
+                f"SLO class {c['name']!r}: deadline_frames must be >= 0 "
+                f"and quantile in (0, 1)")
+        seen.add(c["name"])
+        out.append(c)
+    return tuple(out)
+
+
 def parse_slice_levels(value) -> Tuple[Tuple[int, float, float], ...]:
     """Normalize a slice-level hierarchy: the CLI spelling
     ``"span:bw:lat[,span:bw:lat...]"`` or an iterable of (span,
@@ -129,6 +169,31 @@ class FFConfig:
     # objective (--serve-p99-budget-ms): recorded in __meta__.serving
     # and linted (SHD163 warns when the predicted p99 exceeds it);
     # 0 = no declared budget (rank-only)
+    serve_disaggregation: str = "off"  # "off" | "search" — under
+    # objective="serve", compile() additionally searches a
+    # PREFILL/DECODE DISAGGREGATION (search/disaggregation.py): the
+    # prompt graph and the decode graph placed on disjoint submeshes as
+    # a two-block placement, the KV-page handoff priced as a
+    # cross-block transfer and the serve load split per phase
+    # (prefill = compute-bound arrivals, decode = p99 token load); a
+    # margin-beating winner is lint-gated (SHD164/165) and persists as
+    # __meta__.disaggregation (fflint STR211).  "off" (default) is
+    # byte-identical to history.
+    prefill_chunk: int = 32  # chunk size of the batched prefill lane
+    # (runtime/prefill.py, --prefill-chunk): the prompt's causal
+    # forward runs once per this many tokens and scatters K/V straight
+    # into the page pool, instead of one decode frame per prompt token;
+    # recorded in __meta__.disaggregation.  Must be >= 1.
+    serve_prompt_tokens_mean: int = 0  # phase-split arrival model
+    # (ServingSpec.prefill_tokens_per_frame): mean prompt length of the
+    # arrival stream; 0 derives max_seq_len // 2
+    serve_decode_tokens_mean: int = 0  # mean generated tokens per
+    # request (slot turnover rate); 0 derives max_seq_len // 4
+    serve_slo_classes: Optional[object] = None  # request SLO classes
+    # (--serve-slo-classes "name:priority:deadline_frames[:quantile],
+    # ..."): priority admission / deadline expiry / preemption on the
+    # executor's page allocator (runtime/decode.py SLOClass), per-class
+    # p99 windows, persisted with the disaggregation meta
     comp_mode: str = "training"  # "training" | "inference" — set by
     # compile(comp_mode=...); inference searches rank strategies by
     # forward latency with no weight sync (reference:
@@ -263,6 +328,18 @@ class FFConfig:
             raise ValueError(
                 f"objective must be train|serve, got {self.objective!r}"
             )
+        if self.serve_disaggregation not in ("off", "search"):
+            raise ValueError(
+                f"serve_disaggregation must be off|search, got "
+                f"{self.serve_disaggregation!r}"
+            )
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {self.prefill_chunk}"
+            )
+        if self.serve_slo_classes is not None:
+            self.serve_slo_classes = parse_slo_classes(
+                self.serve_slo_classes)
         if self.objective == "serve" and self.co_search:
             # the joint pricer's exposed-comm currency is a TRAINING
             # currency (weight-grad sync plans); mixing it with the
@@ -404,6 +481,27 @@ class FFConfig:
                        help="declared p99 SLO for objective=serve "
                             "(recorded in __meta__.serving, linted "
                             "SHD163); 0 = rank-only")
+        p.add_argument("--serve-disaggregation",
+                       dest="serve_disaggregation",
+                       choices=("off", "search"), default="off",
+                       help="under objective=serve, also search a "
+                            "prefill/decode disaggregation: prompt and "
+                            "decode graphs on disjoint submeshes, the "
+                            "KV handoff priced as a cross-block "
+                            "transfer (search/disaggregation.py)")
+        p.add_argument("--prefill-chunk", dest="prefill_chunk",
+                       type=int, default=32,
+                       help="chunk size of the batched prefill lane "
+                            "(runtime/prefill.py): prompt tokens "
+                            "written into the KV page pool per causal "
+                            "forward pass")
+        p.add_argument("--serve-slo-classes", dest="serve_slo_classes",
+                       type=str, default=None,
+                       help="request SLO classes for the serving "
+                            "executor: comma list of name:priority:"
+                            "deadline_frames[:quantile] — priority "
+                            "admission, deadline expiry, preemption "
+                            "(runtime/decode.py)")
         p.add_argument("--obs-log", dest="obs_log", type=str, default=None,
                        help="JSONL structured-event telemetry sink "
                             "(flexflow_tpu/obs; tools/ffobs.py renders it)")
@@ -476,6 +574,9 @@ class FFConfig:
             sync_ef=args.sync_ef,
             objective=args.objective,
             serve_p99_budget_ms=args.serve_p99_budget_ms,
+            serve_disaggregation=args.serve_disaggregation,
+            prefill_chunk=args.prefill_chunk,
+            serve_slo_classes=args.serve_slo_classes,
             obs_log_file=args.obs_log,
             obs_trace_file=args.obs_trace,
             device_trace_dir=args.device_trace_dir,

@@ -58,6 +58,8 @@ class FFModel:
         self.strategy = None  # chosen parallelization, set by compile()
         self.pipeline_proposal = None  # staged-pipeline candidate for
         # graphs the stacked executor can't run (StagedPipelineProposal)
+        self.disaggregation = None  # prefill/decode disaggregation
+        # proposal, set by compile() under the serve objective
         self.params = None
         self.opt_state = None
         self.state = None
@@ -450,6 +452,10 @@ class FFModel:
             _obs_bus.configure(self.config.obs_log_file)
         self.pipeline_proposal = None  # a stale proposal from an earlier
         # compile must not hijack this one's lowering
+        self.disaggregation = None  # prefill/decode disaggregation
+        # proposal (search/disaggregation.py DisaggregationProposal):
+        # searched under objective="serve" +
+        # serve_disaggregation="search", persisted when adopted
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
@@ -600,6 +606,23 @@ class FFModel:
                         raise AnalysisError(
                             "imported serving provenance is illegal for "
                             "this graph/strategy", bad)
+                if _imeta.get("disaggregation") is not None:
+                    # imported disaggregation provenance re-lints
+                    # against THIS graph (SHD164/165): the persisted
+                    # pool geometry must agree with the target's decode
+                    # ops and the shared-parameter-set bridge must
+                    # still hold — a hand-edited or re-targeted
+                    # artifact fails with findings at import
+                    from flexflow_tpu.analysis import lint_disaggregation
+
+                    bad = errors_only(lint_disaggregation(
+                        self.graph, _imeta["disaggregation"],
+                        self.config))
+                    if bad:
+                        emit_findings(bad)
+                        raise AnalysisError(
+                            "imported disaggregation proposal is "
+                            "illegal for this graph", bad)
                 if _imeta.get("pipeline") is not None:
                     from flexflow_tpu.analysis import (
                         Finding,
@@ -708,6 +731,10 @@ class FFModel:
                 # (convert_graph_to_operators, substitution.cc:3014)
                 from flexflow_tpu.search.driver import optimize_strategy
 
+                # the pre-search graph: the disaggregation proposal's
+                # narrow-block solves run on it (rewrites bake
+                # full-mesh repartition views narrow blocks can't host)
+                _disagg_base_graph = self.graph
                 best_graph, strategy = optimize_strategy(
                     self.graph, self.config, return_graph=True
                 )
@@ -810,6 +837,34 @@ class FFModel:
         # the chosen strategy is public state: tooling (bench_search,
         # strategy introspection) reads it back after compile
         self.strategy = strategy
+        # prefill/decode disaggregation (search/disaggregation.py):
+        # under the serve objective, also price placing the prompt
+        # graph and this decode graph on disjoint submeshes — the
+        # two-block placement with the KV handoff as a cross-block
+        # transfer.  The proposal (adopted or honest zero) is public
+        # state; adopted winners persist as __meta__.disaggregation.
+        if (
+            searched_strategy
+            and strategy
+            and pipeline is None
+            and mesh is None
+            and comp_mode == "inference"
+            and getattr(self.config, "objective", "train") == "serve"
+            and getattr(self.config, "serve_disaggregation", "off")
+            == "search"
+        ):
+            from flexflow_tpu.search.disaggregation import (
+                propose_disaggregation,
+            )
+            from flexflow_tpu.search.driver import coherent_calibration
+
+            self.disaggregation = propose_disaggregation(
+                self.graph, strategy, self.config,
+                calibration=coherent_calibration(self.config),
+                base_graph=(_disagg_base_graph
+                            if _disagg_base_graph is not self.graph
+                            else None),
+            )
         # sync-precision dimension of the strategy (EQuARX compressed
         # gradient collectives): build the per-weight-group wire map
         # with the SAME cost model the search ranked with, so execution
@@ -1087,6 +1142,15 @@ class FFModel:
 
                 if _sdriver.LAST_SERVING_META:
                     _meta["serving"] = dict(_sdriver.LAST_SERVING_META)
+                if (self.disaggregation is not None
+                        and self.disaggregation.adopted):
+                    # the ADOPTED two-block prefill/decode placement
+                    # (search/disaggregation.py — already SHD164/165
+                    # gated at proposal); import re-lints against the
+                    # target graph, fflint checks the frame stdlib-only
+                    # (STR211).  Honest zeros persist nothing.
+                    _meta["disaggregation"] = \
+                        self.disaggregation.to_meta()
             # pipeline/placement proposals persist NEXT to the strategy
             # behind the same digest gate (the lint already gated them
             # at proposal time; fflint strategy re-checks the frame

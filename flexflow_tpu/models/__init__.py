@@ -16,6 +16,7 @@ from flexflow_tpu.models.decode import (
     SERVE_FRAME_SLOTS,
     build_gpt_decode,
     build_gpt_prefill,
+    derive_prefill_model,
 )
 from flexflow_tpu.models.dlrm import build_dlrm
 from flexflow_tpu.models.xdl import build_xdl
@@ -35,6 +36,7 @@ __all__ = [
     "build_gpt",
     "build_gpt_decode",
     "build_gpt_prefill",
+    "derive_prefill_model",
     "build_gpt_xl",
     "GPT_DECODE_KW",
     "GPT_DECODE_SERVE_KW",

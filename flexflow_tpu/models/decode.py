@@ -116,12 +116,65 @@ def build_gpt_prefill(config: FFConfig, vocab: int = 2048,
     """The prompt-phase graph: the causal GPT forward at prompt length
     (compute-bound, seq-parallelizable — the training-side strategy
     machinery applies unchanged).  Searched under
-    ``comp_mode="inference"`` it ranks by forward latency; cache
-    POPULATION is the executor's job (runtime/decode.py admits prompts
-    token-by-token through the decode graph on the CPU mesh — a
-    chunked-prefill writer is the on-TPU follow-up, ROADMAP item 4)."""
+    ``comp_mode="inference"`` it ranks by forward latency.  Cache
+    POPULATION runs through the chunked-prefill lane
+    (runtime/prefill.py): the prompt's causal forward once per chunk,
+    K/V scattered straight into the page pool, token-identical to the
+    prefill-via-decode fallback.  This graph is also what the
+    DISAGGREGATION search places on its own submesh
+    (search/disaggregation.py) — ``prefill_weight_bridge`` proves its
+    parameter set corresponds weight-for-weight to the decode
+    graph's."""
     from flexflow_tpu.models.transformer import build_gpt
 
     return build_gpt(config, vocab=vocab, num_layers=num_layers,
                      hidden=hidden, num_heads=num_heads, ff_dim=ff_dim,
                      seq_len=seq_len)
+
+
+def derive_prefill_model(decode_graph, config, seq_len: int):
+    """Build the prefill twin of an existing DECODE graph by reading
+    the family widths off the graph itself (vocab/hidden from the
+    token embedding, heads/embed from the decode ops, ff width from
+    the FFN denses) — the disaggregation search derives the prompt
+    graph it places from the deployment's own decode graph instead of
+    trusting a caller to pass a matching one.  Returns ``(model,
+    prefill_config)``; the prefill config prices one prompt at a time
+    (batch 1 — the chunked lane's per-sequence pass), everything else
+    inherited.  ``prefill_weight_bridge`` (runtime/prefill.py) then
+    proves the two graphs share one parameter set."""
+    import dataclasses
+
+    from flexflow_tpu.core.optype import OperatorType
+    from flexflow_tpu.runtime.prefill import prefill_io_nodes
+
+    tok_guid, _, _ = prefill_io_nodes(decode_graph)
+    dec_ops = [n.op for n in decode_graph.topo_order()
+               if n.op.op_type == OperatorType.DECODE_ATTENTION]
+    tok_embed = next(
+        n.op for n in decode_graph.topo_order()
+        if n.op.op_type == OperatorType.EMBEDDING
+        and any(e.src == tok_guid
+                for e in decode_graph.in_edges[n.guid]))
+    vocab = tok_embed.attrs["num_entries"]
+    hidden = tok_embed.attrs["out_dim"]
+    first = dec_ops[0]
+    num_heads = first.attrs["num_heads"]
+    # ff1 is the dense that feeds another dense DIRECTLY (ff1 -> ff2);
+    # out_dim sets can't disambiguate it — ff_dim may collide with
+    # vocab or hidden
+    ff_dim = hidden
+    for n in decode_graph.topo_order():
+        if n.op.op_type != OperatorType.LINEAR:
+            continue
+        feeds_dense = any(
+            decode_graph.nodes[e.dst].op.op_type == OperatorType.LINEAR
+            for e in decode_graph.out_edges[n.guid])
+        if feeds_dense:
+            ff_dim = n.op.attrs["out_dim"]
+            break
+    cfg = dataclasses.replace(config, batch_size=1)
+    model = build_gpt_prefill(
+        cfg, vocab=vocab, num_layers=len(dec_ops), hidden=hidden,
+        num_heads=num_heads, ff_dim=ff_dim, seq_len=seq_len)
+    return model, cfg

@@ -45,6 +45,7 @@ LANE_PREFIX = "ff.lane/"
 PHASE_PREFIX = "ff.phase/"
 STEP_PHASE = PHASE_PREFIX + "step"
 DECODE_PHASE = PHASE_PREFIX + "decode_frame"
+PREFILL_PHASE = PHASE_PREFIX + "prefill_chunk"
 ISSUE_MARK = "#issue"
 DONE_MARK = "#done"
 

@@ -63,6 +63,12 @@ EVENT_KINDS = {
     # serve-objective result (search/serving.py, FFConfig.objective):
     # the SHD16x-gated p99/KV-residency numbers of the returned strategy
     "search.serve": {"p99_s", "kv_bytes_per_device"},
+    # prefill/decode disaggregation search (search/disaggregation.py):
+    # one event per proposal decision — colocated vs disaggregated
+    # serve-currency step, the KV-handoff price, and whether the
+    # two-block placement was adopted (honest zero = adopted=False)
+    "search.disagg": {"adopted", "colocated_ms", "disagg_ms",
+                      "handoff_ms"},
     # continuous-batching decode executor (runtime/decode.py): one
     # event per composed decode frame (admissions/evictions/page
     # residency + measured latency, predicted_s when a serving pricer
@@ -78,6 +84,10 @@ EVENT_KINDS = {
     # request-level currency of the serving telemetry.  Armed requests
     # only: the executor checks the bus ONCE per frame when off.
     "decode.request": {"rid", "phase"},
+    # chunked prefill lane (runtime/prefill.py): one event per admitted
+    # prompt that went through the batched KV writer — tokens written,
+    # chunk passes paid (vs one decode frame per token without it)
+    "decode.prefill": {"rid", "tokens", "chunks"},
     # device-trace ingestion + lane matching (obs/trace_ingest.py):
     # one trace.ingest per parsed capture, one trace.lane_match per
     # predicted sync-bucket lane (matched by annotation tag, never by

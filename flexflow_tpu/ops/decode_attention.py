@@ -220,6 +220,71 @@ class DecodeAttentionOp(Operator):
                        preferred_element_type=jnp.float32)
         return [y[:, None, :].astype(hidden.dtype)]
 
+    # ---- chunked prefill lowering ---------------------------------------
+    def forward_chunk(self, ctx: LoweringContext, inputs, weights):
+        """The CHUNKED-PREFILL twin of ``forward``: C prompt tokens per
+        sequence in ONE pass instead of one decode frame each.  Inputs:
+
+        * hidden    [B, C, E] — the chunk's token embeddings
+        * page_table [B, pages_per_seq]
+        * positions [B, C] int32 — each token's absolute cache position
+          (the caller clamps pad positions into the sequence's own
+          allotment; a pad write is overwritten by the decode loop
+          before any frame reads it, so no masking is needed)
+
+        Scatters all C tokens' K/V into the page pool and attends each
+        query against cache prefix + intra-chunk causal — the same
+        dtype discipline as ``forward`` (projections in the compute
+        dtype, cache and softmax in fp32), so the populated cache is
+        numerically the one the token-by-token path writes
+        (runtime/prefill.py proves token identity end-to-end)."""
+        import jax
+
+        from flexflow_tpu.kernels.ragged_paged_attention import (
+            NEG_INF,
+            gather_kv_pages,
+        )
+
+        a = self.attrs
+        hidden, page_table, positions = inputs
+        page_table = page_table.astype(jnp.int32)
+        positions = positions.astype(jnp.int32)
+        cd = ctx.compute_dtype
+        x = hidden.astype(cd)  # [B, C, E]
+        wq, wk, wv, wo = (weights[n].astype(cd)
+                          for n in ("wq", "wk", "wv", "wo"))
+        q = jnp.einsum("bce,ehd->bchd", x, wq)
+        k_new = jnp.einsum("bce,ehd->bchd", x, wk).astype(jnp.float32)
+        v_new = jnp.einsum("bce,ehd->bchd", x, wv).astype(jnp.float32)
+
+        ps = a["page_size"]
+        k_cache = ctx.state_in[f"{self.name}/k_cache"]
+        v_cache = ctx.state_in[f"{self.name}/v_cache"]
+        slot = positions % ps  # [B, C]
+        page_idx = jnp.minimum(positions // ps, a["pages_per_seq"] - 1)
+        page = jnp.take_along_axis(page_table, page_idx, axis=1)  # [B, C]
+        k_cache = k_cache.at[page, slot].set(k_new)
+        v_cache = v_cache.at[page, slot].set(v_new)
+        ctx.state_out[f"{self.name}/k_cache"] = k_cache
+        ctx.state_out[f"{self.name}/v_cache"] = v_cache
+
+        # each chunk query attends to every cached position <= its own:
+        # the prefix written by earlier chunks plus the intra-chunk
+        # causal triangle (this chunk's K/V are already in the pool)
+        scale = 1.0 / math.sqrt(self.head_dim)
+        k_dense = gather_kv_pages(k_cache, page_table)  # [B, S, H, D]
+        v_dense = gather_kv_pages(v_cache, page_table)
+        qf = q.astype(jnp.float32)
+        s = jnp.einsum("bchd,bshd->bchs", qf, k_dense) * scale
+        pos_k = jnp.arange(k_dense.shape[1], dtype=jnp.int32)
+        mask = pos_k[None, None, :] <= positions[:, :, None]  # [B, C, S]
+        s = jnp.where(mask[:, :, None, :], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bchs,bshd->bchd", p, v_dense)
+        y = jnp.einsum("bchd,hde->bce", out.astype(cd), wo,
+                       preferred_element_type=jnp.float32)
+        return [y.astype(hidden.dtype)]
+
     # ---- degree propagation ---------------------------------------------
     def propagate(self, mv: MachineView) -> OpSharding:
         b, s, e_deg = mv.dim_degrees

@@ -8,13 +8,22 @@ one jitted program serves an arbitrary request stream:
 * a ``PageAllocator`` owns the KV page pool; a request is **admitted**
   only when its full page allotment is free (reservation-style
   residency — an admitted sequence can always grow to ``max_seq_len``
-  without preemption), and **evicted** (pages freed, slot reopened)
-  when it finishes;
+  unpreempted by pool pressure), in **priority order** when SLO
+  classes are armed: higher-priority requests admit first, a request
+  whose ``deadline_frames`` passed while queued is EXPIRED instead of
+  served late, and a strictly-higher-priority arrival may preempt the
+  lowest-priority live sequence (pages freed, sequence re-queued with
+  its tokens so far — regeneration is deterministic);
+* prompts enter through the **chunked prefill lane** when one is armed
+  (``prefill_fn`` — runtime/prefill.py builds it from the decode
+  model, ``compiled_decode_step(model, prefill_chunk=C)``): the
+  prompt's causal forward runs once per C-token chunk and scatters
+  K/V straight into the sequence's pages, then the sequence joins the
+  decode loop at its LAST prompt token — token-identical to the
+  prefill-via-decode fallback (one decode frame per prompt token),
+  which remains the no-prefill-fn path;
 * each ``step`` fills every live slot's next token through ONE decode
-  graph call — prompt tokens first (prefill-via-decode: correct by
-  construction on any mesh; a chunked prefill writer is the on-TPU
-  fast path, see models/decode.py build_gpt_prefill), then generated
-  tokens until ``max_new_tokens`` or EOS;
+  graph call, until ``max_new_tokens`` or EOS;
 * every frame emits a ``decode.frame`` obs event (admissions,
   evictions, live slots, pages in use, measured latency, predicted
   latency when the caller supplies the search's number) and the run
@@ -47,12 +56,60 @@ from flexflow_tpu.obs.events import BUS
 class DecodeRequest:
     """One sequence to serve: the prompt's token ids and how many new
     tokens to generate.  ``eos_id`` stops generation early when the
-    model emits it (None = run to max_new_tokens)."""
+    model emits it (None = run to max_new_tokens).  ``slo`` names the
+    request's SLO class (resolved against the executor's class table);
+    ``priority``/``deadline_frames`` override the class defaults —
+    higher priority admits first, a deadline (frames from enqueue to
+    admission) expires the request instead of serving it late."""
 
     rid: str
     prompt: Sequence[int]
     max_new_tokens: int = 8
     eos_id: Optional[int] = None
+    slo: str = "standard"
+    priority: Optional[int] = None
+    deadline_frames: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class SLOClass:
+    """One request class of the serving deployment: admission priority,
+    queue deadline, and the arrival quantile its latency is watched at
+    (``measured_request_p99``/``TrainingController.observe_p99`` per
+    class).  Persisted into ``__meta__.disaggregation.slo_classes``
+    (fflint STR211 checks the shape stdlib-only)."""
+
+    name: str
+    priority: int = 0
+    deadline_frames: int = 0  # 0 = no deadline
+    quantile: float = 0.99
+
+    def to_jsonable(self) -> dict:
+        return {"name": self.name, "priority": self.priority,
+                "deadline_frames": self.deadline_frames,
+                "quantile": self.quantile}
+
+
+@dataclass
+class _Pending:
+    """A queued sequence: a fresh submission, or a preempted live
+    sequence carrying the tokens it already produced (regeneration is
+    deterministic, so re-decoding continues the same stream)."""
+
+    req: DecodeRequest
+    seq: int               # submission order (FIFO tie-break)
+    priority: int
+    deadline_frames: int   # 0 = none
+    enqueue_frame: int
+    tokens: List[int] = field(default_factory=list)
+    generated: int = 0
+    preempted: int = 0     # times this sequence lost its slot
+    # telemetry stamps carried across preemption (first values win)
+    enqueue_t: Optional[float] = None
+    admit_t: Optional[float] = None
+    prefill_done_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    started_frame: Optional[int] = None
 
 
 @dataclass
@@ -63,10 +120,20 @@ class _Live:
     cached: int = 0        # tokens already written into the KV cache
     generated: int = 0
     started_frame: int = 0
+    priority: int = 0
+    preempted: int = 0
+    seq: int = 0
+    deadline_frames: int = 0
+    enqueue_frame: int = 0
     # request lifecycle span stamps (perf_counter seconds) — populated
-    # only while the obs bus is armed (see step()'s one-check contract)
+    # only while the obs bus is armed (see step()'s one-check contract).
+    # prefill_done_t closes the PREFILL span: the cache holds every
+    # prompt token but the last, so TTFT decomposes exactly into
+    # queue (enqueue→admit) + prefill (admit→prefill_done) +
+    # first decode frame (prefill_done→first_token).
     enqueue_t: Optional[float] = None
     admit_t: Optional[float] = None
+    prefill_done_t: Optional[float] = None
     first_token_t: Optional[float] = None
 
 
@@ -111,11 +178,30 @@ class ContinuousBatchingExecutor:
 
     def __init__(self, step_fn: Callable, *, max_seqs: int,
                  page_size: int, pages_per_seq: int, num_pages: int = 0,
-                 predicted_step_s: Optional[float] = None):
+                 predicted_step_s: Optional[float] = None,
+                 prefill_fn: Optional[Callable] = None,
+                 prefill_chunk: int = 0,
+                 slo_classes: Optional[Sequence[SLOClass]] = None):
         self.step_fn = step_fn
         self.max_seqs = max_seqs
         self.page_size = page_size
         self.pages_per_seq = pages_per_seq
+        # chunked prefill lane (runtime/prefill.py): when armed, a
+        # prompt's first len-1 tokens are written into the cache in
+        # ceil((len-1)/chunk) batched passes at admission instead of
+        # one decode frame each; None keeps the historical
+        # prefill-via-decode path byte-identical
+        self.prefill_fn = prefill_fn
+        self.prefill_chunk = int(prefill_chunk or 0)
+        if prefill_fn is not None and self.prefill_chunk < 1:
+            raise ValueError(
+                "prefill_fn needs prefill_chunk >= 1 (the chunk size "
+                "the jitted writer was built for)")
+        # SLO classes: priority admission / deadline expiry / preemption
+        # (empty table = single-class FIFO, the historical behavior)
+        self.slo_classes: Dict[str, SLOClass] = {
+            c.name: c for c in (slo_classes or ())}
+        self._seq = 0  # submission counter (FIFO tie-break)
         self.allocator = PageAllocator(num_pages or max_seqs * pages_per_seq)
         # slot-aligned allocation: when the pool covers every slot,
         # slot i always takes pages [i*pps, (i+1)*pps) — contiguous
@@ -148,18 +234,22 @@ class ContinuousBatchingExecutor:
         # caller has one — recorded per frame so drift is computable
         self.predicted_step_s = predicted_step_s
         self.slots: List[Optional[_Live]] = [None] * max_seqs
-        self.queue: List[DecodeRequest] = []
+        self.queue: List[_Pending] = []
         self.finished: Dict[str, List[int]] = {}
+        self.expired: Dict[str, List[int]] = {}  # deadline-missed rids
         self.frame = 0
         self.frame_seconds: List[float] = []
         self.total_admitted = 0
         self.total_evicted = 0
-        # per-request lifecycle telemetry (enqueue→admit→first
-        # token→EOS/evict spans; TTFT/TPOT/e2e), recorded only while
-        # the obs bus is armed — the hot path checks BUS.enabled ONCE
-        # per frame (and once per submit batch) and skips every stamp
-        # when it is off
-        self._enqueue_t: Dict[str, float] = {}
+        self.total_expired = 0
+        self.total_preempted = 0
+        self.prefill_chunks = 0  # chunked-prefill passes run
+        self.prefill_tokens = 0  # prompt tokens written by the lane
+        # per-request lifecycle telemetry (enqueue→admit→prefill→first
+        # token→EOS/evict spans; TTFT/TPOT/e2e + the TTFT split),
+        # recorded only while the obs bus is armed — the hot path
+        # checks BUS.enabled ONCE per frame (and once per submit
+        # batch) and skips every stamp when it is off
         self.request_records: List[dict] = []
 
     # ------------------------------------------------------------------
@@ -172,32 +262,167 @@ class ContinuousBatchingExecutor:
             assert need <= cap, (
                 f"request {r.rid!r} wants {need} tokens but a sequence "
                 f"caps at {cap} (page_size x pages_per_seq)")
+            cls = self.slo_classes.get(r.slo)
+            entry = _Pending(
+                req=r, seq=self._seq,
+                priority=(r.priority if r.priority is not None
+                          else (cls.priority if cls else 0)),
+                deadline_frames=(
+                    r.deadline_frames if r.deadline_frames is not None
+                    else (cls.deadline_frames if cls else 0)),
+                enqueue_frame=self.frame,
+                tokens=list(r.prompt),
+            )
+            self._seq += 1
             if obs:
-                self._enqueue_t[r.rid] = time.perf_counter()
-            self.queue.append(r)
+                entry.enqueue_t = time.perf_counter()
+            self.queue.append(entry)
+
+    def _expire(self, obs: bool = False) -> int:
+        """Drop queued requests whose admission deadline passed —
+        deadline-based admission control: a request the deployment can
+        no longer serve inside its SLO is refused loudly (recorded in
+        ``expired``, one ``decode.request`` phase="expired" event),
+        never served late."""
+        expired = 0
+        kept = []
+        for e in self.queue:
+            if (e.deadline_frames
+                    and self.frame - e.enqueue_frame > e.deadline_frames):
+                self.expired[e.req.rid] = e.tokens[len(e.req.prompt):]
+                expired += 1
+                if obs:
+                    rec = {"rid": e.req.rid, "phase": "expired",
+                           "slo": e.req.slo,
+                           "queued_frames": self.frame - e.enqueue_frame,
+                           "deadline_frames": e.deadline_frames}
+                    self.request_records.append(rec)
+                    BUS.emit("decode.request", **rec)
+            else:
+                kept.append(e)
+        self.queue = kept
+        self.total_expired += expired
+        return expired
+
+    def _preempt_for(self, entry: _Pending, obs: bool) -> bool:
+        """Free a slot + pages for a strictly-higher-priority pending
+        request by evicting the LOWEST-priority live sequence
+        (latest-admitted tie-break).  The victim re-queues with its
+        tokens so far — regeneration is deterministic, so its stream
+        continues unchanged after re-admission."""
+        victims = [
+            (live.priority, -live.started_frame, -i, i)
+            for i, live in enumerate(self.slots)
+            if live is not None and live.priority < entry.priority
+        ]
+        if not victims:
+            return False
+        _, _, _, i = min(victims)
+        live = self.slots[i]
+        self.allocator.free(live.pages)
+        self.slots[i] = None
+        self.total_preempted += 1
+        back = _Pending(
+            req=live.req, seq=live.seq, priority=live.priority,
+            deadline_frames=live.deadline_frames,
+            enqueue_frame=live.enqueue_frame,
+            tokens=list(live.tokens), generated=live.generated,
+            preempted=live.preempted + 1,
+            enqueue_t=live.enqueue_t, admit_t=live.admit_t,
+            prefill_done_t=live.prefill_done_t,
+            first_token_t=live.first_token_t,
+            started_frame=live.started_frame,
+        )
+        self.queue.append(back)
+        if obs:
+            BUS.emit("decode.request", rid=live.req.rid,
+                     phase="preempted", slo=live.req.slo,
+                     by=entry.req.rid, tokens=live.generated)
+        return True
+
+    def _run_prefill(self, live: _Live, obs: bool) -> None:
+        """The chunked prefill lane: write the sequence's first
+        ``len(tokens) - 1`` cached-to-be tokens through the batched
+        chunk writer, so the decode loop starts at the LAST token and
+        produces the first generated token in its first frame."""
+        n_pre = len(live.tokens) - 1
+        if n_pre <= 0 or self.prefill_fn is None:
+            return
+        C = self.prefill_chunk
+        cap = self.page_size * self.pages_per_seq
+        table = np.asarray(live.pages, np.int32)[None, :]  # [1, P]
+        chunks = 0
+        with annotate.phase_span(annotate.PREFILL_PHASE):
+            for c0 in range(0, n_pre, C):
+                ids = np.zeros((1, C), np.int32)
+                valid = min(C, n_pre - c0)
+                ids[0, :valid] = live.tokens[c0:c0 + valid]
+                # pad positions clamp into the sequence's own allotment:
+                # they land at FUTURE positions the decode loop rewrites
+                # before any frame reads them (see runtime/prefill.py)
+                pos = np.minimum(c0 + np.arange(C), cap - 1)
+                self.prefill_fn(ids, pos[None, :].astype(np.int32), table)
+                chunks += 1
+        live.cached = n_pre
+        self.prefill_chunks += chunks
+        self.prefill_tokens += n_pre
+        if obs:
+            BUS.emit("decode.prefill", rid=live.req.rid, tokens=n_pre,
+                     chunks=chunks, chunk=C)
 
     def _admit(self, obs: bool = False) -> int:
-        """Fill open slots from the queue while the allocator can
-        reserve a FULL per-sequence allotment (admission by page
-        residency: an admitted sequence never needs preemption)."""
+        """Fill open slots from the queue in (priority, submission)
+        order while the allocator can reserve a FULL per-sequence
+        allotment; expired requests are refused first, and a
+        strictly-higher-priority arrival may preempt the
+        lowest-priority live sequence when no allotment is free."""
+        self._expire(obs)
         admitted = 0
-        for i in range(self.max_seqs):
-            if self.slots[i] is not None or not self.queue:
-                continue
+        while self.queue:
+            order = sorted(range(len(self.queue)),
+                           key=lambda j: (-self.queue[j].priority,
+                                          self.queue[j].seq))
+            entry = self.queue[order[0]]
+            open_slots = [i for i in range(self.max_seqs)
+                          if self.slots[i] is None]
+            if not open_slots and not self._preempt_for(entry, obs):
+                break
+            open_slots = [i for i in range(self.max_seqs)
+                          if self.slots[i] is None]
+            i = open_slots[0]
             if self.slot_aligned:
                 pages = self.allocator.alloc_ids(range(
                     i * self.pages_per_seq, (i + 1) * self.pages_per_seq))
             else:
                 pages = self.allocator.alloc(self.pages_per_seq)
             if pages is None:
-                break
-            req = self.queue.pop(0)
-            live = _Live(req=req, pages=pages,
-                         tokens=list(req.prompt),
-                         started_frame=self.frame)
+                if not self._preempt_for(entry, obs):
+                    break
+                continue  # retry with the freed allotment
+            self.queue.pop(order[0])
+            live = _Live(req=entry.req, pages=pages,
+                         tokens=list(entry.tokens),
+                         generated=entry.generated,
+                         started_frame=(entry.started_frame
+                                        if entry.started_frame is not None
+                                        else self.frame),
+                         priority=entry.priority,
+                         preempted=entry.preempted, seq=entry.seq,
+                         deadline_frames=entry.deadline_frames,
+                         enqueue_frame=entry.enqueue_frame)
             if obs:
-                live.enqueue_t = self._enqueue_t.pop(req.rid, None)
-                live.admit_t = time.perf_counter()
+                live.enqueue_t = entry.enqueue_t
+                live.admit_t = entry.admit_t or time.perf_counter()
+                live.prefill_done_t = entry.prefill_done_t
+                live.first_token_t = entry.first_token_t
+            self._run_prefill(live, obs)
+            if obs and live.prefill_done_t is None:
+                # the prefill span closes here for the chunked lane and
+                # for single-token prompts (nothing to prefill); the
+                # via-decode path closes it in step() when the cache
+                # holds every prompt token but the last
+                if self.prefill_fn is not None or len(live.tokens) <= 1:
+                    live.prefill_done_t = time.perf_counter()
             self.slots[i] = live
             admitted += 1
         self.total_admitted += admitted
@@ -233,10 +458,18 @@ class ContinuousBatchingExecutor:
 
         now = time.perf_counter()
         enq, adm, first = live.enqueue_t, live.admit_t, live.first_token_t
+        pre = live.prefill_done_t
         queue_s = (adm - enq) if (enq is not None and adm is not None) \
             else None
         ttft_s = (first - enq) if (enq is not None and first is not None) \
             else None
+        # the TTFT split: queue + prefill + first decode frame sum to
+        # TTFT exactly (prefill_done closes when the cache holds every
+        # prompt token but the last — chunked lane or via-decode alike)
+        prefill_s = (pre - adm) if (adm is not None and pre is not None) \
+            else None
+        first_frame_s = (first - pre) \
+            if (pre is not None and first is not None) else None
         e2e_s = (now - enq) if enq is not None else None
         tpot_s = None
         if first is not None and live.generated > 1:
@@ -244,15 +477,21 @@ class ContinuousBatchingExecutor:
         rec = {
             "rid": live.req.rid,
             "phase": "finish",
+            "slo": live.req.slo,
             "queue_s": queue_s,
+            "prefill_s": prefill_s,
+            "first_frame_s": first_frame_s,
             "ttft_s": ttft_s,
             "tpot_s": tpot_s,
             "e2e_s": e2e_s,
             "tokens": live.generated,
             "frames": self.frame - live.started_frame + 1,
+            "preempted": live.preempted,
         }
         self.request_records.append(rec)
         for key, v in (("decode.queue_s", queue_s),
+                       ("decode.prefill_s", prefill_s),
+                       ("decode.first_frame_s", first_frame_s),
                        ("decode.ttft_s", ttft_s),
                        ("decode.tpot_s", tpot_s),
                        ("decode.e2e_s", e2e_s)):
@@ -311,7 +550,14 @@ class ContinuousBatchingExecutor:
             live = self.slots[i]
             live.cached += 1
             if live.cached < len(live.tokens):
-                continue  # still prefilling: the next prompt token is queued
+                # still prefilling via decode: the next prompt token is
+                # queued.  The prefill span closes when only the LAST
+                # prompt token remains (the frame that feeds it is the
+                # first decode frame — it produces the first token).
+                if (obs and live.prefill_done_t is None
+                        and live.cached >= len(live.tokens) - 1):
+                    live.prefill_done_t = now
+                continue
             # the model's prediction extends the sequence
             live.tokens.append(int(next_tokens[i]))
             live.generated += 1
@@ -371,6 +617,26 @@ class ContinuousBatchingExecutor:
             else self.frame_seconds
         return self._quantile(times, 0.99)
 
+    def measured_request_p99(self, metric: str = "ttft_s",
+                             slo: Optional[str] = None,
+                             window: int = 0) -> Optional[float]:
+        """p99 of a per-request latency metric (``ttft_s``/``tpot_s``/
+        ``e2e_s``/``queue_s``), optionally restricted to one SLO class
+        and to the trailing ``window`` completions — the per-class
+        serve-currency signal a long-running server feeds
+        ``TrainingController.observe_p99`` (each class watched at its
+        own quantile is the SLO story; p99 here matches the spec's
+        default)."""
+        recs = [r for r in self.request_records
+                if r.get("phase") == "finish"
+                and (slo is None or r.get("slo") == slo)
+                and r.get(metric) is not None]
+        if window:
+            recs = recs[-window:]
+        cls = self.slo_classes.get(slo) if slo else None
+        return self._quantile([r[metric] for r in recs],
+                              cls.quantile if cls else 0.99)
+
     def summary(self) -> dict:
         q = lambda f: self._quantile(self.frame_seconds, f)  # noqa: E731
         out = {
@@ -378,19 +644,45 @@ class ContinuousBatchingExecutor:
             "completed": len(self.finished),
             "admitted": self.total_admitted,
             "evicted": self.total_evicted,
+            "expired": self.total_expired,
+            "preempted": self.total_preempted,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_tokens": self.prefill_tokens,
             "measured_p50_s": q(0.5),
             "measured_p99_s": q(0.99),
             "predicted_step_s": self.predicted_step_s,
         }
-        recs = self.request_records
+        recs = [r for r in self.request_records
+                if r.get("phase") == "finish"]
         if recs:
             # request-level currency (recorded while the bus was
-            # armed): TTFT / TPOT / e2e percentiles across completions
-            for key in ("ttft_s", "tpot_s", "e2e_s", "queue_s"):
+            # armed): TTFT / TPOT / e2e percentiles across completions,
+            # with TTFT split into its queue + prefill + first-frame
+            # components so the prompt path's cost is attributable per
+            # phase
+            for key in ("ttft_s", "tpot_s", "e2e_s", "queue_s",
+                        "prefill_s", "first_frame_s"):
                 vals = [r[key] for r in recs if r.get(key) is not None]
                 out[f"{key[:-2]}_p50_s"] = self._quantile(vals, 0.5)
                 out[f"{key[:-2]}_p99_s"] = self._quantile(vals, 0.99)
             out["requests_recorded"] = len(recs)
+            by_class: Dict[str, list] = {}
+            for r in recs:
+                by_class.setdefault(r.get("slo", "standard"),
+                                    []).append(r)
+            if self.slo_classes or len(by_class) > 1:
+                out["slo_classes"] = {
+                    name: {
+                        "completed": len(rs),
+                        "ttft_p99_s": self._quantile(
+                            [r["ttft_s"] for r in rs
+                             if r.get("ttft_s") is not None], 0.99),
+                        "e2e_p99_s": self._quantile(
+                            [r["e2e_s"] for r in rs
+                             if r.get("e2e_s") is not None], 0.99),
+                    }
+                    for name, rs in sorted(by_class.items())
+                }
         return out
 
     def decode_drift_report(self, threshold: float = 0.5,
@@ -426,11 +718,18 @@ class ContinuousBatchingExecutor:
         return report
 
 
-def compiled_decode_step(model) -> Callable:
+def compiled_decode_step(model, prefill_chunk: int = 0) -> Callable:
     """A ``step_fn`` over a COMPILED decode model: one jitted forward
     per frame, the KV-cache state dict threaded across calls (the
     caches are model state — compiler/lowering.py init_params placed
-    them under the strategy's view)."""
+    them under the strategy's view).
+
+    ``prefill_chunk > 0`` additionally builds the chunked prefill
+    writer over the SAME graph, params and threaded state
+    (runtime/prefill.py — one parameter set by construction, the cache
+    scatter lands in the placed state arrays), attached as
+    ``step.prefill(ids [1,C], positions [1,C], page_table [1,P])`` for
+    the executor's ``prefill_fn``."""
     import jax
 
     compiled = model.compiled
@@ -445,4 +744,15 @@ def compiled_decode_step(model) -> Callable:
         return logits
 
     step.state = box  # tests inspect the threaded cache
+    if prefill_chunk:
+        from flexflow_tpu.runtime.prefill import build_chunk_forward
+
+        pf = jax.jit(build_chunk_forward(model.graph,
+                                         compiled.compute_dtype))
+
+        def prefill(ids, positions, page_table):
+            box["state"] = pf(model.params, box["state"], ids,
+                              positions, page_table)
+
+        step.prefill = prefill
     return step

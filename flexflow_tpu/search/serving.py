@@ -63,6 +63,15 @@ class ServingSpec:
     quantile: float = DEFAULT_QUANTILE
     samples: int = DEFAULT_SAMPLES
     seed: int = 0
+    # phase-split arrival model (prefill/decode disaggregation,
+    # search/disaggregation.py): steady-state prompt traffic the
+    # PREFILL phase must absorb, separate from the decode p99 load.
+    # 0 derives defaults from the cache geometry.  Deliberately NOT
+    # part of ``signature()``: these fields price only the
+    # disaggregation proposal pass, never the per-(op, view) cost rows,
+    # so train/serve search paths stay bit-identical to history.
+    prompt_tokens_mean: int = 0  # 0 = max_seq_len // 2
+    decode_tokens_mean: int = 0  # 0 = max(1, max_seq_len // 4)
     _factors: Dict[int, float] = field(default_factory=dict, compare=False,
                                        repr=False, hash=False)
 
@@ -127,6 +136,22 @@ class ServingSpec:
     def with_quantile(self, q: float) -> "ServingSpec":
         return replace(self, quantile=float(q), _factors={})
 
+    # ---- phase-split arrival model (disaggregation pricing) -------------
+    def prefill_tokens_per_frame(self) -> float:
+        """Expected PROMPT tokens the prefill phase must absorb per
+        decode frame, in steady state: every live slot generates one
+        token per frame and turns over every ``decode_tokens_mean``
+        frames; each turnover admits a fresh prompt of
+        ``prompt_tokens_mean`` tokens.  This is the compute-bound
+        arrival load the disaggregation search prices against the
+        prefill block — colocated deployments pay it as phase
+        interference on the decode devices, disaggregated ones overlap
+        it on their own submesh and pay the KV handoff instead
+        (search/disaggregation.py)."""
+        g = self.decode_tokens_mean or max(1, self.max_seq_len // 4)
+        p = self.prompt_tokens_mean or max(1, self.max_seq_len // 2)
+        return self.max_seqs * (float(p) / float(g))
+
 
 def decode_nodes(graph):
     """The graph's DecodeAttentionOp nodes, topo order."""
@@ -159,6 +184,10 @@ def serving_spec_for(graph, config) -> Optional[ServingSpec]:
         max_seqs=geo[0], page_size=geo[1], pages_per_seq=geo[2],
         p99_budget_ms=float(getattr(config, "serve_p99_budget_ms", 0.0)
                             or 0.0),
+        prompt_tokens_mean=int(getattr(
+            config, "serve_prompt_tokens_mean", 0) or 0),
+        decode_tokens_mean=int(getattr(
+            config, "serve_decode_tokens_mean", 0) or 0),
     )
 
 

@@ -94,7 +94,7 @@ def test_decode_request_spans_one_bus_check_per_frame(monkeypatch):
     assert bus.reads <= frames + 2, (bus.reads, frames)
     # and none of the span machinery ran
     assert ex.request_records == []
-    assert ex._enqueue_t == {}
+    assert ex.queue == []
     assert all(s is None for s in ex.slots)
 
 

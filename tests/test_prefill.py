@@ -1,0 +1,593 @@
+"""Chunked prefill + prefill/decode disaggregation + SLO classes
+(ISSUE 15 — the serving tier's prompt path off the decode loop).
+
+Contract highlights:
+
+* the chunked prefill lane (runtime/prefill.py) is TOKEN-IDENTICAL to
+  the prefill-via-decode oracle across ragged prompt lengths,
+  including single-token prompts and exact chunk boundaries;
+* TTFT decomposes exactly into queue + prefill + first-decode-frame
+  spans (the attribution the ffobs report renders);
+* SLO classes: priority admission order, deadline expiry instead of
+  late service, preemption by strictly-higher priority — all
+  deterministic under a seeded arrival trace;
+* the disaggregation search prices colocated vs two-block placement in
+  the phase-split serve currency, adopts only past the margin
+  (honest zero on the small config), is lint-gated (SHD164/165),
+  persists as __meta__.disaggregation behind the digest gate, and
+  re-lints on import (corrupt artifacts fail with findings);
+* fflint STR211 catches file-level corruption of the persisted
+  disaggregation/SLO meta stdlib-only.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.machine import MachineView
+from flexflow_tpu.runtime.decode import (
+    ContinuousBatchingExecutor,
+    DecodeRequest,
+    SLOClass,
+    compiled_decode_step,
+)
+
+N_DEV = 8
+
+# the short-prompt interactive regime where disaggregation genuinely
+# wins on the stock machine model (bench_search.py GPT_DECODE_CHAT_KW)
+CHAT_KW = dict(vocab=4096, num_layers=2, hidden=2048, num_heads=16,
+               ff_dim=4096, page_size=16, pages_per_seq=32)
+CHAT_ARRIVAL = dict(serve_prompt_tokens_mean=128,
+                    serve_decode_tokens_mean=32)
+
+SMALL_KW = dict(vocab=256, num_layers=2, hidden=64, num_heads=4,
+                ff_dim=64, page_size=4, pages_per_seq=8)
+
+
+def _trivial_strategy(graph):
+    return {
+        n.guid: (n.op.fixed_machine_view()
+                 or MachineView.trivial(n.op.output_shapes[0].ndim))
+        for n in graph.topo_order()
+    }
+
+
+def _compiled_small(num_devices=1, batch=4, **overrides):
+    from flexflow_tpu.models import build_gpt_decode
+
+    kw = dict(SMALL_KW)
+    kw.update(overrides)
+    cfg = ff.FFConfig(batch_size=batch, num_devices=num_devices,
+                      cost_cache_file="")
+    m = build_gpt_decode(cfg, **kw)
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+              comp_mode="inference",
+              strategy=_trivial_strategy(m.graph))
+    return m
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    """One compiled small decode model shared by the executor-level
+    tests: each ``compiled_decode_step`` call snapshots ``model.state``
+    into its own box, so every lane starts from the same fresh caches
+    without recompiling the model."""
+    return _compiled_small()
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill: token identity with the prefill-via-decode oracle
+# ---------------------------------------------------------------------------
+def _serve(model, chunk, prompts, max_new=4, slots=4):
+    step = compiled_decode_step(model, prefill_chunk=chunk)
+    ex = ContinuousBatchingExecutor(
+        step, max_seqs=slots, page_size=SMALL_KW["page_size"],
+        pages_per_seq=SMALL_KW["pages_per_seq"],
+        prefill_fn=getattr(step, "prefill", None), prefill_chunk=chunk)
+    reqs = [DecodeRequest(rid=f"r{i}", prompt=list(p),
+                          max_new_tokens=max_new)
+            for i, p in enumerate(prompts)]
+    out = ex.run(reqs, max_frames=600)
+    return out, ex
+
+
+def test_chunked_prefill_token_identity_ragged(small_model):
+    """THE acceptance contract: the chunked lane's generated tokens
+    equal the token-by-token oracle's for ragged prompt lengths
+    including single-token (nothing to prefill), chunk-boundary
+    (len-1 a multiple of the chunk), and cross-chunk prompts."""
+    rng = np.random.default_rng(3)
+    chunk = 8
+    # 1 = single-token; 9 = exactly one full chunk of prefill (8 = L-1);
+    # 17 = two full chunks; 5/12/23 = ragged tails
+    lengths = (1, 2, 5, 9, 12, 17, 23)
+    prompts = [list(map(int, rng.integers(1, 255, size=L)))
+               for L in lengths]
+    out_oracle, ex0 = _serve(small_model, 0, prompts)
+    out_chunk, ex1 = _serve(small_model, chunk, prompts)
+    assert out_oracle == out_chunk
+    # the lane genuinely ran and genuinely saved frames
+    assert ex1.prefill_tokens == sum(L - 1 for L in lengths)
+    assert ex1.prefill_chunks == sum(
+        -(-(L - 1) // chunk) for L in lengths if L > 1)
+    assert ex1.frame < ex0.frame
+
+
+@pytest.mark.slow
+def test_chunked_prefill_on_searched_multidevice_strategy():
+    """The lane composes with a SEARCHED sharded strategy on the host
+    mesh: the chunk writer updates the placed KV state (the
+    state_shardings discipline), still token-identical."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.models import build_gpt_decode
+
+    kw = dict(vocab=256, num_layers=1, hidden=64, num_heads=4,
+              ff_dim=64, page_size=4, pages_per_seq=4)
+
+    def build():
+        cfg = ff.FFConfig(batch_size=8, num_devices=N_DEV,
+                          search_budget=4, search_timeout_s=20.0,
+                          cost_cache_file="",
+                          machine_spec=MachineSpec.host_cpu(N_DEV))
+        m = build_gpt_decode(cfg, **kw)
+        m.compile(loss_type="sparse_categorical_crossentropy",
+                  metrics=[], comp_mode="inference")
+        return m
+
+    prompts = [[5, 6, 7, 8, 9, 10], [3], [11, 12, 13]]
+
+    def run(chunk):
+        m = build()
+        step = compiled_decode_step(m, prefill_chunk=chunk)
+        ex = ContinuousBatchingExecutor(
+            step, max_seqs=8, page_size=4, pages_per_seq=4,
+            prefill_fn=getattr(step, "prefill", None),
+            prefill_chunk=chunk)
+        return ex.run([DecodeRequest(rid=f"r{i}", prompt=list(p),
+                                     max_new_tokens=4)
+                       for i, p in enumerate(prompts)], max_frames=200)
+
+    assert run(0) == run(4)
+
+
+def test_chunk_forward_rejects_non_decode_graph():
+    from flexflow_tpu.models import build_mlp_unify
+    from flexflow_tpu.runtime.prefill import build_chunk_forward
+
+    cfg = ff.FFConfig(batch_size=4, num_devices=1, cost_cache_file="")
+    m = build_mlp_unify(cfg, in_dim=16, hidden=(16,))
+    with pytest.raises(ValueError, match="no DecodeAttentionOp"):
+        build_chunk_forward(m.graph, np.float32)
+
+
+def test_prefill_weight_bridge():
+    """The weight-correspondence bridge: build_gpt_prefill and
+    build_gpt_decode share one parameter set name-for-name (the
+    positional table as a prefix); a vocab mismatch is a hard error."""
+    from flexflow_tpu.models import (
+        build_gpt_decode,
+        build_gpt_prefill,
+        derive_prefill_model,
+    )
+    from flexflow_tpu.runtime.prefill import prefill_weight_bridge
+
+    cfg = ff.FFConfig(batch_size=4, num_devices=1, cost_cache_file="")
+    dec = build_gpt_decode(cfg, **SMALL_KW)
+    pre, _ = derive_prefill_model(dec.graph, cfg, seq_len=16)
+    bridge = prefill_weight_bridge(pre.graph, dec.graph)
+    # every prefill weight maps to a same-named decode weight
+    assert all(k.split("/")[0] == v.split("/")[0]
+               for k, v in bridge.items())
+    assert "lm_head/kernel" in bridge and "tok_embed/table" in bridge
+    # positional prefix rule: prefill pos table (16 rows) maps onto the
+    # decode table (page_size * pages_per_seq = 32 rows)
+    assert "pos_embed/table" in bridge
+    # vocab mismatch must NOT ride the prefix rule
+    wrong = build_gpt_prefill(
+        cfg, **{**{k: v for k, v in SMALL_KW.items()
+                   if k not in ("page_size", "pages_per_seq")},
+                "vocab": 128}, seq_len=16)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        prefill_weight_bridge(wrong.graph, dec.graph)
+
+
+# ---------------------------------------------------------------------------
+# TTFT split telemetry
+# ---------------------------------------------------------------------------
+def test_ttft_splits_into_queue_prefill_first_frame(tmp_path,
+                                                    small_model):
+    from flexflow_tpu.obs.events import BUS, validate_event
+
+    log = str(tmp_path / "obs.jsonl")
+    BUS.configure(log)
+    try:
+        out, ex = _serve(small_model, 4, [[1, 2, 3, 4, 5, 6, 7], [9]])
+        s = ex.summary()
+        assert s["requests_recorded"] == 2
+        for r in ex.request_records:
+            assert r["phase"] == "finish"
+            # the split sums to TTFT exactly (same stamps, no gaps)
+            assert r["ttft_s"] == pytest.approx(
+                r["queue_s"] + r["prefill_s"] + r["first_frame_s"],
+                rel=1e-6, abs=1e-9)
+        assert s["prefill_p50_s"] is not None
+        assert s["first_frame_p99_s"] is not None
+        BUS.flush()
+        with open(log) as f:
+            events = [json.loads(line) for line in f]
+        for e in events:
+            assert validate_event(e) == []
+        kinds = {e["kind"] for e in events}
+        assert "decode.prefill" in kinds  # the lane emitted its event
+    finally:
+        BUS.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO classes: priority admission, deadline expiry, preemption
+# ---------------------------------------------------------------------------
+def _synthetic_step(vocab=97):
+    def step(ids, table, lens):
+        ids = np.asarray(ids)
+        lens = np.asarray(lens)
+        nxt = (ids[:, 0] * 7 + lens * 13 + 5) % vocab
+        logits = np.zeros((ids.shape[0], 1, vocab), np.float32)
+        logits[np.arange(ids.shape[0]), 0, nxt] = 1.0
+        return logits
+
+    return step
+
+
+SLO_TABLE = (
+    SLOClass("interactive", priority=2, deadline_frames=0),
+    SLOClass("standard", priority=1, deadline_frames=0),
+    SLOClass("batch", priority=0, deadline_frames=0),
+)
+
+
+def test_priority_admission_order():
+    """With one open slot and a full queue, the higher-priority class
+    admits first regardless of submission order."""
+    ex = ContinuousBatchingExecutor(
+        _synthetic_step(), max_seqs=1, page_size=4, pages_per_seq=4,
+        slo_classes=SLO_TABLE)
+    ex.submit([DecodeRequest(rid="batch", prompt=[1], max_new_tokens=2,
+                             slo="batch"),
+               DecodeRequest(rid="inter", prompt=[2], max_new_tokens=2,
+                             slo="interactive")])
+    ex.step()
+    live = [s for s in ex.slots if s is not None]
+    assert live and live[0].req.rid == "inter"
+    ex.run(max_frames=50)
+    assert set(ex.finished) == {"batch", "inter"}
+
+
+def test_deadline_expiry_refuses_late_service():
+    """A queued request whose deadline_frames passes is EXPIRED (never
+    served late): recorded in .expired, absent from .finished."""
+    ex = ContinuousBatchingExecutor(
+        _synthetic_step(), max_seqs=1, page_size=4, pages_per_seq=4)
+    ex.submit([DecodeRequest(rid="long", prompt=[1], max_new_tokens=10),
+               DecodeRequest(rid="dead", prompt=[2], max_new_tokens=2,
+                             deadline_frames=3)])
+    out = ex.run(max_frames=100)
+    assert "dead" not in out and "dead" in ex.expired
+    assert ex.total_expired == 1
+    assert len(out["long"]) == 10
+
+
+def test_preemption_by_higher_priority_continues_stream():
+    """A strictly-higher-priority arrival preempts the lowest-priority
+    live sequence; the victim re-queues with its tokens so far and —
+    regeneration being deterministic — finishes with EXACTLY the
+    tokens of an unpreempted run."""
+    solo = ContinuousBatchingExecutor(
+        _synthetic_step(), max_seqs=1, page_size=4, pages_per_seq=4)
+    expect = solo.run([DecodeRequest(rid="low", prompt=[3, 4],
+                                     max_new_tokens=6)], max_frames=60)
+
+    ex = ContinuousBatchingExecutor(
+        _synthetic_step(), max_seqs=1, page_size=4, pages_per_seq=4,
+        slo_classes=SLO_TABLE)
+    ex.submit([DecodeRequest(rid="low", prompt=[3, 4], max_new_tokens=6,
+                             slo="batch")])
+    ex.step()  # low admitted and running
+    assert ex.slots[0] is not None and ex.slots[0].req.rid == "low"
+    ex.submit([DecodeRequest(rid="hi", prompt=[9], max_new_tokens=2,
+                             slo="interactive")])
+    out = ex.run(max_frames=100)
+    assert ex.total_preempted == 1
+    assert out["low"] == expect["low"]  # the stream survived preemption
+    assert len(out["hi"]) == 2
+
+
+def test_slo_scheduling_deterministic_under_seeded_trace():
+    """The acceptance determinism gate: a seeded ragged arrival trace
+    with mixed classes, deadlines, and pool pressure produces
+    IDENTICAL admissions, expirations, preemptions and token streams
+    across runs."""
+
+    def run():
+        rng = np.random.default_rng(11)
+        ex = ContinuousBatchingExecutor(
+            _synthetic_step(), max_seqs=2, page_size=4, pages_per_seq=4,
+            num_pages=8, slo_classes=SLO_TABLE)
+        outs = {}
+        for wave in range(4):
+            reqs = []
+            for j in range(3):
+                cls = ("interactive", "standard", "batch")[
+                    int(rng.integers(0, 3))]
+                L = int(rng.integers(1, 6))
+                reqs.append(DecodeRequest(
+                    rid=f"w{wave}r{j}",
+                    prompt=list(map(int, rng.integers(1, 96, size=L))),
+                    max_new_tokens=int(rng.integers(1, 5)),
+                    slo=cls,
+                    deadline_frames=(6 if cls == "interactive"
+                                     else None)))
+            ex.submit(reqs)
+            for _ in range(3):
+                ex.step()
+        outs = ex.run(max_frames=300)
+        return (outs, dict(ex.expired), ex.total_preempted,
+                ex.total_expired, ex.total_admitted)
+
+    assert run() == run()
+
+
+def test_measured_request_p99_per_class(tmp_path):
+    from flexflow_tpu.obs.events import BUS
+
+    BUS.configure(str(tmp_path / "obs.jsonl"))
+    try:
+        ex = ContinuousBatchingExecutor(
+            _synthetic_step(), max_seqs=2, page_size=4, pages_per_seq=4,
+            slo_classes=SLO_TABLE)
+        reqs = [DecodeRequest(rid=f"r{i}", prompt=[1 + i],
+                              max_new_tokens=2,
+                              slo=("interactive" if i % 2 else "batch"))
+                for i in range(6)]
+        ex.run(reqs, max_frames=60)
+        s = ex.summary()
+        assert set(s["slo_classes"]) == {"interactive", "batch"}
+        for name in ("interactive", "batch"):
+            v = ex.measured_request_p99("ttft_s", slo=name)
+            assert v is not None and v > 0
+        assert ex.measured_request_p99("ttft_s") is not None
+    finally:
+        BUS.close()
+
+
+# ---------------------------------------------------------------------------
+# disaggregation: search, lints, persistence, import
+# ---------------------------------------------------------------------------
+def _chat_cfg(**overrides):
+    kw = dict(batch_size=32, num_devices=N_DEV, search_budget=8,
+              search_timeout_s=60.0, objective="serve",
+              comp_mode="inference", cost_cache_file="",
+              **CHAT_ARRIVAL)
+    kw.update(overrides)
+    return ff.FFConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def chat_search():
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    cfg = _chat_cfg()
+    m = build_gpt_decode(cfg, **CHAT_KW)
+    g, s = optimize_strategy(m.graph, cfg, return_graph=True)
+    return cfg, m.graph, g, s
+
+
+def test_disaggregation_adopts_where_handoff_is_cheap(chat_search):
+    """THE acceptance scenario (recorded in BENCH_SEARCH
+    "Prefill/decode disaggregation"): on the short-prompt interactive
+    config — the weight-streaming-bound prefill regime, where a
+    prompt's KV handoff is cheap relative to the phase interference
+    colocation pays — the search PICKS disaggregation."""
+    from flexflow_tpu.search.disaggregation import propose_disaggregation
+
+    cfg, base, g, s = chat_search
+    prop = propose_disaggregation(
+        g, s, cfg, base_graph=base if g is not base else None)
+    assert prop is not None and prop.adopted
+    assert prop.disagg_step_s < prop.colocated_step_s
+    assert prop.handoff_s > 0
+    assert prop.prefill_devices + prop.decode_devices <= N_DEV
+    assert prop.prefill_strategy and prop.decode_strategy
+
+
+def test_disaggregation_honest_zero_on_long_cache_config():
+    """The long-cache serving-regime config keeps colocation (its
+    decode phase wants every device and its handoff payload is fat):
+    the proposal is still returned — both prices recorded — but NOT
+    adopted.  The search does not manufacture divergence."""
+    from flexflow_tpu.models import (
+        GPT_DECODE_SERVE_KW,
+        SERVE_FRAME_SLOTS,
+        build_gpt_decode,
+    )
+    from flexflow_tpu.search.disaggregation import propose_disaggregation
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    cfg = ff.FFConfig(batch_size=SERVE_FRAME_SLOTS, num_devices=N_DEV,
+                      search_budget=4, search_timeout_s=45.0,
+                      objective="serve", comp_mode="inference",
+                      cost_cache_file="")
+    m = build_gpt_decode(cfg, **GPT_DECODE_SERVE_KW)
+    g, s = optimize_strategy(m.graph, cfg, return_graph=True)
+    prop = propose_disaggregation(
+        g, s, cfg, base_graph=m.graph if g is not m.graph else None)
+    assert prop is not None and not prop.adopted
+    assert prop.colocated_step_s < prop.disagg_step_s
+
+
+def test_lint_disaggregation_codes(chat_search):
+    from flexflow_tpu.analysis import errors_only, lint_disaggregation
+    from flexflow_tpu.search.disaggregation import propose_disaggregation
+
+    cfg, base, g, s = chat_search
+    prop = propose_disaggregation(
+        g, s, cfg, base_graph=base if g is not base else None)
+    meta = prop.to_meta()
+    graph = base  # un-rewritten: the import-path shape
+    assert not errors_only(lint_disaggregation(graph, meta, cfg))
+    # SHD164: overflowing blocks
+    bad = dict(meta, prefill_devices=N_DEV)
+    codes = [f.code for f in lint_disaggregation(graph, bad, cfg)]
+    assert "SHD164" in codes
+    # SHD164: zero-width block / bad chunk
+    codes = [f.code for f in lint_disaggregation(
+        graph, dict(meta, decode_devices=0), cfg)]
+    assert "SHD164" in codes
+    codes = [f.code for f in lint_disaggregation(
+        graph, dict(meta, chunk=0), cfg)]
+    assert "SHD164" in codes
+    # SHD165: pool geometry disagreement across the handoff
+    codes = [f.code for f in lint_disaggregation(
+        graph, dict(meta, page_size=meta["page_size"] * 2), cfg)]
+    assert "SHD165" in codes
+    # SHD165: malformed SLO classes
+    codes = [f.code for f in lint_disaggregation(
+        graph, dict(meta, slo_classes=[{"name": "a", "quantile": 2.0}]),
+        cfg)]
+    assert "SHD165" in codes
+    codes = [f.code for f in lint_disaggregation(
+        graph, dict(meta, slo_classes=[{"name": "a"}, {"name": "a"}]),
+        cfg)]
+    assert "SHD165" in codes
+
+
+@pytest.mark.slow
+def test_disaggregation_meta_round_trip(tmp_path):
+    """compile(serve_disaggregation=search) persists
+    __meta__.disaggregation behind the digest gate; import re-lints it
+    (SHD164/165) against the target graph; corrupt pool geometry fails
+    the gate with findings."""
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.search.strategy_io import read_meta
+
+    path = str(tmp_path / "disagg_strategy.json")
+    # budget 0: a rewriting search keys its export to the rewritten
+    # graph, which deliberately cannot re-import onto a fresh build
+    # (STR201) — the round trip is the un-rewritten artifact's story.
+    # Half-width chat geometry (still the adopting short-prompt
+    # regime) keeps the three compiles in this test cheap.
+    kw = dict(CHAT_KW, hidden=1024, num_heads=8, ff_dim=2048)
+    cfg = _chat_cfg(serve_disaggregation="search",
+                    serve_slo_classes="interactive:2:64,batch:0:0:0.9",
+                    export_strategy_file=path, search_budget=0,
+                    search_timeout_s=30.0)
+    m = build_gpt_decode(cfg, **kw)
+    m.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+              comp_mode="inference")
+    assert m.disaggregation is not None and m.disaggregation.adopted
+    meta = read_meta(path)
+    dm = meta.get("disaggregation")
+    assert dm and dm["prefill_devices"] + dm["decode_devices"] <= N_DEV
+    assert [c["name"] for c in dm["slo_classes"]] == ["interactive",
+                                                      "batch"]
+    # geometry agrees with the sibling serving block (STR211's rule)
+    assert dm["page_size"] == meta["serving"]["page_size"]
+
+    # clean re-import
+    cfg2 = ff.FFConfig(batch_size=32, num_devices=N_DEV,
+                       cost_cache_file="", import_strategy_file=path)
+    m2 = build_gpt_decode(cfg2, **kw)
+    m2.compile(loss_type="sparse_categorical_crossentropy", metrics=[],
+               comp_mode="inference")
+    assert m2.strategy
+
+    # corrupt geometry -> import gate fails with findings
+    from flexflow_tpu.analysis import AnalysisError
+
+    data = json.load(open(path))
+    data["__meta__"]["disaggregation"]["pages_per_seq"] = 999
+    bad_path = str(tmp_path / "bad.json")
+    json.dump(data, open(bad_path, "w"))
+    cfg3 = ff.FFConfig(batch_size=32, num_devices=N_DEV,
+                       cost_cache_file="",
+                       import_strategy_file=bad_path)
+    m3 = build_gpt_decode(cfg3, **kw)
+    with pytest.raises(AnalysisError):
+        m3.compile(loss_type="sparse_categorical_crossentropy",
+                   metrics=[], comp_mode="inference")
+
+
+def test_str211_disagg_meta_lint(tmp_path):
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        from fflint import lint_strategy_file
+    finally:
+        sys.path.pop(0)
+
+    good = {
+        "graph_digest": "d" * 32,
+        "serving": {"objective": "serve", "max_seqs": 32,
+                    "page_size": 16, "pages_per_seq": 32,
+                    "quantile": 0.99, "p99_budget_ms": 0.0},
+        "disaggregation": {
+            "num_devices": 8, "prefill_devices": 4,
+            "decode_devices": 4, "chunk": 32, "prefill_seq_len": 128,
+            "max_seqs": 32, "page_size": 16, "pages_per_seq": 32,
+            "colocated_step_ms": 0.4, "disagg_step_ms": 0.35,
+            "handoff_ms": 0.09, "prefill_tokens_per_frame": 128.0,
+            "spans_dcn": False,
+            "slo_classes": [{"name": "interactive", "priority": 2,
+                             "deadline_frames": 64, "quantile": 0.99}],
+        },
+    }
+    base = {"lm_head": {"dims": [8, 1, 1], "replica": 1, "start": 0}}
+
+    def write(meta):
+        p = tmp_path / "strategy.json"
+        p.write_text(json.dumps({**base, "__meta__": meta}))
+        return str(p)
+
+    assert not [f for f in lint_strategy_file(write(good))
+                if f[1] == "STR211"]
+    dg = good["disaggregation"]
+    corruptions = [
+        ("not-an-object", {**good, "disaggregation": [1]}),
+        ("zero block", {**good, "disaggregation": {
+            **dg, "prefill_devices": 0}}),
+        ("overflow", {**good, "disaggregation": {
+            **dg, "decode_devices": 7}}),
+        ("bool chunk", {**good, "disaggregation": {**dg, "chunk": True}}),
+        ("geometry vs serving", {**good, "disaggregation": {
+            **dg, "page_size": 64}}),
+        ("nan price", {**good, "disaggregation": {
+            **dg, "handoff_ms": float("nan")}}),
+        ("dup slo", {**good, "disaggregation": {
+            **dg, "slo_classes": [{"name": "a"}, {"name": "a"}]}}),
+        ("bad quantile", {**good, "disaggregation": {
+            **dg, "slo_classes": [{"name": "a", "quantile": 1.5}]}}),
+        ("negative deadline", {**good, "disaggregation": {
+            **dg, "slo_classes": [{"name": "a",
+                                   "deadline_frames": -1}]}}),
+    ]
+    for label, meta in corruptions:
+        found = [f for f in lint_strategy_file(write(meta))
+                 if f[1] == "STR211" and f[0] == "error"]
+        assert found, f"corruption {label!r} not caught by STR211"
+
+
+def test_serving_spec_signature_unchanged_by_phase_fields():
+    """Bit-identity guard: the phase-split arrival fields must NOT
+    enter the cost-row signature — serve cost rows keyed before this
+    PR must keep serving."""
+    from flexflow_tpu.search.serving import ServingSpec
+
+    a = ServingSpec(max_seqs=16, page_size=16, pages_per_seq=16)
+    b = ServingSpec(max_seqs=16, page_size=16, pages_per_seq=16,
+                    prompt_tokens_mean=128, decode_tokens_mean=32)
+    assert a.signature() == b.signature()
+    assert b.prefill_tokens_per_frame() == 16 * (128.0 / 32.0)

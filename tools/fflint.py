@@ -179,6 +179,8 @@ def lint_strategy_file(path: str,
             meta["pipeline"], {k for k in data if k != META_KEY})
     if isinstance(meta, dict) and "serving" in meta:
         out += _lint_serving_meta(meta["serving"])
+    if isinstance(meta, dict) and "disaggregation" in meta:
+        out += _lint_disagg_meta(meta["disaggregation"], meta)
     if isinstance(meta, dict):
         out += _lint_calibration_signature(meta, path, calibration_path)
     views = {k: v for k, v in data.items() if k != META_KEY}
@@ -253,6 +255,95 @@ def _lint_serving_meta(sv) -> List[Tuple[str, str, str]]:
         out.append(("error", "STR209",
                     f"serving meta kv_bytes_per_device {kv!r} is not a "
                     f"non-negative finite number"))
+    return out
+
+
+def _lint_disagg_meta(dm, meta) -> List[Tuple[str, str, str]]:
+    """STR211: structural lint of a persisted
+    ``__meta__.disaggregation`` block (the searched prefill/decode
+    two-block placement + SLO classes, search/disaggregation.py).
+    Graph-side legality (pool-geometry agreement with the decode ops,
+    the shared-parameter-set bridge — SHD164/165) needs the graph and
+    runs at import/compile time; this proves what the file alone can:
+    a coherent disjoint frame, a sane chunk, pool geometry that agrees
+    with the sibling ``__meta__.serving`` block, finite prices, and a
+    well-formed SLO-class table."""
+    if not isinstance(dm, dict):
+        return [("error", "STR211", "disaggregation meta is not an "
+                 "object")]
+    out: List[Tuple[str, str, str]] = []
+    ints = {}
+    for k in ("num_devices", "prefill_devices", "decode_devices",
+              "chunk", "prefill_seq_len", "max_seqs", "page_size",
+              "pages_per_seq"):
+        v = dm.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            out.append(("error", "STR211",
+                        f"disaggregation meta {k} is not a positive "
+                        f"int: {v!r}"))
+        else:
+            ints[k] = v
+    if ("prefill_devices" in ints and "decode_devices" in ints
+            and "num_devices" in ints
+            and ints["prefill_devices"] + ints["decode_devices"]
+            > ints["num_devices"]):
+        out.append(("error", "STR211",
+                    f"disaggregation blocks overflow: prefill "
+                    f"{ints['prefill_devices']} + decode "
+                    f"{ints['decode_devices']} devices on a "
+                    f"{ints['num_devices']}-device machine"))
+    sv = meta.get("serving") if isinstance(meta, dict) else None
+    if isinstance(sv, dict):
+        for k in ("max_seqs", "page_size", "pages_per_seq"):
+            if k in ints and isinstance(sv.get(k), int) \
+                    and sv[k] != ints[k]:
+                out.append(("error", "STR211",
+                            f"disaggregation meta {k}={ints[k]} "
+                            f"disagrees with __meta__.serving "
+                            f"{k}={sv[k]} — one page allocator must "
+                            f"serve both sides of the handoff"))
+    for k in ("colocated_step_ms", "disagg_step_ms", "handoff_ms",
+              "prefill_tokens_per_frame"):
+        v = dm.get(k)
+        if v is not None and (
+                not isinstance(v, (int, float)) or isinstance(v, bool)
+                or not math.isfinite(float(v)) or float(v) < 0.0):
+            out.append(("error", "STR211",
+                        f"disaggregation meta {k} {v!r} is not a "
+                        f"non-negative finite number"))
+    classes = dm.get("slo_classes", [])
+    if not isinstance(classes, list):
+        return out + [("error", "STR211",
+                       f"disaggregation meta slo_classes is not a "
+                       f"list: {str(classes)[:60]}")]
+    seen = set()
+    for i, c in enumerate(classes):
+        if not isinstance(c, dict) or not isinstance(c.get("name"), str) \
+                or not c.get("name"):
+            out.append(("error", "STR211",
+                        f"slo_classes[{i}] is not a named class "
+                        f"object"))
+            continue
+        if c["name"] in seen:
+            out.append(("error", "STR211",
+                        f"slo_classes[{i}] duplicates {c['name']!r}"))
+        seen.add(c["name"])
+        p = c.get("priority", 0)
+        if not isinstance(p, int) or isinstance(p, bool):
+            out.append(("error", "STR211",
+                        f"slo class {c['name']!r} priority {p!r} is "
+                        f"not an int"))
+        df = c.get("deadline_frames", 0)
+        if not isinstance(df, int) or isinstance(df, bool) or df < 0:
+            out.append(("error", "STR211",
+                        f"slo class {c['name']!r} deadline_frames "
+                        f"{df!r} is not a non-negative int"))
+        q = c.get("quantile", 0.99)
+        if not isinstance(q, (int, float)) or isinstance(q, bool) \
+                or not (0.0 < float(q) < 1.0):
+            out.append(("error", "STR211",
+                        f"slo class {c['name']!r} quantile {q!r} "
+                        f"outside (0, 1)"))
     return out
 
 

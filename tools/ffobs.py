@@ -468,6 +468,21 @@ def render_report(events: List[dict], top: int = 10,
             + f", KV residency {kv / 1e6:.1f} MB/device"
             + (" — champion-vs-DP floor kept plain DP"
                if s.get("kept_dp") else ""))
+    disaggs = [e for e in events if e.get("kind") == "search.disagg"]
+    if disaggs:
+        d = disaggs[-1]
+        verdict = (
+            f"ADOPTED prefill[0:{d.get('prefill_devices')}) + "
+            f"decode[{d.get('prefill_devices')}:"
+            f"{(d.get('prefill_devices') or 0) + (d.get('decode_devices') or 0)})"
+            if d.get("adopted") else "colocated stays optimal")
+        lines.append(
+            f"Disaggregation search: colocated "
+            f"{d.get('colocated_ms')} ms vs disaggregated "
+            f"{d.get('disagg_ms')} ms per frame (KV handoff "
+            f"{d.get('handoff_ms')} ms"
+            + (", spans DCN" if d.get("spans_dcn") else "")
+            + f") — {verdict}")
     frames = [e for e in events if e.get("kind") == "decode.frame"]
     summaries = [e for e in events if e.get("kind") == "decode.summary"]
     if frames or summaries:
@@ -493,6 +508,40 @@ def render_report(events: List[dict], top: int = 10,
                     f"{_ms(s.get('tpot_p99_s'))} ms, e2e p99 "
                     f"{_ms(s.get('e2e_p99_s'))} ms, queue wait p99 "
                     f"{_ms(s.get('queue_p99_s'))} ms")
+            if s.get("prefill_p50_s") is not None:
+                # the TTFT split (queue + prefill + first decode frame
+                # sum to TTFT): which phase the prompt path's cost
+                # lives in — the attribution that makes the chunked-
+                # prefill win a number per phase, not a vibe
+                lines.append(
+                    f"TTFT split (p50): queue "
+                    f"{_ms(s.get('queue_p50_s'))} + prefill "
+                    f"{_ms(s.get('prefill_p50_s'))} + first frame "
+                    f"{_ms(s.get('first_frame_p50_s'))} ms "
+                    f"(p99: {_ms(s.get('queue_p99_s'))} + "
+                    f"{_ms(s.get('prefill_p99_s'))} + "
+                    f"{_ms(s.get('first_frame_p99_s'))} ms)")
+            if s.get("prefill_chunks"):
+                lines.append(
+                    f"Chunked prefill lane: {s.get('prefill_tokens')} "
+                    f"prompt tokens in {s.get('prefill_chunks')} "
+                    f"chunk pass(es) — vs one decode frame per token "
+                    f"without the lane")
+            if s.get("expired") or s.get("preempted"):
+                lines.append(
+                    f"SLO scheduling: {s.get('expired', 0)} request(s) "
+                    f"expired past their deadline, "
+                    f"{s.get('preempted', 0)} preemption(s)")
+            if s.get("slo_classes"):
+                lines.append("")
+                lines.append("| SLO class | completed | TTFT p99 ms | "
+                             "e2e p99 ms |")
+                lines.append("|---|---|---|---|")
+                for name, row in sorted(s["slo_classes"].items()):
+                    lines.append(
+                        f"| {name} | {row.get('completed')} | "
+                        f"{_ms(row.get('ttft_p99_s'))} | "
+                        f"{_ms(row.get('e2e_p99_s'))} |")
         requests = [e for e in events if e.get("kind") == "decode.request"]
         if requests:
             lines.append("")

@@ -1130,6 +1130,323 @@ def _disagg_sweep_md_lines(sweep):
     return lines
 
 
+# the mixed-SLO class table every fleet leg shares: an interactive
+# trickle (1/8 of arrivals, priority 2, 64-frame deadline), a standard
+# stream (2/8), and a batch flood (5/8 of arrivals, watched at p90) —
+# the weighted-arrival shape where per-class routing has something to
+# decide (equal-weight classes make uniform routing trivially optimal)
+FLEET_SLO = ("interactive:2:64:0.99:1,standard:1:0:0.99:2,"
+             "batch:0:0:0.9:5")
+
+
+def fleet_sweep(n_devices):
+    """The --fleet sweep, two legs:
+
+    (1) SIMULATED fleet search (search/fleet.py) on the chat decode
+    config and the TPU machine model: ``propose_fleet`` enumerates
+    replica-block partitions x per-SLO-class routing policies, each
+    block's strategy re-searched at its own width, every candidate
+    priced by the phase-split serving simulator in per-class p99
+    currency.  Recorded at nominal offered load, then re-searched at
+    1.8x — the drift episode: the controller's re-search re-sizes the
+    fleet (more, narrower replicas once queueing dominates).
+
+    (2) MEASURED mixed-SLO serving on the CPU host mesh: the fleet the
+    search picks FOR THE HOST MACHINE MODEL (max_replicas=3 so the
+    partition space holds unequal widths) serves a seeded 32-request
+    interactive/standard/batch trace against the single-replica and
+    naive uniform-fleet (even halving, uniform routing) baselines —
+    same compiled frames, same trace, token-identity asserted,
+    per-class TTFT/e2e p99 measured via the fleet roll-up
+    (runtime/fleet.py)."""
+    import os
+    import random
+    import tempfile
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.obs.events import BUS
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+        SLOClass,
+        compiled_decode_step,
+    )
+    from flexflow_tpu.runtime.fleet import FleetExecutor
+    from flexflow_tpu.search.driver import optimize_strategy
+    from flexflow_tpu.search.fleet import propose_fleet
+
+    sweep = {
+        "devices": n_devices,
+        "slo_classes": FLEET_SLO,
+        "note": (
+            "fleet leg simulated on the TPU machine model (per-class "
+            "p99 currency: each replica block's searched strategy "
+            "re-simulated at its routed share's occupancy, priority-"
+            "aware queueing per class); serving leg MEASURED on the "
+            "CPU host mesh — the fleet the search picks for the HOST "
+            "machine model serves a seeded mixed-SLO trace against "
+            "single-replica and uniform-fleet baselines"),
+    }
+
+    def _prop_row(prop):
+        if prop is None:
+            return {"proposal": None}
+        return {
+            "replicas": [r.devices for r in prop.replicas],
+            "routing_policy": prop.routing_policy,
+            "routing": {c: [round(f, 3) for f in fr]
+                        for c, fr in sorted(prop.routing.items())},
+            "single_ms": round(prop.single_cost_s * 1e3, 4),
+            "fleet_ms": round(prop.fleet_cost_s * 1e3, 4),
+            "per_class_p99_ms": {
+                c: round(v * 1e3, 4)
+                for c, v in sorted(prop.per_class_p99_s.items())},
+            "adopted": prop.adopted,
+            "win_ratio": round(
+                prop.single_cost_s / max(prop.fleet_cost_s, 1e-12), 3),
+        }
+
+    # ---- (1) simulated: searched fleet + drift-episode re-size -------
+    cfg = ff.FFConfig(
+        batch_size=8, num_devices=n_devices, search_budget=8,
+        search_timeout_s=60.0, objective="serve",
+        comp_mode="inference", cost_cache_file="",
+        serve_slo_classes=FLEET_SLO, **CHAT_ARRIVAL)
+    m = build_gpt_decode(cfg, **GPT_DECODE_CHAT_KW)
+    t0 = time.monotonic()
+    g, s = optimize_strategy(m.graph, cfg, return_graph=True)
+    base = m.graph if g is not m.graph else None
+    nominal = propose_fleet(g, s, cfg, base_graph=base)
+    drift = propose_fleet(g, s, cfg, base_graph=base, load_scale=1.8)
+    sim = {
+        "config": "gpt_decode_chat (2L, h2048) on the TPU machine "
+                  "model, serve objective, chat arrival",
+        "search_seconds": round(time.monotonic() - t0, 2),
+        "nominal": _prop_row(nominal),
+        "drift": {"load_scale": 1.8, **_prop_row(drift)},
+    }
+    if nominal is not None and drift is not None:
+        sim["drift"]["resized"] = (
+            len(drift.replicas) != len(nominal.replicas))
+    sweep["simulated"] = sim
+    print(json.dumps({"fleet_sweep": "simulated", **sim}))
+
+    # ---- (2) measured: searched fleet vs baselines on the host mesh --
+    kw = dict(vocab=256, num_layers=2, hidden=64, num_heads=4,
+              ff_dim=128, page_size=8, pages_per_seq=8)
+    cfg_h = ff.FFConfig(
+        batch_size=8, num_devices=n_devices, search_budget=4,
+        search_timeout_s=30.0, objective="serve",
+        comp_mode="inference", cost_cache_file="",
+        serve_slo_classes=FLEET_SLO, serve_fleet_max_replicas=3,
+        machine_spec=MachineSpec.host_cpu(n_devices))
+    m_h = build_gpt_decode(cfg_h, **kw)
+    g_h, s_h = optimize_strategy(m_h.graph, cfg_h, return_graph=True)
+    prop_h = propose_fleet(
+        g_h, s_h, cfg_h,
+        base_graph=m_h.graph if g_h is not m_h.graph else None)
+    measured = {
+        "config": "gpt_decode small (2L, h64) on the CPU host mesh, "
+                  "32-request seeded interactive/standard/batch trace "
+                  "(seed 7, arrival weights 1:2:5)",
+        "host_search": _prop_row(prop_h),
+    }
+
+    classes = [SLOClass(name=c["name"], priority=c["priority"],
+                        deadline_frames=c["deadline_frames"],
+                        quantile=c["quantile"])
+               for c in cfg_h.serve_slo_classes]
+    class_names = [c.name for c in classes]
+
+    rng = random.Random(7)
+    trace = []
+    for i in range(32):
+        slo = rng.choices(class_names, weights=[1, 2, 5])[0]
+        plen = rng.randint(4, 32)
+        trace.append(DecodeRequest(
+            rid=f"r{i:02d}",
+            prompt=[rng.randrange(2, 250) for _ in range(plen)],
+            max_new_tokens=rng.randint(4, 12), slo=slo))
+
+    # one compiled decode frame per replica width, shared across the
+    # variants (fresh executors each run; the frames are stateless)
+    steps = {}
+
+    def _step_for(width):
+        if width not in steps:
+            c = ff.FFConfig(batch_size=8, num_devices=width,
+                            comp_mode="inference", cost_cache_file="",
+                            machine_spec=MachineSpec.host_cpu(width))
+            mm = build_gpt_decode(c, **kw)
+            mm.compile(loss_type="sparse_categorical_crossentropy",
+                       metrics=[], comp_mode="inference")
+            step = compiled_decode_step(mm)
+            # jit-warm outside timing (a server pays compile once)
+            ContinuousBatchingExecutor(
+                step, max_seqs=8, page_size=8, pages_per_seq=8).run(
+                [DecodeRequest(rid="w", prompt=[1, 2, 3],
+                               max_new_tokens=2)], max_frames=20)
+            steps[width] = step
+        return steps[width]
+
+    def _measure(widths, routing):
+        reps = [ContinuousBatchingExecutor(
+                    _step_for(w), max_seqs=8, page_size=8,
+                    pages_per_seq=8, slo_classes=classes,
+                    replica_label=str(i))
+                for i, w in enumerate(widths)]
+        fl = FleetExecutor(reps, routing, slo_classes=classes, seed=7)
+        t0 = time.monotonic()
+        out = fl.run(trace)
+        wall = time.monotonic() - t0
+        summ = fl.summary()
+        row = {"replicas": list(widths), "wall_s": round(wall, 2),
+               "per_class": {}}
+        for name, d in sorted(summ["slo_classes"].items()):
+            row["per_class"][name] = {
+                "completed": d["completed"],
+                "ttft_p99_ms": round((d["ttft_p99_s"] or 0) * 1e3, 1),
+                "e2e_p99_ms": round((d["e2e_p99_s"] or 0) * 1e3, 1),
+            }
+        toks = {k: tuple(v) for k, v in out.items()
+                if not k.startswith("w")}
+        return row, toks
+
+    half = max(1, n_devices // 2)
+    variants = {
+        "single_replica": ([n_devices],
+                           {c: [1.0] for c in class_names}),
+        "uniform_fleet": ([half, half],
+                          {c: [0.5, 0.5] for c in class_names}),
+    }
+    if prop_h is not None and len(prop_h.replicas) > 1:
+        variants["searched_fleet"] = (
+            [r.devices for r in prop_h.replicas], prop_h.routing)
+    else:
+        measured["note"] = ("host search kept a single replica — no "
+                            "searched variant to measure")
+
+    # the roll-up percentiles need the request records, which only
+    # stamp while the obs bus is armed; borrow a scratch log when the
+    # caller has not configured one (and leave theirs alone when it has)
+    scratch = None
+    if not BUS.enabled:
+        scratch = tempfile.mktemp(suffix=".jsonl")
+        BUS.configure(scratch)
+    try:
+        tok_sets = []
+        for vname, (widths, routing) in variants.items():
+            row, toks = _measure(widths, routing)
+            measured[vname] = row
+            tok_sets.append(toks)
+            print(json.dumps({"fleet_sweep": vname, **row}))
+    finally:
+        if scratch is not None:
+            BUS.close()
+            if os.path.exists(scratch):
+                os.remove(scratch)
+    measured["token_identical"] = all(
+        t == tok_sets[0] for t in tok_sets[1:])
+    if not measured["token_identical"]:
+        measured["note"] = ("TOKEN MISMATCH across fleet variants — "
+                            "routing must not change what is generated")
+    sweep["measured"] = measured
+    print(json.dumps({"fleet_sweep": "token_identical",
+                      "value": measured["token_identical"]}))
+    return sweep
+
+
+def _fleet_sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## Serving fleet",
+        "",
+        sweep.get("note", ""),
+        "",
+    ]
+    sim = sweep.get("simulated") or {}
+    nom = sim.get("nominal") or {}
+    dri = sim.get("drift") or {}
+
+    def _sim_row(tag, r, ls):
+        if not r or r.get("proposal", "x") is None:
+            return f"| {tag} | {ls} | — | — | — | — | — | no |"
+        pc = "; ".join(f"{c} {v}" for c, v in
+                       (r.get("per_class_p99_ms") or {}).items())
+        return (f"| {tag} | {ls} | {r.get('replicas')} | "
+                f"{r.get('routing_policy')} | {r.get('single_ms')} | "
+                f"{r.get('fleet_ms')} | {pc} | "
+                f"{'YES' if r.get('adopted') else 'no'} |")
+
+    lines += [
+        f"Simulated fleet search ({sim.get('config', '')}):",
+        "",
+        "| episode | load | replicas | routing | single ms | fleet ms "
+        "| per-class p99 ms | adopted |",
+        "|---|---|---|---|---|---|---|---|",
+        _sim_row("nominal", nom, 1.0),
+        _sim_row("drift re-search", dri, dri.get("load_scale", "—")),
+    ]
+    if nom.get("replicas") and dri.get("replicas"):
+        k0, k1 = len(nom["replicas"]), len(dri["replicas"])
+        lines += [
+            "",
+            f"Drift episode: offered load x{dri.get('load_scale')} "
+            f"re-sizes the fleet {k0} -> {k1} replicas "
+            f"({'RESIZED' if k0 != k1 else 'shape held'}) — queueing "
+            f"dominance pushes the search toward more, narrower "
+            f"blocks; the controller applies the same re-search live "
+            f"on measured per-class p99 drift "
+            f"(runtime/controller.py observe_fleet).",
+        ]
+    meas = sweep.get("measured") or {}
+    if meas:
+        hs = meas.get("host_search") or {}
+        names = []
+        for v in ("single_replica", "uniform_fleet", "searched_fleet"):
+            for c in (meas.get(v) or {}).get("per_class", {}):
+                if c not in names:
+                    names.append(c)
+        lines += [
+            "",
+            f"Measured mixed-SLO serving ({meas.get('config', '')}); "
+            f"host-model search picked {hs.get('replicas')} with "
+            f"'{hs.get('routing_policy')}' routing; token-identical "
+            f"{'YES' if meas.get('token_identical') else 'NO'}.",
+            "",
+            "| fleet | replicas | wall s | "
+            + " | ".join(f"{c} TTFT/e2e p99 ms" for c in names)
+            + " |",
+            "|---|---|---|" + "---|" * len(names),
+        ]
+        for v in ("single_replica", "uniform_fleet", "searched_fleet"):
+            r = meas.get(v)
+            if not r:
+                continue
+            cells = []
+            for c in names:
+                d = r["per_class"].get(c)
+                cells.append(f"{d['ttft_p99_ms']} / {d['e2e_p99_ms']}"
+                             if d else "—")
+            lines.append(f"| {v.replace('_', ' ')} | {r['replicas']} | "
+                         f"{r['wall_s']} | " + " | ".join(cells) + " |")
+    lines += [
+        "",
+        "The fleet is the searched N-block serving placement "
+        "(search/fleet.py): each replica block gets its own rewriting "
+        "search at its width, candidate fleets are priced in per-class "
+        "p99 currency with per-SLO-class routing fractions as decision "
+        "variables, and runtime/fleet.py executes the winner — N "
+        "continuous-batching replicas behind a deficit router honoring "
+        "the searched fractions.  The measured leg keeps all variants "
+        "token-identical: routing decides WHERE a request queues, "
+        "never what it generates.",
+    ]
+    return lines
+
+
 def co_search_sweep(n_devices):
     """The --co-search sweep: sequential (strategy→plan) vs JOINT
     strategy x comm-plan pricing (search/comm_plan.py, ROADMAP item 2).
@@ -2270,6 +2587,18 @@ def main():
     ap.add_argument("--disagg-only", action="store_true",
                     help="run ONLY the disaggregation sweep and merge "
                          "it into existing BENCH_SEARCH artifacts")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run the serving-fleet sweep: searched "
+                         "N-replica-block fleets with per-SLO-class "
+                         "routing priced in per-class p99 currency "
+                         "(incl. a drift-episode re-size), plus "
+                         "MEASURED mixed-SLO serving on the CPU host "
+                         "mesh — searched fleet vs single-replica and "
+                         "uniform-fleet baselines (search/fleet.py, "
+                         "runtime/fleet.py)")
+    ap.add_argument("--fleet-only", action="store_true",
+                    help="run ONLY the serving-fleet sweep and merge "
+                         "it into existing BENCH_SEARCH artifacts")
     ap.add_argument("--always-on", action="store_true",
                     help="also run the always-on controller scenario: "
                          "injected calibration drift (re-search + hot "
@@ -2468,6 +2797,39 @@ def main():
                         report["disagg_sweep"]))
                     + "\n" + tail)
         print(f"# merged disaggregation sweep into {path} / {md}")
+        return
+    if args.fleet_only:
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["fleet_sweep"] = fleet_sweep(args.devices)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous serving-fleet section (same
+            # merge discipline as the other --*-only modes)
+            marker = "\n## Serving fleet"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_fleet_sweep_md_lines(
+                        report["fleet_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged serving-fleet sweep into {path} / {md}")
         return
     if args.scale_only:
         path = f"{args.out_prefix}.json"
@@ -2829,6 +3191,8 @@ def main():
         report["serve_sweep"] = serve_sweep(args.devices)
     if args.disagg:
         report["disagg_sweep"] = disagg_sweep(args.devices)
+    if args.fleet:
+        report["fleet_sweep"] = fleet_sweep(args.devices)
     if args.always_on:
         report["always_on"] = always_on_sweep(args.devices)
     if args.obs:
@@ -2919,6 +3283,8 @@ def main():
         lines += _serve_sweep_md_lines(report["serve_sweep"])
     if report.get("disagg_sweep"):
         lines += _disagg_sweep_md_lines(report["disagg_sweep"])
+    if report.get("fleet_sweep"):
+        lines += _fleet_sweep_md_lines(report["fleet_sweep"])
     if report.get("always_on"):
         lines += _always_on_md_lines(report["always_on"])
     if report.get("obs_lanes"):

@@ -53,6 +53,7 @@ from flexflow_tpu.analysis.placement import (
 )
 from flexflow_tpu.analysis.sharding import (
     lint_disaggregation,
+    lint_fleet,
     lint_reduction_plan,
     lint_serving,
     lint_strategy,
@@ -74,6 +75,7 @@ __all__ = [
     "set_verify",
     "verification_enabled",
     "lint_disaggregation",
+    "lint_fleet",
     "lint_pipeline_stages",
     "lint_placement",
     "lint_reduction_plan",

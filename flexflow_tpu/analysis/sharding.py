@@ -855,3 +855,193 @@ def lint_disaggregation(decode_graph, meta, config, prefill_graph=None,
                         for g, mv in strategy.items() if mv is not None}
             findings += lint_strategy(graph, stripped, width)
     return findings
+
+
+# ---------------------------------------------------------------------------
+# serving-fleet legality (SHD166/167)
+# ---------------------------------------------------------------------------
+def lint_fleet(decode_graph, meta, config,
+               replica_blocks=None) -> List[Finding]:
+    """Legality of a serving-fleet proposal/artifact (``__meta__.fleet``,
+    search/fleet.py) against the decode graph it targets — the
+    always-on gate at proposal time and the re-lint at import:
+
+    * **SHD166** N-block frame structure: a non-empty replica list with
+      positive integer widths, non-negative starts, blocks pairwise
+      DISJOINT and inside the machine; each replica's intra split
+      (prefill_devices/decode_devices) fits its own width; the decode
+      graph actually HAS decode-attention ops.
+    * **SHD167** routing + pool coherence: every SLO class the table
+      names is covered by a routing row whose per-replica fractions
+      are in [0, 1] and sum to 1; routing rows name no unknown class
+      and are sized to the replica list; the persisted pool geometry
+      (max_seqs, page_size, pages_per_seq) matches every decode op's
+      own attrs — every replica runs the SAME deployment frame, one
+      request must be servable anywhere its class routes; the
+      SLO-class table is structurally sound.
+
+    When per-replica ``replica_blocks`` — (graph, strategy, width)
+    triples — are supplied (proposal time), each block additionally
+    passes the flat SHD101-110 lint under ITS OWN submesh width, the
+    same per-segment discipline as ``lint_disaggregation``."""
+    from flexflow_tpu.search.serving import decode_nodes
+
+    def _f(code, message, **kw):
+        return Finding(code=code, pass_name="fleet", message=message,
+                       **kw)
+
+    findings: List[Finding] = []
+    if not isinstance(meta, dict):
+        return [_f("SHD166", "fleet meta is not an object")]
+    nodes = decode_nodes(decode_graph)
+    if not nodes:
+        findings.append(_f(
+            "SHD166",
+            "fleet artifact targets a graph with no decode-attention "
+            "ops — there is nothing to replicate"))
+    reps = meta.get("replicas")
+    if not isinstance(reps, list) or not reps:
+        return findings + [_f(
+            "SHD166",
+            f"fleet meta carries no replica list: {reps!r}")]
+    n = getattr(config, "search_devices", 0) or config.num_devices
+    spans = []
+    for i, r in enumerate(reps):
+        if not isinstance(r, dict):
+            findings.append(_f(
+                "SHD166", f"replicas[{i}] is not an object: {r!r}"))
+            continue
+        try:
+            w = int(r.get("devices", 0))
+            s = int(r.get("start", -1))
+            a = int(r.get("prefill_devices", 0))
+            b = int(r.get("decode_devices", 0))
+        except (TypeError, ValueError):
+            findings.append(_f(
+                "SHD166",
+                f"replicas[{i}] has non-integer block fields "
+                f"({r.get('devices')!r}, {r.get('start')!r}, "
+                f"{r.get('prefill_devices')!r}, "
+                f"{r.get('decode_devices')!r})"))
+            continue
+        if w < 1 or s < 0:
+            findings.append(_f(
+                "SHD166",
+                f"replicas[{i}] block [{s}, {s + w}) is not a "
+                f"non-empty in-range device block"))
+            continue
+        if s + w > n:
+            findings.append(_f(
+                "SHD166",
+                f"replicas[{i}] block [{s}, {s + w}) overflows the "
+                f"{n}-device mesh"))
+        if a < 0 or b < 1 or a + b > w:
+            findings.append(_f(
+                "SHD166",
+                f"replicas[{i}] intra split prefill={a} + decode={b} "
+                f"does not fit its {w}-device block"))
+        spans.append((s, s + w, i))
+    spans.sort()
+    for (s0, e0, i0), (s1, e1, i1) in zip(spans, spans[1:]):
+        if s1 < e0:
+            findings.append(_f(
+                "SHD166",
+                f"replica blocks overlap: replicas[{i0}] "
+                f"[{s0}, {e0}) and replicas[{i1}] [{s1}, {e1}) share "
+                f"devices — two page pools cannot own one HBM"))
+
+    # SHD167: pool geometry must agree across every replica
+    geo = (meta.get("max_seqs"), meta.get("page_size"),
+           meta.get("pages_per_seq"))
+    for node in nodes:
+        got = (node.op.max_seqs, node.op.attrs["page_size"],
+               node.op.attrs["pages_per_seq"])
+        if got != geo:
+            findings.append(_f(
+                "SHD167",
+                f"decode op {node.op.name!r} frame geometry {got} "
+                f"disagrees with the persisted fleet geometry {geo} — "
+                f"a request routed across replicas would land in a "
+                f"different pool shape",
+                node=node.guid, op=node.op.name))
+    classes = meta.get("slo_classes", [])
+    names = set()
+    if not isinstance(classes, list):
+        findings.append(_f(
+            "SHD167", f"slo_classes is not a list: {classes!r}"))
+        classes = []
+    for i, c in enumerate(classes):
+        if not isinstance(c, dict) or not c.get("name") \
+                or not isinstance(c.get("name"), str):
+            findings.append(_f(
+                "SHD167",
+                f"slo_classes[{i}] is not a named class object"))
+            continue
+        if c["name"] in names:
+            findings.append(_f(
+                "SHD167",
+                f"slo_classes[{i}] duplicates {c['name']!r}"))
+        names.add(c["name"])
+        df = c.get("deadline_frames", 0)
+        if not isinstance(df, int) or isinstance(df, bool) or df < 0:
+            findings.append(_f(
+                "SHD167",
+                f"slo class {c['name']!r} deadline_frames {df!r} is "
+                f"not a non-negative int"))
+        q = c.get("quantile", 0.99)
+        if not isinstance(q, (int, float)) or isinstance(q, bool) \
+                or not (0.0 < float(q) < 1.0):
+            findings.append(_f(
+                "SHD167",
+                f"slo class {c['name']!r} quantile {q!r} outside "
+                f"(0, 1)"))
+    routing = meta.get("routing")
+    if not isinstance(routing, dict) or not routing:
+        findings.append(_f(
+            "SHD167", f"fleet meta carries no routing table: "
+                      f"{routing!r}"))
+        routing = {}
+    for cname, fr in sorted(routing.items()):
+        if names and cname not in names:
+            findings.append(_f(
+                "SHD167",
+                f"routing row {cname!r} names an unknown SLO class "
+                f"(table: {sorted(names)})"))
+        if (not isinstance(fr, list) or len(fr) != len(reps)
+                or not all(isinstance(v, (int, float))
+                           and not isinstance(v, bool) for v in fr)):
+            findings.append(_f(
+                "SHD167",
+                f"routing row {cname!r} is not a list of "
+                f"{len(reps)} fractions: {fr!r}"))
+            continue
+        if any(v < 0.0 or v > 1.0 for v in fr):
+            findings.append(_f(
+                "SHD167",
+                f"routing row {cname!r} has fractions outside "
+                f"[0, 1]: {fr}"))
+        elif abs(sum(fr) - 1.0) > 1e-3:
+            findings.append(_f(
+                "SHD167",
+                f"routing row {cname!r} fractions sum to "
+                f"{sum(fr):.6f}, not 1 — traffic would be dropped or "
+                f"duplicated"))
+    for cname in sorted(names - set(routing)):
+        findings.append(_f(
+            "SHD167",
+            f"SLO class {cname!r} has no routing row — its requests "
+            f"have nowhere to go"))
+
+    # per-replica flat lint (proposal time only — imports carry no
+    # per-replica strategies): every replica compiles over its OWN
+    # submesh, so its views must pass the gate in that geometry
+    if replica_blocks and not errors_only(findings):
+        from flexflow_tpu.compiler.placement_lowering import _strip_start
+
+        for graph, strategy, width in replica_blocks:
+            if graph is None or strategy is None:
+                continue
+            stripped = {g: _strip_start(mv)
+                        for g, mv in strategy.items() if mv is not None}
+            findings += lint_strategy(graph, stripped, width)
+    return findings

@@ -17,23 +17,29 @@ from flexflow_tpu.core.machine import MachineSpec
 
 def parse_slo_classes(value) -> Tuple[Dict, ...]:
     """Normalize the SLO-class table: the CLI spelling
-    ``"name:priority:deadline_frames[:quantile][,...]"`` or an iterable
-    of dicts -> a tuple of ``{"name", "priority", "deadline_frames",
-    "quantile"}`` dicts (runtime/decode.py ``SLOClass`` consumes them;
-    the winning disaggregation persists them in ``__meta__``)."""
+    ``"name:priority:deadline_frames[:quantile[:weight]][,...]"`` or an
+    iterable of dicts -> a tuple of ``{"name", "priority",
+    "deadline_frames", "quantile", "weight"}`` dicts
+    (runtime/decode.py ``SLOClass`` consumes them; the winning
+    disaggregation/fleet persists them in ``__meta__``).  ``weight`` is
+    the class's RELATIVE arrival rate (default 1 = classes arrive
+    equally often) — the fleet search prices routing against it, so an
+    interactive trickle and a batch flood are different placement
+    questions."""
     if isinstance(value, str):
         classes = []
         for part in value.split(","):
             fields = part.split(":")
-            if len(fields) not in (3, 4):
+            if len(fields) not in (3, 4, 5):
                 raise ValueError(
                     f"SLO class {part!r} must be "
-                    f"name:priority:deadline_frames[:quantile]")
+                    f"name:priority:deadline_frames[:quantile[:weight]]")
             classes.append({
                 "name": fields[0],
                 "priority": int(fields[1]),
                 "deadline_frames": int(fields[2]),
-                "quantile": float(fields[3]) if len(fields) == 4 else 0.99,
+                "quantile": float(fields[3]) if len(fields) >= 4 else 0.99,
+                "weight": float(fields[4]) if len(fields) == 5 else 1.0,
             })
         value = classes
     out = []
@@ -41,7 +47,8 @@ def parse_slo_classes(value) -> Tuple[Dict, ...]:
     for c in value:
         c = {"name": str(c["name"]), "priority": int(c["priority"]),
              "deadline_frames": int(c.get("deadline_frames", 0)),
-             "quantile": float(c.get("quantile", 0.99))}
+             "quantile": float(c.get("quantile", 0.99)),
+             "weight": float(c.get("weight", 1.0))}
         if not c["name"] or c["name"] in seen:
             raise ValueError(
                 f"SLO class names must be unique and non-empty "
@@ -50,6 +57,10 @@ def parse_slo_classes(value) -> Tuple[Dict, ...]:
             raise ValueError(
                 f"SLO class {c['name']!r}: deadline_frames must be >= 0 "
                 f"and quantile in (0, 1)")
+        if not c["weight"] > 0.0:
+            raise ValueError(
+                f"SLO class {c['name']!r}: weight must be > 0, got "
+                f"{c['weight']}")
         seen.add(c["name"])
         out.append(c)
     return tuple(out)
@@ -189,6 +200,24 @@ class FFConfig:
     # arrival stream; 0 derives max_seq_len // 2
     serve_decode_tokens_mean: int = 0  # mean generated tokens per
     # request (slot turnover rate); 0 derives max_seq_len // 4
+    serve_fleet: str = "off"  # "off" | "search" — under
+    # objective="serve", compile() additionally searches a SERVING
+    # FLEET (search/fleet.py): N replica blocks on disjoint submeshes,
+    # each with its own full rewriting search at its width (and its own
+    # intra-replica prefill/decode split), priced together with
+    # per-SLO-class routing fractions in the per-class p99 currency; a
+    # margin-beating fleet is lint-gated (SHD166/167) and persists as
+    # __meta__.fleet (fflint STR212).  "off" (default) is byte-identical
+    # to history.
+    serve_fleet_max_replicas: int = 4  # fleet search bound
+    # (--serve-fleet-max-replicas): the partition enumeration caps at
+    # this many replica blocks.  Must be >= 1.
+    serve_fleet_offered_load: float = 0.85  # steady-state offered load
+    # of the whole deployment, in frames (1.0 = the arrival stream
+    # exactly fills one full decode frame per frame time): sets the
+    # queueing utilization the per-class p99 pricing charges each
+    # replica.  The controller's elastic re-search scales it by the
+    # measured/predicted drift ratio (observe_fleet).
     serve_slo_classes: Optional[object] = None  # request SLO classes
     # (--serve-slo-classes "name:priority:deadline_frames[:quantile],
     # ..."): priority admission / deadline expiry / preemption on the
@@ -336,6 +365,21 @@ class FFConfig:
         if self.prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {self.prefill_chunk}"
+            )
+        if self.serve_fleet not in ("off", "search"):
+            raise ValueError(
+                f"serve_fleet must be off|search, got "
+                f"{self.serve_fleet!r}"
+            )
+        if self.serve_fleet_max_replicas < 1:
+            raise ValueError(
+                f"serve_fleet_max_replicas must be >= 1, got "
+                f"{self.serve_fleet_max_replicas}"
+            )
+        if not (0.0 < self.serve_fleet_offered_load <= 4.0):
+            raise ValueError(
+                f"serve_fleet_offered_load must be in (0, 4], got "
+                f"{self.serve_fleet_offered_load}"
             )
         if self.serve_slo_classes is not None:
             self.serve_slo_classes = parse_slo_classes(
@@ -495,6 +539,18 @@ class FFConfig:
                             "(runtime/prefill.py): prompt tokens "
                             "written into the KV page pool per causal "
                             "forward pass")
+        p.add_argument("--serve-fleet", dest="serve_fleet",
+                       choices=("off", "search"), default="off",
+                       help="under objective=serve, also search a "
+                            "serving FLEET: N replica blocks on "
+                            "disjoint submeshes, per-replica strategy "
+                            "and per-SLO-class routing priced together "
+                            "in per-class p99 (search/fleet.py)")
+        p.add_argument("--serve-fleet-max-replicas",
+                       dest="serve_fleet_max_replicas", type=int,
+                       default=4,
+                       help="upper bound on fleet replica count the "
+                            "partition enumeration explores")
         p.add_argument("--serve-slo-classes", dest="serve_slo_classes",
                        type=str, default=None,
                        help="request SLO classes for the serving "
@@ -575,6 +631,8 @@ class FFConfig:
             objective=args.objective,
             serve_p99_budget_ms=args.serve_p99_budget_ms,
             serve_disaggregation=args.serve_disaggregation,
+            serve_fleet=args.serve_fleet,
+            serve_fleet_max_replicas=args.serve_fleet_max_replicas,
             prefill_chunk=args.prefill_chunk,
             serve_slo_classes=args.serve_slo_classes,
             obs_log_file=args.obs_log,

@@ -60,6 +60,11 @@ class FFModel:
         # graphs the stacked executor can't run (StagedPipelineProposal)
         self.disaggregation = None  # prefill/decode disaggregation
         # proposal, set by compile() under the serve objective
+        self.fleet = None  # serving-fleet proposal (search/fleet.py),
+        # set by compile() under serve_fleet="search"; the controller's
+        # elastic re-search hot-swaps it (research_fleet)
+        self.fleet_base_graph = None  # pre-rewrite graph the fleet
+        # re-search solves narrow blocks on (research_fleet)
         self.params = None
         self.opt_state = None
         self.state = None
@@ -456,6 +461,10 @@ class FFModel:
         # proposal (search/disaggregation.py DisaggregationProposal):
         # searched under objective="serve" +
         # serve_disaggregation="search", persisted when adopted
+        self.fleet = None  # serving-fleet proposal (search/fleet.py
+        # FleetProposal): searched under objective="serve" +
+        # serve_fleet="search", persisted when adopted
+        self.fleet_base_graph = None
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
@@ -623,6 +632,21 @@ class FFModel:
                         raise AnalysisError(
                             "imported disaggregation proposal is "
                             "illegal for this graph", bad)
+                if _imeta.get("fleet") is not None:
+                    # imported fleet provenance re-lints against THIS
+                    # graph (SHD166/167): replica blocks must tile the
+                    # mesh disjointly, routing must cover every SLO
+                    # class, and the persisted pool geometry must agree
+                    # with the target's decode ops
+                    from flexflow_tpu.analysis import lint_fleet
+
+                    bad = errors_only(lint_fleet(
+                        self.graph, _imeta["fleet"], self.config))
+                    if bad:
+                        emit_findings(bad)
+                        raise AnalysisError(
+                            "imported fleet proposal is illegal for "
+                            "this graph", bad)
                 if _imeta.get("pipeline") is not None:
                     from flexflow_tpu.analysis import (
                         Finding,
@@ -864,6 +888,35 @@ class FFModel:
                 base_graph=(_disagg_base_graph
                             if _disagg_base_graph is not self.graph
                             else None),
+            )
+        # serving fleet (search/fleet.py): under the serve objective,
+        # also price partitioning the mesh into N replica blocks with
+        # per-replica strategies and per-SLO-class routing — the
+        # N-block generalization of the disaggregation pass.  Public
+        # state like the disaggregation proposal; adopted winners
+        # persist as __meta__.fleet.
+        if (
+            searched_strategy
+            and strategy
+            and pipeline is None
+            and mesh is None
+            and comp_mode == "inference"
+            and getattr(self.config, "objective", "train") == "serve"
+            and getattr(self.config, "serve_fleet", "off") == "search"
+        ):
+            from flexflow_tpu.search.driver import coherent_calibration
+            from flexflow_tpu.search.fleet import propose_fleet
+
+            # the controller's elastic re-search needs the SAME
+            # pre-rewrite graph for its narrow-block solves (rewrites
+            # bake full-mesh views narrow blocks can't host)
+            self.fleet_base_graph = (
+                _disagg_base_graph
+                if _disagg_base_graph is not self.graph else None)
+            self.fleet = propose_fleet(
+                self.graph, strategy, self.config,
+                calibration=coherent_calibration(self.config),
+                base_graph=self.fleet_base_graph,
             )
         # sync-precision dimension of the strategy (EQuARX compressed
         # gradient collectives): build the per-weight-group wire map
@@ -1151,6 +1204,12 @@ class FFModel:
                     # (STR211).  Honest zeros persist nothing.
                     _meta["disaggregation"] = \
                         self.disaggregation.to_meta()
+                if self.fleet is not None and self.fleet.adopted:
+                    # the ADOPTED N-replica fleet (search/fleet.py —
+                    # already SHD166/167 gated at proposal); import
+                    # re-lints against the target graph, fflint checks
+                    # the frame stdlib-only (STR212)
+                    _meta["fleet"] = self.fleet.to_meta()
             # pipeline/placement proposals persist NEXT to the strategy
             # behind the same digest gate (the lint already gated them
             # at proposal time; fflint strategy re-checks the frame

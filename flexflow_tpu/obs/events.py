@@ -69,6 +69,18 @@ EVENT_KINDS = {
     # two-block placement was adopted (honest zero = adopted=False)
     "search.disagg": {"adopted", "colocated_ms", "disagg_ms",
                       "handoff_ms"},
+    # one event per fleet proposal decision (search/fleet.py): the
+    # N-replica partition, routing policy, per-class p99 roll-up and
+    # whether the fleet beat the single replica (honest zero =
+    # adopted=False)
+    "search.fleet": {"adopted", "replicas", "single_ms", "fleet_ms"},
+    # fleet router (runtime/fleet.py): one event per routed request —
+    # which replica the searched per-class fractions dispatched it to
+    "fleet.route": {"rid", "replica", "slo"},
+    # elastic fleet re-size (runtime/controller.py research_fleet):
+    # measured per-class p99 drift triggered a fleet re-search that
+    # may change N
+    "fleet.scale": {"step", "from_replicas", "to_replicas"},
     # continuous-batching decode executor (runtime/decode.py): one
     # event per composed decode frame (admissions/evictions/page
     # residency + measured latency, predicted_s when a serving pricer

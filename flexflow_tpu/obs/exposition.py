@@ -33,6 +33,37 @@ def _metric_name(name: str) -> str:
     return f"{PREFIX}_{_NAME_RE.sub('_', name)}"
 
 
+def _split_labels(name: str):
+    """Registry names carry optional inline labels after ``|``
+    (``decode.ttft_s|replica=0,slo=interactive`` — the fleet's
+    per-replica/per-class series, runtime/decode.py).  Returns
+    (base_name, [(key, value), ...]); a malformed suffix stays part of
+    the name rather than dropping the series."""
+    if "|" not in name:
+        return name, []
+    base, _, raw = name.partition("|")
+    labels = []
+    for part in raw.split(","):
+        if "=" not in part:
+            return name, []
+        k, _, v = part.partition("=")
+        k = k.strip()
+        v = v.strip()
+        if not k or not v:
+            return name, []
+        labels.append((_NAME_RE.sub("_", k), v.replace('"', "'")))
+    return base, labels
+
+
+def _label_block(labels, extra: str = "") -> str:
+    """``{k="v",...}`` rendering; ``extra`` is a pre-formatted pair
+    (the summary quantile) merged into the same block."""
+    pairs = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
 def _fmt(v) -> str:
     if v is None:
         return "NaN"
@@ -54,29 +85,41 @@ def render_prometheus(snapshot: Dict[str, dict]) -> str:
     ``summary`` (count/sum exact, quantiles from the seeded
     reservoir)."""
     lines = []
+    typed = set()  # one TYPE line per base metric, labeled series share it
+
+    def _type(m: str, kind: str) -> None:
+        if (m, kind) not in typed:
+            typed.add((m, kind))
+            lines.append(f"# TYPE {m} {kind}")
+
     for name, value in sorted((snapshot.get("counters") or {}).items()):
-        m = _metric_name(name)
-        lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {_fmt(value)}")
+        base, labels = _split_labels(name)
+        m = _metric_name(base)
+        _type(m, "counter")
+        lines.append(f"{m}{_label_block(labels)} {_fmt(value)}")
     for name, value in sorted((snapshot.get("gauges") or {}).items()):
-        m = _metric_name(name)
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(value)}")
+        base, labels = _split_labels(name)
+        m = _metric_name(base)
+        _type(m, "gauge")
+        lines.append(f"{m}{_label_block(labels)} {_fmt(value)}")
     for name, summ in sorted((snapshot.get("histograms") or {}).items()):
         if not isinstance(summ, dict):
             continue
-        m = _metric_name(name)
-        lines.append(f"# TYPE {m} summary")
+        base, labels = _split_labels(name)
+        m = _metric_name(base)
+        _type(m, "summary")
         for q in _QUANTILES:
             if q in summ:
-                lines.append(
-                    f'{m}{{quantile="0.{q[1:]}"}} {_fmt(summ[q])}')
-        lines.append(f"{m}_count {_fmt(summ.get('count', 0))}")
+                block = _label_block(labels,
+                                     extra=f'quantile="0.{q[1:]}"')
+                lines.append(f"{m}{block} {_fmt(summ[q])}")
+        lab = _label_block(labels)
+        lines.append(f"{m}_count{lab} {_fmt(summ.get('count', 0))}")
         if "sum" in summ:
-            lines.append(f"{m}_sum {_fmt(summ['sum'])}")
+            lines.append(f"{m}_sum{lab} {_fmt(summ['sum'])}")
         for extra in ("min", "max", "mean"):
             if extra in summ:
-                lines.append(f"{m}_{extra} {_fmt(summ[extra])}")
+                lines.append(f"{m}_{extra}{lab} {_fmt(summ[extra])}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

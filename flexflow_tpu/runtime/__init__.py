@@ -11,6 +11,7 @@ from flexflow_tpu.runtime.decode import (
     PageAllocator,
     compiled_decode_step,
 )
+from flexflow_tpu.runtime.fleet import FleetExecutor
 
 __all__ = [
     "Fault",
@@ -21,6 +22,7 @@ __all__ = [
     "shrink_config",
     "ContinuousBatchingExecutor",
     "DecodeRequest",
+    "FleetExecutor",
     "PageAllocator",
     "compiled_decode_step",
 ]

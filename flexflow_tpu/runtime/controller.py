@@ -131,6 +131,7 @@ class TrainingController:
         # the next step boundary as first-class re-search triggers
         # next to the calibration-signature watch
         self._p99_trigger: Optional[float] = None
+        self._fleet_trigger: Optional[float] = None
         self._lane_trigger: Optional[str] = None
         self._lane_seen = None
         self._ckpt_mgr = None
@@ -198,6 +199,96 @@ class TrainingController:
         if drifted:
             self._p99_trigger = ratio
         return ratio
+
+    def observe_fleet(self, fleet, proposal=None, metric: str = "ttft_s",
+                      window: int = 0,
+                      step: Optional[int] = None) -> Optional[Dict[str, float]]:
+        """Feed a ``FleetExecutor``'s measured per-class p99 windows
+        against a fleet proposal's predictions (``per_class_p99_s``,
+        search/fleet.py).  One ``controller.p99_drift`` event per
+        class (tagged ``slo=``); any class past the model's drift
+        threshold arms a FLEET re-search with the worst
+        measured/predicted ratio as its load scale — consumed by
+        ``maybe_refleet`` (or directly ``research_fleet``), which can
+        re-size N.  Returns the per-class ratio map (None when nothing
+        was comparable)."""
+        prop = proposal if proposal is not None \
+            else getattr(self.model, "fleet", None)
+        if prop is None:
+            return None
+        thr = self.model.config.drift_threshold
+        ratios: Dict[str, float] = {}
+        worst = None
+        for name, pred in sorted(prop.per_class_p99_s.items()):
+            if not pred or not math.isfinite(pred):
+                continue
+            measured = fleet.measured_request_p99(metric, slo=name,
+                                                  window=window)
+            if not measured or not math.isfinite(measured):
+                continue
+            ratio = measured / pred
+            ratios[name] = ratio
+            drifted = ratio > 1.0 + thr or ratio < 1.0 / (1.0 + thr)
+            BUS.emit("controller.p99_drift",
+                     step=step if step is not None
+                     else self.stats["steps"],
+                     ratio=ratio, drifted=drifted, predicted_s=pred,
+                     measured_s=measured, threshold=thr, slo=name)
+            if drifted:
+                worst = ratio if worst is None else max(worst, ratio)
+        if worst is not None:
+            self._fleet_trigger = worst
+        return ratios or None
+
+    def research_fleet(self, step: Optional[int] = None,
+                       load_scale: Optional[float] = None,
+                       proposal=None):
+        """Re-run the fleet search with the measured drift folded into
+        the offered load (``propose_fleet(load_scale=)``) — the
+        elastic re-size: a saturated fleet's re-search shifts the
+        optimum toward more replicas, a lightly-loaded one toward
+        fewer.  Hot-applies the new proposal onto ``model.fleet`` (the
+        same slot the compile-time search fills; callers rebuild their
+        ``FleetExecutor`` from it) and emits ``fleet.scale``.  The
+        load scale is clamped to [1, 8] so a pathological measured
+        window cannot demand an unpriceable load."""
+        from flexflow_tpu.search.driver import coherent_calibration
+        from flexflow_tpu.search.fleet import propose_fleet
+
+        prop = proposal if proposal is not None \
+            else getattr(self.model, "fleet", None)
+        scale = load_scale if load_scale is not None \
+            else (self._fleet_trigger or 1.0)
+        self._fleet_trigger = None
+        scale = min(8.0, max(1.0, float(scale)))
+        step = step if step is not None else self.stats["steps"]
+        new = propose_fleet(
+            self.model.graph, self.model.strategy, self.model.config,
+            calibration=coherent_calibration(self.model.config),
+            base_graph=getattr(self.model, "fleet_base_graph", None),
+            load_scale=scale)
+        old_n = len(prop.replicas) if prop is not None else 1
+        new_n = len(new.replicas) if new is not None else old_n
+        BUS.emit("fleet.scale", step=step, from_replicas=old_n,
+                 to_replicas=new_n, load_scale=round(scale, 6),
+                 resized=new_n != old_n)
+        self.stats["fleet_scales"] = \
+            int(self.stats.get("fleet_scales", 0)) + 1
+        if self.verbose:
+            print(f"[controller] fleet re-search at load x{scale:.2f}: "
+                  f"{old_n} -> {new_n} replicas")
+        if new is not None:
+            self.model.fleet = new
+        return new
+
+    def maybe_refleet(self, step: Optional[int] = None):
+        """Consume a pending fleet drift trigger (armed by
+        ``observe_fleet``): re-search and hot-apply, or None when no
+        drift is pending — the idempotent per-step hook a serving loop
+        calls next to ``step()``."""
+        if self._fleet_trigger is None:
+            return None
+        return self.research_fleet(step=step)
 
     def observe_lane_drift(self, lane_report) -> None:
         """Feed a matched ``LaneDriftReport`` (obs/trace_ingest.py);

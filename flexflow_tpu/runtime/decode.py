@@ -181,8 +181,13 @@ class ContinuousBatchingExecutor:
                  predicted_step_s: Optional[float] = None,
                  prefill_fn: Optional[Callable] = None,
                  prefill_chunk: int = 0,
-                 slo_classes: Optional[Sequence[SLOClass]] = None):
+                 slo_classes: Optional[Sequence[SLOClass]] = None,
+                 replica_label: Optional[str] = None):
         self.step_fn = step_fn
+        # fleet membership (runtime/fleet.py): when set, the request
+        # histograms are ALSO observed under `name|replica=...,slo=...`
+        # labeled series so /metrics can tell fleet members apart
+        self.replica_label = replica_label
         self.max_seqs = max_seqs
         self.page_size = page_size
         self.pages_per_seq = pages_per_seq
@@ -489,6 +494,17 @@ class ContinuousBatchingExecutor:
             "preempted": live.preempted,
         }
         self.request_records.append(rec)
+        # labeled series: the global aggregates stay (back-compat), and
+        # the request-latency histograms are ALSO observed per
+        # (replica, SLO class) so /metrics can tell fleet members and
+        # priority lanes apart (obs/exposition.py parses the |k=v
+        # suffix into Prometheus labels).  Same obs gate as the flat
+        # series — no new BUS.enabled reads.
+        slo = live.req.slo or "standard"
+        lab = (f"slo={slo}" if self.replica_label is None
+               else f"replica={self.replica_label},slo={slo}")
+        labeled = ("decode.queue_s", "decode.ttft_s", "decode.tpot_s",
+                   "decode.e2e_s")
         for key, v in (("decode.queue_s", queue_s),
                        ("decode.prefill_s", prefill_s),
                        ("decode.first_frame_s", first_frame_s),
@@ -497,6 +513,8 @@ class ContinuousBatchingExecutor:
                        ("decode.e2e_s", e2e_s)):
             if v is not None:
                 METRICS.histogram(key).observe(v)
+                if key in labeled:
+                    METRICS.histogram(f"{key}|{lab}").observe(v)
         BUS.emit("decode.request", **rec)
 
     # ------------------------------------------------------------------

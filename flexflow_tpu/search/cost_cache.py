@@ -547,6 +547,17 @@ class CostCache:
                 "serve",
                 float(getattr(config, "serve_p99_budget_ms", 0.0) or 0.0),
             )
+            if getattr(config, "serve_fleet", "off") == "search":
+                # fleet searches price replica blocks at partial
+                # occupancy (arrival shares) — a different search
+                # function again.  Extension-only: serve_fleet=off
+                # keys stay byte-identical to pre-fleet caches
+                knobs = knobs + (
+                    "fleet",
+                    int(getattr(config, "serve_fleet_max_replicas", 4)),
+                    float(getattr(config, "serve_fleet_offered_load",
+                                  0.85)),
+                )
         return stable_graph_digest(graph) + ":" + hashlib.sha256(
             repr(knobs).encode()).hexdigest()[:12]
 

@@ -72,6 +72,14 @@ class ServingSpec:
     # so train/serve search paths stay bit-identical to history.
     prompt_tokens_mean: int = 0  # 0 = max_seq_len // 2
     decode_tokens_mean: int = 0  # 0 = max(1, max_seq_len // 4)
+    # fleet arrival share (search/fleet.py): a replica routed a
+    # fraction of the fleet's traffic runs PARTIAL frames — only
+    # ``occupancy_slots`` of its sequence slots are live, the rest
+    # stream nothing.  0 = full frame (every non-fleet path).  Folded
+    # into ``signature()`` ONLY when set, so no-fleet cost rows stay
+    # byte-identical to history while occupancy-priced rows can never
+    # cross-serve full-frame ones.
+    occupancy_slots: int = 0
     _factors: Dict[int, float] = field(default_factory=dict, compare=False,
                                        repr=False, hash=False)
 
@@ -84,9 +92,13 @@ class ServingSpec:
         component ``cost_cache.cost_signature`` folds in under the
         serve objective (serve rows must never cross-serve train
         runs)."""
-        return ("serve", self.max_seqs, self.page_size,
-                self.pages_per_seq, self.quantile, self.samples,
-                self.seed)
+        sig: Tuple = ("serve", self.max_seqs, self.page_size,
+                      self.pages_per_seq, self.quantile, self.samples,
+                      self.seed)
+        if self.occupancy_slots:
+            # extension-only: absent ⇒ bytes identical to pre-fleet
+            sig = sig + ("occ", self.occupancy_slots)
+        return sig
 
     # ---- arrival model ---------------------------------------------------
     def sample_lengths(self) -> np.ndarray:
@@ -106,7 +118,14 @@ class ServingSpec:
         full = rng.integers(max(1, (7 * L) // 8), L + 1, size=shape)
         lens = np.where(mode < 0.2, fresh, np.where(mode < 0.9, uniform,
                                                     full))
-        return lens.astype(np.int64)
+        lens = lens.astype(np.int64)
+        if 0 < self.occupancy_slots < self.max_seqs:
+            # partial frame: the trailing slots are EMPTY, not short —
+            # the draws for the live slots stay bit-identical to the
+            # full frame's (same rng stream), so occupancy only removes
+            # load, never reshuffles it
+            lens[:, self.occupancy_slots:] = 0
+        return lens
 
     def load_factor(self, batch_degree: int) -> float:
         """p-quantile of the max-shard live-token load under a batch
@@ -135,6 +154,15 @@ class ServingSpec:
 
     def with_quantile(self, q: float) -> "ServingSpec":
         return replace(self, quantile=float(q), _factors={})
+
+    def with_occupancy(self, slots: int) -> "ServingSpec":
+        """The same deployment at ``slots`` live sequence slots per
+        frame (fleet pricing: a replica's arrival share in frame
+        currency).  ``slots >= max_seqs`` is the full frame."""
+        k = max(1, min(self.max_seqs, int(slots)))
+        if k >= self.max_seqs:
+            k = 0
+        return replace(self, occupancy_slots=k, _factors={})
 
     # ---- phase-split arrival model (disaggregation pricing) -------------
     def prefill_tokens_per_frame(self) -> float:

@@ -181,6 +181,8 @@ def lint_strategy_file(path: str,
         out += _lint_serving_meta(meta["serving"])
     if isinstance(meta, dict) and "disaggregation" in meta:
         out += _lint_disagg_meta(meta["disaggregation"], meta)
+    if isinstance(meta, dict) and "fleet" in meta:
+        out += _lint_fleet_meta(meta["fleet"], meta)
     if isinstance(meta, dict):
         out += _lint_calibration_signature(meta, path, calibration_path)
     views = {k: v for k, v in data.items() if k != META_KEY}
@@ -344,6 +346,167 @@ def _lint_disagg_meta(dm, meta) -> List[Tuple[str, str, str]]:
             out.append(("error", "STR211",
                         f"slo class {c['name']!r} quantile {q!r} "
                         f"outside (0, 1)"))
+    return out
+
+
+def _lint_fleet_meta(fm, meta) -> List[Tuple[str, str, str]]:
+    """STR212: structural lint of a persisted ``__meta__.fleet`` block
+    (the searched N-replica serving fleet + per-SLO-class routing,
+    search/fleet.py).  Graph-side legality (per-block view legality,
+    pool-geometry agreement with the decode ops — SHD166/167) needs the
+    graph and runs at import/compile time; this proves what the file
+    alone can: disjoint replica blocks that fit the machine, replicas
+    that actually carry a strategy, routing rows that sum to one over
+    known classes, pool geometry that agrees with the sibling
+    ``__meta__.serving`` block, and finite prices."""
+    if not isinstance(fm, dict):
+        return [("error", "STR212", "fleet meta is not an object")]
+    out: List[Tuple[str, str, str]] = []
+    n = fm.get("num_devices")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        out.append(("error", "STR212",
+                    f"fleet meta num_devices is not a positive int: "
+                    f"{n!r}"))
+        n = None
+    reps = fm.get("replicas")
+    if not isinstance(reps, list) or not reps:
+        return out + [("error", "STR212",
+                       "fleet meta replicas is not a non-empty list")]
+    spans = []
+    for i, r in enumerate(reps):
+        if not isinstance(r, dict):
+            out.append(("error", "STR212",
+                        f"replicas[{i}] is not an object"))
+            continue
+        ok = True
+        for k in ("devices", "decode_devices"):
+            v = r.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                out.append(("error", "STR212",
+                            f"replicas[{i}] {k} is not a positive int: "
+                            f"{v!r}"))
+                ok = False
+        for k in ("start", "prefill_devices"):
+            v = r.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(("error", "STR212",
+                            f"replicas[{i}] {k} is not a non-negative "
+                            f"int: {v!r}"))
+                ok = False
+        if ok:
+            pre, dec, dev = (r["prefill_devices"], r["decode_devices"],
+                             r["devices"])
+            if (pre + dec > dev) if pre else (dec != dev):
+                out.append(("error", "STR212",
+                            f"replicas[{i}] phase split prefill={pre} "
+                            f"decode={dec} does not fit its "
+                            f"{dev}-device block"))
+            spans.append((r["start"], dev, i))
+            if n is not None and r["start"] + dev > n:
+                out.append(("error", "STR212",
+                            f"replicas[{i}] overflows the machine: "
+                            f"start {r['start']} + {dev} devices > "
+                            f"{n}"))
+        share = r.get("share")
+        if not isinstance(share, (int, float)) or isinstance(share, bool) \
+                or not math.isfinite(float(share)) \
+                or not (0.0 <= float(share) <= 1.0):
+            out.append(("error", "STR212",
+                        f"replicas[{i}] share {share!r} outside "
+                        f"[0, 1]"))
+        for k in ("step_ms", "handoff_ms"):
+            v = r.get(k)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not math.isfinite(float(v)) or float(v) < 0.0:
+                out.append(("error", "STR212",
+                            f"replicas[{i}] {k} {v!r} is not a "
+                            f"non-negative finite number"))
+        ops = r.get("strategy_ops")
+        if not isinstance(ops, int) or isinstance(ops, bool) or ops < 1:
+            out.append(("error", "STR212",
+                        f"replicas[{i}] carries no searched strategy "
+                        f"(strategy_ops={ops!r}) — a replica without "
+                        f"one cannot be deployed"))
+    spans.sort()
+    for (s0, w0, i0), (s1, w1, i1) in zip(spans, spans[1:]):
+        if s0 + w0 > s1:
+            out.append(("error", "STR212",
+                        f"replicas[{i0}] and replicas[{i1}] overlap: "
+                        f"[{s0}, {s0 + w0}) vs [{s1}, {s1 + w1})"))
+    sv = meta.get("serving") if isinstance(meta, dict) else None
+    if isinstance(sv, dict):
+        for k in ("max_seqs", "page_size", "pages_per_seq"):
+            fv = fm.get(k)
+            if isinstance(fv, int) and isinstance(sv.get(k), int) \
+                    and sv[k] != fv:
+                out.append(("error", "STR212",
+                            f"fleet meta {k}={fv} disagrees with "
+                            f"__meta__.serving {k}={sv[k]} — every "
+                            f"replica's page allocator must match the "
+                            f"decode graph's frame"))
+    for k in ("single_step_ms", "fleet_step_ms"):
+        v = fm.get(k)
+        if v is not None and (
+                not isinstance(v, (int, float)) or isinstance(v, bool)
+                or not math.isfinite(float(v)) or float(v) < 0.0):
+            out.append(("error", "STR212",
+                        f"fleet meta {k} {v!r} is not a non-negative "
+                        f"finite number"))
+    classes = fm.get("slo_classes", [])
+    names = set()
+    if not isinstance(classes, list):
+        out.append(("error", "STR212",
+                    f"fleet meta slo_classes is not a list: "
+                    f"{str(classes)[:60]}"))
+        classes = []
+    for i, c in enumerate(classes):
+        if not isinstance(c, dict) or not isinstance(c.get("name"), str) \
+                or not c.get("name"):
+            out.append(("error", "STR212",
+                        f"slo_classes[{i}] is not a named class "
+                        f"object"))
+            continue
+        if c["name"] in names:
+            out.append(("error", "STR212",
+                        f"slo_classes[{i}] duplicates {c['name']!r}"))
+        names.add(c["name"])
+        w = c.get("weight", 1.0)
+        if not isinstance(w, (int, float)) or isinstance(w, bool) \
+                or not math.isfinite(float(w)) or float(w) <= 0.0:
+            out.append(("error", "STR212",
+                        f"slo class {c['name']!r} weight {w!r} is not "
+                        f"a positive finite number"))
+    routing = fm.get("routing")
+    if not isinstance(routing, dict) or not routing:
+        return out + [("error", "STR212",
+                       "fleet meta routing is not a non-empty object")]
+    for cname, row in sorted(routing.items()):
+        if names and cname not in names:
+            out.append(("error", "STR212",
+                        f"routing names unknown SLO class {cname!r}"))
+        if not isinstance(row, list) or len(row) != len(reps):
+            out.append(("error", "STR212",
+                        f"routing[{cname!r}] is not a "
+                        f"{len(reps)}-replica fraction row: {row!r}"))
+            continue
+        bad = [f for f in row
+               if not isinstance(f, (int, float)) or isinstance(f, bool)
+               or not math.isfinite(float(f))
+               or not (0.0 <= float(f) <= 1.0)]
+        if bad:
+            out.append(("error", "STR212",
+                        f"routing[{cname!r}] has fractions outside "
+                        f"[0, 1]: {bad!r}"))
+            continue
+        total = sum(float(f) for f in row)
+        if abs(total - 1.0) > 1e-3:
+            out.append(("error", "STR212",
+                        f"routing[{cname!r}] fractions sum to "
+                        f"{total:.6f}, not 1"))
+    for cname in sorted(names - set(routing)):
+        out.append(("error", "STR212",
+                    f"SLO class {cname!r} has no routing row — its "
+                    f"requests would route nowhere"))
     return out
 
 

@@ -27,7 +27,7 @@ import json
 import os
 import sys
 from collections import Counter, defaultdict
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -483,6 +483,89 @@ def render_report(events: List[dict], top: int = 10,
             f"{d.get('handoff_ms')} ms"
             + (", spans DCN" if d.get("spans_dcn") else "")
             + f") — {verdict}")
+    # ---- serving fleet: N-replica search + router + elastic re-size ------
+    fleets = [e for e in events if e.get("kind") == "search.fleet"]
+    scales = [e for e in events if e.get("kind") == "fleet.scale"]
+    routes = [e for e in events if e.get("kind") == "fleet.route"]
+    if fleets or scales or routes:
+        lines.append("")
+        lines.append("## Serving fleet")
+        lines.append("")
+        if fleets:
+            f = fleets[-1]
+            verdict = (f"ADOPTED {f.get('replicas')} replica(s) "
+                       f"{f.get('partition')} policy "
+                       f"{f.get('policy')!r}" if f.get("adopted")
+                       else "single replica stays optimal")
+            lines.append(
+                f"Fleet search: single-replica {f.get('single_ms')} ms "
+                f"vs fleet {f.get('fleet_ms')} ms weighted per-class "
+                f"p99 (offered load x{f.get('load_scale')}) — "
+                f"{verdict}")
+            blocks = f.get("blocks") or []
+            if blocks:
+                lines.append("")
+                lines.append("| replica | devices | span | phase split | "
+                             "share | slots | step ms |")
+                lines.append("|---|---|---|---|---|---|---|")
+                for b in blocks:
+                    s0 = b.get("start") or 0
+                    split = (f"{b.get('prefill_devices')}+"
+                             f"{b.get('decode_devices')}"
+                             if b.get("prefill_devices") else "colocated")
+                    lines.append(
+                        f"| {b.get('replica')} | {b.get('devices')} | "
+                        f"[{s0}, {s0 + (b.get('devices') or 0)}) | "
+                        f"{split} | {b.get('share')} | "
+                        f"{b.get('occupancy_slots')} | "
+                        f"{b.get('step_ms')} |")
+            routing = f.get("routing") or {}
+            per_class = f.get("per_class_ms") or {}
+            if routing:
+                lines.append("")
+                lines.append("| SLO class | routing fractions | "
+                             "predicted p99 ms |")
+                lines.append("|---|---|---|")
+                for name, row in sorted(routing.items()):
+                    lines.append(f"| {name} | {row} | "
+                                 f"{per_class.get(name)} |")
+        for e in scales:
+            lines.append(
+                f"Elastic re-size at step {e.get('step')}: "
+                f"{e.get('from_replicas')} -> {e.get('to_replicas')} "
+                f"replica(s) at offered load x{e.get('load_scale')}"
+                + (" — RESIZED" if e.get("resized") else ""))
+        if routes:
+            per_rep: Dict[object, int] = {}
+            for e in routes:
+                per_rep[e.get("replica")] = \
+                    per_rep.get(e.get("replica"), 0) + 1
+            dist = ", ".join(f"replica {r}: {c}"
+                             for r, c in sorted(per_rep.items(),
+                                                key=lambda kv: str(kv[0])))
+            lines.append(f"Router: {len(routes)} request(s) routed "
+                         f"({dist})")
+        # measured per-class p99 from the per-request stream — the
+        # other side of the search's predicted per-class table
+        fin = [e for e in events if e.get("kind") == "decode.request"
+               and e.get("phase") == "finish"]
+        if fin:
+            by_slo: Dict[str, list] = {}
+            for e in fin:
+                if isinstance(e.get("ttft_s"), (int, float)):
+                    by_slo.setdefault(e.get("slo") or "standard",
+                                      []).append(float(e["ttft_s"]))
+            if by_slo:
+                lines.append("")
+                lines.append("| SLO class | completions | measured "
+                             "TTFT p99 ms |")
+                lines.append("|---|---|---|")
+                for name, vals in sorted(by_slo.items()):
+                    vals.sort()
+                    p99 = vals[min(len(vals) - 1,
+                                   int(0.99 * (len(vals) - 1)))]
+                    lines.append(f"| {name} | {len(vals)} | "
+                                 f"{_ms(p99)} |")
     frames = [e for e in events if e.get("kind") == "decode.frame"]
     summaries = [e for e in events if e.get("kind") == "decode.summary"]
     if frames or summaries:

@@ -1447,6 +1447,319 @@ def _fleet_sweep_md_lines(sweep):
     return lines
 
 
+def request_trace_sweep(n_devices, out_prefix="BENCH_SEARCH"):
+    """The --request-trace sweep, three legs (obs/tracing.py,
+    obs/flight.py, obs/slo.py):
+
+    (1) MEASURED request tracing on the CPU host mesh: a 2-replica
+    fleet serves the seeded 32-request mixed-SLO trace with the tracer
+    armed; every request's span tree is validated (single root, no
+    orphans, children nest inside parents, queue+prefill+decode phase
+    durations reproduce the measured e2e within tolerance) and the
+    whole forest is exported as ``<prefix>_request_traces.json`` —
+    Chrome trace-event format, loaded back and structure-checked so
+    the artifact provably opens in Perfetto.
+
+    (2) fault post-mortem: a replica is stepped with requests still in
+    flight, then a scheduled ``p99_drift`` fault fires — the injection
+    dumps the always-on flight ring, and the dump is asserted to hold
+    the last-N bus events PLUS the in-flight requests' open spans
+    (copied to ``<prefix>_flight_dump.jsonl`` for inspection).
+
+    (3) burn-vs-p99 replay: ``first_fire_indices`` replays latency
+    streams and records the completion index at which the multi-window
+    burn-rate trigger vs the raw p99-drift trigger first fires — the
+    burn signal catches a load ramp earlier and catches a persistent
+    moderate (1.3x) violation that p99-drift never sees at all."""
+    import os
+    import random
+    import shutil
+    import tempfile
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.obs.events import BUS
+    from flexflow_tpu.obs.flight import FLIGHT
+    from flexflow_tpu.obs.slo import first_fire_indices
+    from flexflow_tpu.obs.tracing import TRACER
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+        SLOClass,
+        compiled_decode_step,
+    )
+    from flexflow_tpu.runtime.faults import FaultPlan
+    from flexflow_tpu.runtime.fleet import FleetExecutor
+
+    sweep = {
+        "devices": n_devices,
+        "note": (
+            "request-scoped tracing MEASURED on the CPU host mesh: a "
+            "2-replica fleet serves the seeded 32-request mixed-SLO "
+            "trace with the tracer armed; every span tree is "
+            "validated and exported as a Chrome/Perfetto trace; a "
+            "p99_drift fault injection exercises the always-on flight "
+            "ring's post-mortem dump; burn-rate vs p99-drift trigger "
+            "ordering is replayed on synthetic latency streams"),
+    }
+
+    kw = dict(vocab=256, num_layers=2, hidden=64, num_heads=4,
+              ff_dim=128, page_size=8, pages_per_seq=8)
+    cfg = ff.FFConfig(
+        batch_size=8, num_devices=n_devices, comp_mode="inference",
+        cost_cache_file="", serve_slo_classes=FLEET_SLO,
+        machine_spec=MachineSpec.host_cpu(n_devices))
+    classes = [SLOClass(name=c["name"], priority=c["priority"],
+                        deadline_frames=c["deadline_frames"],
+                        quantile=c["quantile"])
+               for c in cfg.serve_slo_classes]
+    class_names = [c.name for c in classes]
+
+    rng = random.Random(7)
+    trace = []
+    for i in range(32):
+        slo = rng.choices(class_names, weights=[1, 2, 5])[0]
+        plen = rng.randint(4, 32)
+        trace.append(DecodeRequest(
+            rid=f"r{i:02d}",
+            prompt=[rng.randrange(2, 250) for _ in range(plen)],
+            max_new_tokens=rng.randint(4, 12), slo=slo))
+
+    half = max(1, n_devices // 2)
+    c_h = ff.FFConfig(batch_size=8, num_devices=half,
+                      comp_mode="inference", cost_cache_file="",
+                      machine_spec=MachineSpec.host_cpu(half))
+    m_h = build_gpt_decode(c_h, **kw)
+    m_h.compile(loss_type="sparse_categorical_crossentropy",
+                metrics=[], comp_mode="inference")
+    step = compiled_decode_step(m_h)
+    # jit-warm BEFORE the tracer arms: the warm-up request is not part
+    # of the measured forest
+    ContinuousBatchingExecutor(
+        step, max_seqs=8, page_size=8, pages_per_seq=8).run(
+        [DecodeRequest(rid="w", prompt=[1, 2, 3], max_new_tokens=2)],
+        max_frames=20)
+
+    def _replicas():
+        return [ContinuousBatchingExecutor(
+                    step, max_seqs=8, page_size=8, pages_per_seq=8,
+                    slo_classes=classes, replica_label=str(i))
+                for i in range(2)]
+
+    # the tracer, the obs bus and the flight ring are process globals:
+    # borrow them only when the caller has not armed them, and put
+    # every knob back afterwards (same discipline as fleet_sweep's
+    # scratch bus)
+    scratch = None
+    if not BUS.enabled:
+        scratch = tempfile.mktemp(suffix=".jsonl")
+        BUS.configure(scratch)
+    tracer_was = TRACER.enabled
+    prev_dump_dir = FLIGHT.dump_dir
+    tmp = tempfile.mkdtemp(prefix="ff_flight_")
+    TRACER.reset()
+    TRACER.enabled = True
+    FLIGHT.reset()
+    FLIGHT.configure(dump_dir=tmp)
+    try:
+        # ---- (1) traced fleet serve + validation + chrome export -----
+        fl = FleetExecutor(_replicas(),
+                           {c: [0.5, 0.5] for c in class_names},
+                           slo_classes=classes, seed=7)
+        t0 = time.monotonic()
+        fl.run(trace)
+        wall = time.monotonic() - t0
+        recs = {r["rid"]: r for r in fl.request_records
+                if r.get("phase") == "finish"}
+        problems = []
+        validated = 0
+        for tid in TRACER.trace_ids():
+            rec = recs.get(tid.split("#", 1)[0])
+            if rec is None:
+                continue
+            validated += 1
+            problems += TRACER.validate_trace(tid, e2e_s=rec["e2e_s"])
+        from flexflow_tpu.obs.tracing import forest_stats, span_forest
+
+        forest = span_forest(
+            dict(s.to_jsonable(), kind="trace.span")
+            for tid in TRACER.trace_ids()
+            for s in TRACER.trace_spans(tid))
+        total, max_depth, orphans = forest_stats(forest)
+        chrome_path = f"{out_prefix}_request_traces.json"
+        n_events = TRACER.export_chrome_trace(chrome_path)
+        with open(chrome_path) as f:
+            doc = json.load(f)
+        evs = doc.get("traceEvents", [])
+        slices = [e for e in evs if e.get("ph") == "X"]
+        chrome_ok = (
+            isinstance(evs, list) and len(slices) == n_events
+            and all(e.get("ph") in ("X", "M") and "pid" in e
+                    and "tid" in e and "name" in e for e in evs)
+            and all(e.get("ts", -1) >= 0 and e.get("dur", 0) > 0
+                    for e in slices))
+        leg = {
+            "completed": len(recs),
+            "traces_validated": validated,
+            "spans": total,
+            "max_depth": max_depth,
+            "orphans": orphans,
+            "open_spans_left": len(TRACER.open_spans()),
+            "validation_problems": problems[:8],
+            "valid": (not problems and orphans == 0
+                      and validated == len(trace)),
+            "wall_s": round(wall, 2),
+            "chrome_trace": {"path": chrome_path, "events": n_events,
+                             "well_formed": chrome_ok},
+        }
+        sweep["traced_serve"] = leg
+        print(json.dumps({"request_trace_sweep": "traced_serve",
+                          **{k: v for k, v in leg.items()
+                             if k != "validation_problems"}}))
+
+        # ---- (2) fault injection -> flight post-mortem dump ----------
+        ex = ContinuousBatchingExecutor(
+            step, max_seqs=8, page_size=8, pages_per_seq=8,
+            slo_classes=classes, replica_label="pm")
+        live_reqs = [DecodeRequest(
+            rid=f"pm{i}", prompt=[5 + i, 6 + i, 7 + i],
+            max_new_tokens=32, slo="standard") for i in range(3)]
+        ex.submit(live_reqs)
+        for _ in range(3):
+            ex.step()  # admit + a few decode frames; requests stay live
+        plan = FaultPlan.parse("p99_drift@0", seed=7)
+        fault = plan.due(0)[0]
+        ratio = plan.inject_p99_drift(fault)
+        dump_path = FLIGHT.last_dump_path
+        dump_rows = []
+        if dump_path and os.path.exists(dump_path):
+            with open(dump_path) as f:
+                dump_rows = [json.loads(ln) for ln in f if ln.strip()]
+        meta = dump_rows[0] if dump_rows else {}
+        open_rows = [r for r in dump_rows
+                     if r.get("kind") == "trace.open"]
+        live_rids = {r.rid for r in live_reqs}
+        covered = {r["trace_id"].split("#", 1)[0] for r in open_rows
+                   if "#" in r.get("trace_id", "")} & live_rids
+        kept = None
+        if dump_path and os.path.exists(dump_path):
+            kept = f"{out_prefix}_flight_dump.jsonl"
+            shutil.copyfile(dump_path, kept)
+        pm = {
+            "fault": "p99_drift@0",
+            "drift_ratio": round(ratio, 3),
+            "dump": kept,
+            "meta_reason": meta.get("reason"),
+            "ring_events": meta.get("events"),
+            "open_spans_in_dump": len(open_rows),
+            "live_requests_covered": sorted(covered),
+            "post_mortem_ok": (
+                meta.get("kind") == "flight.meta"
+                and (meta.get("events") or 0) > 0
+                and covered == live_rids),
+        }
+        sweep["fault_post_mortem"] = pm
+        print(json.dumps({"request_trace_sweep": "fault_post_mortem",
+                          **pm}))
+    finally:
+        TRACER.reset()
+        TRACER.enabled = tracer_was
+        FLIGHT.dump_dir = prev_dump_dir
+        FLIGHT.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+        if scratch is not None:
+            BUS.close()
+            if os.path.exists(scratch):
+                os.remove(scratch)
+
+    # ---- (3) burn-rate vs raw p99-drift trigger ordering -------------
+    target = 0.1
+    ramp = [0.08 + i * (0.12 / 47.0) for i in range(48)]
+    persistent = [0.13] * 48
+    scenarios = {}
+    for name, lat in (("load_ramp", ramp),
+                      ("persistent_1.3x", persistent)):
+        burn_at, drift_at = first_fire_indices(lat, target)
+        scenarios[name] = {
+            "completions": len(lat),
+            "burn_fires_at": burn_at,
+            "p99_drift_fires_at": drift_at,
+            "burn_leads": (drift_at is None
+                           or (burn_at is not None
+                               and burn_at < drift_at)),
+        }
+    sweep["burn_vs_p99"] = {
+        "target_s": target,
+        "scenarios": scenarios,
+        "burn_always_leads": all(s["burn_leads"]
+                                 for s in scenarios.values()),
+    }
+    print(json.dumps({"request_trace_sweep": "burn_vs_p99",
+                      **sweep["burn_vs_p99"]}))
+    return sweep
+
+
+def _request_trace_md_lines(sweep):
+    lines = [
+        "",
+        "## Observability: request tracing",
+        "",
+        sweep.get("note", ""),
+        "",
+    ]
+    ts = sweep.get("traced_serve") or {}
+    ch = ts.get("chrome_trace") or {}
+    lines += [
+        "| leg | result |",
+        "|---|---|",
+        f"| traced serve | {ts.get('completed')} completed, "
+        f"{ts.get('traces_validated')} span trees validated "
+        f"({'VALID' if ts.get('valid') else 'INVALID'}), "
+        f"{ts.get('spans')} spans, depth {ts.get('max_depth')}, "
+        f"{ts.get('orphans')} orphans, "
+        f"{ts.get('open_spans_left')} left open |",
+        f"| Chrome trace | {ch.get('path')}: {ch.get('events')} "
+        f"events, well-formed "
+        f"{'YES' if ch.get('well_formed') else 'NO'} "
+        f"(loads in Perfetto / chrome://tracing) |",
+    ]
+    pm = sweep.get("fault_post_mortem") or {}
+    if pm:
+        lines += [
+            f"| fault post-mortem | {pm.get('fault')} (ratio "
+            f"{pm.get('drift_ratio')}x) dumped {pm.get('ring_events')} "
+            f"ring events + {pm.get('open_spans_in_dump')} open spans; "
+            f"in-flight requests covered: "
+            f"{', '.join(pm.get('live_requests_covered') or []) or '—'} "
+            f"({'OK' if pm.get('post_mortem_ok') else 'MISSING'}) |",
+        ]
+    bp = sweep.get("burn_vs_p99") or {}
+    for name, s in sorted((bp.get("scenarios") or {}).items()):
+        drift = s.get("p99_drift_fires_at")
+        lines += [
+            f"| burn vs p99-drift: {name} | burn fires at completion "
+            f"{s.get('burn_fires_at')}, p99-drift at "
+            f"{drift if drift is not None else 'NEVER'} "
+            f"({'burn leads' if s.get('burn_leads') else 'NO LEAD'}) |",
+        ]
+    lines += [
+        "",
+        "Every request carries a span tree — route decision, queue "
+        "wait, chunked prefill, decode residency, preemption re-queues "
+        "— minted at the router and validated against the measured "
+        "e2e (obs/tracing.py; render with `tools/ffobs.py trace`).  "
+        "The flight ring records the last-N events even while the bus "
+        "is off, and fault injections / controller fallbacks dump it "
+        "with the in-flight requests' open spans (obs/flight.py).  "
+        "The multi-window burn-rate computer (obs/slo.py) gives the "
+        "controller an earlier, noise-robust re-search trigger than "
+        "raw p99 drift: it catches slow SLO bleed the p99 watch never "
+        "sees.",
+    ]
+    return lines
+
+
 def co_search_sweep(n_devices):
     """The --co-search sweep: sequential (strategy→plan) vs JOINT
     strategy x comm-plan pricing (search/comm_plan.py, ROADMAP item 2).
@@ -2599,6 +2912,19 @@ def main():
     ap.add_argument("--fleet-only", action="store_true",
                     help="run ONLY the serving-fleet sweep and merge "
                          "it into existing BENCH_SEARCH artifacts")
+    ap.add_argument("--request-trace", action="store_true",
+                    help="also run the request-tracing sweep: a "
+                         "2-replica fleet serves the seeded mixed-SLO "
+                         "trace with the tracer armed — span trees "
+                         "validated against measured e2e, Chrome/"
+                         "Perfetto trace exported, a p99_drift fault "
+                         "exercises the flight-ring post-mortem dump, "
+                         "and burn-rate vs p99-drift trigger ordering "
+                         "is replayed (obs/tracing.py, obs/flight.py, "
+                         "obs/slo.py)")
+    ap.add_argument("--request-trace-only", action="store_true",
+                    help="run ONLY the request-tracing sweep and merge "
+                         "it into existing BENCH_SEARCH artifacts")
     ap.add_argument("--always-on", action="store_true",
                     help="also run the always-on controller scenario: "
                          "injected calibration drift (re-search + hot "
@@ -2830,6 +3156,40 @@ def main():
                         report["fleet_sweep"]))
                     + "\n" + tail)
         print(f"# merged serving-fleet sweep into {path} / {md}")
+        return
+    if args.request_trace_only:
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["request_trace_sweep"] = request_trace_sweep(
+            args.devices, args.out_prefix)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous request-tracing section (same
+            # merge discipline as the other --*-only modes)
+            marker = "\n## Observability: request tracing"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_request_trace_md_lines(
+                        report["request_trace_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged request-tracing sweep into {path} / {md}")
         return
     if args.scale_only:
         path = f"{args.out_prefix}.json"
@@ -3193,6 +3553,9 @@ def main():
         report["disagg_sweep"] = disagg_sweep(args.devices)
     if args.fleet:
         report["fleet_sweep"] = fleet_sweep(args.devices)
+    if args.request_trace:
+        report["request_trace_sweep"] = request_trace_sweep(
+            args.devices, args.out_prefix)
     if args.always_on:
         report["always_on"] = always_on_sweep(args.devices)
     if args.obs:
@@ -3285,6 +3648,8 @@ def main():
         lines += _disagg_sweep_md_lines(report["disagg_sweep"])
     if report.get("fleet_sweep"):
         lines += _fleet_sweep_md_lines(report["fleet_sweep"])
+    if report.get("request_trace_sweep"):
+        lines += _request_trace_md_lines(report["request_trace_sweep"])
     if report.get("always_on"):
         lines += _always_on_md_lines(report["always_on"])
     if report.get("obs_lanes"):

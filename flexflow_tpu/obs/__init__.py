@@ -21,6 +21,13 @@ cheap and tooling (tools/ffobs.py) can read artifacts without jax:
   tag (``LaneDriftReport``).
 * ``exposition`` — Prometheus text rendering of the metrics registry
   (+ optional stdlib HTTP endpoint, ``FLEXFLOW_TPU_METRICS_PORT``).
+* ``tracing``/``flight``/``slo`` — request-scoped span trees for the
+  serving fleet (trace ids minted at enqueue, Chrome-trace export,
+  ``FLEXFLOW_TPU_TRACE``), an always-on bounded flight recorder
+  dumped to a post-mortem JSONL on faults/fallbacks/exit
+  (``FLEXFLOW_TPU_FLIGHT_DIR``), and multi-window SLO burn-rate
+  computation feeding the controller an earlier trigger than raw
+  p99 drift.
 
 The reference has no analogue (its search logs through
 RecursiveLogger only); GSPMD-style sharding-decision introspection and
@@ -34,25 +41,43 @@ from flexflow_tpu.obs.exposition import (  # noqa: F401
     render_prometheus,
     start_metrics_server,
 )
+from flexflow_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
 from flexflow_tpu.obs.metrics import METRICS, MetricsRegistry  # noqa: F401
+from flexflow_tpu.obs.slo import burn_rates, first_fire_indices  # noqa: F401
 from flexflow_tpu.obs.trace import write_chrome_trace  # noqa: F401
 from flexflow_tpu.obs.trace_ingest import (  # noqa: F401
     LaneDriftReport,
     apply_lane_measurements,
     build_lane_drift_report,
 )
+from flexflow_tpu.obs.tracing import (  # noqa: F401
+    Span,
+    TRACER,
+    Tracer,
+    forest_stats,
+    span_forest,
+)
 
 __all__ = [
     "BUS",
     "EventBus",
+    "FLIGHT",
+    "FlightRecorder",
     "METRICS",
     "MetricsRegistry",
     "DriftReport",
     "LaneDriftReport",
+    "Span",
+    "TRACER",
+    "Tracer",
     "apply_lane_measurements",
     "build_drift_report",
     "build_lane_drift_report",
+    "burn_rates",
+    "first_fire_indices",
+    "forest_stats",
     "render_prometheus",
+    "span_forest",
     "start_metrics_server",
     "validate_event",
     "write_chrome_trace",

@@ -19,7 +19,10 @@ import json
 import os
 import threading
 import time
-from typing import List, Optional
+import zlib
+from typing import Dict, List, Optional
+
+from flexflow_tpu.obs.flight import FLIGHT
 
 SCHEMA_VERSION = 1
 
@@ -142,7 +145,25 @@ EVENT_KINDS = {
     # saw a measured decode p99 vs the searched prediction; drifted
     # past threshold => the next step re-searches with this trigger
     "controller.p99_drift": {"step", "ratio", "drifted"},
+    # SLO burn-rate watch (obs/slo.py via controller.observe_burn_rate):
+    # one event per class per observation — multi-window error-budget
+    # burn; fired=True arms a re-search BEFORE raw p99 crosses the
+    # drift threshold
+    "controller.burn_rate": {"step", "slo", "fast", "slow", "fired"},
     "controller.summary": {"steps", "swaps", "recoveries"},
+    # request-scoped tracing (obs/tracing.py): one trace.span per
+    # CLOSED span when the bus is armed; trace.open lines appear only
+    # in flight-recorder dumps (the in-flight requests at dump time)
+    "trace.span": {"trace_id", "span", "span_id", "dur_s"},
+    "trace.open": {"trace_id", "span", "span_id"},
+    # flight recorder (obs/flight.py): flight.meta heads every dump
+    # file; flight.dump is emitted on the bus when a post-mortem was
+    # written (fault injection, controller fallback, atexit/SIGTERM)
+    "flight.meta": {"reason", "events", "dropped"},
+    "flight.dump": {"path", "events", "open_spans", "reason"},
+    # event-volume guard roll-up: per-kind counts the sampler
+    # suppressed (emitted at close so totals stay exactly recoverable)
+    "obs.sampled": {"counts"},
 }
 
 _VALID_ACTIONS = frozenset(
@@ -187,6 +208,13 @@ class EventBus:
         self._sink = None
         self._lock = threading.Lock()
         self._atexit_armed = False
+        # event-volume guard: kind -> rate (float < 1.0, probability)
+        # or cap (int >= 1, first-N).  None = no sampling configured,
+        # so the armed hot path pays a single ``is not None`` check.
+        self._sample: Optional[Dict[str, float]] = None
+        self._sample_seed = 0
+        self._emitted: Dict[str, int] = {}
+        self.sampled_out: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     def configure(self, path: str) -> None:
@@ -210,7 +238,49 @@ class EventBus:
             self.enabled = True
         self.emit("obs.meta", schema=SCHEMA_VERSION, pid=os.getpid())
 
+    def configure_sampling(self, spec, seed: int = 0) -> None:
+        """Arm the per-kind event-volume guard.  ``spec`` is either a
+        dict or a ``"kind=rate,kind=cap"`` string: a value < 1.0 keeps
+        that fraction of events (deterministic, seeded — the keep
+        decision hashes (kind, ordinal, seed), so it is independent of
+        interleaving across kinds); an integer >= 1 caps the kind at
+        its first N events.  Unlisted kinds are never sampled.
+        Suppressed events are counted exactly in ``sampled_out`` and
+        rolled up as one ``obs.sampled`` event at close, so totals
+        stay recoverable from the log."""
+        if isinstance(spec, str):
+            parsed: Dict[str, float] = {}
+            for part in spec.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                name, _, val = part.partition("=")
+                v = float(val)
+                parsed[name.strip()] = v if v < 1.0 else int(v)
+            spec = parsed
+        self._sample = dict(spec) if spec else None
+        self._sample_seed = int(seed)
+        self._emitted = {}
+        self.sampled_out = {}
+
+    def _sample_keep(self, kind: str) -> bool:
+        rate = self._sample.get(kind)  # type: ignore[union-attr]
+        if rate is None:
+            return True
+        n = self._emitted.get(kind, 0) + 1
+        self._emitted[kind] = n
+        if isinstance(rate, int):
+            keep = n <= rate
+        else:
+            h = zlib.crc32(f"{kind}:{n}:{self._sample_seed}".encode())
+            keep = h < rate * 2**32
+        if not keep:
+            self.sampled_out[kind] = self.sampled_out.get(kind, 0) + 1
+        return keep
+
     def close(self) -> None:
+        if self.enabled and self.sampled_out:
+            self.emit("obs.sampled", counts=dict(self.sampled_out))
         with self._lock:
             self.enabled = False
             if self._sink is not None:
@@ -225,7 +295,14 @@ class EventBus:
 
     # ------------------------------------------------------------------
     def emit(self, kind: str, **payload) -> None:
+        # flight recorder sees EVERY event, armed bus or not — the
+        # post-mortem ring must survive the off-by-default discipline
+        # (one plain-attribute check + a deque append, no encoding)
+        if FLIGHT.enabled:
+            FLIGHT.record(kind, payload)
         if not self.enabled:
+            return
+        if self._sample is not None and not self._sample_keep(kind):
             return
         evt = {"ts": time.time(), "kind": kind}
         evt.update(payload)
@@ -259,5 +336,15 @@ if _env and _env != "0":
     try:
         BUS.configure(_env if _env not in ("1", "true") else "ffobs.jsonl")
     except OSError:  # unwritable path must not break imports
+        pass
+del _env
+
+_env = os.environ.get("FLEXFLOW_TPU_OBS_SAMPLE", "")
+if _env:
+    try:
+        BUS.configure_sampling(
+            _env,
+            seed=int(os.environ.get("FLEXFLOW_TPU_OBS_SAMPLE_SEED", "0")))
+    except ValueError:  # malformed spec must not break imports
         pass
 del _env

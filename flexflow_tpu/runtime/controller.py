@@ -45,6 +45,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from flexflow_tpu.obs.events import BUS
+from flexflow_tpu.obs.flight import FLIGHT
+from flexflow_tpu.obs.tracing import TRACER
 from flexflow_tpu.runtime.faults import (
     FaultPlan,
     TransientCollectiveError,
@@ -132,6 +134,10 @@ class TrainingController:
         # next to the calibration-signature watch
         self._p99_trigger: Optional[float] = None
         self._fleet_trigger: Optional[float] = None
+        # SLO burn-rate trigger (observe_burn_rate): fires on error-
+        # budget consumption BEFORE the tail itself crosses the drift
+        # threshold — the earlier, less noisy leg of the serving watch
+        self._burn_trigger: Optional[str] = None
         self._lane_trigger: Optional[str] = None
         self._lane_seen = None
         self._ckpt_mgr = None
@@ -200,6 +206,60 @@ class TrainingController:
             self._p99_trigger = ratio
         return ratio
 
+    def observe_burn_rate(self, source, targets: Optional[Dict[str, float]] = None,
+                          metric: str = "ttft_s",
+                          budgets: Optional[Dict[str, float]] = None,
+                          fast: int = 8, slow: int = 32,
+                          fire: float = 2.0,
+                          step: Optional[int] = None) -> Optional[Dict[str, dict]]:
+        """Feed an executor/fleet's finished-request records through the
+        multi-window SLO burn-rate computer (obs/slo.py): per class,
+        the violation fraction of the trailing fast and slow completion
+        windows over the class's error budget.  ``targets`` defaults to
+        the live fleet proposal's per-class p99 predictions
+        (``model.fleet.per_class_p99_s``); ``budgets`` default to
+        ``1 - quantile`` per SLOClass when the source carries a class
+        table.  One ``controller.burn_rate`` event per class; any class
+        burning past ``fire`` on BOTH windows arms a ``"burn_rate"``
+        re-search at the next step boundary — an EARLIER trigger than
+        ``observe_p99``: a persistent moderate violation (say every
+        request at 1.3x target) torches the budget while the raw p99
+        stays under the 1.5x drift threshold forever.  Returns the
+        per-class burn map (None when nothing was comparable)."""
+        from flexflow_tpu.obs.slo import burn_rates
+
+        if targets is None:
+            prop = getattr(self.model, "fleet", None)
+            if prop is None:
+                return None
+            targets = dict(prop.per_class_p99_s)
+        targets = {k: v for k, v in targets.items()
+                   if v and math.isfinite(v)}
+        if not targets:
+            return None
+        if budgets is None:
+            classes = getattr(source, "slo_classes", None) or {}
+            budgets = {name: max(1.0 - cls.quantile, 1e-4)
+                       for name, cls in classes.items()
+                       if name in targets}
+        records = getattr(source, "request_records", source)
+        rates = burn_rates(records, targets, metric=metric,
+                           budgets=budgets, fast=fast, slow=slow,
+                           fire=fire)
+        step = step if step is not None else self.stats["steps"]
+        fired = None
+        for name, row in sorted(rates.items()):
+            BUS.emit("controller.burn_rate", step=step, slo=name,
+                     fast=row["fast"], slow=row["slow"],
+                     fired=row["fired"], target_s=row["target_s"],
+                     budget=row["budget"],
+                     completions=row["completions"])
+            if row["fired"]:
+                fired = name if fired is None else f"{fired},{name}"
+        if fired is not None:
+            self._burn_trigger = fired
+        return rates or None
+
     def observe_fleet(self, fleet, proposal=None, metric: str = "ttft_s",
                       window: int = 0,
                       step: Optional[int] = None) -> Optional[Dict[str, float]]:
@@ -262,6 +322,11 @@ class TrainingController:
         self._fleet_trigger = None
         scale = min(8.0, max(1.0, float(scale)))
         step = step if step is not None else self.stats["steps"]
+        tid = None
+        if TRACER.enabled:
+            tid = TRACER.episode_root(trigger="fleet_drift", step=step)
+            TRACER.begin(tid, "refleet", parent="controller.episode",
+                         load_scale=round(scale, 4))
         new = propose_fleet(
             self.model.graph, self.model.strategy, self.model.config,
             calibration=coherent_calibration(self.model.config),
@@ -269,6 +334,10 @@ class TrainingController:
             load_scale=scale)
         old_n = len(prop.replicas) if prop is not None else 1
         new_n = len(new.replicas) if new is not None else old_n
+        if tid is not None:
+            TRACER.end(tid, "refleet", to_replicas=new_n)
+            TRACER.finish_trace(tid, outcome="applied"
+                                if new is not None else "kept")
         BUS.emit("fleet.scale", step=step, from_replicas=old_n,
                  to_replicas=new_n, load_scale=round(scale, 6),
                  resized=new_n != old_n)
@@ -433,11 +502,24 @@ class TrainingController:
     def _research_and_swap(self, step: int, trigger: str,
                            config=None) -> None:
         cfg = config if config is not None else self.model.config
+        # the controller episode is a trace too: a drift → re-search →
+        # hot-apply chain reads as ONE span tree next to the request
+        # traces it was triggered by (same Chrome-trace export)
+        tid = None
+        if TRACER.enabled:
+            tid = TRACER.episode_root(trigger=trigger, step=step)
+            TRACER.begin(tid, "research", parent="controller.episode")
         new_graph, strategy = self._research(cfg, trigger, step)
+        if tid is not None:
+            TRACER.end(tid, "research")
+            TRACER.begin(tid, "swap", parent="controller.episode")
         self._swap(step, strategy,
                    graph=new_graph if new_graph is not self.model.graph
                    else None,
                    config=config)
+        if tid is not None:
+            TRACER.end(tid, "swap")
+            TRACER.finish_trace(tid, outcome="applied")
         self._cal_state = self._live_cal_state()
 
     def _monolithic_fallback(self, step: int, reason: str) -> None:
@@ -456,6 +538,9 @@ class TrainingController:
         self.model.zero_groups = ()
         self.stats["fallbacks"] += 1
         BUS.emit("controller.fallback", step=step, reason=reason)
+        # a fallback is exactly the moment a post-mortem is worth its
+        # bytes: dump the flight ring (last-N events + open spans)
+        FLIGHT.dump(reason=f"controller-fallback-step{step}")
         if self.verbose:
             print(f"# controller: falling back to monolithic fp32 sync "
                   f"at step {step} ({reason})")
@@ -572,6 +657,14 @@ class TrainingController:
                 state = self._live_cal_state()
                 if state != self._cal_state:
                     self._research_and_swap(step, "calibration_drift")
+            if self._burn_trigger is not None:
+                # the SLO error budget is burning on both windows —
+                # the earlier leg of the serving watch: it consumes
+                # BEFORE the raw-p99 trigger, and a step where both
+                # armed re-searches once, not twice
+                self._burn_trigger = None
+                self._p99_trigger = None
+                self._research_and_swap(step, "burn_rate")
             if self._p99_trigger is not None:
                 # the serving currency drifted past threshold: the
                 # searched strategy's p99 claim is falsified — re-search

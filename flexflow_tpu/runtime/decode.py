@@ -50,6 +50,7 @@ import numpy as np
 
 from flexflow_tpu.obs import annotate
 from flexflow_tpu.obs.events import BUS
+from flexflow_tpu.obs.tracing import TRACER
 
 
 @dataclass
@@ -260,6 +261,7 @@ class ContinuousBatchingExecutor:
     # ------------------------------------------------------------------
     def submit(self, requests: Sequence[DecodeRequest]) -> None:
         obs = BUS.enabled  # one check per submit batch
+        tr = TRACER.enabled  # ditto — the request-trace gate
         for r in requests:
             assert r.prompt, f"request {r.rid!r} has an empty prompt"
             need = len(r.prompt) + r.max_new_tokens
@@ -281,9 +283,15 @@ class ContinuousBatchingExecutor:
             self._seq += 1
             if obs:
                 entry.enqueue_t = time.perf_counter()
+            if tr:
+                # trace minted at enqueue (idempotent: the fleet router
+                # minted it at route time, then this opens children);
+                # the queue span runs enqueue -> admission
+                tid = TRACER.request_root(r.rid, slo=r.slo)
+                TRACER.begin(tid, "queue", parent="request")
             self.queue.append(entry)
 
-    def _expire(self, obs: bool = False) -> int:
+    def _expire(self, obs: bool = False, tr: bool = False) -> int:
         """Drop queued requests whose admission deadline passed —
         deadline-based admission control: a request the deployment can
         no longer serve inside its SLO is refused loudly (recorded in
@@ -303,13 +311,20 @@ class ContinuousBatchingExecutor:
                            "deadline_frames": e.deadline_frames}
                     self.request_records.append(rec)
                     BUS.emit("decode.request", **rec)
+                if tr:
+                    tid = TRACER.trace_of(e.req.rid)
+                    if tid is not None:
+                        TRACER.end(tid, "queue", expired=True)
+                        TRACER.finish_request(e.req.rid,
+                                              outcome="expired")
             else:
                 kept.append(e)
         self.queue = kept
         self.total_expired += expired
         return expired
 
-    def _preempt_for(self, entry: _Pending, obs: bool) -> bool:
+    def _preempt_for(self, entry: _Pending, obs: bool,
+                     tr: bool = False) -> bool:
         """Free a slot + pages for a strictly-higher-priority pending
         request by evicting the LOWEST-priority live sequence
         (latest-admitted tie-break).  The victim re-queues with its
@@ -343,45 +358,50 @@ class ContinuousBatchingExecutor:
             BUS.emit("decode.request", rid=live.req.rid,
                      phase="preempted", slo=live.req.slo,
                      by=entry.req.rid, tokens=live.generated)
+        if tr:
+            tid = TRACER.trace_of(live.req.rid)
+            if tid is not None:
+                # the victim was mid-decode or (via-decode path)
+                # mid-prefill; either way its residency window closes
+                # and a fresh queue span opens — the re-queue edge
+                TRACER.end_any(tid, ("decode", "prefill"),
+                               preempted_by=entry.req.rid)
+                TRACER.begin(tid, "queue", parent="request",
+                             requeue=True)
         return True
 
-    def _run_prefill(self, live: _Live, obs: bool) -> None:
+    def _run_prefill(self, live: _Live, obs: bool,
+                     tr: bool = False) -> None:
         """The chunked prefill lane: write the sequence's first
         ``len(tokens) - 1`` cached-to-be tokens through the batched
-        chunk writer, so the decode loop starts at the LAST token and
-        produces the first generated token in its first frame."""
+        chunk writer (``run_chunked_prefill``, runtime/prefill.py), so
+        the decode loop starts at the LAST token and produces the first
+        generated token in its first frame."""
         n_pre = len(live.tokens) - 1
         if n_pre <= 0 or self.prefill_fn is None:
             return
-        C = self.prefill_chunk
-        cap = self.page_size * self.pages_per_seq
-        table = np.asarray(live.pages, np.int32)[None, :]  # [1, P]
-        chunks = 0
+        from flexflow_tpu.runtime.prefill import run_chunked_prefill
+
         with annotate.phase_span(annotate.PREFILL_PHASE):
-            for c0 in range(0, n_pre, C):
-                ids = np.zeros((1, C), np.int32)
-                valid = min(C, n_pre - c0)
-                ids[0, :valid] = live.tokens[c0:c0 + valid]
-                # pad positions clamp into the sequence's own allotment:
-                # they land at FUTURE positions the decode loop rewrites
-                # before any frame reads them (see runtime/prefill.py)
-                pos = np.minimum(c0 + np.arange(C), cap - 1)
-                self.prefill_fn(ids, pos[None, :].astype(np.int32), table)
-                chunks += 1
+            chunks = run_chunked_prefill(
+                self.prefill_fn, live.tokens, live.pages,
+                chunk=self.prefill_chunk,
+                cap=self.page_size * self.pages_per_seq,
+                trace_id=TRACER.trace_of(live.req.rid) if tr else None)
         live.cached = n_pre
         self.prefill_chunks += chunks
         self.prefill_tokens += n_pre
         if obs:
             BUS.emit("decode.prefill", rid=live.req.rid, tokens=n_pre,
-                     chunks=chunks, chunk=C)
+                     chunks=chunks, chunk=self.prefill_chunk)
 
-    def _admit(self, obs: bool = False) -> int:
+    def _admit(self, obs: bool = False, tr: bool = False) -> int:
         """Fill open slots from the queue in (priority, submission)
         order while the allocator can reserve a FULL per-sequence
         allotment; expired requests are refused first, and a
         strictly-higher-priority arrival may preempt the
         lowest-priority live sequence when no allotment is free."""
-        self._expire(obs)
+        self._expire(obs, tr)
         admitted = 0
         while self.queue:
             order = sorted(range(len(self.queue)),
@@ -390,7 +410,7 @@ class ContinuousBatchingExecutor:
             entry = self.queue[order[0]]
             open_slots = [i for i in range(self.max_seqs)
                           if self.slots[i] is None]
-            if not open_slots and not self._preempt_for(entry, obs):
+            if not open_slots and not self._preempt_for(entry, obs, tr):
                 break
             open_slots = [i for i in range(self.max_seqs)
                           if self.slots[i] is None]
@@ -401,7 +421,7 @@ class ContinuousBatchingExecutor:
             else:
                 pages = self.allocator.alloc(self.pages_per_seq)
             if pages is None:
-                if not self._preempt_for(entry, obs):
+                if not self._preempt_for(entry, obs, tr):
                     break
                 continue  # retry with the freed allotment
             self.queue.pop(order[0])
@@ -420,7 +440,14 @@ class ContinuousBatchingExecutor:
                 live.admit_t = entry.admit_t or time.perf_counter()
                 live.prefill_done_t = entry.prefill_done_t
                 live.first_token_t = entry.first_token_t
-            self._run_prefill(live, obs)
+            tid = TRACER.trace_of(entry.req.rid) if tr else None
+            if tid is not None:
+                # admission edge: the queue window closes, the prefill
+                # window opens (chunk children land under it)
+                TRACER.end(tid, "queue")
+                TRACER.begin(tid, "prefill", parent="request",
+                             slot=i, pages=len(pages))
+            self._run_prefill(live, obs, tr)
             if obs and live.prefill_done_t is None:
                 # the prefill span closes here for the chunked lane and
                 # for single-token prompts (nothing to prefill); the
@@ -428,12 +455,19 @@ class ContinuousBatchingExecutor:
                 # holds every prompt token but the last
                 if self.prefill_fn is not None or len(live.tokens) <= 1:
                     live.prefill_done_t = time.perf_counter()
+            if tid is not None and (self.prefill_fn is not None
+                                    or len(live.tokens) <= 1):
+                # same edge for the span tree: prefill closes, the
+                # decode residency window opens (the via-decode path
+                # closes prefill in step() instead)
+                if TRACER.end(tid, "prefill") is not None:
+                    TRACER.begin(tid, "decode", parent="request")
             self.slots[i] = live
             admitted += 1
         self.total_admitted += admitted
         return admitted
 
-    def _evict(self, obs: bool = False) -> int:
+    def _evict(self, obs: bool = False, tr: bool = False) -> int:
         """Free finished sequences' pages and reopen their slots."""
         evicted = 0
         for i, live in enumerate(self.slots):
@@ -449,6 +483,15 @@ class ContinuousBatchingExecutor:
                 evicted += 1
                 if obs:
                     self._record_request(live)
+                if tr:
+                    tid = TRACER.trace_of(live.req.rid)
+                    if tid is not None:
+                        TRACER.end(tid, "decode", eos=eos,
+                                   tokens=live.generated)
+                        TRACER.finish_request(
+                            live.req.rid, outcome="finish",
+                            tokens=live.generated,
+                            preempted=live.preempted)
         self.total_evicted += evicted
         return evicted
 
@@ -554,7 +597,8 @@ class ContinuousBatchingExecutor:
         ``BUS.enabled`` read per frame when telemetry is off
         (test-enforced)."""
         obs = BUS.enabled  # ONE check per frame gates every span stamp
-        admitted = self._admit(obs)
+        tr = TRACER.enabled  # ditto for the request span tree
+        admitted = self._admit(obs, tr)
         ids, table, lens, active = self._compose_frame()
         t0 = time.perf_counter()
         with annotate.phase_span(annotate.DECODE_PHASE):
@@ -563,7 +607,7 @@ class ContinuousBatchingExecutor:
         self.frame_seconds.append(dt)
         next_tokens = logits[:, 0].argmax(axis=-1).astype(np.int32) \
             if logits.ndim == 3 else logits[:, 0].astype(np.int32)
-        now = time.perf_counter() if obs else 0.0
+        now = time.perf_counter() if (obs or tr) else 0.0
         for i in active:
             live = self.slots[i]
             live.cached += 1
@@ -572,16 +616,22 @@ class ContinuousBatchingExecutor:
                 # queued.  The prefill span closes when only the LAST
                 # prompt token remains (the frame that feeds it is the
                 # first decode frame — it produces the first token).
-                if (obs and live.prefill_done_t is None
-                        and live.cached >= len(live.tokens) - 1):
-                    live.prefill_done_t = now
+                if live.cached >= len(live.tokens) - 1:
+                    if obs and live.prefill_done_t is None:
+                        live.prefill_done_t = now
+                    if tr:
+                        tid = TRACER.trace_of(live.req.rid)
+                        if tid is not None and TRACER.end(
+                                tid, "prefill") is not None:
+                            TRACER.begin(tid, "decode",
+                                         parent="request")
                 continue
             # the model's prediction extends the sequence
             live.tokens.append(int(next_tokens[i]))
             live.generated += 1
             if obs and live.first_token_t is None:
                 live.first_token_t = now  # TTFT closes here
-        evicted = self._evict(obs)
+        evicted = self._evict(obs, tr)
         rec = {
             "frame": self.frame,
             "active": len(active),

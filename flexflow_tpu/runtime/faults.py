@@ -44,6 +44,18 @@ import random
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from flexflow_tpu.obs.flight import FLIGHT
+
+
+def _post_mortem(fault: "Fault") -> None:
+    """Every injection dumps the flight ring (last-N events + the
+    in-flight requests' open spans) — the injected failure is exactly
+    the rehearsal for the unplanned one, so it must exercise the
+    post-mortem path too.  A no-op unless a dump dir is armed
+    (``FLEXFLOW_TPU_FLIGHT_DIR`` / ``FLIGHT.configure``)."""
+    FLIGHT.dump(reason=f"fault-{fault.kind}-step{fault.step}")
+
+
 FAULT_KINDS = (
     "calibration_drift",
     "device_loss",
@@ -171,6 +183,7 @@ class FaultPlan:
         with open(calibration_file, "w") as f:
             json.dump(data, f, indent=1)
         fault.fired = True
+        _post_mortem(fault)
         return factor
 
     def inject_p99_drift(self, fault: Fault) -> float:
@@ -180,6 +193,7 @@ class FaultPlan:
         scheduled p99_drift fault deterministically trips the
         controller's observe_p99 watch)."""
         fault.fired = True
+        _post_mortem(fault)
         return self._draws[id(fault)]
 
     def inject_device_loss(self, fault: Fault, num_devices: int) -> int:
@@ -191,6 +205,7 @@ class FaultPlan:
             raise ValueError(
                 f"device_loss survivors={survivors} not in "
                 f"[1, {num_devices}]")
+        _post_mortem(fault)
         return survivors
 
     def check_collective(self, fault: Fault) -> None:
@@ -201,6 +216,7 @@ class FaultPlan:
         rem = self._remaining.get(id(fault), 0)
         if rem > 0:
             self._remaining[id(fault)] = rem - 1
+            _post_mortem(fault)
             raise TransientCollectiveError(
                 f"injected collective failure at step {fault.step} "
                 f"({rem - 1} failure(s) remaining)")
@@ -219,6 +235,7 @@ class FaultPlan:
         behind the manifest) — the torn-write case restore must detect.
         Returns the corrupted path, or None when nothing exists."""
         fault.fired = True
+        _post_mortem(fault)
         from flexflow_tpu.runtime.checkpoint import CheckpointManager
 
         mgr = CheckpointManager(directory)

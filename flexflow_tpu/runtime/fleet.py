@@ -36,6 +36,7 @@ import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.obs.events import BUS
+from flexflow_tpu.obs.tracing import TRACER
 from flexflow_tpu.runtime.decode import (
     ContinuousBatchingExecutor,
     DecodeRequest,
@@ -112,13 +113,26 @@ class FleetExecutor:
 
     def submit(self, requests: Sequence[DecodeRequest]) -> None:
         obs = BUS.enabled  # one check per submit batch
+        tr = TRACER.enabled  # ditto for the request span tree
         for req in requests:
             i = self.route(req)
             self.assignments[req.rid] = i
+            if tr:
+                self._trace_route(req, i)
             self.replicas[i].submit([req])
             if obs:
                 BUS.emit("fleet.route", rid=req.rid, replica=i,
                          slo=req.slo or "standard")
+
+    def _trace_route(self, req: DecodeRequest, replica: int) -> None:
+        """Mint the request's trace at the FRONT (route time — the
+        first component that sees the request) and stamp the router's
+        decision as a zero-duration ``route`` child with the replica
+        tag; the replica's submit then finds the mapping and only adds
+        the queue/prefill/decode children."""
+        tid = TRACER.request_root(req.rid, slo=req.slo or "standard")
+        TRACER.annotate(tid, "route", parent="request", replica=replica,
+                        label=self.replicas[replica].replica_label)
 
     # ------------------------------------------------------------------
     def step(self) -> int:
@@ -145,12 +159,15 @@ class FleetExecutor:
         out: Dict[str, List[int]] = {}
         if requests:
             obs = BUS.enabled  # one check per run
+            tr = TRACER.enabled
             per_replica: List[List[DecodeRequest]] = \
                 [[] for _ in self.replicas]
             for req in requests:
                 i = self.route(req)
                 self.assignments[req.rid] = i
                 per_replica[i].append(req)
+                if tr:
+                    self._trace_route(req, i)
                 if obs:
                     BUS.emit("fleet.route", rid=req.rid, replica=i,
                              slo=req.slo or "standard")

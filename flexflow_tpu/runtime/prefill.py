@@ -37,11 +37,52 @@ masking, no dynamic shapes, one compiled program per chunk size.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from flexflow_tpu.core.optype import OperatorType
 from flexflow_tpu.ops.base import LoweringContext
 from flexflow_tpu.ops.inout import InputOp
+
+
+def run_chunked_prefill(prefill_fn: Callable, tokens: Sequence[int],
+                        pages: Sequence[int], *, chunk: int, cap: int,
+                        trace_id: Optional[str] = None) -> int:
+    """Drive the chunk writer over a prompt: write ``tokens[:-1]`` into
+    the sequence's pages in ``ceil((len-1)/chunk)`` fixed-shape passes
+    (the decode loop then starts at the LAST token).  Returns the
+    number of chunk passes paid.
+
+    Pad positions past the prompt clamp into the sequence's own
+    allotment (``cap - 1``): a pad write lands at a FUTURE position the
+    decode loop rewrites before any frame reads it (see module
+    docstring) — no masking, no dynamic shapes.
+
+    When ``trace_id`` names a live request trace, each pass closes as
+    one ``prefill.chunk`` child span under the open ``prefill`` span —
+    the per-chunk attribution the request span tree renders."""
+    n_pre = len(tokens) - 1
+    if n_pre <= 0:
+        return 0
+    tracer = None
+    if trace_id is not None:
+        from flexflow_tpu.obs.tracing import TRACER as tracer
+    table = np.asarray(pages, np.int32)[None, :]  # [1, P]
+    chunks = 0
+    for c0 in range(0, n_pre, chunk):
+        if tracer is not None:
+            tracer.begin(trace_id, "prefill.chunk", parent="prefill",
+                         c0=c0)
+        ids = np.zeros((1, chunk), np.int32)
+        valid = min(chunk, n_pre - c0)
+        ids[0, :valid] = tokens[c0:c0 + valid]
+        pos = np.minimum(c0 + np.arange(chunk), cap - 1)
+        prefill_fn(ids, pos[None, :].astype(np.int32), table)
+        if tracer is not None:
+            tracer.end(trace_id, "prefill.chunk", tokens=valid)
+        chunks += 1
+    return chunks
 
 
 def _decode_guids(graph) -> List[int]:

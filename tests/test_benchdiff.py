@@ -1,0 +1,88 @@
+"""tools/benchdiff.py — the opt-in bench-regression gate (ISSUE 17
+satellite): fresh BENCH_SEARCH.json vs the blessed BENCH_LASTGOOD.json,
+non-zero exit only on a MEASURED regression past the tolerance band."""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCHDIFF = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "benchdiff.py")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, BENCHDIFF, *args],
+                          capture_output=True, text=True)
+
+
+def _write(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def test_direction_heuristics():
+    from importlib import util
+
+    spec = util.spec_from_file_location("benchdiff", BENCHDIFF)
+    mod = util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.direction("fleet_sweep.ttft_p99_s") == "down"
+    assert mod.direction("models.gpt.throughput") == "up"
+    assert mod.direction("traced_serve.spans") is None  # informational
+    # legacy single-headline shape maps value -> metric-named key
+    flat = mod.extract({"metric": "transformer_train_throughput",
+                        "value": 2961.0, "unit": "samples/s",
+                        "mfu": 0.478})
+    assert flat["transformer_train_throughput"] == 2961.0
+    assert flat["transformer_train_throughput.mfu"] == 0.478
+
+
+def test_check_regression_and_tolerance(tmp_path):
+    base = _write(tmp_path / "base.json",
+                  {"metrics": {"serve.ttft_p99_s": 1.0,
+                               "models.x.throughput": 100.0}})
+    bad = _write(tmp_path / "bad.json",
+                 {"serve": {"ttft_p99_s": 1.5},
+                  "models": {"x": {"throughput": 60.0}}})
+    proc = _run("check", "--fresh", bad, "--lastgood", base)
+    assert proc.returncode == 2
+    assert "ttft_p99_s" in proc.stdout and "throughput" in proc.stdout
+    ok = _write(tmp_path / "ok.json",
+                {"serve": {"ttft_p99_s": 1.1},
+                 "models": {"x": {"throughput": 95.0}}})
+    assert _run("check", "--fresh", ok, "--lastgood", base).returncode == 0
+    # a loose band blesses the same move (the up-direction band is the
+    # reciprocal ratio: 0.60x clears 1/(1+0.7) ~ 0.588)
+    assert _run("check", "--fresh", bad, "--lastgood", base,
+                "--tolerance", "0.7").returncode == 0
+
+
+def test_check_refuses_only_on_measurement(tmp_path):
+    """Missing files, no metric overlap, and informational-only drift
+    all exit 0 — a gate that blocks on shape drift gets disabled."""
+    base = _write(tmp_path / "base.json",
+                  {"metrics": {"a.spans": 5, "a.completed": 3}})
+    fresh = _write(tmp_path / "fresh.json",
+                   {"a": {"spans": 99, "completed": 1}})
+    assert _run("check", "--fresh", fresh,
+                "--lastgood", base).returncode == 0
+    assert _run("check", "--fresh", str(tmp_path / "nope.json"),
+                "--lastgood", base).returncode == 0
+
+
+def test_snapshot_blesses_and_keeps_legacy_keys(tmp_path):
+    fresh = _write(tmp_path / "fresh.json",
+                   {"serve": {"ttft_p99_s": 1.5}})
+    last = _write(tmp_path / "last.json",
+                  {"metric": "transformer_train_throughput",
+                   "value": 2961.0, "unit": "samples/s"})
+    assert _run("snapshot", "--fresh", fresh,
+                "--lastgood", last).returncode == 0
+    doc = json.load(open(last))
+    assert doc["metric"] == "transformer_train_throughput"  # legacy
+    assert doc["metrics"] == {"serve.ttft_p99_s": 1.5}
+    # the blessed snapshot now passes the gate
+    assert _run("check", "--fresh", fresh,
+                "--lastgood", last).returncode == 0

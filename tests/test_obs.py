@@ -464,3 +464,153 @@ def test_every_emit_site_names_a_registered_kind():
         "emit sites with unregistered kinds (add them to "
         "obs.events.EVENT_KINDS so ffobs validate accepts the logs):\n"
         + "\n".join(unregistered))
+
+
+# ---------------------------------------------------------------------------
+# event-volume sampling (ISSUE 17 satellite): per-kind caps/rates for
+# the serving hot-path kinds, deterministic under a seed, with exact
+# suppressed counts so totals stay recoverable from the log
+
+
+def test_sampling_deterministic_and_interleave_independent(tmp_path):
+    """A fractional rate keeps a seeded, per-ordinal subset: the same
+    (kind, seed) keeps the same ordinals regardless of how OTHER kinds
+    interleave, so two runs of the same workload sample identically."""
+
+    def kept_ordinals(interleave):
+        bus = EventBus()
+        path = str(tmp_path / f"s{interleave}.jsonl")
+        bus.configure(path)
+        bus.configure_sampling("decode.request=0.25", seed=3)
+        for i in range(200):
+            bus.emit("decode.request", rid=f"r{i}", phase="finish")
+            if interleave:
+                bus.emit("search.log", msg="noise")
+        bus.close()
+        evs = [json.loads(ln) for ln in open(path)]
+        return [e["rid"] for e in evs if e["kind"] == "decode.request"]
+
+    plain = kept_ordinals(0)
+    noisy = kept_ordinals(1)
+    assert plain == noisy
+    assert 20 < len(plain) < 80  # ~25% of 200, seeded not exact
+
+
+def test_sampling_cap_and_exact_suppressed_counts(tmp_path):
+    """An integer spec caps a kind at its first N events; everything
+    suppressed is counted exactly and rolled up as one ``obs.sampled``
+    event at close — the log's totals stay reconstructible."""
+    bus = EventBus()
+    path = str(tmp_path / "cap.jsonl")
+    bus.configure(path)
+    bus.configure_sampling({"fleet.route": 10})
+    for i in range(90):
+        bus.emit("fleet.route", rid=f"r{i}", replica=0, slo="standard")
+    bus.emit("search.log", msg="unlisted kinds are never sampled")
+    assert bus.sampled_out == {"fleet.route": 80}
+    bus.close()
+    evs = [json.loads(ln) for ln in open(path)]
+    routed = [e for e in evs if e["kind"] == "fleet.route"]
+    assert len(routed) == 10
+    assert [e["rid"] for e in routed] == [f"r{i}" for i in range(10)]
+    assert any(e["kind"] == "search.log" for e in evs)
+    rollup = [e for e in evs if e["kind"] == "obs.sampled"]
+    assert len(rollup) == 1
+    assert rollup[0]["counts"] == {"fleet.route": 80}
+
+
+def test_sampling_keeps_summary_counts_exact(tmp_path):
+    """Sampling thins the LOG, never the measurement: with
+    ``decode.request`` capped at 1, the executor's request_records and
+    summary still see every completion."""
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+    )
+
+    path = str(tmp_path / "obs.jsonl")
+    BUS.configure(path)
+    BUS.configure_sampling("decode.request=1")
+    try:
+
+        def step(ids, table, lens):
+            b = np.asarray(ids).shape[0]
+            logits = np.zeros((b, 1, 7), np.float32)
+            logits[:, 0, 3] = 1.0
+            return logits
+
+        ex = ContinuousBatchingExecutor(step, max_seqs=2, page_size=4,
+                                        pages_per_seq=2)
+        ex.run([DecodeRequest(rid=f"r{i}", prompt=[1, 2],
+                              max_new_tokens=2) for i in range(4)])
+        assert len(ex.request_records) == 4  # the measurement is whole
+        assert ex.summary()["completed"] == 4
+        BUS.close()
+        evs = [json.loads(ln) for ln in open(path)]
+        assert sum(e["kind"] == "decode.request" for e in evs) == 1
+        rollup = [e for e in evs if e["kind"] == "obs.sampled"]
+        assert rollup and rollup[0]["counts"] == {"decode.request": 3}
+    finally:
+        BUS.configure_sampling(None)
+
+
+def test_sampling_off_keeps_disabled_emit_cheap():
+    """The one-boolean contract survives the sampling knob: with no
+    spec armed (the default), a disabled bus still costs one attribute
+    read per emit — 100k emits well under a second."""
+    bus = EventBus()
+    assert bus._sample is None
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        bus.emit("search.log", msg="x")
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 1.0, f"disabled emit too slow: {elapsed:.3f}s"
+
+
+# ---------------------------------------------------------------------------
+# exposition label edge cases (ISSUE 17 satellite)
+
+
+def test_exposition_labeled_histogram_renders_label_blocks():
+    from flexflow_tpu.obs.exposition import render_prometheus
+
+    reg = MetricsRegistry()
+    hist = reg.histogram("decode.ttft_s|replica=0,slo=interactive")
+    for v in (0.01, 0.02, 0.03):
+        hist.observe(v)
+    reg.counter("fleet.route.total|slo=interactive").inc()
+    text = render_prometheus(reg.snapshot())
+    assert ('flexflow_tpu_decode_ttft_s_count'
+            '{replica="0",slo="interactive"} 3') in text
+    # labeled quantile lines merge the series labels with the quantile
+    assert ('flexflow_tpu_decode_ttft_s'
+            '{replica="0",slo="interactive",quantile="0.50"}') in text
+    assert ('flexflow_tpu_fleet_route_total'
+            '{slo="interactive"} 1') in text
+
+
+def test_exposition_empty_registry_renders_empty():
+    from flexflow_tpu.obs.exposition import render_prometheus
+
+    assert render_prometheus(MetricsRegistry().snapshot()) == ""
+    assert render_prometheus({}) == ""
+
+
+def test_exposition_zero_observation_histogram():
+    """A histogram that exists but never observed renders only its
+    ``_count 0`` line — no NaN quantiles, no sum."""
+    from flexflow_tpu.obs.exposition import render_prometheus
+
+    text = render_prometheus(
+        {"histograms": {"trace.span_s|span=queue": {"count": 0}}})
+    assert text == ("# TYPE flexflow_tpu_trace_span_s summary\n"
+                    'flexflow_tpu_trace_span_s_count{span="queue"} 0\n')
+
+
+def test_exposition_malformed_label_suffix_keeps_series():
+    from flexflow_tpu.obs.exposition import render_prometheus
+
+    text = render_prometheus(
+        {"gauges": {"slo.burn_rate|slo=": 2.5, "ok|a=b": 1.0}})
+    # the malformed suffix stays part of the name; the series survives
+    assert "2.5" in text and 'ok{a="b"} 1.0' in text

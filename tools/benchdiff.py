@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""benchdiff — guard the bench numbers against silent regression.
+
+``BENCH_SEARCH.json`` is the repo's measured record (bench_search.py
+appends a section per landed subsystem); ``BENCH_LASTGOOD.json`` is
+the blessed snapshot.  This tool compares a fresh bench run against
+the snapshot and exits non-zero when any shared metric regressed past
+a tolerance band — the opt-in pre-commit leg next to fflint
+(``FF_PRECOMMIT_BENCHDIFF=1``, see .githooks/pre-commit).
+
+Direction is inferred from the metric name: latency-shaped leaves
+(``*_s``, ``*_ms``, ``p99``, ``ttft``, ``e2e``, ``wall``, ``cost``)
+regress UP; rate-shaped leaves (``throughput``, ``samples``, ``mfu``,
+``win``, ``speedup``) regress DOWN.  Leaves matching neither are
+informational only — a count changing is not a regression.  Missing
+files, no metric overlap, and new/removed sections all exit 0: the
+guard refuses only on MEASURED regression, never on shape drift (an
+opt-in hook that blocks commits spuriously gets turned off, which
+guards nothing).
+
+Usage:
+  benchdiff.py check   [--fresh BENCH_SEARCH.json]
+                       [--lastgood BENCH_LASTGOOD.json]
+                       [--tolerance 0.25]
+  benchdiff.py snapshot [--fresh ...] [--lastgood ...]
+                        write the fresh run's metrics into the
+                        lastgood snapshot (blessing a new baseline;
+                        legacy headline keys are preserved)
+
+Stdlib-only; no jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, Optional, Tuple
+
+# substrings that mark a numeric leaf as lower-is-better (latency /
+# cost shaped) vs higher-is-better (rate shaped); checked in order,
+# first hit wins, no hit = informational
+_LOWER = ("_ms", "_s", "seconds", "p99", "p95", "p50", "ttft", "tpot",
+          "e2e", "wall", "cost", "latency", "bubble", "staleness")
+_HIGHER = ("throughput", "samples_per", "mfu", "win", "speedup",
+           "tokens_per", "hit_rate", "vs_baseline", "value")
+
+
+def direction(path: str) -> Optional[str]:
+    """'down' = lower is better, 'up' = higher is better, None =
+    informational (counts, ids, flags-as-ints)."""
+    leaf = path.rsplit(".", 1)[-1].lower()
+    for pat in _LOWER:
+        if pat in leaf:
+            return "down"
+    for pat in _HIGHER:
+        if pat in leaf:
+            return "up"
+    return None
+
+
+def extract(doc, prefix: str = "") -> Dict[str, float]:
+    """Every finite numeric leaf of a bench JSON as dotted.path ->
+    value.  Booleans are skipped (adopted flags are shape, not
+    measurement); list elements index into the path."""
+    out: Dict[str, float] = {}
+    if isinstance(doc, dict):
+        # legacy single-headline shape: {"metric": name, "value": v}
+        if "metric" in doc and "value" in doc and prefix == "":
+            name = str(doc["metric"])
+            for k, v in doc.items():
+                if k in ("metric", "unit", "measured_at"):
+                    continue
+                key = name if k == "value" else f"{name}.{k}"
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool) \
+                        and math.isfinite(v):
+                    out[key] = float(v)
+            return out
+        for k, v in doc.items():
+            out.update(extract(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            out.update(extract(v, f"{prefix}[{i}]"))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool) \
+            and math.isfinite(doc):
+        out[prefix] = float(doc)
+    return out
+
+
+def _load(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def compare(fresh: Dict[str, float], base: Dict[str, float],
+            tolerance: float) -> Tuple[list, int]:
+    """(regressions, compared): regressions are (path, base, fresh,
+    ratio, direction) rows past the tolerance band on shared,
+    direction-bearing metrics."""
+    regressions = []
+    compared = 0
+    for path in sorted(set(fresh) & set(base)):
+        d = direction(path)
+        if d is None:
+            continue
+        b, f = base[path], fresh[path]
+        compared += 1
+        if b == 0:
+            continue  # ratio undefined; an honest zero is not a base
+        ratio = f / b
+        if d == "down" and ratio > 1.0 + tolerance:
+            regressions.append((path, b, f, ratio, "slower"))
+        elif d == "up" and ratio < 1.0 / (1.0 + tolerance):
+            regressions.append((path, b, f, ratio, "lower"))
+    return regressions, compared
+
+
+def cmd_check(args) -> int:
+    fresh_doc = _load(args.fresh)
+    base_doc = _load(args.lastgood)
+    if fresh_doc is None or base_doc is None:
+        missing = args.fresh if fresh_doc is None else args.lastgood
+        print(f"benchdiff: {missing} missing/unreadable — nothing to "
+              f"compare (ok)")
+        return 0
+    fresh = extract(fresh_doc)
+    base = extract(base_doc.get("metrics", base_doc))
+    regressions, compared = compare(fresh, base, args.tolerance)
+    if not compared:
+        print("benchdiff: no shared direction-bearing metrics — "
+              "nothing to compare (ok)")
+        return 0
+    if not regressions:
+        print(f"benchdiff: {compared} shared metric(s) within "
+              f"{args.tolerance:.0%} of {args.lastgood} — ok")
+        return 0
+    print(f"benchdiff: {len(regressions)} regression(s) past "
+          f"{args.tolerance:.0%} (of {compared} compared):")
+    for path, b, f, ratio, word in regressions:
+        print(f"  {path}: {b:g} -> {f:g}  ({ratio:.2f}x, {word})")
+    print(f"(bless the new numbers with `benchdiff.py snapshot` if "
+          f"they are intentional)")
+    return 2
+
+
+def cmd_snapshot(args) -> int:
+    fresh_doc = _load(args.fresh)
+    if fresh_doc is None:
+        print(f"benchdiff: {args.fresh} missing/unreadable — nothing "
+              f"to snapshot", file=sys.stderr)
+        return 1
+    base_doc = _load(args.lastgood) or {}
+    out = dict(base_doc)  # legacy headline keys survive the blessing
+    out["metrics"] = extract(fresh_doc)
+    with open(args.lastgood, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"benchdiff: snapshotted {len(out['metrics'])} metric(s) "
+          f"from {args.fresh} into {args.lastgood}")
+    return 0
+
+
+def main(argv=None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(prog="benchdiff", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("check", cmd_check), ("snapshot", cmd_snapshot)):
+        p = sub.add_parser(name)
+        p.add_argument("--fresh",
+                       default=os.path.join(root, "BENCH_SEARCH.json"))
+        p.add_argument("--lastgood",
+                       default=os.path.join(root, "BENCH_LASTGOOD.json"))
+        p.add_argument("--tolerance", type=float, default=0.25,
+                       help="relative band a metric may move against "
+                            "its direction before it counts as a "
+                            "regression (default 0.25)")
+        p.set_defaults(fn=fn)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,9 @@ Usage:
   ffobs.py validate <log.jsonl>           schema-check every line
   ffobs.py metrics <log.jsonl>            Prometheus text from the
                                           last metrics.snapshot event
+  ffobs.py trace <log.jsonl>              render request/episode span
+                                          trees (also reads
+                                          flight-recorder dumps)
 """
 
 from __future__ import annotations
@@ -725,6 +728,52 @@ def render_report(events: List[dict], top: int = 10,
             f"(ratio {f'{r:.2f}' if isinstance(r, (int, float)) else '—'})"
             + (" — DRIFTED, re-search triggered" if e.get("drifted")
                else ""))
+    burns = [e for e in events if e.get("kind") == "controller.burn_rate"]
+    for e in burns:
+
+        def _b(v):
+            return f"{v:.1f}x" if isinstance(v, (int, float)) else "—"
+
+        lines.append(
+            f"SLO burn-rate watch at step {e.get('step')} "
+            f"[{e.get('slo')}]: fast {_b(e.get('fast'))} / slow "
+            f"{_b(e.get('slow'))} of budget"
+            + (" — FIRED, re-search triggered" if e.get("fired")
+               else ""))
+    dumps = [e for e in events if e.get("kind") == "flight.dump"]
+    for e in dumps:
+        lines.append(
+            f"Flight-recorder dump ({e.get('reason')}): "
+            f"{e.get('events')} ring event(s) + {e.get('open_spans')} "
+            f"open span(s) -> {e.get('path')}")
+
+    # ---- request traces ---------------------------------------------------
+    from flexflow_tpu.obs.tracing import forest_stats, span_forest
+
+    forest = span_forest(events)
+    if forest:
+        total, depth, orphans = forest_stats(forest)
+        lines.append("")
+        lines.append("## Request traces")
+        lines.append("")
+        lines.append(
+            f"{len(forest)} trace(s), {total} span(s), max depth "
+            f"{depth}, {orphans} orphan span(s)"
+            + (" — ORPHANS ARE A VALIDATION FAILURE (a span named a "
+               "parent the log never closed)" if orphans else ""))
+        outcomes: Counter = Counter()
+        for spans in forest.values():
+            for e in spans:
+                if e.get("parent_id") is None:
+                    outcomes[e.get("outcome") or
+                             ("open" if e.get("kind") == "trace.open"
+                              else "?")] += 1
+        if outcomes:
+            lines.append(
+                "Root outcomes: "
+                + ", ".join(f"{k}={v}"
+                            for k, v in sorted(outcomes.items())))
+        lines.append("(render the trees with `ffobs.py trace <log>`)")
 
     stale = [e for e in events if e.get("kind") == "calibration.staleness"]
     if stale:
@@ -778,6 +827,105 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+_SPAN_META = ("ts", "kind", "trace_id", "span", "span_id", "parent_id",
+              "start_s", "dur_s", "end_s")
+
+
+def _span_label(e: dict) -> str:
+    bits = [str(e.get("span"))]
+    dur = e.get("dur_s")
+    if dur is not None:
+        bits.append(f"{dur * 1e3:.3f} ms")
+    elif e.get("kind") == "trace.open":
+        bits.append("OPEN")
+    attrs = dict(e.get("attrs") or {})
+    attrs.update({k: v for k, v in e.items()
+                  if k not in _SPAN_META and k != "attrs"})
+    if attrs:
+        bits.append(", ".join(f"{k}={v}"
+                              for k, v in sorted(attrs.items())))
+    return "  ".join(bits)
+
+
+def render_trace_trees(events: List[dict],
+                       trace_id: Optional[str] = None,
+                       limit: int = 0) -> str:
+    """Span forests as indented trees — from a bus JSONL
+    (``trace.span`` events) or a flight-recorder dump (``trace.span``
+    + ``trace.open`` lines).  Orphan spans (a ``parent_id`` the log
+    holds no span for) are listed per trace as validation failures."""
+    from flexflow_tpu.obs.tracing import span_forest
+
+    forest = span_forest(events)
+    if trace_id is not None:
+        forest = {t: s for t, s in forest.items() if t == trace_id}
+        if not forest:
+            return f"no spans for trace {trace_id!r}\n"
+    lines: List[str] = []
+    shown = 0
+    for tid, spans in forest.items():
+        if limit and shown >= limit:
+            lines.append(
+                f"... {len(forest) - shown} more trace(s) "
+                f"(raise --limit)")
+            lines.append("")
+            break
+        shown += 1
+        by_id = {e.get("span_id"): e for e in spans
+                 if e.get("span_id") is not None}
+        children: Dict[int, List[dict]] = defaultdict(list)
+        roots: List[dict] = []
+        orphans: List[dict] = []
+        for e in spans:
+            pid = e.get("parent_id")
+            if pid is None:
+                roots.append(e)
+            elif pid in by_id:
+                children[pid].append(e)
+            else:
+                orphans.append(e)
+        lines.append(f"trace {tid}  ({len(spans)} spans)")
+
+        def walk(e: dict, depth: int, seen: tuple) -> None:
+            lines.append("  " * depth + _span_label(e))
+            sid = e.get("span_id")
+            if sid in seen:  # defensive: a cyclic log must not hang
+                return
+            for c in sorted(children.get(sid, ()),
+                            key=lambda c: (c.get("start_s")
+                                           or c.get("ts") or 0)):
+                walk(c, depth + 1, seen + (sid,))
+
+        for r in sorted(roots, key=lambda e: (e.get("start_s")
+                                              or e.get("ts") or 0)):
+            walk(r, 1, ())
+        for o in orphans:
+            lines.append(f"  ORPHAN (parent {o.get('parent_id')} "
+                         f"missing): {_span_label(o)}")
+        lines.append("")
+    if not lines:
+        return ("no trace.span events (arm the tracer: "
+                "FLEXFLOW_TPU_TRACE=1 with the bus on, or read a "
+                "flight dump)\n")
+    return "\n".join(lines)
+
+
+def cmd_trace(args) -> int:
+    events = read_events(args.log)
+    out = render_trace_trees(events, trace_id=args.trace,
+                            limit=args.limit)
+    sys.stdout.write(out)
+    from flexflow_tpu.obs.tracing import forest_stats, span_forest
+
+    forest = span_forest(events)
+    if forest:
+        total, depth, orphans = forest_stats(forest)
+        print(f"{len(forest)} trace(s), {total} span(s), max depth "
+              f"{depth}, {orphans} orphan span(s)")
+        return 1 if orphans else 0
+    return 0
+
+
 def cmd_validate(args) -> int:
     from flexflow_tpu.obs.events import validate_event
 
@@ -811,6 +959,16 @@ def main(argv=None) -> int:
                         "Prometheus text (offline exposition)")
     p_met.add_argument("log")
     p_met.set_defaults(fn=cmd_metrics)
+    p_tr = sub.add_parser(
+        "trace", help="render request/controller span trees from a "
+                      "trace JSONL or flight-recorder dump (exit 1 on "
+                      "orphan spans)")
+    p_tr.add_argument("log")
+    p_tr.add_argument("--trace", default=None,
+                      help="render only this trace id")
+    p_tr.add_argument("--limit", type=int, default=20,
+                      help="max trees to render (0 = all)")
+    p_tr.set_defaults(fn=cmd_trace)
     args = ap.parse_args(argv)
     return args.fn(args)
 

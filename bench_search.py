@@ -1130,6 +1130,268 @@ def _disagg_sweep_md_lines(sweep):
     return lines
 
 
+def kv_sweep(n_devices):
+    """The --kv sweep, two legs (ISSUE 18 — KV memory as a searched
+    resource):
+
+    (1) SEARCHED KV-cache precision (simulated, TPU machine model):
+    the gpt_decode_chat serve-objective search runs with
+    ``kv_precision="search"`` + 2 shared prefix pages/seq; the driver
+    prices fp32/bf16/int8 pool clones in the serve currency (decode
+    stream + quantize-overhead passes, residency discounted by the
+    shared factor) and the winning ``__meta__.kv`` is recorded —
+    chosen dtype, per-dtype predicted p99, pool bytes/device.
+
+    (2) MEASURED radix prefix sharing on the CPU host mesh: eight
+    seeded requests share a 48-token system prompt with divergent
+    tails (one diverging MID-page to exercise copy-on-write); the SAME
+    request set serves through a FIXED 29-page pool with sharing off
+    vs on — peak concurrent sequences, shared/private page claims,
+    prompt tokens skipped at prefill, CoW copies, and token-identity
+    vs solo single-request runs all recorded.  Plus the accuracy
+    contract at op level: int8/bf16 pool drift vs the fp32 attention
+    path and quant-kernel-vs-XLA agreement on seeded pages.
+    CPU-mesh honesty: the dequant overhead and sharing concurrency are
+    measured for real; HBM cache-stream ratios stay simulated until a
+    TPU run."""
+    import numpy as np
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.models import build_gpt_decode
+    from flexflow_tpu.runtime.decode import (
+        ContinuousBatchingExecutor,
+        DecodeRequest,
+        compiled_decode_step,
+    )
+    from flexflow_tpu.search import driver as _driver
+    from flexflow_tpu.search.driver import optimize_strategy
+
+    sweep = {
+        "devices": n_devices,
+        "note": (
+            "precision leg simulated on the TPU machine model (serve "
+            "currency: p99 seconds/frame incl. KV_QUANT_PASSES write "
+            "overhead; residency discounted by the shared-prefix "
+            "factor); sharing + drift legs MEASURED on the CPU host "
+            "mesh — concurrency and dequant drift are real there, HBM "
+            "stream ratios are not"),
+    }
+
+    # ---- leg 1: searched pool precision (simulated) -------------------
+    cfg = ff.FFConfig(
+        batch_size=32, num_devices=n_devices, search_budget=8,
+        search_timeout_s=60.0, objective="serve",
+        comp_mode="inference", cost_cache_file="",
+        kv_precision="search", serve_shared_prefix_pages=2,
+        **CHAT_ARRIVAL)
+    m = build_gpt_decode(cfg, **GPT_DECODE_CHAT_KW)
+    t0 = time.monotonic()
+    optimize_strategy(m.graph, cfg)
+    meta = dict(_driver.LAST_KV_META or {})
+    p99 = meta.get("predicted_p99_step_ms") or {}
+    chosen = meta.get("dtype")
+    searched = {
+        "config": "gpt_decode_chat (serve objective, kv_precision="
+                  "search, 2 shared prefix pages/seq)",
+        "search_seconds": round(time.monotonic() - t0, 2),
+        "dtype": chosen,
+        "predicted_p99_step_ms": p99,
+        "p99_win_vs_fp32": (
+            round(p99["fp32"] / p99[chosen], 4)
+            if chosen in p99 and p99.get("fp32") else None),
+        "kv_bytes_per_device": meta.get("kv_bytes_per_device"),
+        "shared_prefix_pages": meta.get("shared_prefix_pages"),
+        "shared_residency_factor": meta.get("shared_residency_factor"),
+    }
+    sweep["searched_precision"] = searched
+    print(json.dumps({"kv_sweep": "searched_precision", **searched}))
+
+    # ---- leg 2a: measured prefix sharing (CPU host mesh) --------------
+    kw = dict(vocab=256, num_layers=2, hidden=64, num_heads=4,
+              ff_dim=128, page_size=8, pages_per_seq=10)
+    page_bytes = 2 * 8 * 64 * 4  # K+V, page_size x hidden, fp32
+    rng = np.random.default_rng(7)
+    sys_prompt = list(map(int, rng.integers(1, 255, size=48)))
+    # r0 carries a 10-token tail so its page 6 (tokens 48..55) fills
+    # and registers; rc agrees with r0 for 4 tokens past the page-6
+    # boundary then diverges MID-page — the copy-on-write case; the
+    # rest diverge exactly at the boundary (pure refcount claims)
+    tails = [list(map(int, rng.integers(1, 255, size=int(L))))
+             for L in [10, 4, 4, 5, 5, 6, 6]]
+    prompts = [sys_prompt + t for t in tails]
+    prompts.append(sys_prompt + tails[0][:4]
+                   + list(map(int, rng.integers(1, 255, size=3))))
+    scfg = ff.FFConfig(batch_size=8, num_devices=n_devices,
+                       search_budget=4, search_timeout_s=30.0,
+                       cost_cache_file="",
+                       machine_spec=MachineSpec.host_cpu(n_devices))
+    sm = build_gpt_decode(scfg, **kw)
+    sm.compile(loss_type="sparse_categorical_crossentropy",
+               metrics=[], comp_mode="inference")
+    step = compiled_decode_step(sm, prefill_chunk=8)
+
+    def _serve(sharing, num_pages, reqs):
+        ex = ContinuousBatchingExecutor(
+            step, max_seqs=8, page_size=8, pages_per_seq=10,
+            num_pages=num_pages,
+            prefill_fn=getattr(step, "prefill", None), prefill_chunk=8,
+            prefix_sharing=sharing,
+            copy_page_fn=step.copy_page if sharing else None)
+        ex.submit(reqs)
+        peak = 0
+        while ex.queue or any(s is not None for s in ex.slots):
+            if ex.frame >= 2000:
+                raise RuntimeError("kv sweep decode run stuck")
+            ex.step()
+            peak = max(peak, sum(s is not None for s in ex.slots))
+        return dict(ex.finished), peak, ex.summary()
+
+    def _reqs():
+        return [DecodeRequest(rid=f"r{i}", prompt=list(p),
+                              max_new_tokens=8)
+                for i, p in enumerate(prompts)]
+
+    pool = 29  # FIXED pool: 1 scratch + 2 full allotments with change
+    out_off, peak_off, _ = _serve(False, pool, _reqs())
+    out_on, peak_on, summ_on = _serve(True, pool, _reqs())
+    solo = {}
+    for i, p in enumerate(prompts):
+        one, _, _ = _serve(False, 0, [DecodeRequest(
+            rid=f"r{i}", prompt=list(p), max_new_tokens=8)])
+        solo.update(one)
+    sharing = {
+        "config": "gpt_decode small (2L, h64, 8 requests over a "
+                  "48-token shared system prompt, fixed 29-page pool, "
+                  "chunk-8 prefill, host mesh)",
+        "pool_pages": pool,
+        "kv_pool_bytes": pool * page_bytes,
+        "max_concurrent_off": peak_off,
+        "max_concurrent": peak_on,
+        "concurrency_win": round(peak_on / max(peak_off, 1), 2),
+        "token_identical_batched_vs_solo": (out_on == solo
+                                            and out_off == solo),
+        "prefix_hits": summ_on.get("prefix_hits"),
+        "shared_pages": summ_on.get("shared_pages"),
+        "private_pages": summ_on.get("private_pages"),
+        "cow_copies": summ_on.get("cow_copies"),
+        "prefix_tokens": summ_on.get("prefix_tokens"),
+        "kv_shared_bytes": summ_on.get("shared_pages", 0) * page_bytes,
+    }
+    if not sharing["token_identical_batched_vs_solo"]:
+        sharing["note"] = ("TOKEN MISMATCH — shared pages corrupted a "
+                           "sibling's stream")
+    sweep["measured_sharing"] = sharing
+    print(json.dumps({"kv_sweep": "measured_sharing", **sharing}))
+
+    # ---- leg 2b: accuracy contract (measured, op level) ---------------
+    import math
+
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.ragged_paged_attention import (
+        _xla_ragged_paged_quant,
+        ragged_paged_attention,
+        ragged_paged_attention_quant,
+    )
+    from flexflow_tpu.ops.decode_attention import _quantize_kv
+
+    P, ps, H, D, B, pps = 16, 8, 4, 16, 4, 4
+    k = jnp.asarray(rng.normal(size=(P, ps, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(P, ps, H, D)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    table = jnp.asarray(
+        rng.permutation(P)[:B * pps].reshape(B, pps), jnp.int32)
+    lens = jnp.asarray(rng.integers(ps, ps * pps, size=B), jnp.int32)
+    ref = ragged_paged_attention(q, k, v, table, lens)
+    kq, ks = _quantize_kv(k)
+    vq, vs = _quantize_kv(v)
+    got8 = ragged_paged_attention_quant(q, kq, vq, ks, vs, table, lens)
+    xla8 = _xla_ragged_paged_quant(q, kq, vq, ks, vs, table, lens,
+                                   1.0 / math.sqrt(D))
+    gotbf = ragged_paged_attention(
+        q, k.astype(jnp.bfloat16).astype(jnp.float32),
+        v.astype(jnp.bfloat16).astype(jnp.float32), table, lens)
+    drift = {
+        "int8_max_abs_drift": float(jnp.max(jnp.abs(got8 - ref))),
+        "bf16_max_abs_drift": float(jnp.max(jnp.abs(gotbf - ref))),
+        "int8_kernel_vs_xla": float(jnp.max(jnp.abs(got8 - xla8))),
+        "contract_bound": 0.05,
+    }
+    drift["within_contract"] = (
+        drift["int8_max_abs_drift"] < drift["contract_bound"])
+    sweep["accuracy_contract"] = drift
+    print(json.dumps({"kv_sweep": "accuracy_contract", **drift}))
+    return sweep
+
+
+def _kv_sweep_md_lines(sweep):
+    lines = [
+        "",
+        "## KV memory as a searched resource "
+        "(prefix sharing + pool precision)",
+        "",
+        sweep.get("note", ""),
+    ]
+    s = sweep.get("searched_precision")
+    if s:
+        p99 = s.get("predicted_p99_step_ms") or {}
+        lines += [
+            "",
+            f"Searched pool precision ({s['config']}): the lane chose "
+            f"**{s.get('dtype')}** in {s.get('search_seconds')}s.",
+            "",
+            "| pool dtype | predicted p99 ms/frame |",
+            "|---|---|",
+        ] + [f"| {d}{' (chosen)' if d == s.get('dtype') else ''} | "
+             f"{p99[d]} |" for d in ("fp32", "bf16", "int8") if d in p99]
+        if s.get("p99_win_vs_fp32") is not None:
+            lines += [
+                "",
+                f"p99 win vs fp32: {s['p99_win_vs_fp32']}x at "
+                f"{s.get('kv_bytes_per_device')} pool bytes/device; "
+                f"{s.get('shared_prefix_pages')} shared prefix "
+                f"page(s)/seq discount residency to "
+                f"{s.get('shared_residency_factor')} of the private "
+                f"pool (stream is never discounted — every sequence "
+                f"still reads its own prefix).",
+            ]
+    m = sweep.get("measured_sharing")
+    if m:
+        lines += [
+            "",
+            f"Measured radix prefix sharing ({m['config']}): "
+            f"token-identical to solo "
+            f"{'YES' if m['token_identical_batched_vs_solo'] else 'NO'}.",
+            "",
+            "| lane | peak concurrent seqs | shared pages | "
+            "private pages | CoW copies | prompt tokens skipped |",
+            "|---|---|---|---|---|---|",
+            f"| sharing off | {m['max_concurrent_off']} | — | — | — | "
+            f"— |",
+            f"| sharing on | {m['max_concurrent']} | "
+            f"{m['shared_pages']} | {m['private_pages']} | "
+            f"{m['cow_copies']} | {m['prefix_tokens']} |",
+            "",
+            f"Concurrency win at a fixed {m['pool_pages']}-page pool "
+            f"({m['kv_pool_bytes']} bytes): {m['concurrency_win']}x — "
+            f"measured, {m['prefix_hits']} of the admissions claimed "
+            f"cached prefix pages by refcount instead of allocating.",
+        ]
+    d = sweep.get("accuracy_contract")
+    if d:
+        lines += [
+            "",
+            f"Accuracy contract (seeded pages, op level): int8 pool "
+            f"max-abs drift {d['int8_max_abs_drift']:.2e} vs fp32 "
+            f"(bound {d['contract_bound']}, "
+            f"{'WITHIN' if d['within_contract'] else 'EXCEEDED'}), "
+            f"bf16 {d['bf16_max_abs_drift']:.2e}, quant kernel vs XLA "
+            f"fallback {d['int8_kernel_vs_xla']:.2e}.",
+        ]
+    return lines
+
+
 # the mixed-SLO class table every fleet leg shares: an interactive
 # trickle (1/8 of arrivals, priority 2, 64-frame deadline), a standard
 # stream (2/8), and a batch flood (5/8 of arrivals, watched at p90) —
@@ -2900,6 +3162,18 @@ def main():
     ap.add_argument("--disagg-only", action="store_true",
                     help="run ONLY the disaggregation sweep and merge "
                          "it into existing BENCH_SEARCH artifacts")
+    ap.add_argument("--kv", action="store_true",
+                    help="also run the KV-memory sweep: searched pool "
+                         "precision (fp32/bf16/int8 priced in the "
+                         "serve currency, kv_precision=search), "
+                         "MEASURED radix prefix sharing at a fixed "
+                         "pool (peak concurrency, CoW, token identity "
+                         "vs solo) and the int8/bf16 accuracy "
+                         "contract (runtime/decode.py, "
+                         "ops/decode_attention.py)")
+    ap.add_argument("--kv-only", action="store_true",
+                    help="run ONLY the KV-memory sweep and merge it "
+                         "into existing BENCH_SEARCH artifacts")
     ap.add_argument("--fleet", action="store_true",
                     help="also run the serving-fleet sweep: searched "
                          "N-replica-block fleets with per-SLO-class "
@@ -3123,6 +3397,38 @@ def main():
                         report["disagg_sweep"]))
                     + "\n" + tail)
         print(f"# merged disaggregation sweep into {path} / {md}")
+        return
+    if args.kv_only:
+        path = f"{args.out_prefix}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                report = json.load(f)
+        else:
+            report = {"devices": args.devices,
+                      "backend": jax.devices()[0].platform,
+                      "calibrated": False, "calibration_backend": None,
+                      "models": {}}
+        report["kv_sweep"] = kv_sweep(args.devices)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        md = f"{args.out_prefix}.md"
+        head, tail = "", ""
+        if os.path.exists(md):
+            with open(md) as f:
+                head = f.read()
+            # splice out ONLY a previous KV-memory section (same merge
+            # discipline as the other --*-only modes)
+            marker = "\n## KV memory as a searched resource"
+            at = head.find(marker)
+            if at >= 0:
+                nxt = head.find("\n## ", at + 1)
+                tail = head[nxt:] if nxt >= 0 else ""
+                head = head[:at]
+        with open(md, "w") as f:
+            f.write(head.rstrip("\n") + "\n"
+                    + "\n".join(_kv_sweep_md_lines(report["kv_sweep"]))
+                    + "\n" + tail)
+        print(f"# merged KV-memory sweep into {path} / {md}")
         return
     if args.fleet_only:
         path = f"{args.out_prefix}.json"
@@ -3551,6 +3857,8 @@ def main():
         report["serve_sweep"] = serve_sweep(args.devices)
     if args.disagg:
         report["disagg_sweep"] = disagg_sweep(args.devices)
+    if args.kv:
+        report["kv_sweep"] = kv_sweep(args.devices)
     if args.fleet:
         report["fleet_sweep"] = fleet_sweep(args.devices)
     if args.request_trace:
@@ -3646,6 +3954,8 @@ def main():
         lines += _serve_sweep_md_lines(report["serve_sweep"])
     if report.get("disagg_sweep"):
         lines += _disagg_sweep_md_lines(report["disagg_sweep"])
+    if report.get("kv_sweep"):
+        lines += _kv_sweep_md_lines(report["kv_sweep"])
     if report.get("fleet_sweep"):
         lines += _fleet_sweep_md_lines(report["fleet_sweep"])
     if report.get("request_trace_sweep"):

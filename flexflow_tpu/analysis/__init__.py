@@ -54,6 +54,7 @@ from flexflow_tpu.analysis.placement import (
 from flexflow_tpu.analysis.sharding import (
     lint_disaggregation,
     lint_fleet,
+    lint_kv,
     lint_reduction_plan,
     lint_serving,
     lint_strategy,
@@ -76,6 +77,7 @@ __all__ = [
     "verification_enabled",
     "lint_disaggregation",
     "lint_fleet",
+    "lint_kv",
     "lint_pipeline_stages",
     "lint_placement",
     "lint_reduction_plan",

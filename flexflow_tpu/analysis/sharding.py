@@ -99,6 +99,22 @@ of search/serving.py, gated always-on under
 * **SHD163** SLO coherence (warn): predicted p99 over the declared
   budget is reported, never silently clamped
 
+KV-lane legality (``lint_kv`` — the ``__meta__.kv`` artifact of the
+searched KV-precision + prefix-sharing lane, gated always-on when the
+lane is armed and re-run at import):
+
+* **SHD168** sharing/refcount accounting coherence: the declared
+  shared-prefix page count is a sane fraction of the frame (>= 0,
+  < pages_per_seq), agrees with the armed ServingSpec, and the
+  recorded shared-residency factor matches the refcount arithmetic —
+  residency priced against sharing the runtime will not deliver is an
+  OOM deferred, not saved
+* **SHD169** pool-dtype legality: the persisted pool dtype is one of
+  fp32/bf16/int8, every decode op's own ``kv_dtype`` attr (when
+  present) agrees with it and with its siblings, and the scale layout
+  matches the dtype discipline (int8 ⇒ per-(page, slot) "page_slot"
+  scales; fp32/bf16 ⇒ no scales)
+
 Pure host-side: no mesh construction, no XLA — safe to run inside
 ``optimize_strategy`` as an always-on gate.
 """
@@ -681,6 +697,113 @@ def lint_serving(graph, strategy: Dict[int, object], serving,
             f"({predicted_p99_s * 1e3:.3f} ms) exceeds the declared "
             f"SLO budget ({serving.p99_budget_ms:.3f} ms)",
             severity="warn"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# KV-lane legality (SHD168/169)
+# ---------------------------------------------------------------------------
+def lint_kv(graph, strategy: Dict[int, object], kv_meta,
+            serving=None) -> List[Finding]:
+    """Legality of a KV-lane result (``__meta__.kv``,
+    FFConfig.kv_precision / serve_shared_prefix_pages) against the
+    decode graph it targets — the always-on gate the driver runs on
+    fresh AND cache-served serve results, re-run at import before the
+    dtype is adopted onto the graph's decode ops:
+
+    * **SHD168** sharing/refcount accounting coherence (see module
+      docstring): shared_prefix_pages in [0, pages_per_seq), coherent
+      with the armed ServingSpec, and the recorded
+      shared_residency_factor equal to the refcount arithmetic
+      ``(max_seqs*(pps-s)+s) / (max_seqs*pps)``.
+    * **SHD169** pool-dtype legality: dtype in fp32/bf16/int8; decode
+      ops' own ``kv_dtype`` attrs (pre-adoption these are absent —
+      vacuously coherent) agree with the meta and each other; int8
+      carries "page_slot" scales, fp32/bf16 carry none.
+    """
+    from flexflow_tpu.search.serving import decode_nodes
+
+    findings: List[Finding] = []
+    if not isinstance(kv_meta, dict):
+        return [_srv("SHD169",
+                     f"__meta__.kv is not a mapping: {type(kv_meta).__name__}")]
+    nodes = decode_nodes(graph)
+    if not nodes:
+        return [_srv(
+            "SHD169",
+            "kv lane armed on a graph with no decode-attention ops — "
+            "there is no page pool to retype or share")]
+    # ---- SHD169: pool dtype discipline ----------------------------------
+    dtype = kv_meta.get("dtype")
+    if dtype not in ("fp32", "bf16", "int8"):
+        findings.append(_srv(
+            "SHD169",
+            f"__meta__.kv pool dtype {dtype!r} is not one of "
+            f"fp32|bf16|int8"))
+    layout = kv_meta.get("scale_layout", "none")
+    if dtype == "int8" and layout != "page_slot":
+        findings.append(_srv(
+            "SHD169",
+            f"int8 pool requires per-(page, slot) scales "
+            f"(scale_layout='page_slot'), got {layout!r} — dequant "
+            f"inside the page loop has no scales to read"))
+    if dtype in ("fp32", "bf16") and layout not in ("none", None):
+        findings.append(_srv(
+            "SHD169",
+            f"{dtype} pool must not carry scales "
+            f"(scale_layout={layout!r}) — a scale tensor nothing "
+            f"dequants is residency the pricing never saw"))
+    op_dtypes = {n.op.attrs.get("kv_dtype", None) for n in nodes}
+    declared = {d for d in op_dtypes if d is not None}
+    if len(declared) > 1:
+        findings.append(_srv(
+            "SHD169",
+            f"decode ops disagree on kv_dtype ({sorted(declared)}) — "
+            f"one page pool cannot hold two dtypes"))
+    elif declared and dtype in ("fp32", "bf16", "int8") \
+            and declared != {dtype}:
+        findings.append(_srv(
+            "SHD169",
+            f"decode ops carry kv_dtype={next(iter(declared))!r} but "
+            f"__meta__.kv persists {dtype!r} — the artifact does not "
+            f"describe the graph it rides"))
+    # ---- SHD168: sharing accounting coherence ---------------------------
+    pps = nodes[0].op.attrs["pages_per_seq"]
+    max_seqs = nodes[0].op.max_seqs
+    shared = kv_meta.get("shared_prefix_pages", 0)
+    if not isinstance(shared, int) or shared < 0 or shared >= pps:
+        findings.append(_srv(
+            "SHD168",
+            f"shared_prefix_pages={shared!r} outside [0, "
+            f"pages_per_seq={pps}) — a sequence cannot share its whole "
+            f"allotment (the last token's scatter needs a private "
+            f"page)"))
+        shared = 0
+    if serving is not None:
+        sv = int(getattr(serving, "shared_prefix_pages", 0) or 0)
+        if sv != shared:
+            findings.append(_srv(
+                "SHD168",
+                f"__meta__.kv declares shared_prefix_pages={shared} "
+                f"but the serving spec prices {sv} — residency was "
+                f"ranked under sharing the artifact does not record"))
+    factor = kv_meta.get("shared_residency_factor", 1.0)
+    expect = 1.0
+    if shared and max_seqs > 0 and pps > 0:
+        expect = (max_seqs * (pps - shared) + shared) / float(
+            max_seqs * pps)
+    try:
+        ok = abs(float(factor) - expect) <= 1e-9
+    except (TypeError, ValueError):
+        ok = False
+    if not ok:
+        findings.append(_srv(
+            "SHD168",
+            f"shared_residency_factor={factor!r} does not match the "
+            f"refcount arithmetic for shared_prefix_pages={shared} "
+            f"over a {max_seqs}x{pps}-page frame (expected "
+            f"{expect:.9f}) — the residency discount is not the one "
+            f"the allocator's refcounts deliver"))
     return findings
 
 

@@ -223,6 +223,28 @@ class FFConfig:
     # ..."): priority admission / deadline expiry / preemption on the
     # executor's page allocator (runtime/decode.py SLOClass), per-class
     # p99 windows, persisted with the disaggregation meta
+    kv_precision: str = "off"  # KV page-pool dtype lane
+    # (ops/decode_attention.py kv_dtype, --kv-precision): "off"
+    # (default) never touches the lane — cost-cache keys, signatures
+    # and the lowered program stay byte-identical to history.  "fp32"/
+    # "bf16"/"int8" pin the pool dtype (int8 adds per-(page, slot)
+    # fp32 scales, dequant inside the ragged paged-attention kernel's
+    # page loop); "search" makes the dtype a searched lane under
+    # objective="serve" — each candidate dtype is priced through the
+    # decode op's cache-stream + quantize-overhead terms (the same
+    # EQuARX discipline as sync_precision) and the winner persists as
+    # __meta__.kv behind the digest gate (SHD168/169 lint-gated,
+    # fflint STR213).
+    serve_shared_prefix_pages: int = 0  # radix prefix sharing
+    # (runtime/decode.py PageAllocator, --serve-shared-prefix-pages):
+    # declared number of page-pool pages per sequence expected to be
+    # CLAIMED from the shared prefix trie rather than privately
+    # allocated (e.g. a fleet-wide system prompt of N*page_size
+    # tokens).  Enters ServingSpec.shared_residency_factor so SHD161
+    # HBM residency and kv_residency_bytes price SHARED residency —
+    # the search sees the multiplied effective batch.  0 (default) =
+    # no sharing assumed, bit-identical to history.  Must be
+    # < pages_per_seq of the decode graph (linted, SHD168).
     comp_mode: str = "training"  # "training" | "inference" — set by
     # compile(comp_mode=...); inference searches rank strategies by
     # forward latency with no weight sync (reference:
@@ -384,6 +406,17 @@ class FFConfig:
         if self.serve_slo_classes is not None:
             self.serve_slo_classes = parse_slo_classes(
                 self.serve_slo_classes)
+        if self.kv_precision not in ("off", "fp32", "bf16", "int8",
+                                     "search"):
+            raise ValueError(
+                f"kv_precision must be off|fp32|bf16|int8|search, got "
+                f"{self.kv_precision!r}"
+            )
+        if self.serve_shared_prefix_pages < 0:
+            raise ValueError(
+                f"serve_shared_prefix_pages must be >= 0, got "
+                f"{self.serve_shared_prefix_pages}"
+            )
         if self.objective == "serve" and self.co_search:
             # the joint pricer's exposed-comm currency is a TRAINING
             # currency (weight-grad sync plans); mixing it with the
@@ -558,6 +591,23 @@ class FFConfig:
                             "deadline_frames[:quantile] — priority "
                             "admission, deadline expiry, preemption "
                             "(runtime/decode.py)")
+        p.add_argument("--kv-precision", dest="kv_precision",
+                       choices=("off", "fp32", "bf16", "int8", "search"),
+                       default="off",
+                       help="KV page-pool dtype lane (ops/"
+                            "decode_attention.py): pin fp32/bf16/int8 "
+                            "(int8 adds per-page scales + in-kernel "
+                            "dequant) or 'search' to price the lane "
+                            "under objective=serve; 'off' is "
+                            "byte-identical to history")
+        p.add_argument("--serve-shared-prefix-pages",
+                       dest="serve_shared_prefix_pages", type=int,
+                       default=0,
+                       help="pages per sequence expected to be CLAIMED "
+                            "from the radix prefix trie instead of "
+                            "privately allocated (runtime/decode.py) — "
+                            "prices SHARED KV residency in SHD161 and "
+                            "kv_residency_bytes")
         p.add_argument("--obs-log", dest="obs_log", type=str, default=None,
                        help="JSONL structured-event telemetry sink "
                             "(flexflow_tpu/obs; tools/ffobs.py renders it)")
@@ -635,6 +685,8 @@ class FFConfig:
             serve_fleet_max_replicas=args.serve_fleet_max_replicas,
             prefill_chunk=args.prefill_chunk,
             serve_slo_classes=args.serve_slo_classes,
+            kv_precision=args.kv_precision,
+            serve_shared_prefix_pages=args.serve_shared_prefix_pages,
             obs_log_file=args.obs_log,
             obs_trace_file=args.obs_trace,
             device_trace_dir=args.device_trace_dir,

@@ -32,6 +32,16 @@ cover the same call sites.
 ``dense_decode_reference`` is the oracle: materialize every sequence's
 KV densely, mask past ``seq_lens``, plain softmax — the parity target
 for both the kernel and the fallback (tests/test_serving.py).
+
+Pool dtype (the searched KV-precision lane, ops/decode_attention.py):
+the plain entry points accept fp32 or bf16 pools — every dot casts its
+operands to fp32, a no-op on the fp32 path, so the historical numerics
+are bit-identical.  An int8 pool carries per-(page, slot) fp32 scales
+and enters through ``ragged_paged_attention_quant``: the Pallas
+variant dequantizes INSIDE the page loop (the scales ride the same
+scalar-prefetched page indirection as the payload, one [page_size]
+row per grid step), so only quantized bytes ever stream HBM→VMEM —
+that smaller stream is the whole point of the lane.
 """
 
 from __future__ import annotations
@@ -88,12 +98,31 @@ def gather_kv_pages(pages, page_table):
     return g.reshape(b, npp * ps, h, d)
 
 
+def gather_kv_pages_quant(pages, scales, page_table):
+    """Densify + DEQUANTIZE an int8 pool: pages [P, page_size, H, D]
+    int8, scales [P, page_size] fp32 (per-(page, slot), shared across
+    heads) -> dense fp32 [B, pages_per_seq * page_size, H, D].  The
+    fallback/chunk-prefill sibling of the in-kernel page-loop
+    dequant."""
+    dense = gather_kv_pages(pages, page_table).astype(jnp.float32)
+    s = scales[page_table]  # [B, pages_per_seq, page_size]
+    b, npp, ps = s.shape
+    return dense * s.reshape(b, npp * ps)[:, :, None, None]
+
+
 # ---------------------------------------------------------------------------
 # pure-XLA fallback: gather pages, mask, dense softmax
 # ---------------------------------------------------------------------------
 def _xla_ragged_paged(q, k_pages, v_pages, page_table, seq_lens, scale):
     k_dense = gather_kv_pages(k_pages, page_table)
     v_dense = gather_kv_pages(v_pages, page_table)
+    return dense_decode_reference(q, k_dense, v_dense, seq_lens, scale)
+
+
+def _xla_ragged_paged_quant(q, k_pages, v_pages, k_scale, v_scale,
+                            page_table, seq_lens, scale):
+    k_dense = gather_kv_pages_quant(k_pages, k_scale, page_table)
+    v_dense = gather_kv_pages_quant(v_pages, v_scale, page_table)
     return dense_decode_reference(q, k_dense, v_dense, seq_lens, scale)
 
 
@@ -127,8 +156,10 @@ def _rpa_kernel(
     @pl.when(j * page_size < n)
     def _step():
         q = q_ref[0]        # [1, D] — the lone decode token's row
-        k = k_ref[0, :, 0]  # [page_size, D]
-        v = v_ref[0, :, 0]
+        # fp32 casts are no-ops on the historical fp32 pool (numerics
+        # bit-identical) and make the SAME kernel serve a bf16 pool
+        k = k_ref[0, :, 0].astype(jnp.float32)  # [page_size, D]
+        v = v_ref[0, :, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -144,7 +175,7 @@ def _rpa_kernel(
         alpha = jnp.exp(m_prev - m_new)
         l_scratch[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scratch[:] = m_new
@@ -197,6 +228,136 @@ def _pallas_ragged_paged(q, k_pages, v_pages, page_table, seq_lens, scale,
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
       q, k_pages, v_pages)
     return out
+
+
+def _rpa_kernel_quant(
+    page_table_ref, seq_lens_ref,  # scalar-prefetch operands
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_scratch, l_scratch, acc_scratch,
+    *, page_size: int, scale: float,
+):
+    """The int8-pool twin of ``_rpa_kernel``: identical online softmax,
+    but the page's K/V arrive quantized and are DEQUANTIZED here, in
+    the page loop — ``ks_ref``/``vs_ref`` hold this page's
+    per-(page, slot) fp32 scale rows, routed by the same
+    scalar-prefetched page indirection as the payload.  HBM→VMEM moves
+    1 byte per element + 8 scale bytes per token; the fp32 values
+    exist only in registers/VMEM."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    npp = pl.num_programs(2)
+    n = seq_lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    @pl.when(j * page_size < n)
+    def _step():
+        q = q_ref[0]  # [1, D]
+        k = k_ref[0, :, 0].astype(jnp.float32) * ks_ref[0][:, None]
+        v = v_ref[0, :, 0].astype(jnp.float32) * vs_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        cols = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < n, s, NEG_INF)
+        m_prev = m_scratch[:]
+        l_prev = l_scratch[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scratch[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scratch[:] = m_new
+
+    @pl.when(j == npp - 1)
+    def _finish():
+        l = jnp.maximum(l_scratch[:], 1e-30)
+        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+
+
+def _pallas_ragged_paged_quant(q, k_pages, v_pages, k_scale, v_scale,
+                               page_table, seq_lens, scale,
+                               interpret: bool):
+    b, h, d = q.shape
+    num_pages, page_size, hp, dp = k_pages.shape
+    assert (hp, dp) == (h, d), (k_pages.shape, q.shape)
+    pages_per_seq = page_table.shape[1]
+    grid = (b, h, pages_per_seq)
+
+    def kv_map(bi, hi, j, pt_ref, sl_ref):
+        live = (j * page_size) < sl_ref[bi]
+        page = jnp.where(live, pt_ref[bi, j], 0)
+        return (page, 0, hi, 0)
+
+    def scale_map(bi, hi, j, pt_ref, sl_ref):
+        # the scale rows ride the SAME page indirection as the payload
+        live = (j * page_size) < sl_ref[bi]
+        page = jnp.where(live, pt_ref[bi, j], 0)
+        return (page, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda bi, hi, j, pt, sl: (bi, hi, 0)),
+            pl.BlockSpec((1, page_size, 1, d), kv_map),
+            pl.BlockSpec((1, page_size, 1, d), kv_map),
+            pl.BlockSpec((1, page_size), scale_map),
+            pl.BlockSpec((1, page_size), scale_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, d), lambda bi, hi, j, pt, sl: (bi, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _rpa_kernel_quant, page_size=page_size, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_pages, v_pages, k_scale, v_scale)
+    return out
+
+
+def ragged_paged_attention_quant(
+    q, k_pages, v_pages, k_scale, v_scale, page_table, seq_lens,
+    scale=None,
+):
+    """Paged-KV decode attention over an INT8 pool: like
+    ``ragged_paged_attention`` but ``k_pages``/``v_pages`` are int8 and
+    ``k_scale``/``v_scale`` [P, page_size] fp32 carry each token's
+    symmetric per-(page, slot) scale (shared across heads).  Same
+    kernel gating and fallback contract as the fp32 entry point."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    d = q.shape[-1]
+    page_size = k_pages.shape[1]
+    if _HAS_PLTPU and d % 8 == 0 and page_size % 8 == 0:
+        interpret = jax.default_backend() != "tpu"
+        try:
+            return _pallas_ragged_paged_quant(
+                q, k_pages, v_pages, k_scale, v_scale, page_table,
+                seq_lens, float(scale), interpret)
+        except Exception:
+            pass  # fall through to the XLA path (e.g. unsupported jax)
+    return _xla_ragged_paged_quant(q, k_pages, v_pages, k_scale, v_scale,
+                                   page_table, seq_lens, float(scale))
 
 
 def ragged_paged_attention(

@@ -47,6 +47,42 @@ def _merge_matching(new, old):
     return new
 
 
+def _adopt_kv_dtype(graph, dtype) -> None:
+    """Retype the graph's decode-attention page pools IN PLACE to the
+    ``__meta__.kv`` dtype (searched or imported, both SHD168/169-gated
+    before this runs).  Called strictly AFTER the strategy export's
+    digest computation — exported artifacts stay keyed to the attr-free
+    frontend graph, so the import-side digest gate still passes — and
+    before lowering, so ``state_specs``/``state_shardings`` build the
+    quantized pool (+ per-(page, slot) scales under int8) the pricing
+    chose.  fp32 is the attr-free default: nothing to adopt, the
+    lowered program stays bit-identical to history."""
+    if dtype in (None, "fp32"):
+        return
+    from flexflow_tpu.core.graph import Node
+    from flexflow_tpu.core.optype import OperatorType
+
+    changed = False
+    for guid, node in list(graph.nodes.items()):
+        op = node.op
+        if op.op_type != OperatorType.DECODE_ATTENTION:
+            continue
+        if op.attrs.get("kv_dtype", "fp32") == dtype:
+            continue
+        a = op.attrs
+        clone = type(op)(
+            op.name, op.input_shapes,
+            embed_dim=a["embed_dim"], num_heads=a["num_heads"],
+            page_size=a["page_size"], pages_per_seq=a["pages_per_seq"],
+            num_pages=a["num_pages"], use_kernel=a["use_kernel"],
+            kv_dtype=dtype, kernel_initializer=op._kernel_init,
+        )
+        graph.nodes[guid] = Node(guid, clone)
+        changed = True
+    if changed:
+        graph._invalidate()
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         self.config = config or FFConfig()
@@ -494,6 +530,12 @@ class FFModel:
         imported_sync_schedule = None  # __meta__.sync_schedule of an
         # imported strategy file (already behind the digest gate)
         imported_zero_groups = None  # __meta__.zero_groups likewise
+        kv_adopt_dtype = None  # pool dtype the decode ops ADOPT right
+        # before lowering (searched __meta__.kv or an imported one,
+        # both SHD168/169-gated).  Adoption is deliberately deferred
+        # past the strategy export: exported digests stay keyed to the
+        # attr-free frontend graph, so the import-side digest gate
+        # still passes and the kv block re-lints there instead.
         if strategy is None:
             if pipeline is not None:
                 # dp over the devices left after the pp axis is carved off
@@ -575,6 +617,8 @@ class FFModel:
                         raise AnalysisError(
                             "imported placement proposal is illegal for "
                             "this graph/strategy", bad)
+                _ispec = None  # the imported ServingSpec, shared with
+                # the __meta__.kv re-lint below
                 if _imeta.get("serving") is not None:
                     # imported serving provenance re-lints against THIS
                     # graph/strategy (SHD16x): a hand-edited or
@@ -595,6 +639,12 @@ class FFModel:
                             p99_budget_ms=float(
                                 _sv.get("p99_budget_ms", 0.0)),
                             quantile=float(_sv.get("quantile", 0.99)),
+                            # residency was ranked under the kv block's
+                            # prefix sharing (when present): the SHD161
+                            # re-proof must price the same pool
+                            shared_prefix_pages=int(
+                                (_imeta.get("kv") or {}).get(
+                                    "shared_prefix_pages", 0) or 0),
                         )
                     except (KeyError, TypeError, ValueError) as e:
                         raise AnalysisError(
@@ -604,17 +654,37 @@ class FFModel:
                     # model (the search ran under comp_mode=inference):
                     # a training-mode CostModel counts activations 2x
                     # and would SHD161-reject legal near-capacity
-                    # artifacts the search-time gate passed
+                    # artifacts the search-time gate passed; serving=
+                    # arms the same shared-residency discount
                     bad = errors_only(lint_serving(
                         self.graph, strategy, _spec,
                         _SCM(self.config.machine_spec,
                              num_devices=self.config.search_devices,
-                             inference=comp_mode == "inference")))
+                             inference=comp_mode == "inference",
+                             serving=_spec)))
                     if bad:
                         emit_findings(bad)
                         raise AnalysisError(
                             "imported serving provenance is illegal for "
                             "this graph/strategy", bad)
+                    _ispec = _spec
+                if _imeta.get("kv") is not None:
+                    # imported KV-lane provenance re-lints against THIS
+                    # graph/strategy (SHD168/169) BEFORE the pool dtype
+                    # is adopted onto the decode ops: a hand-edited or
+                    # re-targeted __meta__.kv fails with findings at
+                    # import, never inside the lowering or the kernel
+                    from flexflow_tpu.analysis import lint_kv
+
+                    bad = errors_only(lint_kv(
+                        self.graph, strategy, _imeta["kv"],
+                        serving=_ispec))
+                    if bad:
+                        emit_findings(bad)
+                        raise AnalysisError(
+                            "imported __meta__.kv block is illegal for "
+                            "this graph/strategy", bad)
+                    kv_adopt_dtype = _imeta["kv"].get("dtype")
                 if _imeta.get("disaggregation") is not None:
                     # imported disaggregation provenance re-lints
                     # against THIS graph (SHD164/165): the persisted
@@ -764,6 +834,14 @@ class FFModel:
                 )
                 self.graph = best_graph
                 searched_strategy = True
+                from flexflow_tpu.search import driver as _kvdriver
+
+                if _kvdriver.LAST_KV_META:
+                    # the searched pool dtype (SHD168/169-gated inside
+                    # the driver); adopted onto the decode ops right
+                    # before lowering, AFTER the strategy export's
+                    # digest computation
+                    kv_adopt_dtype = _kvdriver.LAST_KV_META.get("dtype")
                 # the strategy object the driver's sync-schedule gate
                 # ran against — a pipeline/placement proposal below may
                 # REPLACE `strategy`, and the gated schedule must not
@@ -1195,6 +1273,15 @@ class FFModel:
 
                 if _sdriver.LAST_SERVING_META:
                     _meta["serving"] = dict(_sdriver.LAST_SERVING_META)
+                if _sdriver.LAST_KV_META:
+                    # the KV-lane provenance (pool dtype + scale layout
+                    # + prefix-sharing residency accounting, SHD168/169
+                    # gated in the driver; fflint checks the frame
+                    # stdlib-only, STR213).  Persisted BEFORE the dtype
+                    # is adopted onto the decode ops, so the exported
+                    # digests stay keyed to the attr-free frontend
+                    # graph and import's digest gate still passes.
+                    _meta["kv"] = dict(_sdriver.LAST_KV_META)
                 if (self.disaggregation is not None
                         and self.disaggregation.adopted):
                     # the ADOPTED two-block prefill/decode placement
@@ -1267,6 +1354,12 @@ class FFModel:
             Simulator.for_config(self.config).export_task_graph_dot(
                 self.graph, strategy, self.config.export_strategy_task_graph_file
             )
+
+        # KV-lane adoption (searched or imported __meta__.kv, both
+        # SHD168/169-gated above): the decode ops take the chosen pool
+        # dtype NOW — after every export computed its digests against
+        # the attr-free graph, before any lowering builds state
+        _adopt_kv_dtype(self.graph, kv_adopt_dtype)
 
         from flexflow_tpu.compiler.placement_lowering import placeable
 

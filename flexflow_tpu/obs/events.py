@@ -66,6 +66,10 @@ EVENT_KINDS = {
     # serve-objective result (search/serving.py, FFConfig.objective):
     # the SHD16x-gated p99/KV-residency numbers of the returned strategy
     "search.serve": {"p99_s", "kv_bytes_per_device"},
+    # KV-lane decision (search/driver.py _choose_kv_precision): the
+    # chosen pool dtype, whether it was searched or pinned, the
+    # declared shared-prefix pages, and the per-dtype priced p99 map
+    "search.kv": {"dtype", "searched", "shared_prefix_pages"},
     # prefill/decode disaggregation search (search/disaggregation.py):
     # one event per proposal decision — colocated vs disaggregated
     # serve-currency step, the KV-handoff price, and whether the
@@ -103,6 +107,13 @@ EVENT_KINDS = {
     # prompt that went through the batched KV writer — tokens written,
     # chunk passes paid (vs one decode frame per token without it)
     "decode.prefill": {"rid", "tokens", "chunks"},
+    # radix prefix sharing (runtime/decode.py PageAllocator): one
+    # prefix_hit per admission that claimed trie-cached pages by
+    # refcount instead of allocating (pages claimed, prompt tokens
+    # skipped); one cow per copy-on-write page copy at a mid-page
+    # divergence (the reserve-on-divergence path)
+    "decode.prefix_hit": {"rid", "pages", "tokens"},
+    "decode.cow": {"rid", "src_page", "dst_page", "tokens"},
     # device-trace ingestion + lane matching (obs/trace_ingest.py):
     # one trace.ingest per parsed capture, one trace.lane_match per
     # predicted sync-bucket lane (matched by annotation tag, never by

@@ -51,6 +51,19 @@ from flexflow_tpu.ops.base import (
 )
 
 
+def _quantize_kv(x):
+    """Per-token symmetric int8 quantization of fresh K or V rows:
+    x [..., H, D] fp32 -> (int8 payload, fp32 scale over the trailing
+    (H, D) axes).  One scale per token (the pool's per-(page, slot)
+    "page_slot" layout) — amax/127 symmetric, the EQuARX-style scheme
+    whose drift bound the accuracy-contract test asserts."""
+    amax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    s = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(x / s[..., None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, s.astype(jnp.float32)
+
+
 @register_op
 class DecodeAttentionOp(Operator):
     """hidden [B, 1, E], page_table [B, pages_per_seq] i32,
@@ -58,7 +71,11 @@ class DecodeAttentionOp(Operator):
 
     attrs: embed_dim, num_heads, page_size, pages_per_seq, num_pages
     (pool size; default max_seqs * pages_per_seq), use_kernel (take the
-    Pallas ragged-paged path when shapes allow).
+    Pallas ragged-paged path when shapes allow), kv_dtype (POOL dtype
+    of the cache — "fp32"/"bf16"/"int8", the searched KV-precision
+    lane; present in ``attrs`` ONLY when not "fp32", so the default
+    pool adds no attr and signatures/digests/cost-cache keys stay
+    byte-identical to the pre-precision tree).
     """
 
     op_type = OperatorType.DECODE_ATTENTION
@@ -79,16 +96,21 @@ class DecodeAttentionOp(Operator):
         pages_per_seq: int = 8,
         num_pages: int = 0,
         use_kernel: bool = True,
+        kv_dtype: str = "fp32",
         kernel_initializer: Initializer | None = None,
     ):
         assert embed_dim % num_heads == 0
         assert page_size >= 1 and pages_per_seq >= 1
+        assert kv_dtype in ("fp32", "bf16", "int8"), kv_dtype
         b = input_shapes[0].sizes[0]
         num_pages = num_pages or b * pages_per_seq
         assert num_pages >= b, (
             f"page pool ({num_pages}) smaller than the decode frame's "
             f"sequence slots ({b})")
         self._kernel_init = kernel_initializer or DEFAULT_WEIGHT_INIT
+        # extension-only attr discipline (like ServingSpec.signature's
+        # occupancy part): the default fp32 pool contributes NO attr
+        extra = {} if kv_dtype == "fp32" else {"kv_dtype": kv_dtype}
         super().__init__(
             name,
             input_shapes,
@@ -98,6 +120,7 @@ class DecodeAttentionOp(Operator):
             pages_per_seq=pages_per_seq,
             num_pages=num_pages,
             use_kernel=use_kernel,
+            **extra,
         )
 
     # ---- shapes ----------------------------------------------------------
@@ -127,6 +150,10 @@ class DecodeAttentionOp(Operator):
     def max_seq_len(self) -> int:
         return self.attrs["page_size"] * self.attrs["pages_per_seq"]
 
+    @property
+    def kv_dtype(self) -> str:
+        return self.attrs.get("kv_dtype", "fp32")
+
     def weight_specs(self) -> Sequence[WeightSpec]:
         a = self.attrs
         e, h = a["embed_dim"], a["num_heads"]
@@ -141,12 +168,30 @@ class DecodeAttentionOp(Operator):
 
     # ---- state (the paged KV cache) -------------------------------------
     def state_specs(self):
-        """The layer's page-pool cache: fp32 like the weights (decode
-        numerics match the training-side attention's accumulate
-        dtype)."""
+        """The layer's page-pool cache, in the POOL dtype: fp32 by
+        default (decode numerics match the training-side attention's
+        accumulate dtype); bf16/int8 under the searched KV-precision
+        lane, an int8 pool carrying per-(page, slot) fp32 scales —
+        the "page_slot" layout, one symmetric scale per cached token
+        shared across heads, so scattering a fresh token never
+        rescales already-written slots."""
         a = self.attrs
         shape = (a["num_pages"], a["page_size"], a["num_heads"],
                  self.head_dim)
+        kvd = self.kv_dtype
+        if kvd == "bf16":
+            return [
+                ("k_cache", shape, jnp.bfloat16, 0.0),
+                ("v_cache", shape, jnp.bfloat16, 0.0),
+            ]
+        if kvd == "int8":
+            sshape = (a["num_pages"], a["page_size"])
+            return [
+                ("k_cache", shape, jnp.int8, 0),
+                ("v_cache", shape, jnp.int8, 0),
+                ("k_scale", sshape, jnp.float32, 0.0),
+                ("v_scale", sshape, jnp.float32, 0.0),
+            ]
         return [
             ("k_cache", shape, jnp.float32, 0.0),
             ("v_cache", shape, jnp.float32, 0.0),
@@ -162,13 +207,23 @@ class DecodeAttentionOp(Operator):
         b = max(mv.dim_degrees[0], 1) if mv.dim_degrees else 1
         r = max(mv.replica_degree, 1)
         annot = ShardAnnot((b, 1, r, 1), idx=(0, -1, REPLICA_SLOT, -1))
-        return {"k_cache": annot, "v_cache": annot}
+        out = {"k_cache": annot, "v_cache": annot}
+        if self.kv_dtype == "int8":
+            # the scales shard with the page dim but REPLICATE over the
+            # head split — every replica's heads share the per-token
+            # scale row
+            s_annot = ShardAnnot((b, 1), replica=r, idx=(0, -1))
+            out["k_scale"] = s_annot
+            out["v_scale"] = s_annot
+        return out
 
     # ---- lowering --------------------------------------------------------
     def forward(self, ctx: LoweringContext, inputs, weights):
         from flexflow_tpu.kernels.ragged_paged_attention import (
             _xla_ragged_paged,
+            _xla_ragged_paged_quant,
             ragged_paged_attention,
+            ragged_paged_attention_quant,
         )
 
         a = self.attrs
@@ -202,15 +257,43 @@ class DecodeAttentionOp(Operator):
         page_idx = jnp.minimum(seq_lens // ps, self.attrs["pages_per_seq"] - 1)
         page = jnp.take_along_axis(
             page_table, page_idx[:, None], axis=1)[:, 0]
-        k_cache = k_cache.at[page, slot].set(k_new)
-        v_cache = v_cache.at[page, slot].set(v_new)
+        kvd = self.kv_dtype
+        if kvd == "int8":
+            # quantize-on-scatter: the fresh token's fp32 rows collapse
+            # to int8 + one per-token scale; the pool never holds fp32
+            k_q, k_s = _quantize_kv(k_new)
+            v_q, v_s = _quantize_kv(v_new)
+            k_scale = ctx.state_in[f"{self.name}/k_scale"]
+            v_scale = ctx.state_in[f"{self.name}/v_scale"]
+            k_cache = k_cache.at[page, slot].set(k_q)
+            v_cache = v_cache.at[page, slot].set(v_q)
+            k_scale = k_scale.at[page, slot].set(k_s)
+            v_scale = v_scale.at[page, slot].set(v_s)
+            ctx.state_out[f"{self.name}/k_scale"] = k_scale
+            ctx.state_out[f"{self.name}/v_scale"] = v_scale
+        else:
+            # bf16 stores the cast; fp32 stores the rows UNCHANGED —
+            # the historical (bit-identical, test-enforced) path
+            k_cache = k_cache.at[page, slot].set(
+                k_new.astype(k_cache.dtype))
+            v_cache = v_cache.at[page, slot].set(
+                v_new.astype(v_cache.dtype))
         ctx.state_out[f"{self.name}/k_cache"] = k_cache
         ctx.state_out[f"{self.name}/v_cache"] = v_cache
 
         scale = 1.0 / math.sqrt(self.head_dim)
         lens = seq_lens + 1  # the fresh token attends to itself too
         qf = q.astype(jnp.float32)
-        if a["use_kernel"]:
+        if kvd == "int8":
+            if a["use_kernel"]:
+                out = ragged_paged_attention_quant(
+                    qf, k_cache, v_cache, k_scale, v_scale,
+                    page_table, lens, scale)
+            else:
+                out = _xla_ragged_paged_quant(
+                    qf, k_cache, v_cache, k_scale, v_scale,
+                    page_table, lens, scale)
+        elif a["use_kernel"]:
             out = ragged_paged_attention(
                 qf, k_cache, v_cache, page_table, lens, scale)
         else:
@@ -243,6 +326,7 @@ class DecodeAttentionOp(Operator):
         from flexflow_tpu.kernels.ragged_paged_attention import (
             NEG_INF,
             gather_kv_pages,
+            gather_kv_pages_quant,
         )
 
         a = self.attrs
@@ -263,8 +347,25 @@ class DecodeAttentionOp(Operator):
         slot = positions % ps  # [B, C]
         page_idx = jnp.minimum(positions // ps, a["pages_per_seq"] - 1)
         page = jnp.take_along_axis(page_table, page_idx, axis=1)  # [B, C]
-        k_cache = k_cache.at[page, slot].set(k_new)
-        v_cache = v_cache.at[page, slot].set(v_new)
+        kvd = self.kv_dtype
+        if kvd == "int8":
+            # batched quantize-on-scatter, same per-token scheme as the
+            # decode step — the chunked path populates the SAME pool
+            k_q, k_s = _quantize_kv(k_new)  # [B, C, H, D] / [B, C]
+            v_q, v_s = _quantize_kv(v_new)
+            k_scale = ctx.state_in[f"{self.name}/k_scale"]
+            v_scale = ctx.state_in[f"{self.name}/v_scale"]
+            k_cache = k_cache.at[page, slot].set(k_q)
+            v_cache = v_cache.at[page, slot].set(v_q)
+            k_scale = k_scale.at[page, slot].set(k_s)
+            v_scale = v_scale.at[page, slot].set(v_s)
+            ctx.state_out[f"{self.name}/k_scale"] = k_scale
+            ctx.state_out[f"{self.name}/v_scale"] = v_scale
+        else:
+            k_cache = k_cache.at[page, slot].set(
+                k_new.astype(k_cache.dtype))
+            v_cache = v_cache.at[page, slot].set(
+                v_new.astype(v_cache.dtype))
         ctx.state_out[f"{self.name}/k_cache"] = k_cache
         ctx.state_out[f"{self.name}/v_cache"] = v_cache
 
@@ -272,8 +373,13 @@ class DecodeAttentionOp(Operator):
         # the prefix written by earlier chunks plus the intra-chunk
         # causal triangle (this chunk's K/V are already in the pool)
         scale = 1.0 / math.sqrt(self.head_dim)
-        k_dense = gather_kv_pages(k_cache, page_table)  # [B, S, H, D]
-        v_dense = gather_kv_pages(v_cache, page_table)
+        if kvd == "int8":
+            k_dense = gather_kv_pages_quant(k_cache, k_scale,
+                                            page_table)  # [B, S, H, D]
+            v_dense = gather_kv_pages_quant(v_cache, v_scale, page_table)
+        else:
+            k_dense = gather_kv_pages(k_cache, page_table)  # [B, S, H, D]
+            v_dense = gather_kv_pages(v_cache, page_table)
         qf = q.astype(jnp.float32)
         s = jnp.einsum("bchd,bshd->bchs", qf, k_dense) * scale
         pos_k = jnp.arange(k_dense.shape[1], dtype=jnp.int32)
@@ -321,22 +427,54 @@ class DecodeAttentionOp(Operator):
         attn = 2.0 * bsz * h * self.max_seq_len * dk * 2
         return proj + attn
 
-    def kv_bytes_per_token(self) -> float:
-        """fp32 K + V bytes one cached token occupies across all
-        heads."""
-        return 2.0 * self.attrs["num_heads"] * self.head_dim * 4.0
+    # KV quantize-overhead pricing (the EQuARX discipline the cost
+    # model's wire-precision terms follow, machine_model.QUANT_PASSES):
+    # writing a quantized token costs streaming passes over the
+    # per-step fp32 token buffer (read the projections, round, write
+    # payload + scales).  The READ side's dequant runs in-register on
+    # bytes already streamed — its price IS the smaller stream, so no
+    # extra read pass is charged.
+    KV_QUANT_PASSES = 3.0
 
-    def kv_cache_bytes(self, mv: MachineView) -> float:
+    def _kv_payload_bytes_per_token(self) -> float:
+        """K + V PAYLOAD bytes per cached token in the pool dtype
+        (scales excluded — they shard differently)."""
+        itemsize = {"fp32": 4.0, "bf16": 2.0, "int8": 1.0}[self.kv_dtype]
+        return 2.0 * self.attrs["num_heads"] * self.head_dim * itemsize
+
+    def _kv_scale_bytes_per_token(self) -> float:
+        """The int8 pool's per-(page, slot) fp32 k/v scales: 8 bytes
+        per cached token, replicated over a head split."""
+        return 8.0 if self.kv_dtype == "int8" else 0.0
+
+    def kv_bytes_per_token(self) -> float:
+        """K + V bytes one cached token occupies across all heads, in
+        the POOL dtype (int8 includes its two fp32 scales)."""
+        return (self._kv_payload_bytes_per_token()
+                + self._kv_scale_bytes_per_token())
+
+    def kv_cache_bytes(self, mv: MachineView, serving=None) -> float:
         """Per-device resident bytes of this layer's page pool under
         ``mv`` — the KV-residency term of the simulator's HBM check.
         Batch degree shards sequences (each device holds its sequences'
         pages — realized by the executor's slot-aligned allocation),
-        the replica degree shards heads; both divide the pool."""
-        total = (self.attrs["num_pages"] * self.attrs["page_size"]
-                 * self.kv_bytes_per_token())
+        the replica degree shards heads; both divide the payload, while
+        an int8 pool's scales divide only by batch (each replica needs
+        every token's scale).  When the serving arrival model declares
+        an expected shared prefix (``ServingSpec.shared_prefix_pages``
+        — realized by the executor's radix prefix sharing), residency
+        is the SHARED total: the common-prefix pages exist once, not
+        once per sequence."""
+        tokens = self.attrs["num_pages"] * self.attrs["page_size"]
         b = max(mv.dim_degrees[0], 1) if mv.dim_degrees else 1
         r = max(mv.replica_degree, 1)
-        return total / (b * r)
+        per_dev = (tokens * self._kv_payload_bytes_per_token() / (b * r)
+                   + tokens * self._kv_scale_bytes_per_token() / b)
+        if serving is not None:
+            factor = getattr(serving, "shared_residency_factor", None)
+            if factor is not None:
+                per_dev *= factor()
+        return per_dev
 
     def bytes_accessed(self) -> float:
         # activations + weights + the full-occupancy cache read (the
@@ -368,9 +506,22 @@ class DecodeAttentionOp(Operator):
             for d in ws.shape:
                 n *= d
             wbytes += n * ws.dtype.itemsize
-        kv_full = (self.max_seqs * self.max_seq_len
-                   * self.kv_bytes_per_token())
-        kv = kv_full / (b * r)
+        live = self.max_seqs * self.max_seq_len
+        # attention streams each sequence's OWN pages (a prefix shared
+        # in residency is still read once per attending sequence), so
+        # the stream term never takes the shared-residency discount —
+        # the pool DTYPE is what shrinks it
+        kv = live * self._kv_payload_bytes_per_token() / (b * r)
+        # each replica streams every one of its sequences' scales
+        kv += live * self._kv_scale_bytes_per_token() / b
         if serving is not None:
             kv *= serving.load_factor(b)
-        return act / b + wbytes / r + kv
+        quant = 0.0
+        if self.kv_dtype != "fp32":
+            # quantize overhead on the write path (KV_QUANT_PASSES,
+            # class comment): per step each slot collapses one fp32
+            # K + V token to the pool dtype
+            tok_fp32 = (self.max_seqs * 2.0 * self.attrs["num_heads"]
+                        * self.head_dim * 4.0)
+            quant = self.KV_QUANT_PASSES * tok_fp32 / (b * r)
+        return act / b + wbytes / r + kv + quant

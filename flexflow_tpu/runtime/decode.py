@@ -6,14 +6,26 @@ the compiled decode graph (models/decode.py) was specialized for — so
 one jitted program serves an arbitrary request stream:
 
 * a ``PageAllocator`` owns the KV page pool; a request is **admitted**
-  only when its full page allotment is free (reservation-style
-  residency — an admitted sequence can always grow to ``max_seq_len``
-  unpreempted by pool pressure), in **priority order** when SLO
-  classes are armed: higher-priority requests admit first, a request
-  whose ``deadline_frames`` passed while queued is EXPIRED instead of
-  served late, and a strictly-higher-priority arrival may preempt the
-  lowest-priority live sequence (pages freed, sequence re-queued with
-  its tokens so far — regeneration is deterministic);
+  only when its full page allotment is reservable.  With radix
+  **prefix sharing** armed, part of that allotment may CLAIM
+  already-cached pages by refcount — a prefix-trie lookup keyed on
+  token ids at page granularity finds the longest cached prefix of
+  the prompt — and a mid-page divergence duplicates exactly that one
+  page at admission (copy-on-write, ``copy_page_fn``).  The residency
+  contract is **reserve-on-divergence**: the moment a sequence is
+  admitted, every page at or after the first position it will write
+  is PRIVATE (refcount 1, asserted by
+  ``PageAllocator.assert_divergence_reserved``) — so an admitted
+  sequence can always grow to ``max_seq_len`` unpreempted by pool
+  pressure, writes never land in a shared page, and eviction returns
+  a page to the free list only at refcount zero.  Admission runs in
+  **priority order** when SLO classes are armed: higher-priority
+  requests admit first, a request whose ``deadline_frames`` passed
+  while queued is EXPIRED instead of served late, and a
+  strictly-higher-priority arrival may preempt the lowest-priority
+  live sequence (pages refcount-released, sequence re-queued with
+  its tokens so far — regeneration is deterministic, and re-admission
+  may re-claim the prefix a sibling still holds);
 * prompts enter through the **chunked prefill lane** when one is armed
   (``prefill_fn`` — runtime/prefill.py builds it from the decode
   model, ``compiled_decode_step(model, prefill_chunk=C)``): the
@@ -21,7 +33,9 @@ one jitted program serves an arbitrary request stream:
   K/V straight into the sequence's pages, then the sequence joins the
   decode loop at its LAST prompt token — token-identical to the
   prefill-via-decode fallback (one decode frame per prompt token),
-  which remains the no-prefill-fn path;
+  which remains the no-prefill-fn path; under prefix sharing both
+  paths START at the first token past the claimed cached prefix
+  (prefill skips pages the trie already holds);
 * each ``step`` fills every live slot's next token through ONE decode
   graph call, until ``max_new_tokens`` or EOS;
 * every frame emits a ``decode.frame`` obs event (admissions,
@@ -139,11 +153,38 @@ class _Live:
 
 
 class PageAllocator:
-    """Free-list page allocator over the decode graph's pool."""
+    """Free-list page allocator over the decode graph's pool, with
+    copy-on-write refcounts and a radix prefix trie.
+
+    Every in-use page carries a refcount (``alloc`` starts it at 1;
+    ``share`` lets a second sequence claim it; ``free`` decrements and
+    returns the page to the free list only at zero).  The trie maps
+    token-id prefixes — at page granularity — to the page caching that
+    prefix's K/V, published by ``register_prefix`` as sequences fill
+    pages and consulted by ``lookup_prefix`` at admission.  Sharing a
+    cached page is sound because a causal decoder's K/V at position i
+    is a deterministic function of tokens[:i+1] alone.
+
+    The residency contract is **reserve-on-divergence**: callers must
+    arrange (CoW at admission) that every page at or after a
+    sequence's first write position is private — checked by
+    ``assert_divergence_reserved``.  That preserves the historical
+    guarantee in the new regime: an admitted sequence can always grow
+    to ``max_seq_len`` unpreempted by pool pressure, because its
+    writable tail is reserved up front and shared pages are read-only
+    by construction."""
 
     def __init__(self, num_pages: int):
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, -1, -1))
+        self._ref: Dict[int, int] = {}  # in-use page -> refcount
+        # prefix trie, flattened: full-prefix tuple -> page caching its
+        # last page_size tokens; parent prefix -> {page: token chunk}
+        # for mid-page (CoW) matches; page -> (parent, chunk) for
+        # removal at refcount zero
+        self._prefix: Dict[tuple, int] = {}
+        self._children: Dict[tuple, Dict[int, tuple]] = {}
+        self._page_key: Dict[int, tuple] = {}
 
     @property
     def free_pages(self) -> int:
@@ -153,10 +194,16 @@ class PageAllocator:
     def pages_in_use(self) -> int:
         return self.num_pages - len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
 
     def alloc_ids(self, ids: Sequence[int]) -> Optional[List[int]]:
         """Reserve SPECIFIC page ids (the slot-aligned fast path), or
@@ -165,12 +212,114 @@ class PageAllocator:
             return None
         for p in ids:
             self._free.remove(p)
+            self._ref[p] = 1
         return list(ids)
+
+    def share(self, pages: Sequence[int]) -> None:
+        """Claim already-cached pages for one more sequence: each must
+        be live (a sibling holds it), its refcount goes up by one, and
+        ``free`` from either owner now only drops the count."""
+        for p in pages:
+            assert self._ref.get(p, 0) >= 1, (
+                f"page {p} is not live — the trie served a stale hit")
+            self._ref[p] += 1
 
     def free(self, pages: Sequence[int]) -> None:
         for p in pages:
             assert 0 <= p < self.num_pages and p not in self._free, p
-            self._free.append(p)
+            r = self._ref.get(p, 0)
+            assert r >= 1, f"page {p} freed more times than referenced"
+            if r > 1:
+                self._ref[p] = r - 1
+            else:
+                del self._ref[p]
+                self._drop_prefix(p)
+                self._free.append(p)
+
+    # ---- prefix trie -----------------------------------------------------
+    def register_prefix(self, tokens: Sequence[int], page_size: int,
+                        pages: Sequence[int], cached: int) -> None:
+        """Publish every FULLY-cached page of a sequence (the first
+        ``cached`` tokens of ``tokens`` live in ``pages``) into the
+        trie.  First registration of a prefix wins; already-published
+        pages are skipped, so calling this at every page boundary is
+        idempotent and O(full pages)."""
+        for j in range(cached // page_size):
+            p = pages[j]
+            if p in self._page_key:
+                continue  # this page already backs a trie entry
+            parent = tuple(tokens[:j * page_size])
+            chunk = tuple(tokens[j * page_size:(j + 1) * page_size])
+            if parent + chunk in self._prefix:
+                continue  # a sibling's page already owns this prefix
+            self._prefix[parent + chunk] = p
+            self._children.setdefault(parent, {})[p] = chunk
+            self._page_key[p] = (parent, chunk)
+
+    def lookup_prefix(self, tokens: Sequence[int], page_size: int):
+        """Longest cached prefix of ``tokens``: returns
+        ``(pages, matched, partial)`` — the fully-matching cached pages
+        (claim them via ``share``), the token count they cover, and,
+        when a further cached page agrees on ``extra`` more tokens
+        mid-page, ``partial = (src_page, extra)`` for the caller to
+        copy-on-write.  Pure lookup: claims nothing."""
+        tokens = tuple(tokens)
+        pages: List[int] = []
+        k = 0
+        while (k + 1) * page_size <= len(tokens):
+            p = self._prefix.get(tokens[:(k + 1) * page_size])
+            if p is None:
+                break
+            pages.append(p)
+            k += 1
+        matched = k * page_size
+        partial = None
+        rest = tokens[matched:]
+        if rest:
+            best_m, best_p = 0, None
+            for p, chunk in self._children.get(tokens[:matched],
+                                               {}).items():
+                m = 0
+                for a, b in zip(chunk, rest):
+                    if a != b:
+                        break
+                    m += 1
+                if m > best_m:
+                    best_m, best_p = m, p
+            if best_m:
+                partial = (best_p, best_m)
+        return pages, matched, partial
+
+    def assert_divergence_reserved(self, pages: Sequence[int],
+                                   first_write_page: int) -> None:
+        """The reserve-on-divergence invariant, checked at admission:
+        every page at or after the first page this sequence will write
+        must be PRIVATE (refcount exactly 1) — shared pages are
+        read-only, so post-admission writes can never need an
+        in-flight CoW and the sequence's growth to ``max_seq_len`` is
+        reserved up front."""
+        for j in range(first_write_page, len(pages)):
+            assert self._ref.get(pages[j], 0) == 1, (
+                f"page {pages[j]} (allotment index {j}) is shared at "
+                f"refcount {self._ref.get(pages[j], 0)} but lies at or "
+                f"after the sequence's first write page "
+                f"{first_write_page} — reserve-on-divergence violated")
+
+    def _drop_prefix(self, page: int) -> None:
+        """Remove a page's trie entry when its refcount hits zero —
+        the bytes are about to be reused, so the prefix is no longer
+        cached anywhere."""
+        key = self._page_key.pop(page, None)
+        if key is None:
+            return
+        parent, chunk = key
+        if self._prefix.get(parent + chunk) == page:
+            del self._prefix[parent + chunk]
+        kids = self._children.get(parent)
+        if kids is not None:
+            kids.pop(page, None)
+            if not kids:
+                del self._children[parent]
 
 
 class ContinuousBatchingExecutor:
@@ -183,7 +332,9 @@ class ContinuousBatchingExecutor:
                  prefill_fn: Optional[Callable] = None,
                  prefill_chunk: int = 0,
                  slo_classes: Optional[Sequence[SLOClass]] = None,
-                 replica_label: Optional[str] = None):
+                 replica_label: Optional[str] = None,
+                 prefix_sharing: bool = False,
+                 copy_page_fn: Optional[Callable] = None):
         self.step_fn = step_fn
         # fleet membership (runtime/fleet.py): when set, the request
         # histograms are ALSO observed under `name|replica=...,slo=...`
@@ -208,6 +359,14 @@ class ContinuousBatchingExecutor:
         self.slo_classes: Dict[str, SLOClass] = {
             c.name: c for c in (slo_classes or ())}
         self._seq = 0  # submission counter (FIFO tie-break)
+        # radix prefix sharing: admission claims trie-cached prefix
+        # pages by refcount instead of allocating them, mid-page
+        # divergence copies that one page via copy_page_fn (CoW at
+        # admission — reserve-on-divergence, see PageAllocator), and
+        # prefill starts at the first token past the claimed prefix.
+        # Off keeps every historical path byte-identical.
+        self.prefix_sharing = bool(prefix_sharing)
+        self.copy_page_fn = copy_page_fn
         self.allocator = PageAllocator(num_pages or max_seqs * pages_per_seq)
         # slot-aligned allocation: when the pool covers every slot,
         # slot i always takes pages [i*pps, (i+1)*pps) — contiguous
@@ -218,9 +377,12 @@ class ContinuousBatchingExecutor:
         # executor, not merely priced.  Undersized (oversubscribed)
         # pools fall back to the free list, where a sequence's pages
         # may land on another group's shard — the locality price of
-        # oversubscription.
+        # oversubscription.  Prefix sharing ALSO forces the free list:
+        # a claimed page lives wherever the sibling's allotment put it,
+        # so slot-aligned page identities cannot hold.
         self.slot_aligned = (
-            self.allocator.num_pages >= max_seqs * pages_per_seq)
+            not self.prefix_sharing
+            and self.allocator.num_pages >= max_seqs * pages_per_seq)
         # idle frame rows still scatter one garbage k/v (static-shape
         # scatter — the op cannot skip rows), so they must point at a
         # page no LIVE sequence can own.  Slot-aligned pools use the
@@ -251,6 +413,11 @@ class ContinuousBatchingExecutor:
         self.total_preempted = 0
         self.prefill_chunks = 0  # chunked-prefill passes run
         self.prefill_tokens = 0  # prompt tokens written by the lane
+        # prefix-sharing roll-up (all zero while sharing is off)
+        self.prefix_hits = 0     # admissions that claimed a cached prefix
+        self.shared_pages = 0    # pages claimed by refcount, cumulative
+        self.cow_copies = 0      # mid-page divergences copied at admission
+        self.prefix_tokens = 0   # prompt tokens served from shared cache
         # per-request lifecycle telemetry (enqueue→admit→prefill→first
         # token→EOS/evict spans; TTFT/TPOT/e2e + the TTFT split),
         # recorded only while the obs bus is armed — the hot path
@@ -376,9 +543,12 @@ class ContinuousBatchingExecutor:
         ``len(tokens) - 1`` cached-to-be tokens through the batched
         chunk writer (``run_chunked_prefill``, runtime/prefill.py), so
         the decode loop starts at the LAST token and produces the first
-        generated token in its first frame."""
+        generated token in its first frame.  Under prefix sharing the
+        first ``live.cached`` tokens are already in claimed/copied
+        pages — the writer starts at the first divergent token."""
         n_pre = len(live.tokens) - 1
-        if n_pre <= 0 or self.prefill_fn is None:
+        start = live.cached  # shared-prefix skip-ahead (0 off-sharing)
+        if n_pre - start <= 0 or self.prefill_fn is None:
             return
         from flexflow_tpu.runtime.prefill import run_chunked_prefill
 
@@ -387,12 +557,14 @@ class ContinuousBatchingExecutor:
                 self.prefill_fn, live.tokens, live.pages,
                 chunk=self.prefill_chunk,
                 cap=self.page_size * self.pages_per_seq,
+                start=start,
                 trace_id=TRACER.trace_of(live.req.rid) if tr else None)
         live.cached = n_pre
         self.prefill_chunks += chunks
-        self.prefill_tokens += n_pre
+        self.prefill_tokens += n_pre - start
         if obs:
-            BUS.emit("decode.prefill", rid=live.req.rid, tokens=n_pre,
+            BUS.emit("decode.prefill", rid=live.req.rid,
+                     tokens=n_pre - start,
                      chunks=chunks, chunk=self.prefill_chunk)
 
     def _admit(self, obs: bool = False, tr: bool = False) -> int:
@@ -415,18 +587,58 @@ class ContinuousBatchingExecutor:
             open_slots = [i for i in range(self.max_seqs)
                           if self.slots[i] is None]
             i = open_slots[0]
+            # prefix-sharing claim: the trie lookup runs INSIDE the
+            # preempt-retry loop because a preemption below may free a
+            # matched page to refcount zero (stale hit otherwise).
+            # Only the to-be-cached prefix (all but the last token) is
+            # eligible — the last token is fed through decode, and its
+            # scatter must land in a page this sequence owns.
+            shared: List[int] = []
+            matched = 0
+            partial = None
+            if self.prefix_sharing:
+                shared, matched, partial = self.allocator.lookup_prefix(
+                    entry.tokens[:-1], self.page_size)
+                if partial is not None and self.copy_page_fn is None:
+                    partial = None  # cannot CoW without a page copier
             if self.slot_aligned:
                 pages = self.allocator.alloc_ids(range(
                     i * self.pages_per_seq, (i + 1) * self.pages_per_seq))
             else:
-                pages = self.allocator.alloc(self.pages_per_seq)
+                pages = self.allocator.alloc(
+                    self.pages_per_seq - len(shared))
             if pages is None:
                 if not self._preempt_for(entry, obs, tr):
                     break
                 continue  # retry with the freed allotment
+            if shared:
+                self.allocator.share(shared)
+                pages = shared + pages
+            if partial is not None:
+                # mid-page divergence: duplicate the one agreeing page
+                # into the first fresh page NOW (CoW at admission), so
+                # every post-admission write lands in owned pages
+                src, extra = partial
+                dst = pages[len(shared)]
+                self.copy_page_fn(src, dst)
+                matched += extra
+                self.cow_copies += 1
+                if obs:
+                    BUS.emit("decode.cow", rid=entry.req.rid,
+                             src_page=src, dst_page=dst, tokens=extra)
+            if matched:
+                self.prefix_hits += 1
+                self.shared_pages += len(shared)
+                self.prefix_tokens += matched
+                if obs:
+                    BUS.emit("decode.prefix_hit", rid=entry.req.rid,
+                             pages=len(shared), tokens=matched)
+            if self.prefix_sharing:
+                self.allocator.assert_divergence_reserved(
+                    pages, matched // self.page_size)
             self.queue.pop(order[0])
             live = _Live(req=entry.req, pages=pages,
-                         tokens=list(entry.tokens),
+                         tokens=list(entry.tokens), cached=matched,
                          generated=entry.generated,
                          started_frame=(entry.started_frame
                                         if entry.started_frame is not None
@@ -443,20 +655,32 @@ class ContinuousBatchingExecutor:
             tid = TRACER.trace_of(entry.req.rid) if tr else None
             if tid is not None:
                 # admission edge: the queue window closes, the prefill
-                # window opens (chunk children land under it)
+                # window opens (chunk children land under it);
+                # cached_prefix records how many prompt tokens the
+                # shared cache already held — the span's duration is
+                # the cost of the REMAINING tokens only
                 TRACER.end(tid, "queue")
                 TRACER.begin(tid, "prefill", parent="request",
-                             slot=i, pages=len(pages))
+                             slot=i, pages=len(pages),
+                             cached_prefix=matched)
             self._run_prefill(live, obs, tr)
+            if self.prefix_sharing and live.cached:
+                # publish this sequence's fully-cached pages (claimed
+                # ones are already in the trie and skip out)
+                self.allocator.register_prefix(
+                    live.tokens, self.page_size, live.pages, live.cached)
             if obs and live.prefill_done_t is None:
-                # the prefill span closes here for the chunked lane and
-                # for single-token prompts (nothing to prefill); the
-                # via-decode path closes it in step() when the cache
-                # holds every prompt token but the last
-                if self.prefill_fn is not None or len(live.tokens) <= 1:
+                # the prefill span closes here for the chunked lane,
+                # for single-token prompts, and for prompts fully
+                # served from a shared prefix (nothing left to
+                # prefill); the via-decode path closes it in step()
+                # when the cache holds every prompt token but the last
+                if (self.prefill_fn is not None or len(live.tokens) <= 1
+                        or live.cached >= len(live.tokens) - 1):
                     live.prefill_done_t = time.perf_counter()
             if tid is not None and (self.prefill_fn is not None
-                                    or len(live.tokens) <= 1):
+                                    or len(live.tokens) <= 1
+                                    or live.cached >= len(live.tokens) - 1):
                 # same edge for the span tree: prefill closes, the
                 # decode residency window opens (the via-decode path
                 # closes prefill in step() instead)
@@ -611,6 +835,13 @@ class ContinuousBatchingExecutor:
         for i in active:
             live = self.slots[i]
             live.cached += 1
+            if (self.prefix_sharing
+                    and live.cached % self.page_size == 0):
+                # a page just filled — publish it so later admissions
+                # can claim it (generated tokens included: the stream
+                # is deterministic, so equal prefixes mean equal K/V)
+                self.allocator.register_prefix(
+                    live.tokens, self.page_size, live.pages, live.cached)
             if live.cached < len(live.tokens):
                 # still prefilling via decode: the next prompt token is
                 # queued.  The prefill span closes when only the LAST
@@ -720,6 +951,18 @@ class ContinuousBatchingExecutor:
             "measured_p99_s": q(0.99),
             "predicted_step_s": self.predicted_step_s,
         }
+        if self.prefix_sharing:
+            # prefix-sharing roll-up (keys appear only when the mode is
+            # armed, keeping historical summaries byte-identical):
+            # cumulative hits/claims/copies plus the private-page
+            # complement so ffobs can render shared vs private
+            out["prefix_hits"] = self.prefix_hits
+            out["shared_pages"] = self.shared_pages
+            out["private_pages"] = (
+                self.total_admitted * self.pages_per_seq
+                - self.shared_pages)
+            out["cow_copies"] = self.cow_copies
+            out["prefix_tokens"] = self.prefix_tokens
         recs = [r for r in self.request_records
                 if r.get("phase") == "finish"]
         if recs:
@@ -812,6 +1055,23 @@ def compiled_decode_step(model, prefill_chunk: int = 0) -> Callable:
         return logits
 
     step.state = box  # tests inspect the threaded cache
+
+    def copy_page(src: int, dst: int) -> None:
+        """CoW page copy for the prefix-sharing executor
+        (``copy_page_fn``): duplicate page ``src`` of every layer's
+        paged KV state — k/v pools and, under an int8 pool, their
+        per-slot scales — into page ``dst``, which the divergent
+        sequence then owns.  Rare (once per mid-page divergence at
+        admission), so plain dispatch is fine."""
+        st = box["state"]
+        out = dict(st)
+        for key, val in st.items():
+            leaf = key.rsplit("/", 1)[-1]
+            if leaf in ("k_cache", "v_cache", "k_scale", "v_scale"):
+                out[key] = val.at[dst].set(val[src])
+        box["state"] = out
+
+    step.copy_page = copy_page
     if prefill_chunk:
         from flexflow_tpu.runtime.prefill import build_chunk_forward
 

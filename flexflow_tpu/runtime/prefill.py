@@ -48,11 +48,19 @@ from flexflow_tpu.ops.inout import InputOp
 
 def run_chunked_prefill(prefill_fn: Callable, tokens: Sequence[int],
                         pages: Sequence[int], *, chunk: int, cap: int,
+                        start: int = 0,
                         trace_id: Optional[str] = None) -> int:
-    """Drive the chunk writer over a prompt: write ``tokens[:-1]`` into
-    the sequence's pages in ``ceil((len-1)/chunk)`` fixed-shape passes
-    (the decode loop then starts at the LAST token).  Returns the
-    number of chunk passes paid.
+    """Drive the chunk writer over a prompt: write ``tokens[start:-1]``
+    into the sequence's pages in ``ceil((len-1-start)/chunk)``
+    fixed-shape passes (the decode loop then starts at the LAST
+    token).  Returns the number of chunk passes paid.
+
+    ``start`` is the prefix-sharing skip-ahead (runtime/decode.py):
+    the first ``start`` tokens already live in pages the admission
+    claimed from the trie (or copied on divergence), so the writer
+    begins at the first divergent token — its chunk windows simply
+    shift, positions stay absolute, and the already-cached pages are
+    never touched.
 
     Pad positions past the prompt clamp into the sequence's own
     allotment (``cap - 1``): a pad write lands at a FUTURE position the
@@ -63,14 +71,14 @@ def run_chunked_prefill(prefill_fn: Callable, tokens: Sequence[int],
     one ``prefill.chunk`` child span under the open ``prefill`` span —
     the per-chunk attribution the request span tree renders."""
     n_pre = len(tokens) - 1
-    if n_pre <= 0:
+    if n_pre - start <= 0:
         return 0
     tracer = None
     if trace_id is not None:
         from flexflow_tpu.obs.tracing import TRACER as tracer
     table = np.asarray(pages, np.int32)[None, :]  # [1, P]
     chunks = 0
-    for c0 in range(0, n_pre, chunk):
+    for c0 in range(start, n_pre, chunk):
         if tracer is not None:
             tracer.begin(trace_id, "prefill.chunk", parent="prefill",
                          c0=c0)

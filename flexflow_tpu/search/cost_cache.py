@@ -558,6 +558,20 @@ class CostCache:
                     float(getattr(config, "serve_fleet_offered_load",
                                   0.85)),
                 )
+            if getattr(config, "kv_precision", "off") != "off":
+                # the KV-precision lane re-prices the decode cache
+                # stream per pool dtype — a different search function.
+                # Extension-only: kv_precision=off keys stay
+                # byte-identical to pre-lane caches
+                knobs = knobs + ("kv", config.kv_precision)
+            if int(getattr(config, "serve_shared_prefix_pages", 0) or 0):
+                # prefix sharing discounts KV residency (the memory
+                # feasibility check), so results ranked under it must
+                # not cross-serve unshared runs — same extension rule
+                knobs = knobs + (
+                    "kvshared",
+                    int(config.serve_shared_prefix_pages),
+                )
         return stable_graph_digest(graph) + ":" + hashlib.sha256(
             repr(knobs).encode()).hexdigest()[:12]
 

@@ -1045,6 +1045,102 @@ LAST_ZERO_GROUPS: tuple = ()
 # under the default train objective
 LAST_SERVING_META = None
 
+# KV-lane provenance of the last serve-objective search: chosen pool
+# dtype + scale layout + prefix-sharing residency accounting — compile()
+# persists it as __meta__.kv behind the digest gate (fflint strategy
+# checks it stdlib-only, STR213; SHD168/169 re-lint at import); None
+# when the lane is unarmed (kv_precision="off" and no declared sharing)
+LAST_KV_META = None
+
+
+def _kv_candidate_graph(graph, dtype: str):
+    """A pricing CLONE of ``graph`` whose decode ops carry
+    ``kv_dtype=dtype`` — the caller's graph (and the frontend digest
+    the strategy export is keyed to) is never mutated; attr ADOPTION
+    happens in model.py, after the export meta is computed on the
+    export side and after the SHD168/169 re-lint passes on the import
+    side.  fp32 adds no attr (extension-only discipline), so the
+    original graph IS the fp32 candidate."""
+    if dtype == "fp32":
+        return graph
+    from flexflow_tpu.core.graph import Node
+    from flexflow_tpu.core.optype import OperatorType
+
+    g2 = graph.copy()
+    for guid, node in list(g2.nodes.items()):
+        op = node.op
+        if op.op_type != OperatorType.DECODE_ATTENTION:
+            continue
+        a = op.attrs
+        clone = type(op)(
+            op.name, op.input_shapes,
+            embed_dim=a["embed_dim"], num_heads=a["num_heads"],
+            page_size=a["page_size"], pages_per_seq=a["pages_per_seq"],
+            num_pages=a["num_pages"], use_kernel=a["use_kernel"],
+            kv_dtype=dtype, kernel_initializer=op._kernel_init,
+        )
+        g2.nodes[guid] = Node(guid, clone)
+    g2._invalidate()
+    return g2
+
+
+def _choose_kv_precision(graph, strategy, config, serving, calibration):
+    """The KV-lane decision for a finished serve-objective result:
+    price the pool-dtype candidates (fp32/bf16/int8 under
+    ``kv_precision="search"``, the single pinned dtype otherwise)
+    through the SAME p99 currency the search ranked in — each
+    candidate's decode cache stream shrinks with the dtype while the
+    quantize-overhead term (KV_QUANT_PASSES, the EQuARX discipline
+    wire precision already pays) charges the write path — and return
+    the ``__meta__.kv`` provenance block, or None when the lane is
+    unarmed.  Pricing uses fresh simulators with the persistent cost
+    cache detached (lane probes are result provenance, not the
+    search's cost surface)."""
+    lane = getattr(config, "kv_precision", "off")
+    sharing = int(getattr(serving, "shared_prefix_pages", 0) or 0) \
+        if serving is not None else 0
+    if serving is None or not strategy or (lane == "off" and not sharing):
+        return None
+    from flexflow_tpu.search.serving import kv_residency_bytes
+    from flexflow_tpu.search.simulator import Simulator
+
+    if lane == "search":
+        cands = ["fp32", "bf16", "int8"]
+    elif lane == "off":
+        cands = ["fp32"]  # sharing armed alone: pool dtype stays put
+    else:
+        cands = [lane]
+    priced = {}
+    graphs = {}
+    for dt in cands:
+        g = _kv_candidate_graph(graph, dt)
+        graphs[dt] = g
+        sim = Simulator(
+            config.machine_spec, num_devices=config.search_devices,
+            calibration=calibration, inference=True, serving=serving,
+        )
+        priced[dt] = sim.simulate(g, strategy)
+    best = min(cands, key=lambda d: priced[d])
+    meta = {
+        "dtype": best,
+        "searched": lane == "search",
+        "scale_layout": "page_slot" if best == "int8" else "none",
+        "shared_prefix_pages": sharing,
+        "shared_residency_factor": serving.shared_residency_factor(),
+        "predicted_p99_step_ms": {
+            d: round(t * 1e3, 6) for d, t in sorted(priced.items())},
+        "kv_bytes_per_device": kv_residency_bytes(
+            graphs[best], strategy, config.search_devices,
+            serving=serving),
+    }
+    BUS.emit(
+        "search.kv", dtype=best, searched=lane == "search",
+        shared_prefix_pages=sharing,
+        p99_ms={d: round(t * 1e3, 6) for d, t in sorted(priced.items())},
+        kv_bytes_per_device=meta["kv_bytes_per_device"],
+    )
+    return meta
+
 
 def _build_sync_schedule(graph, strategy, sim, config, joint=None):
     """Choose + legality-gate the gradient-sync schedule for a search
@@ -1221,7 +1317,7 @@ def optimize_strategy(
 def _optimize_strategy(
     graph: Graph, config: FFConfig, return_graph: bool = False
 ) -> "Strategy | Tuple[Graph, Strategy]":
-    global LAST_SERVING_META
+    global LAST_SERVING_META, LAST_KV_META
     from flexflow_tpu.utils.logging import SEARCH_LOG as log
 
     t_start = time.monotonic()
@@ -1454,6 +1550,34 @@ def _optimize_strategy(
                 )
                 cache.drop_search_result(graph, config)
                 served = None
+        _served_kv_meta = None
+        if served is not None and serving is not None:
+            # KV lane (kv_precision / shared-prefix residency): served
+            # results pass the SAME always-on SHD168/169 gate as fresh
+            # ones before the provenance block is recorded — a served
+            # entry that cannot carry a legal __meta__.kv costs one
+            # re-search, never an illegal artifact
+            _served_kv_meta = _choose_kv_precision(
+                best_graph, best_strategy, config, serving, calibration)
+            if _served_kv_meta is not None:
+                from flexflow_tpu.analysis import (
+                    emit_findings,
+                    errors_only,
+                    lint_kv,
+                )
+
+                kfind = lint_kv(best_graph, best_strategy,
+                                _served_kv_meta, serving=serving)
+                emit_findings(kfind)
+                kbad = errors_only(kfind)
+                if kbad:
+                    log.log(
+                        f"cost cache: served search result FAILED the "
+                        f"KV-lane gate ({kbad[0]}); dropping the entry "
+                        f"and searching fresh"
+                    )
+                    cache.drop_search_result(graph, config)
+                    served = None
         if served is not None:
             log.log(
                 f"cost cache: served searched strategy "
@@ -1461,6 +1585,7 @@ def _optimize_strategy(
                 f"node graph — skipping the search"
             )
             LAST_SERVING_META = None
+            LAST_KV_META = _served_kv_meta
             if serving is not None:
                 from flexflow_tpu.search.serving import kv_residency_bytes
 
@@ -1473,7 +1598,7 @@ def _optimize_strategy(
                     "quantile": serving.quantile,
                     "predicted_p99_step_ms": round(best_cost * 1e3, 6),
                     "kv_bytes_per_device": kv_residency_bytes(
-                        best_graph, best_strategy, n),
+                        best_graph, best_strategy, n, serving=serving),
                 }
             _emit_search_done(
                 floor_sim, best_graph, graph, best_strategy, best_cost,
@@ -1624,11 +1749,13 @@ def _optimize_strategy(
     # views the executor's fixed frames can shard (SHD160-162; SHD163
     # warns on a blown SLO) — before it is returned or persisted.
     LAST_SERVING_META = None
+    LAST_KV_META = None
     if serving is not None and best_strategy and math.isfinite(best_cost):
         from flexflow_tpu.analysis import (
             AnalysisError,
             emit_findings,
             errors_only,
+            lint_kv,
             lint_serving,
         )
         from flexflow_tpu.search.serving import kv_residency_bytes
@@ -1641,7 +1768,8 @@ def _optimize_strategy(
             raise AnalysisError(
                 "serve-objective search produced an illegal serving "
                 "artifact", sbad)
-        kv = kv_residency_bytes(best_graph, best_strategy, n)
+        kv = kv_residency_bytes(best_graph, best_strategy, n,
+                                serving=serving)
         LAST_SERVING_META = {
             "objective": "serve",
             "p99_budget_ms": serving.p99_budget_ms,
@@ -1655,6 +1783,21 @@ def _optimize_strategy(
         BUS.emit("search.serve", p99_s=best_cost,
                  budget_ms=serving.p99_budget_ms,
                  kv_bytes_per_device=kv, kept_dp=kept_dp)
+        # KV lane (kv_precision / shared-prefix residency): choose the
+        # pool dtype in the same p99 currency and gate the provenance
+        # block on SHD168/169 — always-on, like the serving gate above
+        LAST_KV_META = _choose_kv_precision(
+            best_graph, best_strategy, config, serving, calibration)
+        if LAST_KV_META is not None:
+            kfind = lint_kv(best_graph, best_strategy, LAST_KV_META,
+                            serving=serving)
+            emit_findings(kfind)
+            kbad = errors_only(kfind)
+            if kbad:
+                LAST_KV_META = None
+                raise AnalysisError(
+                    "KV-precision lane produced an illegal __meta__.kv "
+                    "artifact", kbad)
 
     # persist: cost rows accumulated this search + the finished result
     # (only complete searches — a deadline-truncated result is not the

@@ -998,6 +998,13 @@ class CostModel:
             # that cannot hold the pool are rejected inside the search's
             # memory check, not at runtime OOM.  Full occupancy, not the
             # arrival model's ragged load — HBM must fit the worst frame
-            # the executor is allowed to admit.
-            mem += kv(mv)
+            # the executor is allowed to admit.  Prefix sharing shrinks
+            # that worst frame (shared pages are resident once across
+            # the pool, ServingSpec.shared_residency_factor) — thread
+            # the armed spec into hooks that accept it; legacy hooks
+            # without the keyword price unshared.
+            try:
+                mem += kv(mv, serving=self.serving)
+            except TypeError:
+                mem += kv(mv)
         return mem

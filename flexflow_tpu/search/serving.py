@@ -80,6 +80,17 @@ class ServingSpec:
     # byte-identical to history while occupancy-priced rows can never
     # cross-serve full-frame ones.
     occupancy_slots: int = 0
+    # radix prefix sharing (runtime/decode.py PageAllocator): pages per
+    # sequence expected to be CLAIMED from the shared prefix trie
+    # rather than privately allocated (FFConfig.
+    # serve_shared_prefix_pages — e.g. a fleet-wide system prompt of
+    # N*page_size tokens).  Enters ``shared_residency_factor`` so
+    # RESIDENCY pricing (kv_cache_bytes, SHD161) counts the shared
+    # pages ONCE across the frame; the decode STREAM is deliberately
+    # unaffected — every sequence still reads its own prefix.  Folded
+    # into ``signature()`` ONLY when set (extension-only, like
+    # occupancy_slots).  0 = no sharing assumed.
+    shared_prefix_pages: int = 0
     _factors: Dict[int, float] = field(default_factory=dict, compare=False,
                                        repr=False, hash=False)
 
@@ -98,7 +109,24 @@ class ServingSpec:
         if self.occupancy_slots:
             # extension-only: absent ⇒ bytes identical to pre-fleet
             sig = sig + ("occ", self.occupancy_slots)
+        if self.shared_prefix_pages:
+            # extension-only: absent ⇒ bytes identical to pre-sharing
+            sig = sig + ("shared", self.shared_prefix_pages)
         return sig
+
+    def shared_residency_factor(self) -> float:
+        """SHARED/private residency ratio of the page pool: with
+        ``shared_prefix_pages`` pages per sequence claimed from one
+        trie-resident prefix, the frame holds
+        ``max_seqs * (pps - shared) + shared`` distinct pages instead
+        of ``max_seqs * pps``.  Multiplies kv_cache_bytes (residency/
+        SHD161) only — never the decode stream."""
+        s = max(0, min(self.shared_prefix_pages, self.pages_per_seq - 1))
+        if s == 0 or self.max_seqs <= 0 or self.pages_per_seq <= 0:
+            return 1.0
+        total = self.max_seqs * self.pages_per_seq
+        distinct = self.max_seqs * (self.pages_per_seq - s) + s
+        return float(distinct) / float(total)
 
     # ---- arrival model ---------------------------------------------------
     def sample_lengths(self) -> np.ndarray:
@@ -216,14 +244,19 @@ def serving_spec_for(graph, config) -> Optional[ServingSpec]:
             config, "serve_prompt_tokens_mean", 0) or 0),
         decode_tokens_mean=int(getattr(
             config, "serve_decode_tokens_mean", 0) or 0),
+        shared_prefix_pages=int(getattr(
+            config, "serve_shared_prefix_pages", 0) or 0),
     )
 
 
-def kv_residency_bytes(graph, strategy, num_devices: int) -> float:
+def kv_residency_bytes(graph, strategy, num_devices: int,
+                       serving: Optional[ServingSpec] = None) -> float:
     """Per-device resident KV bytes of ``(graph, strategy)``: the sum of
     every decode op's ``kv_cache_bytes`` under its view — the number
     SHD161 checks against the HBM capacity and the serve bench records
-    per strategy."""
+    per strategy.  ``serving`` threads the prefix-sharing residency
+    discount (``shared_residency_factor``) into ops whose hook accepts
+    it; a legacy hook without the keyword is priced unshared."""
     from flexflow_tpu.core.machine import MachineView
 
     total = 0.0
@@ -232,7 +265,10 @@ def kv_residency_bytes(graph, strategy, num_devices: int) -> float:
         if mv is None:
             mv = node.op.fixed_machine_view() or MachineView.trivial(
                 node.op.output_shapes[0].ndim)
-        total += node.op.kv_cache_bytes(mv)
+        try:
+            total += node.op.kv_cache_bytes(mv, serving=serving)
+        except TypeError:
+            total += node.op.kv_cache_bytes(mv)
     return total
 
 

@@ -260,8 +260,14 @@ def test_searched_never_loses_to_dp(name):
         f"{name}: predicted win {r['sim_ratio']:.3f} is inside the "
         f"uncertainty margin yet the search returned a non-DP strategy"
     )
-    # 3. direction: a big predicted win must be a real win
+    # 3. direction: a big predicted win must be a real win — with the
+    # same one-shot interleaved re-timing as the never-lose bound
+    # (_remeasure NOTE): a first pass measured on the contended host
+    # can report the searched program a few % slow even when the win is
+    # real, and this was the only timing assert without the retry
     if r["sim_ratio"] >= BIG_WIN:
+        if r["exec_ratio"] <= 1.0:
+            r = _remeasure(name)
         assert r["exec_ratio"] > 1.0, (
             f"{name}: sim predicted {r['sim_ratio']:.2f}x but execution "
             f"measured {r['exec_ratio']:.3f} — direction violated; {r}"

@@ -47,11 +47,25 @@ _LOWER = ("_ms", "_s", "seconds", "p99", "p95", "p50", "ttft", "tpot",
 _HIGHER = ("throughput", "samples_per", "mfu", "win", "speedup",
            "tokens_per", "hit_rate", "vs_baseline", "value")
 
+# KV-lane metrics (the --kv sweep, PR 18) need EXPLICIT leaf names
+# checked before the substring scan: "kv_shared_bytes" would otherwise
+# hit the "_s" latency pattern ("_shared") and judge MORE sharing as a
+# regression, and "kv_pool_bytes" matches nothing.  Shared bytes /
+# concurrency up = better; pool residency / CoW copies down = better.
+_KV_UP = ("kv_shared_bytes", "max_concurrent", "prefix_hits",
+          "shared_pages")
+_KV_DOWN = ("kv_pool_bytes", "kv_bytes_per_device", "cow_copies",
+            "private_pages")
+
 
 def direction(path: str) -> Optional[str]:
     """'down' = lower is better, 'up' = higher is better, None =
     informational (counts, ids, flags-as-ints)."""
     leaf = path.rsplit(".", 1)[-1].lower()
+    if leaf in _KV_UP:
+        return "up"
+    if leaf in _KV_DOWN:
+        return "down"
     for pat in _LOWER:
         if pat in leaf:
             return "down"

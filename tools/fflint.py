@@ -183,6 +183,8 @@ def lint_strategy_file(path: str,
         out += _lint_disagg_meta(meta["disaggregation"], meta)
     if isinstance(meta, dict) and "fleet" in meta:
         out += _lint_fleet_meta(meta["fleet"], meta)
+    if isinstance(meta, dict) and "kv" in meta:
+        out += _lint_kv_meta(meta["kv"], meta)
     if isinstance(meta, dict):
         out += _lint_calibration_signature(meta, path, calibration_path)
     views = {k: v for k, v in data.items() if k != META_KEY}
@@ -256,6 +258,114 @@ def _lint_serving_meta(sv) -> List[Tuple[str, str, str]]:
             or not math.isfinite(float(kv)) or float(kv) < 0.0):
         out.append(("error", "STR209",
                     f"serving meta kv_bytes_per_device {kv!r} is not a "
+                    f"non-negative finite number"))
+    return out
+
+
+_KV_DTYPES = ("fp32", "bf16", "int8")
+
+
+def _lint_kv_meta(kv, meta) -> List[Tuple[str, str, str]]:
+    """STR213: structural lint of a persisted ``__meta__.kv`` block
+    (the searched KV-precision + prefix-sharing provenance,
+    search/driver.py ``_choose_kv_precision``).  Graph-side legality
+    (dtype agreement with the decode ops' own attrs, refcount-factor
+    coherence with the armed ServingSpec — SHD168/169) needs the graph
+    and runs at import/compile time; this proves what the file alone
+    can: a known pool dtype, the scale-layout discipline (int8 carries
+    per-(page, slot) scales, fp32/bf16 carry none), sharing accounting
+    coherent with itself and with the sibling ``__meta__.serving``
+    frame, and finite per-dtype prices."""
+    if not isinstance(kv, dict):
+        return [("error", "STR213", "kv meta is not an object")]
+    out: List[Tuple[str, str, str]] = []
+    dt = kv.get("dtype")
+    if dt not in _KV_DTYPES:
+        out.append(("error", "STR213",
+                    f"kv meta pool dtype {dt!r} is not one of "
+                    f"{'/'.join(_KV_DTYPES)}"))
+    layout = kv.get("scale_layout", "none")
+    if dt == "int8" and layout != "page_slot":
+        out.append(("error", "STR213",
+                    f"int8 pool requires scale_layout 'page_slot', got "
+                    f"{layout!r}"))
+    if dt in ("fp32", "bf16") and layout not in ("none", None):
+        out.append(("error", "STR213",
+                    f"{dt} pool must not carry scales "
+                    f"(scale_layout={layout!r})"))
+    if not isinstance(kv.get("searched", False), bool):
+        out.append(("error", "STR213",
+                    f"kv meta searched flag is not a bool: "
+                    f"{kv.get('searched')!r}"))
+    shared = kv.get("shared_prefix_pages", 0)
+    if not isinstance(shared, int) or isinstance(shared, bool) \
+            or shared < 0:
+        out.append(("error", "STR213",
+                    f"kv meta shared_prefix_pages is not a "
+                    f"non-negative int: {shared!r}"))
+        shared = 0
+    sv = meta.get("serving") if isinstance(meta, dict) else None
+    pps = sv.get("pages_per_seq") if isinstance(sv, dict) else None
+    mseq = sv.get("max_seqs") if isinstance(sv, dict) else None
+    if isinstance(pps, int) and not isinstance(pps, bool) \
+            and shared >= pps > 0:
+        out.append(("error", "STR213",
+                    f"kv meta shared_prefix_pages={shared} >= the "
+                    f"sibling __meta__.serving pages_per_seq={pps} — a "
+                    f"sequence cannot share its whole allotment (the "
+                    f"last token's scatter needs a private page)"))
+    factor = kv.get("shared_residency_factor", 1.0)
+    if not isinstance(factor, (int, float)) or isinstance(factor, bool) \
+            or not math.isfinite(float(factor)) \
+            or not (0.0 < float(factor) <= 1.0):
+        out.append(("error", "STR213",
+                    f"kv meta shared_residency_factor {factor!r} "
+                    f"outside (0, 1]"))
+    elif shared == 0 and float(factor) != 1.0:
+        out.append(("error", "STR213",
+                    f"kv meta claims a residency discount "
+                    f"(factor={factor!r}) with shared_prefix_pages=0 — "
+                    f"sharing that prices but never happens is an OOM "
+                    f"deferred"))
+    elif (shared > 0 and isinstance(pps, int) and isinstance(mseq, int)
+          and not isinstance(pps, bool) and not isinstance(mseq, bool)
+          and mseq > 0 and pps > shared):
+        expect = (mseq * (pps - shared) + shared) / float(mseq * pps)
+        if abs(float(factor) - expect) > 1e-9:
+            out.append(("error", "STR213",
+                        f"kv meta shared_residency_factor {factor!r} "
+                        f"does not match the refcount arithmetic for "
+                        f"shared_prefix_pages={shared} over the sibling "
+                        f"serving frame ({mseq}x{pps} pages): expected "
+                        f"{expect:.9f}"))
+    p99 = kv.get("predicted_p99_step_ms")
+    if p99 is not None:
+        if not isinstance(p99, dict):
+            out.append(("error", "STR213",
+                        f"kv meta predicted_p99_step_ms is not an "
+                        f"object: {p99!r}"))
+        else:
+            for k, v in sorted(p99.items()):
+                if k not in _KV_DTYPES:
+                    out.append(("error", "STR213",
+                                f"kv meta predicted_p99_step_ms keys an "
+                                f"unknown dtype {k!r}"))
+                if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                        or not math.isfinite(float(v)) or float(v) <= 0.0:
+                    out.append(("error", "STR213",
+                                f"kv meta predicted_p99_step_ms[{k!r}] "
+                                f"{v!r} is not a positive finite number"))
+            if dt in _KV_DTYPES and p99 and dt not in p99:
+                out.append(("error", "STR213",
+                            f"kv meta chose dtype {dt!r} but the priced "
+                            f"map never priced it: "
+                            f"{sorted(p99.keys())}"))
+    b = kv.get("kv_bytes_per_device")
+    if b is not None and (
+            not isinstance(b, (int, float)) or isinstance(b, bool)
+            or not math.isfinite(float(b)) or float(b) < 0.0):
+        out.append(("error", "STR213",
+                    f"kv meta kv_bytes_per_device {b!r} is not a "
                     f"non-negative finite number"))
     return out
 

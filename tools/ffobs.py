@@ -471,6 +471,18 @@ def render_report(events: List[dict], top: int = 10,
             + f", KV residency {kv / 1e6:.1f} MB/device"
             + (" — champion-vs-DP floor kept plain DP"
                if s.get("kept_dp") else ""))
+    kvs = [e for e in events if e.get("kind") == "search.kv"]
+    if kvs:
+        k = kvs[-1]
+        p99 = k.get("p99_ms") or {}
+        priced = ", ".join(f"{d} {v} ms" for d, v in sorted(p99.items()))
+        lines.append(
+            f"KV lane: pool dtype {k.get('dtype')!r} "
+            + ("searched" if k.get("searched") else "pinned")
+            + (f" (priced: {priced})" if priced else "")
+            + (f"; {k.get('shared_prefix_pages')} shared prefix "
+               f"page(s)/seq priced into residency"
+               if k.get("shared_prefix_pages") else ""))
     disaggs = [e for e in events if e.get("kind") == "search.disagg"]
     if disaggs:
         d = disaggs[-1]
@@ -613,6 +625,25 @@ def render_report(events: List[dict], top: int = 10,
                     f"prompt tokens in {s.get('prefill_chunks')} "
                     f"chunk pass(es) — vs one decode frame per token "
                     f"without the lane")
+            if "prefix_hits" in s:
+                # radix prefix sharing roll-up (PageAllocator trie):
+                # claimed vs privately-allocated pages and the CoW
+                # copies the reserve-on-divergence path paid
+                total_pg = ((s.get("shared_pages") or 0)
+                            + (s.get("private_pages") or 0))
+                rate = (100.0 * (s.get("prefix_hits") or 0)
+                        / max(1, s.get("admitted") or 0))
+                lines.append(
+                    f"Prefix sharing: {s.get('prefix_hits')} of "
+                    f"{s.get('admitted')} admission(s) hit the trie "
+                    f"({rate:.0f}%), {s.get('shared_pages')} page(s) "
+                    f"claimed shared vs {s.get('private_pages')} "
+                    f"private"
+                    + (f" ({100.0 * (s.get('shared_pages') or 0) / total_pg:.0f}% of the pool walk)"
+                       if total_pg else "")
+                    + f", {s.get('prefix_tokens')} prompt token(s) "
+                      f"skipped, {s.get('cow_copies')} copy-on-write "
+                      f"page cop(ies)")
             if s.get("expired") or s.get("preempted"):
                 lines.append(
                     f"SLO scheduling: {s.get('expired', 0)} request(s) "
